@@ -1,0 +1,96 @@
+"""horovod_tpu: a TPU-native distributed training framework with the
+capabilities of Horovod.
+
+Data plane: XLA collectives (psum / all_gather / all_to_all /
+psum_scatter) over a ``jax.sharding.Mesh`` riding ICI/DCN.
+Control plane: a native C++ coordination core (coordinator/worker tensor
+negotiation, response cache, tensor fusion, stall detection) over a TCP
+full mesh bootstrapped by an HTTP rendezvous — the role MPI/Gloo play in
+the reference (see SURVEY.md for the reference layer map).
+
+Top-level usage mirrors Horovod::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    ...
+    avg = hvd.allreduce(grad, name="g")        # eager, handle-based under the hood
+    # or, inside a pjit/shard_map training step (the TPU fast path):
+    g = hvd.allreduce_ingraph(g, op=hvd.Average, axis="data")
+"""
+
+__version__ = "0.1.0"
+
+from horovod_tpu.common import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    ProcessSet,
+    add_process_set,
+    cross_rank,
+    cross_size,
+    get_process_set_ids,
+    global_process_set,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    remove_process_set,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+)
+from horovod_tpu.common.basics import (  # noqa: F401
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+    tpu_built,
+)
+from horovod_tpu.ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allgather_async,
+    allgather_ingraph,
+    allreduce,
+    allreduce_async,
+    allreduce_ingraph,
+    alltoall,
+    alltoall_async,
+    alltoall_ingraph,
+    barrier,
+    broadcast,
+    broadcast_async,
+    broadcast_ingraph,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    grouped_allreduce_ingraph,
+    join,
+    poll,
+    reducescatter,
+    reducescatter_async,
+    reducescatter_ingraph,
+    synchronize,
+)
+from horovod_tpu.parallel import (  # noqa: F401
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    global_mesh,
+    make_mesh,
+    set_global_mesh,
+)

@@ -1,0 +1,25 @@
+from horovod_tpu.common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+)
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    get_process_set_ids,
+    global_process_set,
+    remove_process_set,
+)

@@ -1,0 +1,255 @@
+"""Process topology and lifecycle for horovod_tpu.
+
+This is the TPU-native analog of the reference's ``horovod/common/basics.py``
+(ctypes wrapper over the C core, reference: horovod/common/basics.py:29-487).
+Here the Python side owns topology bookkeeping; the native core
+(``horovod_tpu.core``) is attached when world size > 1 to run the
+coordinator/worker negotiation protocol and the CPU control-plane
+collectives. The TPU data plane is XLA collectives over a
+``jax.sharding.Mesh`` — see ``horovod_tpu.ops``.
+
+Environment contract (set by the ``hvdrun`` launcher, mirroring the
+reference's Gloo env contract, reference: horovod/runner/gloo_run.py:65-76):
+
+- ``HOROVOD_RANK`` / ``HOROVOD_SIZE``: global rank / world size.
+- ``HOROVOD_LOCAL_RANK`` / ``HOROVOD_LOCAL_SIZE``: rank / size on this host.
+- ``HOROVOD_CROSS_RANK`` / ``HOROVOD_CROSS_SIZE``: rank / size across hosts
+  (index of this host among hosts owning this local_rank).
+- ``HOROVOD_RENDEZVOUS_ADDR`` / ``HOROVOD_RENDEZVOUS_PORT``: HTTP KV store
+  run by the launcher, used by the native core for bootstrap.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+
+@dataclass
+class Topology:
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+
+
+@dataclass
+class _Context:
+    """Per-process singleton (analog of HorovodGlobalState,
+    reference: horovod/common/global_state.h:39-126)."""
+
+    initialized: bool = False
+    topology: Topology = field(default_factory=Topology)
+    # Native core handle (horovod_tpu.core.CoreSession) when size > 1.
+    core: Optional[object] = None
+    # Timeline state (horovod_tpu.utils.timeline.Timeline), lazily created.
+    timeline: Optional[object] = None
+    lock: threading.RLock = field(default_factory=threading.RLock)
+
+
+_ctx = _Context()
+
+
+def _int_env(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def _topology_from_env() -> Topology:
+    size = _int_env("HOROVOD_SIZE", 1)
+    return Topology(
+        rank=_int_env("HOROVOD_RANK", 0),
+        size=size,
+        local_rank=_int_env("HOROVOD_LOCAL_RANK", 0),
+        local_size=_int_env("HOROVOD_LOCAL_SIZE", 1 if size == 1 else size),
+        cross_rank=_int_env("HOROVOD_CROSS_RANK", 0),
+        cross_size=_int_env("HOROVOD_CROSS_SIZE", 1),
+    )
+
+
+def init(process_sets=None):
+    """Initialize horovod_tpu.
+
+    Reads the launcher environment, and when world size > 1 starts the
+    native coordination core (background cycle thread + TCP control plane;
+    analog of InitializeHorovodOnce, reference:
+    horovod/common/operations.cc:791-843).
+
+    Args:
+        process_sets: optional list of ``ProcessSet`` objects to register at
+            init time (analog of the reference's ``process_sets`` argument).
+    """
+    with _ctx.lock:
+        if _ctx.initialized:
+            return
+        _ctx.topology = _topology_from_env()
+        if _ctx.topology.size > 1:
+            from horovod_tpu.core import CoreSession
+
+            _ctx.core = CoreSession.start(_ctx.topology)
+        _ctx.initialized = True
+        if process_sets:
+            from horovod_tpu.common import process_sets as ps_mod
+
+            for ps in process_sets:
+                ps_mod.add_process_set(ps)
+        atexit.register(shutdown)
+
+
+def shutdown():
+    """Shut down background machinery (idempotent)."""
+    with _ctx.lock:
+        if not _ctx.initialized:
+            return
+        if _ctx.core is not None:
+            try:
+                _ctx.core.shutdown()
+            finally:
+                _ctx.core = None
+        if _ctx.timeline is not None:
+            try:
+                _ctx.timeline.close()
+            finally:
+                _ctx.timeline = None
+        _ctx.initialized = False
+
+
+def is_initialized() -> bool:
+    return _ctx.initialized
+
+
+def _check_initialized():
+    if not _ctx.initialized:
+        raise HorovodInternalError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init()."
+        )
+
+
+def rank() -> int:
+    _check_initialized()
+    return _ctx.topology.rank
+
+
+def size() -> int:
+    _check_initialized()
+    return _ctx.topology.size
+
+
+def local_rank() -> int:
+    _check_initialized()
+    return _ctx.topology.local_rank
+
+
+def local_size() -> int:
+    _check_initialized()
+    return _ctx.topology.local_size
+
+
+def cross_rank() -> int:
+    _check_initialized()
+    return _ctx.topology.cross_rank
+
+
+def cross_size() -> int:
+    _check_initialized()
+    return _ctx.topology.cross_size
+
+
+def is_homogeneous() -> bool:
+    """True when every host runs the same number of processes."""
+    _check_initialized()
+    t = _ctx.topology
+    return t.size == t.local_size * t.cross_size
+
+
+# --- build/capability queries (reference: horovod/common/basics.py:250-330) ---
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    # The native TCP control plane fills the role Gloo plays in the reference.
+    return _ctx.core is not None
+
+
+def gloo_built() -> bool:
+    from horovod_tpu.core import core_built
+
+    return core_built()
+
+
+def nccl_built() -> int:
+    return 0
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def tpu_built() -> bool:
+    """True when JAX reports at least one TPU device (or any XLA backend —
+    the data plane is XLA collectives regardless of platform)."""
+    return True
+
+
+def core_session():
+    """The native CoreSession, or None in single-process mode."""
+    return _ctx.core
+
+
+def _timeline():
+    return _ctx.timeline
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False):
+    """Begin writing a Chrome-tracing timeline (analog of
+    horovod_start_timeline, reference: horovod/common/operations.cc:1011-1041)."""
+    _check_initialized()
+    from horovod_tpu.utils.timeline import Timeline
+
+    with _ctx.lock:
+        if _ctx.timeline is not None:
+            _ctx.timeline.close()
+        _ctx.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+        if _ctx.core is not None:
+            _ctx.core.attach_timeline(_ctx.timeline)
+
+
+def stop_timeline():
+    _check_initialized()
+    with _ctx.lock:
+        if _ctx.timeline is not None:
+            _ctx.timeline.close()
+            _ctx.timeline = None
+        if _ctx.core is not None:
+            _ctx.core.attach_timeline(None)

@@ -1,0 +1,40 @@
+"""Exception types for horovod_tpu.
+
+Capability parity with the reference's ``horovod/common/exceptions.py``
+(reference: horovod/common/exceptions.py:1-49): a framework-internal error
+that elastic training catches to trigger restore+reinit, and the interrupt
+raised when the elastic driver reports a host-set change.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective routine fails.
+
+    Elastic mode treats this as recoverable: state is restored from the last
+    commit and the communication layer is re-initialized.
+    """
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised asynchronously (at commit/step boundaries) when the elastic
+    driver discovers that the set of available hosts has changed.
+
+    ``skip_sync`` indicates whether the restart can skip state
+    re-synchronization (pure host addition with no failures).
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class HorovodTimeoutError(RuntimeError):
+    """A negotiation or rendezvous step exceeded its deadline."""
+
+
+class TensorShapeMismatchError(ValueError):
+    """Ranks submitted inconsistent shapes for the same named tensor."""
+
+
+class TensorDtypeMismatchError(ValueError):
+    """Ranks submitted inconsistent dtypes for the same named tensor."""

@@ -1,0 +1,154 @@
+"""Process sets: concurrent collectives on subsets of ranks.
+
+Analog of the reference's ``horovod/common/process_sets.py:18-156`` and the
+native ``ProcessSetTable`` (reference: horovod/common/process_set.h:26-168).
+
+On TPU a process set maps to (a) a rank subset for the control-plane
+negotiation in the native core, and (b) a sub-mesh / collective sub-group on
+the device side (``jax.lax`` collectives accept axis subsets via
+``axis_index_groups``; see ``horovod_tpu.ops``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from horovod_tpu.common import basics
+
+
+class ProcessSet:
+    """A subset of ranks that can run collectives concurrently with (and
+    independently of) the global set.
+
+    ``ProcessSet(ranks)`` with an explicit rank list. The global set is
+    ``global_process_set`` with id 0.
+    """
+
+    process_set_id: Optional[int]
+
+    def __init__(self, ranks: Sequence[int]):
+        self.ranks = sorted(int(r) for r in ranks)
+        if len(set(self.ranks)) != len(self.ranks):
+            raise ValueError("Process set ranks must be unique: %r" % (ranks,))
+        self.process_set_id = None
+
+    def included(self) -> bool:
+        """Whether the current rank belongs to this process set."""
+        if self.process_set_id is None:
+            raise RuntimeError("Process set has not been registered yet.")
+        return basics.rank() in self.ranks
+
+    def rank(self) -> int:
+        """Rank of this process within the set (error if not included)."""
+        if not self.included():
+            raise RuntimeError(
+                "Rank %d is not part of process set %r" % (basics.rank(), self.ranks)
+            )
+        return self.ranks.index(basics.rank())
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def __repr__(self):
+        return "ProcessSet(id=%s, ranks=%r)" % (self.process_set_id, self.ranks)
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessSet) and self.ranks == other.ranks
+
+    def __hash__(self):
+        return hash(tuple(self.ranks))
+
+
+class _GlobalProcessSet(ProcessSet):
+    def __init__(self):
+        # Ranks are resolved lazily once topology is known.
+        self.process_set_id = 0
+
+    @property
+    def ranks(self) -> List[int]:  # type: ignore[override]
+        if basics.is_initialized():
+            return list(range(basics.size()))
+        return [0]
+
+    def included(self) -> bool:
+        return True
+
+    def rank(self) -> int:
+        return basics.rank()
+
+    def size(self) -> int:
+        return basics.size()
+
+
+global_process_set = _GlobalProcessSet()
+
+_lock = threading.Lock()
+_registry: Dict[int, ProcessSet] = {0: global_process_set}
+_next_id = 1
+
+
+def add_process_set(process_set) -> ProcessSet:
+    """Register a new process set after init (dynamic registration; analog of
+    reference horovod/common/process_sets.py:99-156).
+
+    Accepts a ``ProcessSet`` or a plain rank list.
+    """
+    basics._check_initialized()
+    global _next_id
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    if process_set.ranks and process_set.ranks[-1] >= basics.size():
+        raise ValueError(
+            "Process set %r contains ranks outside world size %d"
+            % (process_set.ranks, basics.size())
+        )
+    with _lock:
+        for existing in _registry.values():
+            if list(existing.ranks) == process_set.ranks:
+                raise ValueError(
+                    "A process set with ranks %r already exists" % (process_set.ranks,)
+                )
+        ps_id = _next_id
+        _next_id += 1
+        process_set.process_set_id = ps_id
+        _registry[ps_id] = process_set
+    core = basics.core_session()
+    if core is not None:
+        core.add_process_set(ps_id, process_set.ranks)
+    return process_set
+
+
+def remove_process_set(process_set: ProcessSet) -> bool:
+    """Deregister a process set. The global set cannot be removed."""
+    basics._check_initialized()
+    ps_id = process_set.process_set_id
+    if ps_id is None or ps_id == 0:
+        return False
+    with _lock:
+        if ps_id not in _registry:
+            return False
+        del _registry[ps_id]
+    core = basics.core_session()
+    if core is not None:
+        core.remove_process_set(ps_id)
+    process_set.process_set_id = None
+    return True
+
+
+def get_process_set_ids() -> List[int]:
+    with _lock:
+        return sorted(_registry.keys())
+
+
+def get_process_set(ps_id: int) -> ProcessSet:
+    with _lock:
+        return _registry[ps_id]
+
+
+def _reset_for_tests():
+    global _next_id
+    with _lock:
+        _registry.clear()
+        _registry[0] = global_process_set
+        _next_id = 1

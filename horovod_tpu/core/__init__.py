@@ -1,0 +1,27 @@
+"""Native coordination core (C++): background cycle thread, coordinator/
+worker tensor negotiation, response cache, tensor fusion, TCP control-plane
+collectives, HTTP rendezvous client.
+
+The shared library is built on demand from ``horovod_tpu/core/src`` by
+``horovod_tpu.core.build``; the ctypes session wrapper lives in
+``horovod_tpu.core.session``.
+"""
+
+from __future__ import annotations
+
+
+def core_built() -> bool:
+    try:
+        from horovod_tpu.core.build import library_path
+
+        return library_path(build_if_missing=False) is not None
+    except ImportError:
+        return False
+
+
+def __getattr__(name):
+    if name == "CoreSession":
+        from horovod_tpu.core.session import CoreSession
+
+        return CoreSession
+    raise AttributeError(name)

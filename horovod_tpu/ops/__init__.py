@@ -1,0 +1,35 @@
+"""Collective operations: in-graph (XLA, the TPU fast path) and eager
+(process-level, handle-based) variants."""
+
+from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather as allgather_ingraph,
+    allreduce as allreduce_ingraph,
+    alltoall as alltoall_ingraph,
+    broadcast as broadcast_ingraph,
+    grouped_allreduce as grouped_allreduce_ingraph,
+    reducescatter as reducescatter_ingraph,
+)
+from horovod_tpu.ops.eager import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    join,
+    poll,
+    reducescatter,
+    reducescatter_async,
+    synchronize,
+)

@@ -1,0 +1,300 @@
+"""Eager (op-by-op) process-level collectives with async handles.
+
+This reproduces the reference's enqueue-side contract: named tensors
+submitted asynchronously from framework code, negotiated across processes
+by the background controller, executed in coordinator-decided order, with
+handle-based completion (reference: horovod/torch/mpi_ops_v2.cc:89-127
+DoAllreduce → EnqueueTensorAllreduce, handle table
+horovod/torch/handle_manager.cc; Python surface
+horovod/torch/mpi_ops.py:98-266,865-886).
+
+Dispatch:
+- world size 1 → ``LocalBackend`` (pure semantics, no communication);
+- world size > 1 → ``NativeBackend`` over the native core's coordination
+  protocol + CPU TCP data plane, with device arrays staged through host
+  memory (the cross-process leg of hierarchical allreduce; pure-ICI
+  reductions belong to the in-graph path in
+  ``horovod_tpu.ops.collective_ops``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+from horovod_tpu.ops.collective_ops import (
+    Adasum, Average, Max, Min, Product, Sum,
+)
+
+_handle_lock = threading.Lock()
+_handles: Dict[int, Future] = {}
+_next_handle = itertools.count(1)
+_name_counters = {}
+
+
+def _auto_name(kind: str) -> str:
+    # Matches the reference's 'allreduce.noname.<n>' naming scheme
+    # (horovod/torch/mpi_ops.py handle naming).
+    with _handle_lock:
+        n = _name_counters.get(kind, 0)
+        _name_counters[kind] = n + 1
+    return "%s.noname.%d" % (kind, n + 1)
+
+
+def _register(future: Future) -> int:
+    with _handle_lock:
+        h = next(_next_handle)
+        _handles[h] = future
+    return h
+
+
+def poll(handle: int) -> bool:
+    """True when the operation behind ``handle`` has completed
+    (analog of PollHandle, reference: horovod/torch/mpi_ops_v2.cc:566-569)."""
+    with _handle_lock:
+        fut = _handles.get(handle)
+    if fut is None:
+        raise ValueError("Unknown handle %r" % (handle,))
+    return fut.done()
+
+
+def synchronize(handle: int):
+    """Block until completion and return the result
+    (analog of WaitAndClear, reference: horovod/torch/mpi_ops_v2.cc:570-575)."""
+    with _handle_lock:
+        fut = _handles.get(handle)
+    if fut is None:
+        raise ValueError("Unknown handle %r" % (handle,))
+    try:
+        result = fut.result()
+    except Exception as e:
+        raise HorovodInternalError(str(e)) from e
+    finally:
+        with _handle_lock:
+            _handles.pop(handle, None)
+    return result
+
+
+def _backend():
+    core = basics.core_session()
+    if core is not None:
+        return core.backend
+    return _LOCAL
+
+
+def _to_numpy(x) -> np.ndarray:
+    if isinstance(x, np.ndarray):
+        return x
+    # jax arrays and anything implementing __array__ (torch handled in binding)
+    return np.asarray(x)
+
+
+def _like_input(result: np.ndarray, template):
+    if isinstance(template, np.ndarray):
+        return result
+    try:
+        import jax.numpy as jnp
+
+        if hasattr(template, "devices") or type(template).__module__.startswith("jax"):
+            return jnp.asarray(result)
+    except ImportError:
+        pass
+    return result
+
+
+class LocalBackend:
+    """World-size-1 backend: applies op semantics without communication."""
+
+    def allreduce_async(self, arrays, names, op, prescale, postscale,
+                        process_set) -> Future:
+        fut = Future()
+        outs = []
+        for a in arrays:
+            x = _to_numpy(a)
+            scaled = x * prescale if prescale != 1.0 else x
+            # n == 1: Average == Sum == Min == Max == Product == identity.
+            out = scaled * postscale if postscale != 1.0 else scaled
+            outs.append(np.asarray(out, dtype=x.dtype))
+        fut.set_result(outs)
+        return fut
+
+    def allgather_async(self, arrays, names, process_set) -> Future:
+        fut = Future()
+        fut.set_result([_to_numpy(a) for a in arrays])
+        return fut
+
+    def broadcast_async(self, arrays, names, root_rank, process_set) -> Future:
+        if root_rank != 0:
+            fut = Future()
+            fut.set_exception(
+                ValueError("root_rank %d out of range for size 1" % root_rank))
+            return fut
+        fut = Future()
+        fut.set_result([_to_numpy(a) for a in arrays])
+        return fut
+
+    def alltoall_async(self, array, splits, process_set) -> Future:
+        fut = Future()
+        a = _to_numpy(array)
+        if splits is not None and int(np.sum(splits)) != a.shape[0]:
+            fut.set_exception(ValueError("splits must sum to dim-0 size"))
+        else:
+            fut.set_result((a, np.asarray([a.shape[0]], dtype=np.int32)))
+        return fut
+
+    def reducescatter_async(self, arrays, names, op, process_set) -> Future:
+        fut = Future()
+        fut.set_result([_to_numpy(a) for a in arrays])
+        return fut
+
+    def barrier(self, process_set):
+        return None
+
+    def join(self) -> int:
+        return 0
+
+
+_LOCAL = LocalBackend()
+
+
+def _effective_op(op: Optional[int], average: Optional[bool]) -> int:
+    # Back-compat shim mirroring the reference's average= deprecation
+    # (horovod/torch/mpi_ops.py:203-232).
+    if op is not None and average is not None:
+        raise ValueError("Specify either op or average, not both")
+    if op is None:
+        if average is None or average:
+            return Average
+        return Sum
+    return op
+
+
+# --- public eager API -------------------------------------------------------
+
+def allreduce_async(tensor, *, name: Optional[str] = None, op: Optional[int] = None,
+                    average: Optional[bool] = None,
+                    prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                    process_set: ProcessSet = global_process_set) -> int:
+    basics._check_initialized()
+    op = _effective_op(op, average)
+    name = name or _auto_name("allreduce")
+    fut = _backend().allreduce_async([tensor], [name], op, prescale_factor,
+                                     postscale_factor, process_set)
+    out = Future()
+    _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    return _register(out)
+
+
+def allreduce(tensor, **kwargs):
+    return synchronize(allreduce_async(tensor, **kwargs))
+
+
+def grouped_allreduce_async(tensors: Sequence, *, name: Optional[str] = None,
+                            op: Optional[int] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set: ProcessSet = global_process_set) -> int:
+    basics._check_initialized()
+    op = _effective_op(op, None)
+    base = name or _auto_name("grouped_allreduce")
+    names = ["%s.%d" % (base, i) for i in range(len(tensors))]
+    fut = _backend().allreduce_async(list(tensors), names, op, prescale_factor,
+                                     postscale_factor, process_set)
+    out = Future()
+    _chain(fut, out,
+           lambda rs: [_like_input(r, t) for r, t in zip(rs, tensors)])
+    return _register(out)
+
+
+def grouped_allreduce(tensors, **kwargs):
+    return synchronize(grouped_allreduce_async(tensors, **kwargs))
+
+
+def allgather_async(tensor, *, name: Optional[str] = None,
+                    process_set: ProcessSet = global_process_set) -> int:
+    basics._check_initialized()
+    name = name or _auto_name("allgather")
+    fut = _backend().allgather_async([tensor], [name], process_set)
+    out = Future()
+    _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    return _register(out)
+
+
+def allgather(tensor, **kwargs):
+    return synchronize(allgather_async(tensor, **kwargs))
+
+
+def broadcast_async(tensor, root_rank: int, *, name: Optional[str] = None,
+                    process_set: ProcessSet = global_process_set) -> int:
+    basics._check_initialized()
+    name = name or _auto_name("broadcast")
+    fut = _backend().broadcast_async([tensor], [name], root_rank, process_set)
+    out = Future()
+    _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    return _register(out)
+
+
+def broadcast(tensor, root_rank: int, **kwargs):
+    return synchronize(broadcast_async(tensor, root_rank, **kwargs))
+
+
+def alltoall_async(tensor, splits=None, *, name: Optional[str] = None,
+                   process_set: ProcessSet = global_process_set) -> int:
+    basics._check_initialized()
+    name = name or _auto_name("alltoall")
+    fut = _backend().alltoall_async(tensor, splits, process_set)
+    out = Future()
+    _chain(fut, out,
+           lambda r: (_like_input(r[0], tensor), r[1]))
+    return _register(out)
+
+
+def alltoall(tensor, splits=None, **kwargs):
+    """Returns (output, received_splits)."""
+    return synchronize(alltoall_async(tensor, splits, **kwargs))
+
+
+def reducescatter_async(tensor, *, name: Optional[str] = None,
+                        op: int = Sum,
+                        process_set: ProcessSet = global_process_set) -> int:
+    basics._check_initialized()
+    name = name or _auto_name("reducescatter")
+    fut = _backend().reducescatter_async([tensor], [name], op, process_set)
+    out = Future()
+    _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    return _register(out)
+
+
+def reducescatter(tensor, **kwargs):
+    return synchronize(reducescatter_async(tensor, **kwargs))
+
+
+def barrier(process_set: ProcessSet = global_process_set):
+    """Block until all ranks in the set reach the barrier."""
+    basics._check_initialized()
+    return _backend().barrier(process_set)
+
+
+def join() -> int:
+    """Signal that this rank is out of data; blocks until all ranks join.
+    Returns the last rank to join (reference:
+    horovod/common/operations.cc:1714-1742, torch/mpi_ops.py:888)."""
+    basics._check_initialized()
+    return _backend().join()
+
+
+def _chain(src: Future, dst: Future, transform):
+    def _done(f: Future):
+        try:
+            dst.set_result(transform(f.result()))
+        except Exception as e:  # propagate as-is; synchronize wraps
+            dst.set_exception(e)
+
+    src.add_done_callback(_done)

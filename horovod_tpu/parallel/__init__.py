@@ -1,0 +1,17 @@
+"""Parallelism strategies over the device mesh: data / tensor / sequence /
+expert / pipeline axes, hierarchical collectives, Adasum."""
+
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    axis_size,
+    data_sharding,
+    global_mesh,
+    make_mesh,
+    replicated,
+    reset_global_mesh,
+    set_global_mesh,
+)

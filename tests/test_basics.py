@@ -1,0 +1,95 @@
+"""Lifecycle + topology tests (single process).
+
+Mirrors the reference's basic init/rank/size assertions scattered through
+test/parallel/test_torch.py (reference: test/parallel/test_torch.py:154+).
+"""
+
+import numpy as np
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second call is a no-op
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+
+
+def test_capability_queries(hvd):
+    assert not hvd.mpi_built()
+    assert not hvd.mpi_enabled()
+    assert not hvd.cuda_built()
+    assert hvd.tpu_built()
+
+
+def test_uninitialized_raises():
+    import horovod_tpu.common.basics as basics
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    saved = basics._ctx
+    basics._ctx = type(saved)()
+    try:
+        with pytest.raises(HorovodInternalError):
+            basics.rank()
+    finally:
+        basics._ctx = saved
+
+
+def test_eager_allreduce_size1(hvd):
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = hvd.allreduce(x, name="t0")
+    np.testing.assert_array_equal(out, x)  # average over 1 rank
+    out = hvd.allreduce(x, name="t1", op=hvd.Sum, prescale_factor=2.0)
+    np.testing.assert_allclose(out, 2.0 * x)
+
+
+def test_eager_async_handles(hvd):
+    x = np.ones(4, dtype=np.float32)
+    h = hvd.allreduce_async(x, name="h0")
+    assert hvd.poll(h)
+    out = hvd.synchronize(h)
+    np.testing.assert_array_equal(out, x)
+    with pytest.raises(ValueError):
+        hvd.synchronize(h)  # handle cleared
+
+
+def test_eager_other_ops_size1(hvd):
+    x = np.arange(4, dtype=np.int64)
+    np.testing.assert_array_equal(hvd.allgather(x), x)
+    np.testing.assert_array_equal(hvd.broadcast(x, root_rank=0), x)
+    out, splits = hvd.alltoall(x)
+    np.testing.assert_array_equal(out, x)
+    assert splits.tolist() == [4]
+    hvd.barrier()
+    assert hvd.join() == 0
+
+
+def test_grouped_allreduce_size1(hvd):
+    xs = [np.ones(3, np.float32), np.full(2, 2.0, np.float32)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    assert len(outs) == 2
+    np.testing.assert_array_equal(outs[0], xs[0])
+    np.testing.assert_array_equal(outs[1], xs[1])
+
+
+def test_process_set_registry(hvd):
+    from horovod_tpu.common import process_sets as ps
+
+    assert hvd.global_process_set.process_set_id == 0
+    assert hvd.global_process_set.included()
+    assert hvd.global_process_set.size() == 1
+    # With size 1, [0] duplicates the global set → rejected, matching the
+    # reference's duplicate-set error.
+    with pytest.raises(ValueError):
+        hvd.add_process_set(hvd.ProcessSet([0]))
+    with pytest.raises(ValueError):
+        hvd.add_process_set(hvd.ProcessSet([0, 5]))  # out of range
+    with pytest.raises(ValueError):
+        hvd.ProcessSet([0, 0])  # non-unique ranks
+    assert not hvd.remove_process_set(hvd.global_process_set)
+    assert ps.get_process_set_ids() == [0]

@@ -1,0 +1,39 @@
+"""JAX binding: the first-class framework integration of horovod_tpu.
+
+Provides the ``DistributedOptimizer`` (optax) wrapper, gradient allreduce
+helpers, parameter/object broadcast, compression, and SyncBatchNorm —
+the capability set of the reference's framework bindings
+(reference: horovod/torch/optimizer.py, horovod/torch/functions.py,
+horovod/torch/compression.py, horovod/torch/sync_batch_norm.py) expressed
+JAX-natively.
+"""
+
+from horovod_tpu.common import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, start_timeline, stop_timeline,
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from horovod_tpu.ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, Sum,
+    allgather, allgather_async, allreduce, allreduce_async,
+    alltoall, alltoall_async, barrier, broadcast, broadcast_async,
+    grouped_allreduce, grouped_allreduce_async, join, poll, synchronize,
+    allreduce_ingraph, allgather_ingraph, broadcast_ingraph,
+    alltoall_ingraph, reducescatter_ingraph, grouped_allreduce_ingraph,
+)
+from horovod_tpu.jax.compression import Compression  # noqa: F401
+from horovod_tpu.jax.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from horovod_tpu.jax.optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    allreduce_gradients,
+    allreduce_transformation,
+)
+from horovod_tpu.jax.sync_batch_norm import (  # noqa: F401
+    SyncBatchNorm,
+    sync_batch_stats,
+)

@@ -1,0 +1,74 @@
+"""Gradient compression algorithms.
+
+Mirrors the reference's compression interface
+(reference: horovod/torch/compression.py:20-74): ``Compression.none`` and
+``Compression.fp16``, where ``compress`` returns ``(tensor, ctx)`` and
+``decompress`` restores the original dtype after the collective.
+
+On TPU the natural wire format is bfloat16 (no loss of exponent range, MXU
+native), so ``Compression.bf16`` is provided as the TPU-first choice
+alongside fp16 parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: horovod/torch/compression.py:27-38)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: np.dtype
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast float tensors to fp16 for the wire
+    (reference: horovod/torch/compression.py:41-60)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """TPU-native: cast float tensors to bfloat16 for the wire."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,96 @@
+"""State synchronization helpers for JAX pytrees.
+
+Parity with the reference's ``horovod/torch/functions.py:29-266``:
+``broadcast_parameters`` (model/optimizer pytrees), ``broadcast_object`` /
+``allgather_object`` (arbitrary picklable state via a uint8 wire tensor),
+``broadcast_optimizer_state``.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.process_sets import global_process_set
+from horovod_tpu.ops import eager
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=global_process_set):
+    """Broadcast a parameter pytree from ``root_rank`` to all ranks;
+    returns the synchronized pytree.
+
+    Single-process SPMD runs (one controller, params already consistent)
+    return the input unchanged.
+    """
+    basics._check_initialized()
+    if basics.size() == 1:
+        return params
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    handles = [
+        eager.broadcast_async(
+            np.asarray(l), root_rank,
+            name="broadcast_parameters.%d" % i, process_set=process_set)
+        for i, l in enumerate(leaves)
+    ]
+    out = [jnp.asarray(eager.synchronize(h)) for h in handles]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              process_set=global_process_set):
+    """Broadcast an optax optimizer state pytree (same mechanics as
+    parameters; reference: horovod/torch/functions.py:118-187)."""
+    return broadcast_parameters(opt_state, root_rank, process_set=process_set)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = None,
+                     process_set=global_process_set) -> Any:
+    """Broadcast an arbitrary picklable object
+    (reference: horovod/torch/functions.py:190-232): pickle to bytes,
+    broadcast the length, then the payload."""
+    basics._check_initialized()
+    if basics.size() == 1:
+        return obj
+    name = name or "broadcast_object"
+    if basics.rank() == root_rank:
+        payload = pickle.dumps(obj)
+        buf = np.frombuffer(payload, dtype=np.uint8).copy()
+        sz = np.array([buf.size], dtype=np.int64)
+    else:
+        buf = None
+        sz = np.zeros(1, dtype=np.int64)
+    sz = eager.broadcast(sz, root_rank, name=name + ".sz",
+                         process_set=process_set)
+    if buf is None:
+        buf = np.zeros(int(sz[0]), dtype=np.uint8)
+    buf = eager.broadcast(buf, root_rank, name=name + ".data",
+                          process_set=process_set)
+    return pickle.loads(np.asarray(buf).tobytes())
+
+
+def allgather_object(obj: Any, name: str = None,
+                     process_set=global_process_set) -> List[Any]:
+    """Gather one picklable object per rank; returns the list ordered by
+    rank (reference: horovod/torch/functions.py:235-266)."""
+    basics._check_initialized()
+    if basics.size() == 1:
+        return [obj]
+    name = name or "allgather_object"
+    payload = pickle.dumps(obj)
+    buf = np.frombuffer(payload, dtype=np.uint8).copy()
+    sizes = eager.allgather(np.array([buf.size], dtype=np.int64),
+                            name=name + ".sz", process_set=process_set)
+    data = eager.allgather(buf, name=name + ".data", process_set=process_set)
+    data = np.asarray(data)
+    out, off = [], 0
+    for s in np.asarray(sizes).ravel().tolist():
+        out.append(pickle.loads(data[off:off + s].tobytes()))
+        off += s
+    return out

@@ -1,0 +1,108 @@
+"""Synthetic ResNet-50 training throughput benchmark.
+
+TPU-native analog of the reference's headline harness
+(reference: examples/pytorch/pytorch_synthetic_benchmark.py): synthetic
+ImageNet-shaped data, forward+backward+SGD step, images/sec.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference's published illustrative throughput of 1656.82
+images/sec on 16 Pascal GPUs (reference: docs/benchmarks.rst:38-42) =
+103.55 images/sec/accelerator; vs_baseline is per-chip throughput divided
+by that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_ACCEL = 1656.82 / 16.0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--model", default="resnet50")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu import models
+
+    hvd.init()
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # Keep a CPU fallback run finishable: tiny batch + images.
+        args.batch_size = min(args.batch_size, 8)
+        args.image_size = min(args.image_size, 64)
+        args.iters = min(args.iters, 3)
+
+    model_cls = {"resnet50": models.ResNet50, "resnet101": models.ResNet101,
+                 "resnet18": models.ResNet18}[args.model]
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(
+        rng, (args.batch_size, args.image_size, args.image_size, 3),
+        jnp.bfloat16)
+    labels = jax.random.randint(rng, (args.batch_size,), 0, 1000)
+
+    variables = model.init(jax.random.PRNGKey(1), images, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    tx = hvd_jax.DistributedOptimizer(optax.sgd(0.05, momentum=0.9))
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, updates["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, batch_stats, opt_state, loss
+
+    for _ in range(args.warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)  # host transfer: forces execution even where
+    # block_until_ready is a no-op (remote-relay platforms)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = args.batch_size * args.iters / dt
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip (%s, bs=%d, bf16)" % (platform, args.batch_size),
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_ACCEL, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

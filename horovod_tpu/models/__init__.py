@@ -1,0 +1,17 @@
+"""Model zoo: the parity workloads from the reference's examples
+(ResNet family, MNIST models) plus the multi-axis transformer flagship."""
+
+from horovod_tpu.models.mnist import MnistCNN, MnistMLP  # noqa: F401
+from horovod_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from horovod_tpu.models.transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+    get_param_specs,
+)

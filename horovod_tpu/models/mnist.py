@@ -1,0 +1,46 @@
+"""MNIST models matching the reference example workloads.
+
+The reference's acceptance configs include the small MNIST CNN
+(reference: examples/pytorch/pytorch_mnist.py Net — two 5x5 conv layers,
+dropout, two dense layers) and Keras MNIST
+(reference: examples/keras/keras_mnist.py). Implemented flax-native.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class MnistCNN(nn.Module):
+    """Conv(10,5x5) → pool → Conv(20,5x5) → pool → FC 50 → FC 10
+    (reference: examples/pytorch/pytorch_mnist.py Net)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: (N, 28, 28, 1)
+        x = nn.Conv(10, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(10)(x)
+        return x
+
+
+class MnistMLP(nn.Module):
+    """Dense 512-512-10 MLP (reference: examples/keras/keras_mnist.py)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(512)(x))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return nn.Dense(10)(x)

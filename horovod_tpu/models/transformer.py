@@ -1,0 +1,165 @@
+"""Decoder-only transformer — the multi-axis-parallelism flagship.
+
+The reference is a pure data-parallel framework; its model-parallel
+building blocks are generic collectives (SURVEY.md §2.3). This model shows
+how horovod_tpu composes those blocks TPU-first: parameters carry
+partitioning metadata (Megatron-style tensor parallelism over the
+``model`` axis), activations shard batch over ``data`` and optionally
+sequence over ``seq`` (ring attention / Ulysses,
+``horovod_tpu.parallel.sequence``), and MoE layers route tokens over the
+``expert`` axis with all_to_all.
+
+Param layout (tensor parallel over 'model'):
+- attention QKV projections shard the head dim;
+- attention output projection shards the head (input) dim;
+- MLP wi shards the hidden dim, wo shards the hidden (input) dim;
+so each layer needs exactly one psum (after wo) per sublayer — the
+standard Megatron communication pattern, inserted automatically by XLA
+from the shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from flax.linen import partitioning as nn_partitioning
+
+param_with_axes = nn.with_partitioning
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    # 'dense' | 'ring' (ring attention over the seq axis, sequence
+    # parallelism) | 'ulysses' (all_to_all head/seq re-sharding).
+    attention: str = "dense"
+    seq_axis: Optional[str] = None  # mesh axis for ring/ulysses attention
+    # MoE: 0 = dense MLP; >0 = top-1 routed experts over the 'expert' axis.
+    num_experts: int = 0
+    expert_axis: Optional[str] = None
+    remat: bool = False
+
+
+def _dense_causal_attention(q, k, v, dtype):
+    # q, k, v: (B, S, H, D)
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    s = scores.shape[-1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(causal[None, None], scores, jnp.asarray(-1e9, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h, d = cfg.n_heads, cfg.d_model // cfg.n_heads
+        init = nn.initializers.normal(0.02)
+        wqkv = self.param(
+            "wqkv",
+            param_with_axes(init, (None, None, "model", None)),
+            (3, cfg.d_model, h, d), jnp.float32)
+        wo = self.param(
+            "wo",
+            param_with_axes(init, ("model", None, None)),
+            (h, d, cfg.d_model), jnp.float32)
+        wqkv = wqkv.astype(cfg.dtype)
+        wo = wo.astype(cfg.dtype)
+        q = jnp.einsum("bsm,mhd->bshd", x, wqkv[0])
+        k = jnp.einsum("bsm,mhd->bshd", x, wqkv[1])
+        v = jnp.einsum("bsm,mhd->bshd", x, wqkv[2])
+        if cfg.attention == "dense":
+            ctx = _dense_causal_attention(q, k, v, cfg.dtype)
+        elif cfg.attention == "ring":
+            from horovod_tpu.parallel.sequence import ring_attention
+
+            ctx = ring_attention(q, k, v, axis=cfg.seq_axis, causal=True)
+        elif cfg.attention == "ulysses":
+            from horovod_tpu.parallel.sequence import ulysses_attention
+
+            ctx = ulysses_attention(q, k, v, axis=cfg.seq_axis, causal=True)
+        else:
+            raise ValueError("Unknown attention impl %r" % (cfg.attention,))
+        return jnp.einsum("bshd,hdm->bsm", ctx, wo)
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        init = nn.initializers.normal(0.02)
+        wi = self.param("wi", param_with_axes(init, (None, "model")),
+                        (cfg.d_model, cfg.d_ff), jnp.float32)
+        wo = self.param("wo", param_with_axes(init, ("model", None)),
+                        (cfg.d_ff, cfg.d_model), jnp.float32)
+        y = x @ wi.astype(cfg.dtype)
+        y = nn.gelu(y)
+        return y @ wo.astype(cfg.dtype)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        x = x + SelfAttention(cfg, name="attn")(y)
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        if cfg.num_experts > 0:
+            from horovod_tpu.parallel.moe import MoeMlp
+
+            x = x + MoeMlp(cfg, name="moe")(y)
+        else:
+            x = x + Mlp(cfg, name="mlp")(y)
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        init = nn.initializers.normal(0.02)
+        embed = self.param(
+            "embed", param_with_axes(init, ("model", None)),
+            (cfg.vocab_size, cfg.d_model), jnp.float32)
+        pos = self.param(
+            "pos", param_with_axes(init, (None, None)),
+            (cfg.max_seq_len, cfg.d_model), jnp.float32)
+        x = embed.astype(cfg.dtype)[tokens]
+        x = x + pos.astype(cfg.dtype)[None, :tokens.shape[1]]
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        for i in range(cfg.n_layers):
+            x = block(cfg, name="layer_%d" % i)(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = jnp.einsum("bsm,vm->bsv", x, embed.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+
+def get_param_specs(cfg: TransformerConfig, sample_tokens):
+    """PartitionSpecs for the parameter pytree, derived from the
+    ``with_partitioning`` metadata (consumed by pjit NamedShardings)."""
+    model = Transformer(cfg)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), sample_tokens))
+    return nn.get_partition_spec(abstract)

@@ -1,0 +1,89 @@
+"""Model zoo shape/correctness checks (tiny shapes, CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import models
+
+
+def test_mnist_cnn_shapes():
+    m = models.MnistCNN()
+    x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x, train=False)
+    out = m.apply(params, x, train=False)
+    assert out.shape == (4, 10)
+
+
+def test_mnist_mlp_shapes():
+    m = models.MnistMLP()
+    x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x, train=False)
+    out = m.apply(params, x, train=False)
+    assert out.shape == (4, 10)
+
+
+def test_resnet18_forward():
+    m = models.ResNet18(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0), x, train=True)
+    out, updates = m.apply(variables, x, train=True, mutable=["batch_stats"])
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    assert "batch_stats" in updates
+
+
+def test_resnet50_param_count():
+    m = models.ResNet50(num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: m.init(jax.random.PRNGKey(0), x, train=False))
+    n = sum(np.prod(p.shape) for p in
+            jax.tree_util.tree_leaves(variables["params"]))
+    # Torchvision resnet50 has 25.56M params; conv/dense/bn layout matches.
+    assert 25.0e6 < n < 26.0e6, n
+
+
+def test_transformer_forward_and_specs():
+    cfg = models.TransformerConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32)
+    m = models.Transformer(cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), tokens)
+    out = m.apply(params, tokens)
+    assert out.shape == (2, 16, 128)
+
+    specs = models.get_param_specs(cfg, tokens)
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: hasattr(x, "index"))
+    # Tensor-parallel metadata must mark the model axis somewhere.
+    from jax.sharding import PartitionSpec as P
+    leaves = jax.tree_util.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert any("model" in str(l) for l in leaves)
+
+
+def test_transformer_causality():
+    cfg = models.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32)
+    m = models.Transformer(cfg)
+    t1 = jnp.zeros((1, 8), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), t1)
+    a = m.apply(params, t1)
+    # Changing a later token must not affect earlier positions' logits.
+    t2 = t1.at[0, 7].set(5)
+    b = m.apply(params, t2)
+    np.testing.assert_allclose(np.asarray(a[0, :7]), np.asarray(b[0, :7]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_graft_entry_dryrun():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape[-1] == 8192
+    g.dryrun_multichip(8)

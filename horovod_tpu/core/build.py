@@ -1,0 +1,56 @@
+"""On-demand build of the native core shared library.
+
+Analog of the reference's CMake-driven extension build
+(reference: CMakeLists.txt, setup.py:35-120), scoped to the coordination
+core: a single `make` producing ``libhvdcore.so``, rebuilt when any
+source is newer than the library. Guarded by an inter-process file lock so
+concurrent ranks don't race the compiler.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import subprocess
+from typing import Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "build")
+_LIB = os.path.join(_BUILD_DIR, "libhvdcore.so")
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    lib_mtime = os.path.getmtime(_LIB)
+    for fn in os.listdir(_SRC_DIR):
+        if fn.endswith((".cc", ".h", "Makefile")):
+            if os.path.getmtime(os.path.join(_SRC_DIR, fn)) > lib_mtime:
+                return True
+    return False
+
+
+def library_path(build_if_missing: bool = True) -> Optional[str]:
+    """Path to libhvdcore.so, building it if needed. Returns None when the
+    library is absent and ``build_if_missing`` is False."""
+    if not _needs_build():
+        return _LIB
+    if not build_if_missing:
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    lock_path = os.path.join(_BUILD_DIR, ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if _needs_build():
+                subprocess.run(
+                    ["make", "-C", _SRC_DIR, "-j2",
+                     "BUILDDIR=" + _BUILD_DIR],
+                    check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                "Failed to build horovod_tpu native core:\n" + e.stderr
+            ) from e
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return _LIB

@@ -1,0 +1,345 @@
+"""ctypes session over the native coordination core.
+
+Analog of the reference's ``HorovodBasics`` ctypes layer plus the
+framework adapters (reference: horovod/common/basics.py:29-487,
+horovod/torch/mpi_ops_v2.cc:89-127 handle flow): Python submits named
+tensors to the C++ background loop and receives completion through a
+single global callback trampoline keyed by integer tags.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import os
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.core.build import library_path
+
+# OpType values must match core/src/common.h.
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+OP_ALLTOALL = 3
+OP_JOIN = 4
+OP_BARRIER = 5
+OP_REDUCESCATTER = 6
+
+_DTYPE_CODES = {
+    "uint8": 0, "int8": 1, "int32": 2, "int64": 3,
+    "float16": 4, "float32": 5, "float64": 6, "bool": 7, "bfloat16": 8,
+}
+
+_CALLBACK_TYPE = ctypes.CFUNCTYPE(
+    None, ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
+    ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int)
+
+
+def _dtype_code(dtype) -> int:
+    name = np.dtype(dtype).name if np.dtype(dtype).name != "object" else None
+    if name is None or name not in _DTYPE_CODES:
+        # ml_dtypes (bfloat16) reports via str()
+        name = str(dtype)
+    if name not in _DTYPE_CODES:
+        raise TypeError("Unsupported dtype for native collectives: %r" % dtype)
+    return _DTYPE_CODES[name]
+
+
+class _Pending:
+    """One in-flight op: owns input/output buffers until completion."""
+
+    __slots__ = ("kind", "buf", "group", "index", "shape", "dtype")
+
+    def __init__(self, kind, buf, group, index, shape, dtype):
+        self.kind = kind
+        self.buf = buf
+        self.group = group
+        self.index = index
+        self.shape = shape
+        self.dtype = dtype
+
+
+class _Group:
+    """Aggregates per-tensor completions into one Future over a list."""
+
+    def __init__(self, n):
+        self.n = n
+        self.results: List = [None] * n
+        self.remaining = n
+        self.future: Future = Future()
+        self.error = None
+
+    def complete(self, index, result, error=None):
+        if error is not None and self.error is None:
+            self.error = error
+        self.results[index] = result
+        self.remaining -= 1
+        if self.remaining == 0:
+            if self.error is not None:
+                self.future.set_exception(self.error)
+            else:
+                self.future.set_result(self.results)
+
+
+class CoreSession:
+    """Owns the native core lifecycle for this process."""
+
+    def __init__(self, lib, topology):
+        self._lib = lib
+        self._topology = topology
+        self._lock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._tags = itertools.count(1)
+        self.backend = NativeBackend(self)
+        self._timeline = None
+        # Keep the trampoline alive for the lib's lifetime; installed in
+        # start() after hvd_core_init (the core ignores it before init).
+        self._trampoline = _CALLBACK_TYPE(self._on_done)
+
+    # --- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def start(cls, topology) -> "CoreSession":
+        path = library_path(build_if_missing=True)
+        lib = ctypes.CDLL(path)
+        lib.hvd_core_init.restype = ctypes.c_int
+        lib.hvd_core_init.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double, ctypes.c_longlong, ctypes.c_int]
+        lib.hvd_core_enqueue.restype = ctypes.c_int
+        lib.hvd_core_enqueue.argtypes = [
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+            ctypes.c_int, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvd_core_join.argtypes = [ctypes.c_longlong, ctypes.c_int]
+
+        addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
+        port = int(os.environ.get("HOROVOD_CONTROLLER_PORT", "0"))
+        if port == 0:
+            raise RuntimeError(
+                "HOROVOD_CONTROLLER_PORT must be set for multi-process runs "
+                "(the hvdrun launcher sets it).")
+        cycle_ms = float(os.environ.get("HOROVOD_CYCLE_TIME", "1.0"))
+        fusion = int(os.environ.get("HOROVOD_FUSION_THRESHOLD",
+                                    str(64 * 1024 * 1024)))
+        cache_cap = int(os.environ.get("HOROVOD_CACHE_CAPACITY", "1024"))
+
+        session = cls.__new__(cls)
+        CoreSession.__init__(session, lib, topology)
+        rc = lib.hvd_core_init(
+            topology.rank, topology.size, addr.encode(), port,
+            cycle_ms, fusion, cache_cap)
+        if rc != 0:
+            raise RuntimeError(
+                "Native core initialization failed (rc=%d); check that all "
+                "ranks are running and the controller address %s:%d is "
+                "reachable." % (rc, addr, port))
+        lib.hvd_core_set_callback(session._trampoline)
+        return session
+
+    def shutdown(self):
+        self._lib.hvd_core_shutdown()
+
+    def attach_timeline(self, timeline):
+        self._timeline = timeline
+
+    # --- completion trampoline --------------------------------------------
+
+    def _on_done(self, tag, status, err, out_ptr, out_bytes, splits_ptr,
+                 n_splits):
+        with self._lock:
+            pending = self._pending.pop(tag, None)
+        if pending is None:
+            return
+        if status != 0:
+            from horovod_tpu.common.exceptions import HorovodInternalError
+
+            msg = err.decode() if err else "collective failed"
+            pending.group.complete(pending.index, None,
+                                   HorovodInternalError(msg))
+            return
+        try:
+            result = self._materialize(pending, out_ptr, out_bytes,
+                                       splits_ptr, n_splits)
+        except Exception as e:  # defensive: never throw into C
+            pending.group.complete(pending.index, None, e)
+            return
+        pending.group.complete(pending.index, result)
+
+    def _materialize(self, pending, out_ptr, out_bytes, splits_ptr, n_splits):
+        kind = pending.kind
+        if kind in (OP_ALLREDUCE, OP_BROADCAST):
+            return pending.buf.reshape(pending.shape)
+        if kind == OP_JOIN:
+            val = ctypes.cast(out_ptr,
+                              ctypes.POINTER(ctypes.c_longlong)).contents
+            return int(val.value)
+        if kind == OP_BARRIER:
+            return None
+        # Ops with core-owned output buffers: copy out under the callback.
+        n_elems = out_bytes // np.dtype(pending.dtype).itemsize
+        flat = np.empty(int(n_elems), dtype=pending.dtype)
+        if out_bytes:
+            ctypes.memmove(flat.ctypes.data, out_ptr, int(out_bytes))
+        tail = pending.shape[1:] if len(pending.shape) > 0 else ()
+        slice_elems = int(np.prod(tail)) if tail else 1
+        if kind == OP_ALLGATHER:
+            rows = int(n_elems) // slice_elems
+            return flat.reshape((rows,) + tuple(tail))
+        if kind == OP_ALLTOALL:
+            counts = np.ctypeslib.as_array(splits_ptr, shape=(n_splits,)).copy()
+            rows = int(n_elems) // slice_elems
+            return (flat.reshape((rows,) + tuple(tail)),
+                    (counts // slice_elems).astype(np.int32))
+        if kind == OP_REDUCESCATTER:
+            rows = int(n_elems) // slice_elems
+            return flat.reshape((rows,) + tuple(tail))
+        raise ValueError("unknown op kind %r" % kind)
+
+    # --- submission --------------------------------------------------------
+
+    def submit(self, kind, name, array, *, group, index, op=1, root_rank=0,
+               prescale=1.0, postscale=1.0, ps_id=0, splits=None):
+        arr = np.ascontiguousarray(array)
+        if kind in (OP_ALLREDUCE, OP_BROADCAST):
+            arr = arr.copy()  # in-place target; result buffer
+        dtype_code = _dtype_code(arr.dtype)
+        shape = (ctypes.c_longlong * arr.ndim)(*arr.shape)
+        if splits is not None:
+            splits = np.asarray(splits, dtype=np.int64)
+            splits_c = (ctypes.c_longlong * len(splits))(*splits.tolist())
+            nsplits = len(splits)
+        else:
+            splits_c = None
+            nsplits = 0
+        tag = next(self._tags)
+        pending = _Pending(kind, arr, group, index, tuple(arr.shape),
+                           arr.dtype)
+        with self._lock:
+            self._pending[tag] = pending
+        rc = self._lib.hvd_core_enqueue(
+            tag, kind, name.encode(), dtype_code,
+            arr.ctypes.data_as(ctypes.c_void_p), shape, arr.ndim,
+            root_rank, prescale, postscale, ps_id, op, splits_c, nsplits)
+        if rc != 0:
+            with self._lock:
+                self._pending.pop(tag, None)
+            group.complete(index, None,
+                           RuntimeError("enqueue failed rc=%d (%s)" %
+                                        (rc, name)))
+
+    def submit_join(self, ps_id=0) -> Future:
+        group = _Group(1)
+        tag = next(self._tags)
+        pending = _Pending(OP_JOIN, None, group, 0, (), np.int64)
+        with self._lock:
+            self._pending[tag] = pending
+        rc = self._lib.hvd_core_join(tag, ps_id)
+        if rc != 0:
+            group.complete(0, None, RuntimeError("join enqueue failed"))
+        fut = Future()
+        _chain_first(group.future, fut)
+        return fut
+
+    def add_process_set(self, ps_id: int, ranks: Sequence[int]):
+        """Collective: all ranks must call in the same order."""
+        group = _Group(1)
+        name = "__ps_add__%d" % ps_id
+        self.submit(OP_BARRIER, name, np.zeros(0, np.uint8), group=group,
+                    index=0, root_rank=ps_id, ps_id=0,
+                    splits=list(ranks))
+        group.future.result(timeout=120)
+
+    def remove_process_set(self, ps_id: int):
+        group = _Group(1)
+        name = "__ps_remove__%d" % ps_id
+        self.submit(OP_BARRIER, name, np.zeros(0, np.uint8), group=group,
+                    index=0, root_rank=ps_id, ps_id=0)
+        group.future.result(timeout=120)
+
+
+def _chain_first(src: Future, dst: Future):
+    def _done(f):
+        try:
+            dst.set_result(f.result()[0])
+        except Exception as e:
+            dst.set_exception(e)
+
+    src.add_done_callback(_done)
+
+
+class NativeBackend:
+    """Backend for horovod_tpu.ops.eager over the native core."""
+
+    def __init__(self, session: CoreSession):
+        self._s = session
+        self._barrier_counter = itertools.count()
+
+    @staticmethod
+    def _ps_id(process_set) -> int:
+        ps_id = getattr(process_set, "process_set_id", 0)
+        if ps_id is None:
+            raise RuntimeError("Process set is not registered")
+        return ps_id
+
+    def allreduce_async(self, arrays, names, op, prescale, postscale,
+                        process_set) -> Future:
+        group = _Group(len(arrays))
+        ps_id = self._ps_id(process_set)
+        for i, (a, name) in enumerate(zip(arrays, names)):
+            self._s.submit(OP_ALLREDUCE, name, np.asarray(a), group=group,
+                           index=i, op=op, prescale=prescale,
+                           postscale=postscale, ps_id=ps_id)
+        return group.future
+
+    def allgather_async(self, arrays, names, process_set) -> Future:
+        group = _Group(len(arrays))
+        ps_id = self._ps_id(process_set)
+        for i, (a, name) in enumerate(zip(arrays, names)):
+            self._s.submit(OP_ALLGATHER, name, np.asarray(a), group=group,
+                           index=i, ps_id=ps_id)
+        return group.future
+
+    def broadcast_async(self, arrays, names, root_rank, process_set) -> Future:
+        group = _Group(len(arrays))
+        ps_id = self._ps_id(process_set)
+        for i, (a, name) in enumerate(zip(arrays, names)):
+            self._s.submit(OP_BROADCAST, name, np.asarray(a), group=group,
+                           index=i, root_rank=root_rank, ps_id=ps_id)
+        return group.future
+
+    def alltoall_async(self, array, splits, process_set) -> Future:
+        group = _Group(1)
+        ps_id = self._ps_id(process_set)
+        import horovod_tpu.ops.eager as eager_mod
+
+        name = eager_mod._auto_name("alltoall.native")
+        self._s.submit(OP_ALLTOALL, name, np.asarray(array), group=group,
+                       index=0, ps_id=ps_id, splits=splits)
+        fut = Future()
+        _chain_first(group.future, fut)
+        return fut
+
+    def reducescatter_async(self, arrays, names, op, process_set) -> Future:
+        group = _Group(len(arrays))
+        ps_id = self._ps_id(process_set)
+        for i, (a, name) in enumerate(zip(arrays, names)):
+            self._s.submit(OP_REDUCESCATTER, name, np.asarray(a), group=group,
+                           index=i, op=op, ps_id=ps_id)
+        return group.future
+
+    def barrier(self, process_set):
+        group = _Group(1)
+        ps_id = self._ps_id(process_set)
+        name = "__barrier__.%d" % next(self._barrier_counter)
+        self._s.submit(OP_BARRIER, name, np.zeros(0, np.uint8), group=group,
+                       index=0, ps_id=ps_id)
+        return group.future.result(timeout=300)
+
+    def join(self) -> int:
+        return self._s.submit_join(0).result(timeout=300)

@@ -1,0 +1,334 @@
+#include "collectives.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+namespace hvd {
+
+namespace {
+
+// --- half-precision conversion (fp16 / bf16 via float) ---------------------
+// The reference accelerates fp16 reduction with AVX/F16C intrinsics
+// (reference: horovod/common/half.cc:1-80); here a portable scalar
+// conversion is used — the CPU path is the control-plane / cross-host leg,
+// not the throughput-critical ICI path.
+
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ff;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ff;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000 | (mant << 13);
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToHalf(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 16) & 0x8000;
+  int32_t exp = (int32_t)((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffff;
+  if (exp <= 0) {
+    if (exp < -10) return (uint16_t)sign;
+    mant |= 0x800000;
+    uint32_t shift = (uint32_t)(14 - exp);
+    return (uint16_t)(sign | (mant >> shift));
+  }
+  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00);
+  return (uint16_t)(sign | ((uint32_t)exp << 10) | (mant >> 13));
+}
+
+inline float Bf16ToFloat(uint16_t h) {
+  uint32_t f = (uint32_t)h << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToBf16(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  // round-to-nearest-even
+  uint32_t rounding = 0x7fff + ((f >> 16) & 1);
+  return (uint16_t)((f + rounding) >> 16);
+}
+
+template <typename T>
+void ReduceTyped(T* dst, const T* src, int64_t count, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::AVERAGE:
+    case ReduceOp::SUM:
+    case ReduceOp::ADASUM:
+      for (int64_t i = 0; i < count; ++i) dst[i] = (T)(dst[i] + src[i]);
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < count; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < count; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (int64_t i = 0; i < count; ++i) dst[i] = (T)(dst[i] * src[i]);
+      break;
+  }
+}
+
+template <float (*Decode)(uint16_t), uint16_t (*Encode)(float)>
+void ReduceHalf(uint16_t* dst, const uint16_t* src, int64_t count,
+                ReduceOp op) {
+  for (int64_t i = 0; i < count; ++i) {
+    float a = Decode(dst[i]);
+    float b = Decode(src[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::MIN: r = std::min(a, b); break;
+      case ReduceOp::MAX: r = std::max(a, b); break;
+      case ReduceOp::PRODUCT: r = a * b; break;
+      default: r = a + b; break;
+    }
+    dst[i] = Encode(r);
+  }
+}
+
+}  // namespace
+
+void ReduceBuffer(void* dst, const void* src, int64_t count, DataType dtype,
+                  ReduceOp op) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      ReduceTyped<float>((float*)dst, (const float*)src, count, op);
+      break;
+    case DataType::FLOAT64:
+      ReduceTyped<double>((double*)dst, (const double*)src, count, op);
+      break;
+    case DataType::INT32:
+      ReduceTyped<int32_t>((int32_t*)dst, (const int32_t*)src, count, op);
+      break;
+    case DataType::INT64:
+      ReduceTyped<int64_t>((int64_t*)dst, (const int64_t*)src, count, op);
+      break;
+    case DataType::INT8:
+      ReduceTyped<int8_t>((int8_t*)dst, (const int8_t*)src, count, op);
+      break;
+    case DataType::UINT8:
+    case DataType::BOOL:
+      ReduceTyped<uint8_t>((uint8_t*)dst, (const uint8_t*)src, count, op);
+      break;
+    case DataType::FLOAT16:
+      ReduceHalf<HalfToFloat, FloatToHalf>((uint16_t*)dst,
+                                           (const uint16_t*)src, count, op);
+      break;
+    case DataType::BFLOAT16:
+      ReduceHalf<Bf16ToFloat, FloatToBf16>((uint16_t*)dst,
+                                           (const uint16_t*)src, count, op);
+      break;
+  }
+}
+
+void ScaleBuffer(void* data, int64_t count, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::FLOAT32: {
+      float* p = (float*)data;
+      for (int64_t i = 0; i < count; ++i) p[i] = (float)(p[i] * factor);
+      break;
+    }
+    case DataType::FLOAT64: {
+      double* p = (double*)data;
+      for (int64_t i = 0; i < count; ++i) p[i] *= factor;
+      break;
+    }
+    case DataType::INT32: {
+      int32_t* p = (int32_t*)data;
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = (int32_t)llround(p[i] * factor);
+      break;
+    }
+    case DataType::INT64: {
+      int64_t* p = (int64_t*)data;
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = (int64_t)llround((double)p[i] * factor);
+      break;
+    }
+    case DataType::INT8: {
+      int8_t* p = (int8_t*)data;
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = (int8_t)llround(p[i] * factor);
+      break;
+    }
+    case DataType::UINT8:
+    case DataType::BOOL: {
+      uint8_t* p = (uint8_t*)data;
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = (uint8_t)llround(p[i] * factor);
+      break;
+    }
+    case DataType::FLOAT16: {
+      uint16_t* p = (uint16_t*)data;
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToHalf((float)(HalfToFloat(p[i]) * factor));
+      break;
+    }
+    case DataType::BFLOAT16: {
+      uint16_t* p = (uint16_t*)data;
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToBf16((float)(Bf16ToFloat(p[i]) * factor));
+      break;
+    }
+  }
+}
+
+Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
+                     ReduceOp op, const std::vector<int>& members) {
+  int n = (int)members.size();
+  if (n <= 1 || count == 0) return Status::OK();
+  int idx = -1;
+  for (int i = 0; i < n; ++i)
+    if (members[(size_t)i] == comm.rank()) idx = i;
+  if (idx < 0) return Status::InvalidArgument("rank not in member list");
+
+  size_t esize = DataTypeSize(dtype);
+  char* base = (char*)data;
+
+  // Chunk boundaries: first (count % n) chunks get one extra element.
+  std::vector<int64_t> counts((size_t)n, count / n);
+  for (int i = 0; i < (int)(count % n); ++i) counts[(size_t)i]++;
+  std::vector<int64_t> offsets((size_t)n, 0);
+  for (int i = 1; i < n; ++i)
+    offsets[(size_t)i] = offsets[(size_t)i - 1] + counts[(size_t)i - 1];
+
+  int right = members[(size_t)((idx + 1) % n)];
+  int left = members[(size_t)((idx - 1 + n) % n)];
+  int64_t max_chunk = 0;
+  for (auto c : counts) max_chunk = std::max(max_chunk, c);
+  std::vector<char> scratch((size_t)(max_chunk * (int64_t)esize));
+
+  // Phase 1: reduce-scatter. After step s, chunk (idx - s) has been
+  // accumulated by its current holder.
+  for (int s = 0; s < n - 1; ++s) {
+    int send_c = ((idx - s) % n + n) % n;
+    int recv_c = ((idx - s - 1) % n + n) % n;
+    Status st = comm.RawSendRecv(
+        right, base + offsets[(size_t)send_c] * esize,
+        (size_t)(counts[(size_t)send_c] * (int64_t)esize), left,
+        scratch.data(), (size_t)(counts[(size_t)recv_c] * (int64_t)esize));
+    if (!st.ok()) return st;
+    ReduceBuffer(base + offsets[(size_t)recv_c] * esize, scratch.data(),
+                 counts[(size_t)recv_c], dtype, op);
+  }
+  // Phase 2: allgather. Rank holds fully-reduced chunk (idx + 1) % n.
+  for (int s = 0; s < n - 1; ++s) {
+    int send_c = ((idx + 1 - s) % n + n) % n;
+    int recv_c = ((idx - s) % n + n) % n;
+    Status st = comm.RawSendRecv(
+        right, base + offsets[(size_t)send_c] * esize,
+        (size_t)(counts[(size_t)send_c] * (int64_t)esize), left,
+        base + offsets[(size_t)recv_c] * esize,
+        (size_t)(counts[(size_t)recv_c] * (int64_t)esize));
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status RingAllgatherv(TcpComm& comm, const void* sendbuf, void* recvbuf,
+                      const std::vector<int64_t>& bytes_per_member,
+                      const std::vector<int>& members) {
+  int n = (int)members.size();
+  int idx = -1;
+  for (int i = 0; i < n; ++i)
+    if (members[(size_t)i] == comm.rank()) idx = i;
+  if (idx < 0) return Status::InvalidArgument("rank not in member list");
+
+  std::vector<int64_t> offsets((size_t)n, 0);
+  for (int i = 1; i < n; ++i)
+    offsets[(size_t)i] =
+        offsets[(size_t)i - 1] + bytes_per_member[(size_t)i - 1];
+  char* out = (char*)recvbuf;
+  memcpy(out + offsets[(size_t)idx], sendbuf,
+         (size_t)bytes_per_member[(size_t)idx]);
+  if (n <= 1) return Status::OK();
+
+  int right = members[(size_t)((idx + 1) % n)];
+  int left = members[(size_t)((idx - 1 + n) % n)];
+  for (int s = 0; s < n - 1; ++s) {
+    int send_b = ((idx - s) % n + n) % n;
+    int recv_b = ((idx - s - 1) % n + n) % n;
+    Status st = comm.RawSendRecv(
+        right, out + offsets[(size_t)send_b],
+        (size_t)bytes_per_member[(size_t)send_b], left,
+        out + offsets[(size_t)recv_b],
+        (size_t)bytes_per_member[(size_t)recv_b]);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status BroadcastData(TcpComm& comm, void* data, int64_t bytes, int root_idx,
+                     const std::vector<int>& members) {
+  int n = (int)members.size();
+  if (n <= 1) return Status::OK();
+  int root = members[(size_t)root_idx];
+  if (comm.rank() == root) {
+    for (int m : members) {
+      if (m == comm.rank()) continue;
+      Status st = comm.RawSendRecv(m, data, (size_t)bytes, -1, nullptr, 0);
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+  return comm.RawSendRecv(-1, nullptr, 0, root, data, (size_t)bytes);
+}
+
+Status AlltoallvData(TcpComm& comm, const void* sendbuf,
+                     const std::vector<int64_t>& send_bytes, void* recvbuf,
+                     const std::vector<int64_t>& recv_bytes,
+                     const std::vector<int>& members) {
+  int n = (int)members.size();
+  int idx = -1;
+  for (int i = 0; i < n; ++i)
+    if (members[(size_t)i] == comm.rank()) idx = i;
+  if (idx < 0) return Status::InvalidArgument("rank not in member list");
+
+  std::vector<int64_t> soff((size_t)n, 0), roff((size_t)n, 0);
+  for (int i = 1; i < n; ++i) {
+    soff[(size_t)i] = soff[(size_t)i - 1] + send_bytes[(size_t)i - 1];
+    roff[(size_t)i] = roff[(size_t)i - 1] + recv_bytes[(size_t)i - 1];
+  }
+  const char* sb = (const char*)sendbuf;
+  char* rb = (char*)recvbuf;
+  memcpy(rb + roff[(size_t)idx], sb + soff[(size_t)idx],
+         (size_t)send_bytes[(size_t)idx]);
+  // Pairwise exchange: at offset s, trade with (idx + s) and (idx - s).
+  for (int s = 1; s < n; ++s) {
+    int to = (idx + s) % n;
+    int from = ((idx - s) % n + n) % n;
+    Status st = comm.RawSendRecv(
+        members[(size_t)to], sb + soff[(size_t)to],
+        (size_t)send_bytes[(size_t)to], members[(size_t)from],
+        rb + roff[(size_t)from], (size_t)recv_bytes[(size_t)from]);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+}  // namespace hvd

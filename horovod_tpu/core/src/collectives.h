@@ -1,0 +1,48 @@
+// CPU data-plane collective algorithms over the TCP mesh.
+//
+// Fills the role of the reference's Gloo/MPI op implementations
+// (reference: horovod/common/ops/gloo_operations.cc:32-357,
+// mpi_operations.cc). Ring allreduce = reduce-scatter + allgather with
+// duplex transfers; allgatherv = ring rotation; alltoallv = pairwise
+// exchange; broadcast = root star.
+
+#ifndef HVD_TPU_COLLECTIVES_H
+#define HVD_TPU_COLLECTIVES_H
+
+#include "comm.h"
+#include "common.h"
+
+namespace hvd {
+
+// In-place ring allreduce over `members` (sorted global ranks).
+// AVERAGE is reduced as SUM; the caller applies the 1/n scale.
+Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
+                     ReduceOp op, const std::vector<int>& members);
+
+// Allgather with per-member byte counts. `sendbuf` (my part) is copied
+// into `recvbuf` at my offset; parts ordered by member index.
+Status RingAllgatherv(TcpComm& comm, const void* sendbuf, void* recvbuf,
+                      const std::vector<int64_t>& bytes_per_member,
+                      const std::vector<int>& members);
+
+// Broadcast `bytes` from members[root_idx] to all members (root star).
+Status BroadcastData(TcpComm& comm, void* data, int64_t bytes, int root_idx,
+                     const std::vector<int>& members);
+
+// Pairwise all-to-all with ragged splits. send_bytes/recv_bytes are
+// per-member; buffers are packed in member order.
+Status AlltoallvData(TcpComm& comm, const void* sendbuf,
+                     const std::vector<int64_t>& send_bytes, void* recvbuf,
+                     const std::vector<int64_t>& recv_bytes,
+                     const std::vector<int>& members);
+
+// Elementwise dst = dst (op) src for `count` elements of `dtype`.
+void ReduceBuffer(void* dst, const void* src, int64_t count, DataType dtype,
+                  ReduceOp op);
+
+// dst *= factor (float dtypes; ints are scaled via double rounding).
+void ScaleBuffer(void* data, int64_t count, DataType dtype, double factor);
+
+}  // namespace hvd
+
+#endif  // HVD_TPU_COLLECTIVES_H

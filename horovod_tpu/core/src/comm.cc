@@ -1,0 +1,399 @@
+#include "comm.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvd {
+
+namespace {
+
+struct FrameHeader {
+  uint32_t magic;
+  uint32_t sender;
+  uint64_t len;
+};
+constexpr uint32_t kMagic = 0x48564454;  // "HVDT"
+
+void SetSockOpts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+TcpComm::~TcpComm() { Close(); }
+
+void TcpComm::Close() {
+  for (auto& fd : fds_) {
+    if (fd >= 0) {
+      ::shutdown(fd, SHUT_RDWR);
+      ::close(fd);
+      fd = -1;
+    }
+  }
+  if (listen_fd_ >= 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+Status TcpComm::SendAll(int fd, const void* data, size_t len) {
+  const char* p = static_cast<const char*>(data);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("send failed: ") + strerror(errno));
+    }
+    p += n;
+    len -= (size_t)n;
+  }
+  return Status::OK();
+}
+
+Status TcpComm::RecvAll(int fd, void* data, size_t len) {
+  char* p = static_cast<char*>(data);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n == 0) return Status::Aborted("peer closed connection");
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("recv failed: ") + strerror(errno));
+    }
+    p += n;
+    len -= (size_t)n;
+  }
+  return Status::OK();
+}
+
+Status TcpComm::ConnectTo(const std::string& host, int port, int* fd_out,
+                          double timeout_sec) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_sec);
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return Status::Error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      hostent* he = gethostbyname(host.c_str());
+      if (!he) {
+        ::close(fd);
+        return Status::Error("cannot resolve host " + host);
+      }
+      memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof(addr.sin_addr));
+    }
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      SetSockOpts(fd);
+      *fd_out = fd;
+      return Status::OK();
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline) {
+      return Status::Error("connect to " + host + ":" +
+                           std::to_string(port) + " timed out");
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+}
+
+Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
+                     int controller_port, double timeout_sec) {
+  rank_ = rank;
+  size_ = size;
+  fds_.assign((size_t)size, -1);
+  if (size == 1) return Status::OK();
+
+  // Data-plane listener on an ephemeral port.
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return Status::Error("listen socket failed");
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in self{};
+  self.sin_family = AF_INET;
+  self.sin_addr.s_addr = htonl(INADDR_ANY);
+  self.sin_port = 0;
+  if (::bind(listen_fd_, (sockaddr*)&self, sizeof(self)) != 0)
+    return Status::Error("bind failed");
+  if (::listen(listen_fd_, size) != 0) return Status::Error("listen failed");
+  socklen_t slen = sizeof(self);
+  getsockname(listen_fd_, (sockaddr*)&self, &slen);
+  int my_port = ntohs(self.sin_port);
+
+  // Hostname other ranks should dial; single-host jobs use loopback.
+  const char* adv = getenv("HOROVOD_HOSTNAME");
+  std::string my_host = adv ? adv : "127.0.0.1";
+  std::string my_ep = my_host + ":" + std::to_string(my_port);
+
+  // --- bootstrap star through rank 0's controller socket ---
+  std::vector<std::string> table((size_t)size);
+  if (rank == 0) {
+    int boot_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    setsockopt(boot_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in baddr{};
+    baddr.sin_family = AF_INET;
+    baddr.sin_addr.s_addr = htonl(INADDR_ANY);
+    baddr.sin_port = htons((uint16_t)controller_port);
+    if (::bind(boot_fd, (sockaddr*)&baddr, sizeof(baddr)) != 0)
+      return Status::Error("rank 0 cannot bind controller port " +
+                           std::to_string(controller_port));
+    if (::listen(boot_fd, size) != 0)
+      return Status::Error("controller listen failed");
+    table[0] = my_ep;
+    std::vector<int> boot_fds((size_t)size, -1);
+    for (int i = 1; i < size; ++i) {
+      int cfd = ::accept(boot_fd, nullptr, nullptr);
+      if (cfd < 0) return Status::Error("controller accept failed");
+      SetSockOpts(cfd);
+      int32_t peer_rank;
+      Status s = RecvAll(cfd, &peer_rank, sizeof(peer_rank));
+      if (!s.ok()) return s;
+      uint32_t ep_len;
+      s = RecvAll(cfd, &ep_len, sizeof(ep_len));
+      if (!s.ok()) return s;
+      std::string ep(ep_len, 0);
+      s = RecvAll(cfd, ep.data(), ep_len);
+      if (!s.ok()) return s;
+      table[(size_t)peer_rank] = ep;
+      boot_fds[(size_t)peer_rank] = cfd;
+    }
+    // Broadcast the endpoint table.
+    std::string blob;
+    for (auto& ep : table) {
+      uint32_t n = (uint32_t)ep.size();
+      blob.append((char*)&n, sizeof(n));
+      blob.append(ep);
+    }
+    uint64_t blen = blob.size();
+    for (int i = 1; i < size; ++i) {
+      Status s = SendAll(boot_fds[(size_t)i], &blen, sizeof(blen));
+      if (s.ok()) s = SendAll(boot_fds[(size_t)i], blob.data(), blob.size());
+      if (!s.ok()) return s;
+      ::close(boot_fds[(size_t)i]);
+    }
+    ::close(boot_fd);
+  } else {
+    int boot_fd = -1;
+    Status s = ConnectTo(controller_addr, controller_port, &boot_fd,
+                         timeout_sec);
+    if (!s.ok()) return s;
+    int32_t r32 = rank;
+    uint32_t ep_len = (uint32_t)my_ep.size();
+    s = SendAll(boot_fd, &r32, sizeof(r32));
+    if (s.ok()) s = SendAll(boot_fd, &ep_len, sizeof(ep_len));
+    if (s.ok()) s = SendAll(boot_fd, my_ep.data(), my_ep.size());
+    if (!s.ok()) return s;
+    uint64_t blen;
+    s = RecvAll(boot_fd, &blen, sizeof(blen));
+    if (!s.ok()) return s;
+    std::string blob(blen, 0);
+    s = RecvAll(boot_fd, blob.data(), blen);
+    if (!s.ok()) return s;
+    ::close(boot_fd);
+    const char* p = blob.data();
+    for (int i = 0; i < size; ++i) {
+      uint32_t n;
+      memcpy(&n, p, sizeof(n));
+      p += sizeof(n);
+      table[(size_t)i].assign(p, n);
+      p += n;
+    }
+  }
+
+  // --- full-mesh connect: i dials j for i < j; j accepts ---
+  for (int j = rank + 1; j < size; ++j) {
+    auto colon = table[(size_t)j].rfind(':');
+    std::string host = table[(size_t)j].substr(0, colon);
+    int port = std::stoi(table[(size_t)j].substr(colon + 1));
+    int fd = -1;
+    Status s = ConnectTo(host, port, &fd, timeout_sec);
+    if (!s.ok()) return s;
+    int32_t r32 = rank;
+    s = SendAll(fd, &r32, sizeof(r32));
+    if (!s.ok()) return s;
+    fds_[(size_t)j] = fd;
+  }
+  for (int i = 0; i < rank; ++i) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return Status::Error("mesh accept failed");
+    SetSockOpts(fd);
+    int32_t peer_rank;
+    Status s = RecvAll(fd, &peer_rank, sizeof(peer_rank));
+    if (!s.ok()) return s;
+    fds_[(size_t)peer_rank] = fd;
+  }
+  HVD_LOG(LogLevel::DEBUG, "TCP mesh established, size=" +
+                               std::to_string(size));
+  return Status::OK();
+}
+
+Status TcpComm::Send(int peer, const void* data, size_t len) {
+  FrameHeader h{kMagic, (uint32_t)rank_, (uint64_t)len};
+  Status s = SendAll(fds_[(size_t)peer], &h, sizeof(h));
+  if (!s.ok()) return s;
+  return SendAll(fds_[(size_t)peer], data, len);
+}
+
+Status TcpComm::Recv(int peer, std::string* out) {
+  FrameHeader h;
+  Status s = RecvAll(fds_[(size_t)peer], &h, sizeof(h));
+  if (!s.ok()) return s;
+  if (h.magic != kMagic) return Status::Error("bad frame magic");
+  out->resize(h.len);
+  return RecvAll(fds_[(size_t)peer], out->data(), h.len);
+}
+
+Status TcpComm::RecvInto(int peer, void* buf, size_t len) {
+  FrameHeader h;
+  Status s = RecvAll(fds_[(size_t)peer], &h, sizeof(h));
+  if (!s.ok()) return s;
+  if (h.magic != kMagic) return Status::Error("bad frame magic");
+  if (h.len != len)
+    return Status::Error("frame length mismatch: got " +
+                         std::to_string(h.len) + " want " +
+                         std::to_string(len));
+  return RecvAll(fds_[(size_t)peer], buf, len);
+}
+
+Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
+                            int peer_r, void* rbuf, size_t rlen) {
+  int sfd = peer_s >= 0 ? fds_[(size_t)peer_s] : -1;
+  int rfd = peer_r >= 0 ? fds_[(size_t)peer_r] : -1;
+  const char* sp = static_cast<const char*>(sbuf);
+  char* rp = static_cast<char*>(rbuf);
+  size_t sleft = sfd >= 0 ? slen : 0;
+  size_t rleft = rfd >= 0 ? rlen : 0;
+  while (sleft > 0 || rleft > 0) {
+    struct pollfd pfds[2];
+    int n = 0;
+    int si = -1, ri = -1;
+    if (sleft > 0) {
+      si = n;
+      pfds[n].fd = sfd;
+      pfds[n].events = POLLOUT;
+      ++n;
+    }
+    if (rleft > 0) {
+      ri = n;
+      pfds[n].fd = rfd;
+      pfds[n].events = POLLIN;
+      ++n;
+    }
+    int rc = ::poll(pfds, (nfds_t)n, 60000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) return Status::Error("duplex transfer timed out");
+    if (si >= 0 && (pfds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t w = ::send(sfd, sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Status::Error(std::string("send failed: ") + strerror(errno));
+      if (w > 0) {
+        sp += w;
+        sleft -= (size_t)w;
+      }
+    }
+    if (ri >= 0 && (pfds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r = ::recv(rfd, rp, rleft, MSG_DONTWAIT);
+      if (r == 0) return Status::Aborted("peer closed connection");
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Status::Error(std::string("recv failed: ") + strerror(errno));
+      if (r > 0) {
+        rp += r;
+        rleft -= (size_t)r;
+      }
+    }
+  }
+  return Status::OK();
+}
+
+Status TcpComm::Gatherv(const std::string& mine,
+                        std::vector<std::string>* all, int root,
+                        const std::vector<int>& members) {
+  if (rank_ == root) {
+    all->assign(members.size(), std::string());
+    for (size_t idx = 0; idx < members.size(); ++idx) {
+      int m = members[idx];
+      if (m == rank_) {
+        (*all)[idx] = mine;
+      } else {
+        Status s = Recv(m, &(*all)[idx]);
+        if (!s.ok()) return s;
+      }
+    }
+    return Status::OK();
+  }
+  return Send(root, mine.data(), mine.size());
+}
+
+Status TcpComm::Bcast(std::string* blob, int root,
+                      const std::vector<int>& members) {
+  if (rank_ == root) {
+    for (int m : members) {
+      if (m == rank_) continue;
+      Status s = Send(m, blob->data(), blob->size());
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  return Recv(root, blob);
+}
+
+Status TcpComm::BitAllreduce(std::vector<uint8_t>* bits, bool is_and,
+                             int root, const std::vector<int>& members) {
+  std::string mine((const char*)bits->data(), bits->size());
+  if (rank_ == root) {
+    std::vector<std::string> all;
+    Status s = Gatherv(mine, &all, root, members);
+    if (!s.ok()) return s;
+    for (auto& other : all) {
+      if (other.size() != bits->size())
+        return Status::Error("bitvector size mismatch");
+      for (size_t i = 0; i < bits->size(); ++i) {
+        uint8_t o = (uint8_t)other[i];
+        (*bits)[i] = is_and ? ((*bits)[i] & o) : ((*bits)[i] | o);
+      }
+    }
+    std::string out((const char*)bits->data(), bits->size());
+    return Bcast(&out, root, members);
+  }
+  Status s = Gatherv(mine, nullptr, root, members);
+  if (!s.ok()) return s;
+  std::string out;
+  s = Bcast(&out, root, members);
+  if (!s.ok()) return s;
+  if (out.size() != bits->size())
+    return Status::Error("bitvector size mismatch after bcast");
+  memcpy(bits->data(), out.data(), out.size());
+  return Status::OK();
+}
+
+Status TcpComm::Barrier(int root, const std::vector<int>& members) {
+  std::string token("B");
+  if (rank_ == root) {
+    std::vector<std::string> all;
+    Status s = Gatherv(token, &all, root, members);
+    if (!s.ok()) return s;
+    std::string go("G");
+    return Bcast(&go, root, members);
+  }
+  Status s = Gatherv(token, nullptr, root, members);
+  if (!s.ok()) return s;
+  std::string go;
+  return Bcast(&go, root, members);
+}
+
+}  // namespace hvd

@@ -1,0 +1,73 @@
+// TCP full-mesh communicator: the control plane (and CPU data plane) of
+// the core. Fills the role Gloo/MPI play in the reference
+// (reference: horovod/common/gloo/gloo_context.cc:150-230 rendezvous +
+// full-mesh connect; horovod/common/mpi/mpi_controller.cc gather/bcast).
+//
+// Bootstrap: rank 0 listens on HOROVOD_CONTROLLER_ADDR:PORT; every other
+// rank connects, sends its data-plane listen endpoint, receives the full
+// endpoint table, then ranks connect pairwise (i connects to j for i < j)
+// to form the mesh. All collective traffic is framed and runs on the
+// single background thread, so no per-connection locking is needed.
+
+#ifndef HVD_TPU_COMM_H
+#define HVD_TPU_COMM_H
+
+#include "common.h"
+
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class TcpComm {
+ public:
+  TcpComm() = default;
+  ~TcpComm();
+
+  // Establish the mesh. Returns non-OK on timeout/refusal.
+  Status Init(int rank, int size, const std::string& controller_addr,
+              int controller_port, double timeout_sec = 60.0);
+  void Close();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Framed point-to-point (blocking, background thread only).
+  Status Send(int peer, const void* data, size_t len);
+  Status Recv(int peer, std::string* out);
+  // Receive exactly `len` bytes into `buf`.
+  Status RecvInto(int peer, void* buf, size_t len);
+
+  // Unframed duplex transfer: simultaneously stream `slen` bytes to
+  // `peer_s` and read `rlen` bytes from `peer_r` (poll-based, required for
+  // ring steps — pure blocking send+recv deadlocks once payloads exceed
+  // kernel socket buffers). Either peer may be -1 to skip that side.
+  Status RawSendRecv(int peer_s, const void* sbuf, size_t slen, int peer_r,
+                     void* rbuf, size_t rlen);
+
+  // --- control-plane collectives over the star/mesh (blocking) ---
+  // Gather variable-size blobs to `root` (root gets all, others send).
+  Status Gatherv(const std::string& mine, std::vector<std::string>* all,
+                 int root, const std::vector<int>& members);
+  // Broadcast a blob from `root` to `members`.
+  Status Bcast(std::string* blob, int root, const std::vector<int>& members);
+  // Bitwise AND/OR of fixed-size bitvectors across `members` (via root).
+  Status BitAllreduce(std::vector<uint8_t>* bits, bool is_and, int root,
+                      const std::vector<int>& members);
+  Status Barrier(int root, const std::vector<int>& members);
+
+ private:
+  Status ConnectTo(const std::string& host, int port, int* fd_out,
+                   double timeout_sec);
+  Status SendAll(int fd, const void* data, size_t len);
+  Status RecvAll(int fd, void* data, size_t len);
+
+  int rank_ = 0;
+  int size_ = 1;
+  std::vector<int> fds_;  // fds_[peer] = socket, -1 for self
+  int listen_fd_ = -1;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TPU_COMM_H

@@ -1,0 +1,217 @@
+// Core types for the horovod_tpu native coordination core.
+//
+// TPU-native rebuild of the reference's common layer
+// (reference: horovod/common/common.h:107-384 — Status, TensorShape,
+// Request/Response, knob constants). The data plane here is the CPU
+// control/data path (TCP full mesh); device collectives run in XLA and
+// only their ordering is decided by this core.
+
+#ifndef HVD_TPU_COMMON_H
+#define HVD_TPU_COMMON_H
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace hvd {
+
+// ---------------------------------------------------------------- status ---
+
+enum class StatusType : int {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+struct Status {
+  StatusType type = StatusType::OK;
+  std::string reason;
+
+  static Status OK() { return Status{}; }
+  static Status Error(const std::string& msg) {
+    return Status{StatusType::UNKNOWN_ERROR, msg};
+  }
+  static Status PreconditionError(const std::string& msg) {
+    return Status{StatusType::PRECONDITION_ERROR, msg};
+  }
+  static Status InvalidArgument(const std::string& msg) {
+    return Status{StatusType::INVALID_ARGUMENT, msg};
+  }
+  static Status Aborted(const std::string& msg) {
+    return Status{StatusType::ABORTED, msg};
+  }
+  bool ok() const { return type == StatusType::OK; }
+};
+
+// ---------------------------------------------------------------- dtypes ---
+
+// Wire dtype ids; stable across ranks (mirrors the reference's DataType,
+// reference: horovod/common/common.h / wire/message.fbs).
+enum class DataType : int {
+  UINT8 = 0,
+  INT8 = 1,
+  INT32 = 2,
+  INT64 = 3,
+  FLOAT16 = 4,
+  FLOAT32 = 5,
+  FLOAT64 = 6,
+  BOOL = 7,
+  BFLOAT16 = 8,
+};
+
+inline size_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8:
+    case DataType::INT8:
+    case DataType::BOOL:
+      return 1;
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return 2;
+    case DataType::INT32:
+    case DataType::FLOAT32:
+      return 4;
+    case DataType::INT64:
+    case DataType::FLOAT64:
+      return 8;
+  }
+  return 1;
+}
+
+const char* DataTypeName(DataType dt);
+
+// ---------------------------------------------------------- tensor shape ---
+
+struct TensorShape {
+  std::vector<int64_t> dims;
+
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+  bool operator==(const TensorShape& o) const { return dims == o.dims; }
+  bool operator!=(const TensorShape& o) const { return dims != o.dims; }
+  std::string DebugString() const;
+};
+
+// -------------------------------------------------------------- messages ---
+
+// Collective kinds (reference Request::RequestType,
+// horovod/common/message.h:50-151).
+enum class OpType : int {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  JOIN = 4,
+  BARRIER = 5,
+  REDUCESCATTER = 6,
+  ERROR_OP = 7,
+};
+
+// Reduction ops matching horovod_tpu.ops (Average/Sum/.../Product).
+enum class ReduceOp : int {
+  AVERAGE = 0,
+  SUM = 1,
+  ADASUM = 2,
+  MIN = 3,
+  MAX = 4,
+  PRODUCT = 5,
+};
+
+// A rank's announcement that a named tensor is ready
+// (reference: Request, horovod/common/message.h:50).
+struct Request {
+  int32_t request_rank = 0;
+  OpType op_type = OpType::ALLREDUCE;
+  ReduceOp reduce_op = ReduceOp::AVERAGE;
+  DataType dtype = DataType::FLOAT32;
+  std::string tensor_name;
+  TensorShape shape;
+  int32_t root_rank = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<int64_t> splits;  // alltoall send splits (may be empty)
+
+  void SerializeTo(std::string* out) const;
+  static Request Parse(const char* data, size_t len, size_t* consumed);
+};
+
+// Coordinator's instruction to execute a (possibly fused) collective
+// (reference: Response, horovod/common/message.h:153).
+struct Response {
+  OpType op_type = OpType::ALLREDUCE;
+  ReduceOp reduce_op = ReduceOp::AVERAGE;
+  DataType dtype = DataType::FLOAT32;
+  std::vector<std::string> tensor_names;
+  std::vector<int64_t> tensor_sizes;  // per-tensor element counts
+  std::string error_reason;           // op_type == ERROR_OP
+  int32_t root_rank = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+
+  void SerializeTo(std::string* out) const;
+  static Response Parse(const char* data, size_t len, size_t* consumed);
+};
+
+void SerializeRequestList(const std::vector<Request>& reqs, std::string* out);
+std::vector<Request> ParseRequestList(const char* data, size_t len);
+void SerializeResponseList(const std::vector<Response>& resps,
+                           std::string* out);
+std::vector<Response> ParseResponseList(const char* data, size_t len);
+
+// -------------------------------------------------------- tensor entries ---
+
+using DoneCallback = std::function<void(const Status&, const void* out,
+                                        int64_t out_bytes,
+                                        const int64_t* recv_splits,
+                                        int n_splits)>;
+
+// A pending tensor operation owned by the enqueue layer
+// (reference: TensorTableEntry, horovod/common/common.h:341).
+struct TensorTableEntry {
+  std::string name;
+  OpType op_type = OpType::ALLREDUCE;
+  ReduceOp reduce_op = ReduceOp::AVERAGE;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  void* data = nullptr;  // caller-owned, in-place for allreduce/broadcast
+  int32_t root_rank = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<int64_t> splits;
+  int32_t process_set_id = 0;
+  DoneCallback callback;
+};
+
+// ---------------------------------------------------------------- logging ---
+
+enum class LogLevel : int { TRACE = 0, DEBUG = 1, INFO = 2, WARN = 3, ERROR = 4 };
+
+LogLevel CurrentLogLevel();
+void LogMessage(LogLevel level, const std::string& msg);
+
+#define HVD_LOG(level, msg)                                            \
+  do {                                                                 \
+    if (static_cast<int>(level) >=                                     \
+        static_cast<int>(hvd::CurrentLogLevel())) {                    \
+      hvd::LogMessage(level, msg);                                     \
+    }                                                                  \
+  } while (0)
+
+}  // namespace hvd
+
+#endif  // HVD_TPU_COMMON_H

@@ -1,0 +1,481 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <cstdlib>
+
+namespace hvd {
+
+// ------------------------------------------------------------ TensorQueue ---
+
+Status TensorQueue::Add(TensorTableEntry entry, const Request& req) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (table_.count(entry.name)) {
+    return Status::InvalidArgument(
+        "Duplicate tensor name in flight: " + entry.name +
+        "; each submitted tensor must have a unique name while pending.");
+  }
+  table_.emplace(entry.name, std::move(entry));
+  queue_.push_back(req);
+  return Status::OK();
+}
+
+std::vector<Request> TensorQueue::PopMessages() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<Request> out(queue_.begin(), queue_.end());
+  queue_.clear();
+  return out;
+}
+
+bool TensorQueue::Lookup(const std::string& name, TensorTableEntry* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = table_.find(name);
+  if (it == table_.end()) return false;
+  if (out) *out = it->second;
+  return true;
+}
+
+bool TensorQueue::Erase(const std::string& name, TensorTableEntry* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = table_.find(name);
+  if (it == table_.end()) return false;
+  if (out) *out = std::move(it->second);
+  table_.erase(it);
+  return true;
+}
+
+void TensorQueue::AbortAll(const Status& reason) {
+  std::unordered_map<std::string, TensorTableEntry> table;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    table.swap(table_);
+    queue_.clear();
+  }
+  for (auto& kv : table) {
+    if (kv.second.callback)
+      kv.second.callback(reason, nullptr, 0, nullptr, 0);
+  }
+}
+
+size_t TensorQueue::pending_count() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return table_.size();
+}
+
+// ---------------------------------------------------------- ResponseCache ---
+
+ResponseCache::State ResponseCache::Cached(const Request& req) const {
+  auto it = position_.find(req.tensor_name);
+  if (it == position_.end()) return State::MISS;
+  const Entry& e = entries_.at(it->second);
+  const Request& r = e.request;
+  // Changed parameters under the same name invalidate the entry
+  // (reference: response_cache.cc put_ INVALID handling).
+  if (r.op_type != req.op_type || r.dtype != req.dtype ||
+      r.shape != req.shape || r.root_rank != req.root_rank ||
+      r.reduce_op != req.reduce_op || r.prescale != req.prescale ||
+      r.postscale != req.postscale || r.splits != req.splits) {
+    return State::INVALID;
+  }
+  return State::HIT;
+}
+
+void ResponseCache::Put(const Request& req, const Response& resp) {
+  if (capacity_ == 0) return;
+  auto it = position_.find(req.tensor_name);
+  if (it != position_.end()) {
+    Entry& e = entries_[it->second];
+    e.request = req;
+    e.response = resp;
+    e.lru_tick = ++tick_;
+    return;
+  }
+  size_t pos = 0;
+  if (entries_.size() >= capacity_) {
+    // Evict LRU, reuse its position (stable bit index space).
+    auto lru = entries_.begin();
+    for (auto i = entries_.begin(); i != entries_.end(); ++i)
+      if (i->second.lru_tick < lru->second.lru_tick) lru = i;
+    position_.erase(lru->second.request.tensor_name);
+    pos = lru->first;
+    entries_.erase(lru);
+  } else {
+    // First unused position.
+    while (entries_.count(pos)) ++pos;
+  }
+  Entry e;
+  e.request = req;
+  e.response = resp;
+  e.lru_tick = ++tick_;
+  entries_.emplace(pos, std::move(e));
+  position_[req.tensor_name] = pos;
+}
+
+const Response& ResponseCache::GetByPosition(size_t pos) const {
+  return entries_.at(pos).response;
+}
+
+size_t ResponseCache::PositionOf(const std::string& name) const {
+  return position_.at(name);
+}
+
+void ResponseCache::EraseByName(const std::string& name) {
+  auto it = position_.find(name);
+  if (it == position_.end()) return;
+  entries_.erase(it->second);
+  position_.erase(it);
+}
+
+// --------------------------------------------------------- StallInspector ---
+
+StallInspector::StallInspector() {
+  warn_sec_ = 60.0;
+  if (const char* env = getenv("HOROVOD_STALL_CHECK_TIME_SECONDS"))
+    warn_sec_ = atof(env);
+  last_check_ = std::chrono::steady_clock::now();
+}
+
+void StallInspector::Record(const std::string& name, int rank) {
+  auto it = reported_.find(name);
+  if (it == reported_.end()) {
+    reported_[name] = {std::chrono::steady_clock::now(), {rank}};
+  } else {
+    it->second.second.insert(rank);
+  }
+}
+
+void StallInspector::Remove(const std::string& name) {
+  reported_.erase(name);
+}
+
+void StallInspector::Check(const std::set<int>& members) {
+  if (warn_sec_ <= 0) return;
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_check_).count() < warn_sec_)
+    return;
+  last_check_ = now;
+  for (auto& kv : reported_) {
+    double age =
+        std::chrono::duration<double>(now - kv.second.first).count();
+    if (age < warn_sec_) continue;
+    std::string missing, have;
+    for (int m : members) {
+      if (kv.second.second.count(m))
+        have += std::to_string(m) + " ";
+      else
+        missing += std::to_string(m) + " ";
+    }
+    HVD_LOG(LogLevel::WARN,
+            "Stalled tensor " + kv.first + " (" +
+                std::to_string((int)age) + "s): ready on ranks [" + have +
+                "], missing on ranks [" + missing +
+                "]. One or more ranks may have exited or diverged.");
+  }
+}
+
+// -------------------------------------------------------------- Controller ---
+
+bool Controller::IncrementTensorCount(ProcessSetState& ps,
+                                      const Request& req) {
+  auto& ranks = ps.message_table[req.tensor_name];
+  ranks.insert(req.request_rank);
+  ps.requests_by_name[req.tensor_name].push_back(req);
+  ps.stall.Record(req.tensor_name, req.request_rank);
+  size_t needed = 0;
+  for (int m : ps.members)
+    if (!ps.joined_ranks.count(m)) ++needed;
+  return ranks.size() >= needed;
+}
+
+Response Controller::ConstructResponse(ProcessSetState& ps,
+                                       const std::string& name) {
+  auto& reqs = ps.requests_by_name[name];
+  const Request& first = reqs.front();
+  Response resp;
+  resp.tensor_names = {name};
+  resp.op_type = first.op_type;
+  resp.reduce_op = first.reduce_op;
+  resp.dtype = first.dtype;
+  resp.root_rank = first.root_rank;
+  resp.prescale = first.prescale;
+  resp.postscale = first.postscale;
+
+  auto error = [&](const std::string& why) {
+    Response e;
+    e.op_type = OpType::ERROR_OP;
+    e.tensor_names = {name};
+    e.error_reason = why;
+    return e;
+  };
+
+  for (auto& r : reqs) {
+    if (r.op_type != first.op_type)
+      return error("Mismatched op types for tensor " + name);
+    if (r.dtype != first.dtype)
+      return error("Mismatched data types for tensor " + name + ": " +
+                   DataTypeName(r.dtype) + " vs " + DataTypeName(first.dtype));
+    if (r.root_rank != first.root_rank)
+      return error("Mismatched root rank for broadcast " + name);
+  }
+
+  switch (first.op_type) {
+    case OpType::ALLREDUCE:
+    case OpType::REDUCESCATTER: {
+      for (auto& r : reqs) {
+        if (r.shape != first.shape)
+          return error("Mismatched allreduce shapes for tensor " + name +
+                       ": " + r.shape.DebugString() + " vs " +
+                       first.shape.DebugString());
+        if (r.reduce_op != first.reduce_op)
+          return error("Mismatched reduce op for tensor " + name);
+        if (r.prescale != first.prescale || r.postscale != first.postscale)
+          return error("Mismatched scale factors for tensor " + name);
+      }
+      resp.tensor_sizes = {first.shape.num_elements()};
+      break;
+    }
+    case OpType::BROADCAST: {
+      for (auto& r : reqs) {
+        if (r.shape != first.shape)
+          return error("Mismatched broadcast shapes for tensor " + name);
+      }
+      resp.tensor_sizes = {first.shape.num_elements()};
+      break;
+    }
+    case OpType::ALLGATHER: {
+      // Dim 0 may differ per rank; trailing dims must match.
+      auto tail = [](const TensorShape& s) {
+        return std::vector<int64_t>(s.dims.begin() + (s.dims.empty() ? 0 : 1),
+                                    s.dims.end());
+      };
+      // tensor_sizes = per-member total element counts, member order.
+      resp.tensor_sizes.assign(ps.members.size(), 0);
+      for (auto& r : reqs) {
+        if (r.shape.dims.empty())
+          return error("Allgather of scalar is not supported for " + name);
+        if (tail(r.shape) != tail(first.shape))
+          return error("Mismatched allgather trailing shapes for " + name);
+        int idx = ps.member_index(r.request_rank);
+        resp.tensor_sizes[(size_t)idx] = r.shape.num_elements();
+      }
+      break;
+    }
+    case OpType::ALLTOALL: {
+      size_t n = ps.members.size();
+      // Validate splits; build n x n element-count matrix (row = sender).
+      resp.tensor_sizes.assign(n * n, 0);
+      for (auto& r : reqs) {
+        if (r.shape.dims.empty())
+          return error("Alltoall requires rank >= 1 tensor for " + name);
+        std::vector<int64_t> splits = r.splits;
+        if (splits.empty()) {
+          if (r.shape.dims[0] % (int64_t)n)
+            return error("Alltoall dim 0 not divisible by member count for " +
+                         name);
+          splits.assign(n, r.shape.dims[0] / (int64_t)n);
+        }
+        if (splits.size() != n)
+          return error("Alltoall splits length mismatch for " + name);
+        int64_t total = 0;
+        for (auto s : splits) total += s;
+        if (total != r.shape.dims[0])
+          return error("Alltoall splits do not sum to dim 0 for " + name);
+        int64_t slice = 1;
+        for (size_t d = 1; d < r.shape.dims.size(); ++d)
+          slice *= r.shape.dims[d];
+        int idx = ps.member_index(r.request_rank);
+        for (size_t j = 0; j < n; ++j)
+          resp.tensor_sizes[(size_t)idx * n + j] = splits[j] * slice;
+      }
+      break;
+    }
+    case OpType::BARRIER:
+      break;
+    default:
+      return error("Unsupported op type in negotiation");
+  }
+  return resp;
+}
+
+void Controller::FuseResponses(std::vector<Response>* responses) {
+  // Greedy bin-packing of adjacent-compatible allreduces under the fusion
+  // threshold (reference: horovod/common/controller.cc:793-930, including
+  // the lookahead: later responses may join an open bin).
+  std::vector<Response> fused;
+  std::vector<bool> used(responses->size(), false);
+  for (size_t i = 0; i < responses->size(); ++i) {
+    if (used[i]) continue;
+    Response r = (*responses)[i];
+    used[i] = true;
+    if (r.op_type == OpType::ALLREDUCE) {
+      int64_t bytes = r.tensor_sizes[0] * (int64_t)DataTypeSize(r.dtype);
+      for (size_t j = i + 1; j < responses->size(); ++j) {
+        if (used[j]) continue;
+        const Response& c = (*responses)[j];
+        if (c.op_type != OpType::ALLREDUCE || c.dtype != r.dtype ||
+            c.reduce_op != r.reduce_op || c.prescale != r.prescale ||
+            c.postscale != r.postscale)
+          continue;
+        int64_t cb = c.tensor_sizes[0] * (int64_t)DataTypeSize(c.dtype);
+        if (bytes + cb > fusion_threshold_) continue;
+        r.tensor_names.push_back(c.tensor_names[0]);
+        r.tensor_sizes.push_back(c.tensor_sizes[0]);
+        bytes += cb;
+        used[j] = true;
+      }
+    }
+    fused.push_back(std::move(r));
+  }
+  responses->swap(fused);
+}
+
+Status Controller::ComputeResponseList(ProcessSetState& ps,
+                                       std::vector<Response>* out) {
+  out->clear();
+  const int me = comm_.rank();
+  const int root = ps.coordinator();
+  const bool coord = ps.is_coordinator(me);
+  const size_t cap = ps.cache.capacity();
+
+  // 1. Pop newly-submitted requests; classify against the cache.
+  std::vector<Request> popped = ps.queue.PopMessages();
+  std::vector<Request> uncached;
+  for (auto& req : popped) {
+    if (req.op_type == OpType::JOIN) {
+      ps.joined_locally = true;
+      continue;
+    }
+    auto state = ps.cache.Cached(req);
+    if (state == ResponseCache::State::HIT) {
+      ps.pending_hits.push_back(req.tensor_name);
+    } else {
+      if (state == ResponseCache::State::INVALID)
+        ps.cache.EraseByName(req.tensor_name);
+      uncached.push_back(req);
+    }
+  }
+
+  // 2. Sync cache bits + status flags across members.
+  //    Layout: [0] = has-uncached flag (OR), [1] = join flag (OR),
+  //    [2 .. 2+cap) = cache-hit bits (AND).
+  std::vector<uint8_t> bits(2 + cap, 0);
+  bits[0] = uncached.empty() ? 0 : 1;
+  bits[1] = ps.joined_locally ? 1 : 0;
+  for (auto& name : ps.pending_hits)
+    bits[2 + ps.cache.PositionOf(name)] = 1;
+  // Two logical reductions in one message round: flags use OR, hit bits
+  // use AND. Do them as separate reductions for protocol clarity.
+  std::vector<uint8_t> flags(bits.begin(), bits.begin() + 2);
+  Status s = comm_.BitAllreduce(&flags, /*is_and=*/false, root, ps.members);
+  if (!s.ok()) return s;
+  std::vector<uint8_t> hit_bits(bits.begin() + 2, bits.end());
+  if (cap > 0) {
+    s = comm_.BitAllreduce(&hit_bits, /*is_and=*/true, root, ps.members);
+    if (!s.ok()) return s;
+  }
+  bool any_uncached = flags[0] != 0;
+  bool any_join = flags[1] != 0;
+
+  // 3. Fast path: globally-agreed cache hits execute without coordination.
+  std::vector<std::string> still_pending;
+  std::vector<size_t> agreed;
+  for (auto& name : ps.pending_hits) {
+    size_t pos = ps.cache.PositionOf(name);
+    if (hit_bits[pos])
+      agreed.push_back(pos);
+    else
+      still_pending.push_back(name);
+  }
+  ps.pending_hits.swap(still_pending);
+  std::sort(agreed.begin(), agreed.end());
+  agreed.erase(std::unique(agreed.begin(), agreed.end()), agreed.end());
+  std::vector<Response> cached_responses;
+  for (size_t pos : agreed)
+    cached_responses.push_back(ps.cache.GetByPosition(pos));
+  FuseResponses(&cached_responses);
+  for (auto& r : cached_responses) out->push_back(std::move(r));
+
+  // 4. Slow path: negotiate uncached tensors through the coordinator.
+  if (any_uncached || any_join) {
+    std::string my_blob;
+    if (ps.joined_locally) {
+      Request jr;
+      jr.op_type = OpType::JOIN;
+      jr.request_rank = me;
+      std::vector<Request> mine = uncached;
+      mine.push_back(jr);
+      SerializeRequestList(mine, &my_blob);
+    } else {
+      SerializeRequestList(uncached, &my_blob);
+    }
+
+    std::vector<Response> negotiated;
+    if (coord) {
+      std::vector<std::string> blobs;
+      s = comm_.Gatherv(my_blob, &blobs, root, ps.members);
+      if (!s.ok()) return s;
+      for (auto& blob : blobs) {
+        for (auto& req : ParseRequestList(blob.data(), blob.size())) {
+          if (req.op_type == OpType::JOIN) {
+            ps.joined_ranks.insert(req.request_rank);
+            ps.last_join_rank = req.request_rank;
+            continue;
+          }
+          if (IncrementTensorCount(ps, req))
+            ps.ready_order.push_back(req.tensor_name);
+        }
+      }
+      // Joined ranks count implicitly: re-check previously-pending names.
+      if (!ps.joined_ranks.empty()) {
+        for (auto it = ps.message_table.begin();
+             it != ps.message_table.end();) {
+          const std::string& name = it->first;
+          bool already_ready = false;
+          for (auto& rn : ps.ready_order)
+            if (rn == name) already_ready = true;
+          size_t needed = 0;
+          for (int m : ps.members)
+            if (!ps.joined_ranks.count(m)) ++needed;
+          if (!already_ready && it->second.size() >= needed)
+            ps.ready_order.push_back(name);
+          ++it;
+        }
+      }
+      for (auto& name : ps.ready_order) {
+        negotiated.push_back(ConstructResponse(ps, name));
+        ps.message_table.erase(name);
+        ps.requests_by_name.erase(name);
+        ps.stall.Remove(name);
+      }
+      ps.ready_order.clear();
+
+      // All ranks joined and nothing pending → emit JOIN completion.
+      if (ps.joined_ranks.size() == ps.members.size() &&
+          ps.message_table.empty()) {
+        Response jr;
+        jr.op_type = OpType::JOIN;
+        jr.root_rank = ps.last_join_rank;
+        negotiated.push_back(jr);
+        ps.joined_ranks.clear();
+        ps.last_join_rank = -1;
+      }
+      FuseResponses(&negotiated);
+      std::set<int> mem_set(ps.members.begin(), ps.members.end());
+      ps.stall.Check(mem_set);
+      std::string resp_blob;
+      SerializeResponseList(negotiated, &resp_blob);
+      s = comm_.Bcast(&resp_blob, root, ps.members);
+      if (!s.ok()) return s;
+    } else {
+      s = comm_.Gatherv(my_blob, nullptr, root, ps.members);
+      if (!s.ok()) return s;
+      std::string resp_blob;
+      s = comm_.Bcast(&resp_blob, root, ps.members);
+      if (!s.ok()) return s;
+      negotiated = ParseResponseList(resp_blob.data(), resp_blob.size());
+    }
+    for (auto& r : negotiated) out->push_back(std::move(r));
+  }
+  return Status::OK();
+}
+
+}  // namespace hvd

@@ -1,0 +1,158 @@
+// Coordinator/worker negotiation: the heart of the core.
+//
+// Reproduces the reference's controller protocol
+// (reference: horovod/common/controller.cc:73-461 ComputeResponseList,
+// :483-763 ConstructResponse, :793-930 FuseResponses,
+// :958 IncrementTensorCount; response cache
+// horovod/common/response_cache.cc; tensor queue
+// horovod/common/tensor_queue.cc; stall inspector
+// horovod/common/stall_inspector.cc) over the TCP control plane.
+
+#ifndef HVD_TPU_CONTROLLER_H
+#define HVD_TPU_CONTROLLER_H
+
+#include "collectives.h"
+#include "comm.h"
+#include "common.h"
+
+#include <chrono>
+#include <deque>
+#include <list>
+#include <set>
+
+namespace hvd {
+
+// ---------------------------------------------------------- tensor queue ---
+
+class TensorQueue {
+ public:
+  // Rejects duplicate in-flight names (reference: DUPLICATE_NAME_ERROR,
+  // horovod/common/common.h:224).
+  Status Add(TensorTableEntry entry, const Request& req);
+  std::vector<Request> PopMessages();
+  bool Lookup(const std::string& name, TensorTableEntry* out);
+  bool Erase(const std::string& name, TensorTableEntry* out);
+  // Fail everything pending (shutdown / fatal comm error).
+  void AbortAll(const Status& reason);
+  size_t pending_count();
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::deque<Request> queue_;
+};
+
+// --------------------------------------------------------- response cache ---
+
+// LRU cache of negotiated responses keyed by tensor name. A steady-state
+// hit lets all ranks skip the coordinator gather/bcast and agree via two
+// fixed-size bitvector reductions (reference:
+// horovod/common/response_cache.cc, CacheCoordinator::sync
+// horovod/common/response_cache.h:107-169).
+class ResponseCache {
+ public:
+  enum class State { MISS = 0, HIT = 1, INVALID = 2 };
+
+  void SetCapacity(size_t cap) { capacity_ = cap; }
+  size_t capacity() const { return capacity_; }
+
+  State Cached(const Request& req) const;
+  void Put(const Request& req, const Response& resp);
+  const Response& GetByPosition(size_t pos) const;
+  size_t PositionOf(const std::string& name) const;
+  void EraseByName(const std::string& name);
+  size_t size() const { return entries_.size(); }
+
+ private:
+  struct Entry {
+    Request request;  // signature for INVALID detection
+    Response response;
+    uint64_t lru_tick = 0;
+  };
+  size_t capacity_ = 1024;
+  uint64_t tick_ = 0;
+  // position (stable bit index) -> entry; name -> position
+  std::map<size_t, Entry> entries_;
+  std::unordered_map<std::string, size_t> position_;
+};
+
+// --------------------------------------------------------- stall inspector ---
+
+class StallInspector {
+ public:
+  StallInspector();
+  // Record that `name` was first reported by `rank` (coordinator side).
+  void Record(const std::string& name, int rank);
+  void Remove(const std::string& name);
+  // Log a warning for tensors pending longer than the warn threshold;
+  // lists which members have/haven't reported.
+  void Check(const std::set<int>& members);
+
+ private:
+  double warn_sec_ = 60.0;
+  std::chrono::steady_clock::time_point last_check_;
+  std::unordered_map<std::string,
+                     std::pair<std::chrono::steady_clock::time_point,
+                               std::set<int>>>
+      reported_;
+};
+
+// -------------------------------------------------------- process set state ---
+
+struct ProcessSetState {
+  int id = 0;
+  std::vector<int> members;  // sorted global ranks
+  TensorQueue queue;
+  ResponseCache cache;
+  StallInspector stall;
+
+  // Names whose cache bits are set locally but not yet globally agreed.
+  std::vector<std::string> pending_hits;
+
+  // Coordinator-only negotiation state.
+  std::unordered_map<std::string, std::set<int>> message_table;
+  std::unordered_map<std::string, std::vector<Request>> requests_by_name;
+  std::deque<std::string> ready_order;
+
+  // Join state.
+  bool joined_locally = false;
+  std::set<int> joined_ranks;  // coordinator view
+  int last_join_rank = -1;
+
+  int coordinator() const { return members.empty() ? 0 : members[0]; }
+  bool is_coordinator(int rank) const { return rank == coordinator(); }
+  int member_index(int rank) const {
+    for (size_t i = 0; i < members.size(); ++i)
+      if (members[i] == rank) return (int)i;
+    return -1;
+  }
+};
+
+// ------------------------------------------------------------- controller ---
+
+class Controller {
+ public:
+  Controller(TcpComm& comm, int64_t fusion_bytes)
+      : comm_(comm), fusion_threshold_(fusion_bytes) {}
+
+  // One negotiation round for one process set. Returns the ordered list
+  // of responses every member must execute this cycle.
+  Status ComputeResponseList(ProcessSetState& ps,
+                             std::vector<Response>* out);
+
+  void set_fusion_threshold(int64_t b) { fusion_threshold_ = b; }
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+
+ private:
+  // Coordinator: all members reported (joined ranks count implicitly)?
+  bool IncrementTensorCount(ProcessSetState& ps, const Request& req);
+  Response ConstructResponse(ProcessSetState& ps, const std::string& name);
+  void FuseResponses(std::vector<Response>* responses);
+
+  TcpComm& comm_;
+  int64_t fusion_threshold_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TPU_CONTROLLER_H

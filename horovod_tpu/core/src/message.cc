@@ -1,0 +1,239 @@
+// Control-plane message serialization.
+//
+// The reference uses flatbuffers (horovod/common/wire/message.fbs,
+// message.cc:1-515); this core uses a compact hand-rolled
+// length-prefixed binary format — the control messages are tiny and
+// schema evolution is handled by a version byte.
+
+#include "common.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <stdexcept>
+
+namespace hvd {
+
+namespace {
+
+constexpr uint8_t kWireVersion = 1;
+
+void PutU8(std::string* out, uint8_t v) { out->push_back((char)v); }
+void PutI32(std::string* out, int32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+void PutI64(std::string* out, int64_t v) {
+  out->append(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+void PutF64(std::string* out, double v) {
+  out->append(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+void PutStr(std::string* out, const std::string& s) {
+  PutI32(out, (int32_t)s.size());
+  out->append(s);
+}
+void PutI64Vec(std::string* out, const std::vector<int64_t>& v) {
+  PutI32(out, (int32_t)v.size());
+  for (auto x : v) PutI64(out, x);
+}
+
+struct Reader {
+  const char* p;
+  const char* end;
+  Reader(const char* data, size_t len) : p(data), end(data + len) {}
+  void Need(size_t n) {
+    if (p + n > end) throw std::runtime_error("message truncated");
+  }
+  uint8_t U8() { Need(1); return (uint8_t)*p++; }
+  int32_t I32() {
+    Need(4);
+    int32_t v;
+    memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  int64_t I64() {
+    Need(8);
+    int64_t v;
+    memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+  double F64() {
+    Need(8);
+    double v;
+    memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+  std::string Str() {
+    int32_t n = I32();
+    Need((size_t)n);
+    std::string s(p, (size_t)n);
+    p += n;
+    return s;
+  }
+  std::vector<int64_t> I64Vec() {
+    int32_t n = I32();
+    std::vector<int64_t> v((size_t)n);
+    for (int32_t i = 0; i < n; ++i) v[(size_t)i] = I64();
+    return v;
+  }
+};
+
+}  // namespace
+
+const char* DataTypeName(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8: return "uint8";
+    case DataType::INT8: return "int8";
+    case DataType::INT32: return "int32";
+    case DataType::INT64: return "int64";
+    case DataType::FLOAT16: return "float16";
+    case DataType::FLOAT32: return "float32";
+    case DataType::FLOAT64: return "float64";
+    case DataType::BOOL: return "bool";
+    case DataType::BFLOAT16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+std::string TensorShape::DebugString() const {
+  std::string s = "[";
+  for (size_t i = 0; i < dims.size(); ++i) {
+    if (i) s += ", ";
+    s += std::to_string(dims[i]);
+  }
+  return s + "]";
+}
+
+void Request::SerializeTo(std::string* out) const {
+  PutU8(out, kWireVersion);
+  PutI32(out, request_rank);
+  PutU8(out, (uint8_t)op_type);
+  PutU8(out, (uint8_t)reduce_op);
+  PutU8(out, (uint8_t)dtype);
+  PutStr(out, tensor_name);
+  PutI64Vec(out, shape.dims);
+  PutI32(out, root_rank);
+  PutF64(out, prescale);
+  PutF64(out, postscale);
+  PutI64Vec(out, splits);
+}
+
+static Request ParseRequestFrom(Reader& r) {
+  Request req;
+  uint8_t ver = r.U8();
+  if (ver != kWireVersion) throw std::runtime_error("bad request version");
+  req.request_rank = r.I32();
+  req.op_type = (OpType)r.U8();
+  req.reduce_op = (ReduceOp)r.U8();
+  req.dtype = (DataType)r.U8();
+  req.tensor_name = r.Str();
+  req.shape.dims = r.I64Vec();
+  req.root_rank = r.I32();
+  req.prescale = r.F64();
+  req.postscale = r.F64();
+  req.splits = r.I64Vec();
+  return req;
+}
+
+Request Request::Parse(const char* data, size_t len, size_t* consumed) {
+  Reader r(data, len);
+  Request req = ParseRequestFrom(r);
+  if (consumed) *consumed = (size_t)(r.p - data);
+  return req;
+}
+
+void Response::SerializeTo(std::string* out) const {
+  PutU8(out, kWireVersion);
+  PutU8(out, (uint8_t)op_type);
+  PutU8(out, (uint8_t)reduce_op);
+  PutU8(out, (uint8_t)dtype);
+  PutI32(out, (int32_t)tensor_names.size());
+  for (auto& n : tensor_names) PutStr(out, n);
+  PutI64Vec(out, tensor_sizes);
+  PutStr(out, error_reason);
+  PutI32(out, root_rank);
+  PutF64(out, prescale);
+  PutF64(out, postscale);
+}
+
+static Response ParseResponseFrom(Reader& r) {
+  Response resp;
+  uint8_t ver = r.U8();
+  if (ver != kWireVersion) throw std::runtime_error("bad response version");
+  resp.op_type = (OpType)r.U8();
+  resp.reduce_op = (ReduceOp)r.U8();
+  resp.dtype = (DataType)r.U8();
+  int32_t n = r.I32();
+  resp.tensor_names.reserve((size_t)n);
+  for (int32_t i = 0; i < n; ++i) resp.tensor_names.push_back(r.Str());
+  resp.tensor_sizes = r.I64Vec();
+  resp.error_reason = r.Str();
+  resp.root_rank = r.I32();
+  resp.prescale = r.F64();
+  resp.postscale = r.F64();
+  return resp;
+}
+
+Response Response::Parse(const char* data, size_t len, size_t* consumed) {
+  Reader r(data, len);
+  Response resp = ParseResponseFrom(r);
+  if (consumed) *consumed = (size_t)(r.p - data);
+  return resp;
+}
+
+void SerializeRequestList(const std::vector<Request>& reqs, std::string* out) {
+  PutI32(out, (int32_t)reqs.size());
+  for (auto& r : reqs) r.SerializeTo(out);
+}
+
+std::vector<Request> ParseRequestList(const char* data, size_t len) {
+  Reader r(data, len);
+  int32_t n = r.I32();
+  std::vector<Request> reqs;
+  reqs.reserve((size_t)n);
+  for (int32_t i = 0; i < n; ++i) reqs.push_back(ParseRequestFrom(r));
+  return reqs;
+}
+
+void SerializeResponseList(const std::vector<Response>& resps,
+                           std::string* out) {
+  PutI32(out, (int32_t)resps.size());
+  for (auto& r : resps) r.SerializeTo(out);
+}
+
+std::vector<Response> ParseResponseList(const char* data, size_t len) {
+  Reader r(data, len);
+  int32_t n = r.I32();
+  std::vector<Response> resps;
+  resps.reserve((size_t)n);
+  for (int32_t i = 0; i < n; ++i) resps.push_back(ParseResponseFrom(r));
+  return resps;
+}
+
+// ------------------------------------------------------------------ logging
+
+LogLevel CurrentLogLevel() {
+  static LogLevel level = [] {
+    const char* env = getenv("HOROVOD_LOG_LEVEL");
+    if (!env) return LogLevel::WARN;
+    std::string s(env);
+    if (s == "trace") return LogLevel::TRACE;
+    if (s == "debug") return LogLevel::DEBUG;
+    if (s == "info") return LogLevel::INFO;
+    if (s == "warning" || s == "warn") return LogLevel::WARN;
+    return LogLevel::ERROR;
+  }();
+  return level;
+}
+
+void LogMessage(LogLevel level, const std::string& msg) {
+  static const char* names[] = {"TRACE", "DEBUG", "INFO", "WARN", "ERROR"};
+  const char* rank = getenv("HOROVOD_RANK");
+  fprintf(stderr, "[hvd-core %s rank=%s] %s\n",
+          names[(int)level], rank ? rank : "?", msg.c_str());
+}
+
+}  // namespace hvd

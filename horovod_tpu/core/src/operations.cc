@@ -1,0 +1,594 @@
+// Global state, background cycle loop, response executor, C ABI.
+//
+// Rebuild of the reference's operations layer
+// (reference: horovod/common/operations.cc:381-786 BackgroundThreadLoop /
+// RunLoopOnce, :257-306 PerformOperation, :791-843 InitializeHorovodOnce,
+// :867-1338 extern "C" API, :1342-1742 Enqueue*). One background thread
+// per process negotiates readiness and executes CPU collectives; device
+// collectives live in XLA programs and only consume the ordering this
+// loop decides.
+
+#include "controller.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvd {
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+typedef void (*DoneCb)(long long tag, int status, const char* err,
+                       const void* out, long long out_bytes,
+                       const long long* splits, int n_splits);
+
+struct Global {
+  TcpComm comm;
+  int rank = 0;
+  int size = 1;
+  std::unique_ptr<Controller> controller;
+
+  std::mutex ps_mutex;
+  std::map<int, std::unique_ptr<ProcessSetState>> process_sets;
+
+  std::atomic<bool> shut_down{false};
+  std::atomic<bool> failed{false};
+  std::thread background;
+
+  double cycle_ms = 1.0;
+  int64_t fusion_bytes = 64 * 1024 * 1024;
+  int cache_cap = 1024;
+  std::vector<char> fusion_buffer;
+  // Removals are deferred to the end of the cycle: a "__ps_remove__"
+  // barrier executes while the loop still holds pointers into the set
+  // table, so the erase must not happen mid-iteration.
+  std::vector<int> pending_removals;
+
+  DoneCb callback = nullptr;
+
+  std::mutex init_mutex;
+  std::condition_variable init_cv;
+  bool init_done = false;
+  Status init_status;
+
+  // Join callbacks per process set (tag ids).
+  std::mutex join_mutex;
+  std::map<int, long long> join_tags;
+};
+
+Global* g = nullptr;
+
+void FireCallback(long long tag, const Status& s, const void* out = nullptr,
+                  int64_t out_bytes = 0, const int64_t* splits = nullptr,
+                  int n_splits = 0) {
+  if (g->callback) {
+    g->callback(tag, (int)s.type, s.reason.c_str(), out, out_bytes,
+                (const long long*)splits, n_splits);
+  }
+}
+
+// Tag transport: the enqueue layer owns no Python objects; the done
+// callback closure captures the integer tag handed in through the C ABI.
+DoneCallback MakeDone(long long tag) {
+  return [tag](const Status& s, const void* out, int64_t out_bytes,
+               const int64_t* splits, int n_splits) {
+    FireCallback(tag, s, out, out_bytes, splits, n_splits);
+  };
+}
+
+// ----------------------------------------------------------- executor ------
+
+void ExecuteError(ProcessSetState& ps, const Response& resp) {
+  for (auto& name : resp.tensor_names) {
+    TensorTableEntry e;
+    if (ps.queue.Erase(name, &e) && e.callback)
+      e.callback(Status::PreconditionError(resp.error_reason), nullptr, 0,
+                 nullptr, 0);
+  }
+}
+
+Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
+  size_t esize = DataTypeSize(resp.dtype);
+  int n_members = (int)ps.members.size();
+  double avg_scale =
+      resp.reduce_op == ReduceOp::AVERAGE ? 1.0 / n_members : 1.0;
+
+  struct Part {
+    TensorTableEntry entry;
+    bool present;
+    int64_t count;
+  };
+  std::vector<Part> parts;
+  int64_t total = 0;
+  for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+    Part p;
+    p.count = resp.tensor_sizes[i];
+    p.present = ps.queue.Erase(resp.tensor_names[i], &p.entry);
+    total += p.count;
+    parts.push_back(std::move(p));
+  }
+
+  Status st;
+  if (parts.size() == 1 && parts[0].present) {
+    // Single tensor: reduce in place, no fusion copy.
+    Part& p = parts[0];
+    if (resp.prescale != 1.0)
+      ScaleBuffer(p.entry.data, p.count, resp.dtype, resp.prescale);
+    st = RingAllreduce(g->comm, p.entry.data, p.count, resp.dtype,
+                       resp.reduce_op, ps.members);
+    if (st.ok()) {
+      double s = avg_scale * resp.postscale;
+      if (s != 1.0) ScaleBuffer(p.entry.data, p.count, resp.dtype, s);
+    }
+  } else {
+    // Fused path: pack into the persistent fusion buffer
+    // (reference: fusion_buffer_manager.h:40, PerformOperation memcpys).
+    if ((int64_t)g->fusion_buffer.size() < total * (int64_t)esize)
+      g->fusion_buffer.resize((size_t)(total * (int64_t)esize));
+    char* buf = g->fusion_buffer.data();
+    int64_t off = 0;
+    for (auto& p : parts) {
+      if (p.present) {
+        memcpy(buf + off * esize, p.entry.data, (size_t)(p.count * esize));
+      } else {
+        memset(buf + off * esize, 0, (size_t)(p.count * esize));
+      }
+      off += p.count;
+    }
+    if (resp.prescale != 1.0)
+      ScaleBuffer(buf, total, resp.dtype, resp.prescale);
+    st = RingAllreduce(g->comm, buf, total, resp.dtype, resp.reduce_op,
+                       ps.members);
+    if (st.ok()) {
+      double s = avg_scale * resp.postscale;
+      if (s != 1.0) ScaleBuffer(buf, total, resp.dtype, s);
+      off = 0;
+      for (auto& p : parts) {
+        if (p.present)
+          memcpy(p.entry.data, buf + off * esize,
+                 (size_t)(p.count * esize));
+        off += p.count;
+      }
+    }
+  }
+  for (auto& p : parts) {
+    if (p.present && p.entry.callback)
+      p.entry.callback(st, p.entry.data, p.count * (int64_t)esize, nullptr,
+                       0);
+  }
+  return st;
+}
+
+Status ExecuteAllgather(ProcessSetState& ps, const Response& resp) {
+  const std::string& name = resp.tensor_names[0];
+  TensorTableEntry e;
+  bool present = ps.queue.Erase(name, &e);
+  size_t esize = DataTypeSize(resp.dtype);
+  size_t n = ps.members.size();
+
+  std::vector<int64_t> bytes(n);
+  int64_t total_bytes = 0;
+  for (size_t i = 0; i < n; ++i) {
+    bytes[i] = resp.tensor_sizes[i] * (int64_t)esize;
+    total_bytes += bytes[i];
+  }
+  std::vector<char> out((size_t)total_bytes);
+  const void* send = present ? e.data : nullptr;
+  Status st = RingAllgatherv(g->comm, send, out.data(), bytes, ps.members);
+  if (present && e.callback) {
+    // splits: per-member element counts (python derives dim 0).
+    e.callback(st, out.data(), total_bytes, resp.tensor_sizes.data(),
+               (int)n);
+  }
+  return st;
+}
+
+Status ExecuteBroadcast(ProcessSetState& ps, const Response& resp) {
+  const std::string& name = resp.tensor_names[0];
+  TensorTableEntry e;
+  bool present = ps.queue.Erase(name, &e);
+  size_t esize = DataTypeSize(resp.dtype);
+  int64_t bytes = resp.tensor_sizes[0] * (int64_t)esize;
+  int root_idx = ps.member_index(resp.root_rank);
+  if (root_idx < 0)
+    return Status::InvalidArgument("broadcast root not in process set");
+  std::vector<char> scratch;
+  void* data;
+  if (present) {
+    data = e.data;
+  } else {
+    scratch.resize((size_t)bytes);
+    data = scratch.data();
+  }
+  Status st = BroadcastData(g->comm, data, bytes, root_idx, ps.members);
+  if (present && e.callback)
+    e.callback(st, data, bytes, nullptr, 0);
+  return st;
+}
+
+Status ExecuteAlltoall(ProcessSetState& ps, const Response& resp) {
+  const std::string& name = resp.tensor_names[0];
+  TensorTableEntry e;
+  bool present = ps.queue.Erase(name, &e);
+  size_t esize = DataTypeSize(resp.dtype);
+  size_t n = ps.members.size();
+  int my_idx = ps.member_index(g->comm.rank());
+
+  std::vector<int64_t> send_bytes(n), recv_bytes(n);
+  int64_t total_recv = 0;
+  for (size_t j = 0; j < n; ++j) {
+    send_bytes[j] = resp.tensor_sizes[(size_t)my_idx * n + j] * (int64_t)esize;
+    recv_bytes[j] = resp.tensor_sizes[j * n + (size_t)my_idx] * (int64_t)esize;
+    total_recv += recv_bytes[j];
+  }
+  std::vector<char> out((size_t)total_recv);
+  const void* send = present ? e.data : nullptr;
+  Status st =
+      AlltoallvData(g->comm, send, send_bytes, out.data(), recv_bytes,
+                    ps.members);
+  if (present && e.callback) {
+    std::vector<int64_t> recv_counts(n);
+    for (size_t j = 0; j < n; ++j)
+      recv_counts[j] = recv_bytes[j] / (int64_t)esize;
+    e.callback(st, out.data(), total_recv, recv_counts.data(), (int)n);
+  }
+  return st;
+}
+
+Status ExecuteReducescatter(ProcessSetState& ps, const Response& resp) {
+  // Reduce + local shard extraction. The shard split follows the ring
+  // chunking convention: dim-0-balanced contiguous shards by member index.
+  const std::string& name = resp.tensor_names[0];
+  TensorTableEntry e;
+  bool present = ps.queue.Erase(name, &e);
+  size_t esize = DataTypeSize(resp.dtype);
+  int64_t count = resp.tensor_sizes[0];
+  int n = (int)ps.members.size();
+  int my_idx = ps.member_index(g->comm.rank());
+
+  std::vector<char> scratch;
+  void* data;
+  if (present) {
+    data = e.data;
+  } else {
+    scratch.assign((size_t)(count * (int64_t)esize), 0);
+    data = scratch.data();
+  }
+  Status st = RingAllreduce(g->comm, data, count, resp.dtype, resp.reduce_op,
+                            ps.members);
+  if (st.ok() && resp.reduce_op == ReduceOp::AVERAGE)
+    ScaleBuffer(data, count, resp.dtype, 1.0 / n);
+  if (present && e.callback) {
+    // Shard on dim 0 elements — callback gets (ptr, bytes) of my shard.
+    int64_t rows = e.shape.dims.empty() ? count : e.shape.dims[0];
+    int64_t slice = count / (rows ? rows : 1);
+    int64_t base_rows = rows / n, extra = rows % n;
+    int64_t my_rows = base_rows + (my_idx < extra ? 1 : 0);
+    int64_t start_row = (int64_t)my_idx * base_rows +
+                        std::min<int64_t>(my_idx, extra);
+    e.callback(st, (char*)data + start_row * slice * (int64_t)esize,
+               my_rows * slice * (int64_t)esize, nullptr, 0);
+  }
+  return st;
+}
+
+void CreateProcessSetLocked(int ps_id, const std::vector<int>& ranks);
+
+Status ExecuteBarrier(ProcessSetState& ps, const Response& resp) {
+  const std::string& name = resp.tensor_names[0];
+  TensorTableEntry e;
+  bool present = ps.queue.Erase(name, &e);
+  Status st = g->comm.Barrier(ps.coordinator(), ps.members);
+
+  // Dynamic process-set registration rides the barrier mechanism: the
+  // member list travels in the entry's splits (reference analog:
+  // ProcessSetTable::InitializeRegisteredAndRemoveMarkedIfReady,
+  // horovod/common/process_set.h:105-114).
+  if (st.ok() && present && name.rfind("__ps_add__", 0) == 0) {
+    std::vector<int> ranks(e.splits.begin(), e.splits.end());
+    int new_id = (int)e.root_rank;
+    std::lock_guard<std::mutex> lk(g->ps_mutex);
+    CreateProcessSetLocked(new_id, ranks);
+  } else if (st.ok() && present && name.rfind("__ps_remove__", 0) == 0) {
+    int dead_id = (int)e.root_rank;
+    std::lock_guard<std::mutex> lk(g->ps_mutex);
+    g->pending_removals.push_back(dead_id);
+  }
+  if (present && e.callback) e.callback(st, nullptr, 0, nullptr, 0);
+  return st;
+}
+
+void ExecuteJoin(ProcessSetState& ps, const Response& resp) {
+  ps.joined_locally = false;
+  ps.queue.Erase("__join__", nullptr);
+  long long tag = -1;
+  {
+    std::lock_guard<std::mutex> lk(g->join_mutex);
+    auto it = g->join_tags.find(ps.id);
+    if (it != g->join_tags.end()) {
+      tag = it->second;
+      g->join_tags.erase(it);
+    }
+  }
+  if (tag >= 0) {
+    int64_t last = resp.root_rank;
+    FireCallback(tag, Status::OK(), &last, sizeof(last), nullptr, 0);
+  }
+}
+
+Status PerformOperation(ProcessSetState& ps, const Response& resp,
+                        bool from_cache) {
+  Status st;
+  switch (resp.op_type) {
+    case OpType::ERROR_OP:
+      ExecuteError(ps, resp);
+      return Status::OK();
+    case OpType::ALLREDUCE:
+      st = ExecuteAllreduce(ps, resp);
+      break;
+    case OpType::ALLGATHER:
+      st = ExecuteAllgather(ps, resp);
+      break;
+    case OpType::BROADCAST:
+      st = ExecuteBroadcast(ps, resp);
+      break;
+    case OpType::ALLTOALL:
+      st = ExecuteAlltoall(ps, resp);
+      break;
+    case OpType::REDUCESCATTER:
+      st = ExecuteReducescatter(ps, resp);
+      break;
+    case OpType::BARRIER:
+      st = ExecuteBarrier(ps, resp);
+      break;
+    case OpType::JOIN:
+      ExecuteJoin(ps, resp);
+      return Status::OK();
+  }
+  // Populate the cache after a successful uncached allreduce/broadcast
+  // (fixed-signature ops; allgather/alltoall sizes vary per step).
+  if (st.ok() && !from_cache &&
+      (resp.op_type == OpType::ALLREDUCE ||
+       resp.op_type == OpType::BROADCAST)) {
+    for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+      Request sig;
+      sig.tensor_name = resp.tensor_names[i];
+      sig.op_type = resp.op_type;
+      sig.reduce_op = resp.reduce_op;
+      sig.dtype = resp.dtype;
+      sig.root_rank = resp.root_rank;
+      sig.prescale = resp.prescale;
+      sig.postscale = resp.postscale;
+      sig.shape.dims = {resp.tensor_sizes[i]};  // flattened signature
+      Response single;
+      single.op_type = resp.op_type;
+      single.reduce_op = resp.reduce_op;
+      single.dtype = resp.dtype;
+      single.root_rank = resp.root_rank;
+      single.prescale = resp.prescale;
+      single.postscale = resp.postscale;
+      single.tensor_names = {resp.tensor_names[i]};
+      single.tensor_sizes = {resp.tensor_sizes[i]};
+      ps.cache.Put(sig, single);
+    }
+  }
+  return st;
+}
+
+// ------------------------------------------------- process set management ---
+
+void CreateProcessSetLocked(int ps_id, const std::vector<int>& ranks) {
+  if (g->process_sets.count(ps_id)) return;
+  auto ps = std::make_unique<ProcessSetState>();
+  ps->id = ps_id;
+  ps->members = ranks;
+  std::sort(ps->members.begin(), ps->members.end());
+  ps->cache.SetCapacity((size_t)g->cache_cap);
+  g->process_sets.emplace(ps_id, std::move(ps));
+}
+
+// -------------------------------------------------------- background loop ---
+
+void BackgroundLoop() {
+  auto last_cycle = Clock::now();
+  while (!g->shut_down.load()) {
+    // Maintain the cycle cadence (reference: RunLoopOnce sleep,
+    // operations.cc:689-697).
+    auto target = last_cycle + std::chrono::duration_cast<Clock::duration>(
+                                   std::chrono::duration<double, std::milli>(
+                                       g->cycle_ms));
+    auto now = Clock::now();
+    if (now < target) std::this_thread::sleep_for(target - now);
+    last_cycle = Clock::now();
+
+    std::vector<ProcessSetState*> sets;
+    {
+      std::lock_guard<std::mutex> lk(g->ps_mutex);
+      for (auto& kv : g->process_sets) sets.push_back(kv.second.get());
+    }
+    for (auto* ps : sets) {
+      // Membership: ranks outside a set skip its negotiation entirely;
+      // concurrent sets are safe because every member processes sets in
+      // the same (id-sorted) order on the one background thread.
+      if (ps->member_index(g->comm.rank()) < 0) continue;
+      std::vector<Response> responses;
+      Status s = g->controller->ComputeResponseList(*ps, &responses);
+      if (!s.ok()) {
+        HVD_LOG(LogLevel::ERROR,
+                "coordination failed: " + s.reason + "; failing pending ops");
+        g->failed.store(true);
+        ps->queue.AbortAll(s);
+        continue;
+      }
+      for (size_t i = 0; i < responses.size(); ++i) {
+        Status es = PerformOperation(*ps, responses[i], false);
+        if (!es.ok()) {
+          HVD_LOG(LogLevel::ERROR, "collective failed: " + es.reason);
+          g->failed.store(true);
+        }
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(g->ps_mutex);
+      for (int dead : g->pending_removals) {
+        auto it = g->process_sets.find(dead);
+        if (it != g->process_sets.end()) {
+          it->second->queue.AbortAll(
+              Status::Aborted("process set removed"));
+          g->process_sets.erase(it);
+        }
+      }
+      g->pending_removals.clear();
+    }
+  }
+  // Drain: fail anything still pending.
+  std::lock_guard<std::mutex> lk(g->ps_mutex);
+  for (auto& kv : g->process_sets)
+    kv.second->queue.AbortAll(Status::Aborted("horovod_tpu core shut down"));
+}
+
+}  // namespace
+}  // namespace hvd
+
+// ------------------------------------------------------------------ C ABI ---
+
+using namespace hvd;
+
+extern "C" {
+
+int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
+                  double cycle_ms, long long fusion_bytes, int cache_cap) {
+  if (g) return -1;
+  g = new Global();
+  g->rank = rank;
+  g->size = size;
+  g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
+  if (fusion_bytes > 0) g->fusion_bytes = fusion_bytes;
+  if (cache_cap >= 0) g->cache_cap = cache_cap;
+
+  Status s = g->comm.Init(rank, size, ctrl_addr ? ctrl_addr : "127.0.0.1",
+                          ctrl_port);
+  if (!s.ok()) {
+    HVD_LOG(LogLevel::ERROR, "core init failed: " + s.reason);
+    delete g;
+    g = nullptr;
+    return -2;
+  }
+  g->controller = std::make_unique<Controller>(g->comm, g->fusion_bytes);
+  {
+    std::lock_guard<std::mutex> lk(g->ps_mutex);
+    std::vector<int> world(size);
+    for (int i = 0; i < size; ++i) world[(size_t)i] = i;
+    CreateProcessSetLocked(0, world);
+  }
+  g->background = std::thread(BackgroundLoop);
+  return 0;
+}
+
+void hvd_core_shutdown() {
+  if (!g) return;
+  g->shut_down.store(true);
+  if (g->background.joinable()) g->background.join();
+  g->comm.Close();
+  delete g;
+  g = nullptr;
+}
+
+void hvd_core_set_callback(void (*cb)(long long, int, const char*,
+                                      const void*, long long,
+                                      const long long*, int)) {
+  if (g) g->callback = (DoneCb)cb;
+}
+
+int hvd_core_enqueue(long long tag, int op_type, const char* name, int dtype,
+                     void* data, const long long* shape, int ndim,
+                     int root_rank, double prescale, double postscale,
+                     int ps_id, int reduce_op, const long long* splits,
+                     int nsplits) {
+  if (!g) return -1;
+  ProcessSetState* ps;
+  {
+    std::lock_guard<std::mutex> lk(g->ps_mutex);
+    auto it = g->process_sets.find(ps_id);
+    if (it == g->process_sets.end()) return -3;
+    ps = it->second.get();
+  }
+  TensorTableEntry e;
+  e.name = name;
+  e.op_type = (OpType)op_type;
+  e.reduce_op = (ReduceOp)reduce_op;
+  e.dtype = (DataType)dtype;
+  for (int i = 0; i < ndim; ++i) e.shape.dims.push_back(shape[i]);
+  e.data = data;
+  e.root_rank = root_rank;
+  e.prescale = prescale;
+  e.postscale = postscale;
+  for (int i = 0; i < nsplits; ++i) e.splits.push_back(splits[i]);
+  e.process_set_id = ps_id;
+  e.callback = MakeDone(tag);
+
+  Request req;
+  req.request_rank = g->rank;
+  req.op_type = e.op_type;
+  req.reduce_op = e.reduce_op;
+  req.dtype = e.dtype;
+  req.tensor_name = e.name;
+  req.shape = e.shape;
+  req.root_rank = e.root_rank;
+  req.prescale = e.prescale;
+  req.postscale = e.postscale;
+  req.splits = e.splits;
+
+  Status s = ps->queue.Add(std::move(e), req);
+  if (!s.ok()) {
+    FireCallback(tag, s);
+    return -4;
+  }
+  return 0;
+}
+
+int hvd_core_join(long long tag, int ps_id) {
+  if (!g) return -1;
+  ProcessSetState* ps;
+  {
+    std::lock_guard<std::mutex> lk(g->ps_mutex);
+    auto it = g->process_sets.find(ps_id);
+    if (it == g->process_sets.end()) return -3;
+    ps = it->second.get();
+  }
+  {
+    std::lock_guard<std::mutex> lk(g->join_mutex);
+    g->join_tags[ps_id] = tag;
+  }
+  TensorTableEntry e;
+  e.name = "__join__";
+  e.op_type = OpType::JOIN;
+  Request req;
+  req.request_rank = g->rank;
+  req.op_type = OpType::JOIN;
+  req.tensor_name = e.name;
+  Status s = ps->queue.Add(std::move(e), req);
+  return s.ok() ? 0 : -4;
+}
+
+int hvd_core_rank() { return g ? g->rank : -1; }
+int hvd_core_size() { return g ? g->size : -1; }
+int hvd_core_failed() { return g && g->failed.load() ? 1 : 0; }
+
+void hvd_core_set_params(double cycle_ms, long long fusion_bytes) {
+  if (!g) return;
+  if (cycle_ms > 0) g->cycle_ms = cycle_ms;
+  if (fusion_bytes > 0 && g->controller) {
+    g->fusion_bytes = fusion_bytes;
+    g->controller->set_fusion_threshold(fusion_bytes);
+  }
+}
+
+double hvd_core_cycle_ms() { return g ? g->cycle_ms : 0.0; }
+long long hvd_core_fusion_bytes() {
+  return g ? (long long)g->fusion_bytes : 0;
+}
+
+}  // extern "C"

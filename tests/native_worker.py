@@ -1,0 +1,161 @@
+"""Worker process for the native-core multi-process tests.
+
+Launched np-at-a-time by test_native_core.py with the launcher env set.
+Mirrors the reference's test pattern: every rank computes a deterministic
+rank-dependent tensor, runs the collective, and asserts against the
+locally computed expectation (reference: test/parallel/test_torch.py:154+).
+Exits non-zero on any assertion failure.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never claim the TPU from workers
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 2, "native worker test needs np >= 2"
+
+    # --- allreduce: average and sum -------------------------------------
+    x = np.arange(8, dtype=np.float32) + r
+    out = hvd.allreduce(x, name="ar.avg")
+    expect = np.arange(8, dtype=np.float32) + (n - 1) / 2.0
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    out = hvd.allreduce(x, name="ar.sum", op=hvd.Sum)
+    expect = np.arange(8, dtype=np.float32) * n + sum(range(n))
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    # --- min / max / product on ints ------------------------------------
+    xi = np.array([r + 1, 10 - r], dtype=np.int32)
+    np.testing.assert_array_equal(
+        hvd.allreduce(xi, name="ar.min", op=hvd.Min), [1, 10 - (n - 1)])
+    np.testing.assert_array_equal(
+        hvd.allreduce(xi, name="ar.max", op=hvd.Max), [n, 10])
+    prod_expect = [int(np.prod([k + 1 for k in range(n)])),
+                   int(np.prod([10 - k for k in range(n)]))]
+    np.testing.assert_array_equal(
+        hvd.allreduce(xi, name="ar.prod", op=hvd.Product), prod_expect)
+
+    # --- prescale / postscale -------------------------------------------
+    out = hvd.allreduce(np.ones(4, np.float32), name="ar.scale", op=hvd.Sum,
+                        prescale_factor=0.5, postscale_factor=3.0)
+    np.testing.assert_allclose(out, 0.5 * n * 3.0)
+
+    # --- fp16 / bf16 / float64 / bool ------------------------------------
+    out = hvd.allreduce(np.full(16, 0.5, np.float16), name="ar.f16",
+                        op=hvd.Sum)
+    np.testing.assert_allclose(out, 0.5 * n)
+    import ml_dtypes
+
+    out = hvd.allreduce(np.full(16, 0.5, ml_dtypes.bfloat16), name="ar.bf16",
+                        op=hvd.Sum)
+    np.testing.assert_allclose(out.astype(np.float32), 0.5 * n)
+    out = hvd.allreduce(np.full(4, 0.25, np.float64), name="ar.f64",
+                        op=hvd.Sum)
+    np.testing.assert_allclose(out, 0.25 * n)
+
+    # --- grouped allreduce (fusion path) --------------------------------
+    xs = [np.full(5, float(i + 1), np.float32) * (r + 1) for i in range(3)]
+    outs = hvd.grouped_allreduce(xs, name="gar", op=hvd.Sum)
+    tot = sum(k + 1 for k in range(n))
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, (i + 1) * tot)
+
+    # --- steady state: repeat named tensors (response cache fast path) ---
+    for it in range(6):
+        outs = hvd.grouped_allreduce(
+            [np.full(33, 1.0, np.float32), np.full(77, 2.0, np.float32)],
+            name="steady", op=hvd.Average)
+        np.testing.assert_allclose(outs[0], 1.0)
+        np.testing.assert_allclose(outs[1], 2.0)
+
+    # --- allgather (ragged dim 0) ----------------------------------------
+    part = np.full((r + 1, 3), float(r), np.float32)
+    out = hvd.allgather(part, name="ag")
+    assert out.shape == (sum(k + 1 for k in range(n)), 3), out.shape
+    off = 0
+    for k in range(n):
+        np.testing.assert_allclose(out[off:off + k + 1], float(k))
+        off += k + 1
+
+    # --- broadcast -------------------------------------------------------
+    b = np.arange(6, dtype=np.float64) * (r + 1)
+    out = hvd.broadcast(b, root_rank=1, name="bc")
+    np.testing.assert_allclose(out, np.arange(6, dtype=np.float64) * 2)
+
+    # --- alltoall (ragged splits) ----------------------------------------
+    # rank r sends (k+1) rows of value r to each k.
+    splits = np.array([k + 1 for k in range(n)], dtype=np.int64)
+    rows = int(splits.sum())
+    send = np.full((rows, 2), float(r), np.float32)
+    out, rsplits = hvd.alltoall(send, splits=splits, name="a2a")
+    # rank r receives (r+1) rows from each sender.
+    assert out.shape == ((r + 1) * n, 2), out.shape
+    np.testing.assert_array_equal(np.asarray(rsplits),
+                                  np.full(n, r + 1, np.int32))
+    for k in range(n):
+        np.testing.assert_allclose(
+            out[k * (r + 1):(k + 1) * (r + 1)], float(k))
+
+    # --- reducescatter ----------------------------------------------------
+    big = np.ones((n * 2, 3), np.float32) * (r + 1)
+    out = hvd.reducescatter(big, name="rs", op=hvd.Sum)
+    assert out.shape == (2, 3), out.shape
+    np.testing.assert_allclose(out, float(tot))
+
+    # --- barrier ---------------------------------------------------------
+    hvd.barrier()
+
+    # --- process sets ----------------------------------------------------
+    evens = [k for k in range(n) if k % 2 == 0]
+    odds = [k for k in range(n) if k % 2 == 1]
+    ps_even = hvd.add_process_set(hvd.ProcessSet(evens))
+    ps_odd = hvd.add_process_set(hvd.ProcessSet(odds)) if odds else None
+    my_ps = ps_even if r % 2 == 0 else ps_odd
+    group = evens if r % 2 == 0 else odds
+    out = hvd.allreduce(np.full(4, float(r), np.float32), name="ps.ar",
+                        op=hvd.Sum, process_set=my_ps)
+    np.testing.assert_allclose(out, float(sum(group)))
+    hvd.remove_process_set(ps_even)
+    if ps_odd:
+        hvd.remove_process_set(ps_odd)
+
+    # --- error: mismatched dtype across ranks ----------------------------
+    bad = (np.ones(3, np.float32) if r == 0 else np.ones(3, np.float64))
+    try:
+        hvd.allreduce(bad, name="mismatch", op=hvd.Sum)
+        raise AssertionError("expected HorovodInternalError for dtype "
+                             "mismatch")
+    except HorovodInternalError:
+        pass
+    # The pipeline must still work after a coordinator error.
+    out = hvd.allreduce(np.ones(4, np.float32), name="post.err", op=hvd.Sum)
+    np.testing.assert_allclose(out, float(n))
+
+    # --- join: rank 0 leaves early, others do one extra allreduce --------
+    if r != 0:
+        others = list(range(1, n))
+        out = hvd.allreduce(np.ones(4, np.float32), name="uneven",
+                            op=hvd.Sum)
+        # rank 0 contributes zeros via join.
+        np.testing.assert_allclose(out, float(len(others)))
+    last = hvd.join()
+    assert 0 <= last < n
+
+    hvd.shutdown()
+    print("native worker rank %d OK" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

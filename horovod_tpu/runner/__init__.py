@@ -1,0 +1,14 @@
+"""Launcher: hvdrun CLI, slot assignment, rendezvous server, elastic driver.
+
+Run as ``python -m horovod_tpu.runner -np N <command>`` (the
+``horovodrun`` equivalent; reference: horovod/runner/launch.py:242-774).
+"""
+
+from horovod_tpu.runner.hosts import (  # noqa: F401
+    HostInfo,
+    SlotInfo,
+    get_host_assignments,
+    parse_hostfile,
+    parse_hosts,
+)
+from horovod_tpu.runner.launch import parse_args, run_commandline  # noqa: F401

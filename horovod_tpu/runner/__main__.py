@@ -1,0 +1,3 @@
+from horovod_tpu.runner.launch import main
+
+main()

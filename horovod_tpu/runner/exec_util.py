@@ -1,0 +1,96 @@
+"""Process spawning for launcher slots: local subprocess or ssh fan-out.
+
+Mirrors the reference's executor plumbing
+(reference: horovod/runner/common/util/safe_shell_exec.py:1-270 — setsid
+process groups, SIGTERM grace then SIGKILL; gloo_run.py:226-271 ssh
+command construction and per-slot output forwarding).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+LOCAL_HOSTS = {"localhost", "127.0.0.1", "0.0.0.0"}
+
+
+def is_local(hostname: str) -> bool:
+    import socket
+
+    return (hostname in LOCAL_HOSTS or hostname == socket.gethostname()
+            or hostname == socket.getfqdn())
+
+
+class SlotProcess:
+    """One launched worker with output forwarding and group termination."""
+
+    def __init__(self, rank: int, command: List[str], env: Dict[str, str],
+                 hostname: str = "localhost", ssh_port: Optional[int] = None,
+                 prefix_output: bool = True, output_file=None):
+        self.rank = rank
+        self.hostname = hostname
+        if is_local(hostname):
+            full_cmd = command
+            proc_env = dict(os.environ)
+            proc_env.update(env)
+        else:
+            # Remote: carry env through the ssh command line
+            # (reference: gloo_run.py:79-101).
+            env_str = " ".join(
+                "%s=%s" % (k, shlex.quote(v)) for k, v in env.items())
+            ssh_args = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if ssh_port:
+                ssh_args += ["-p", str(ssh_port)]
+            remote = "cd %s && %s %s" % (
+                shlex.quote(os.getcwd()), env_str,
+                " ".join(shlex.quote(c) for c in command))
+            full_cmd = ssh_args + [hostname, remote]
+            proc_env = dict(os.environ)
+        self.proc = subprocess.Popen(
+            full_cmd, env=proc_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, start_new_session=True)
+        self._forwarder = threading.Thread(
+            target=self._forward, args=(prefix_output, output_file),
+            daemon=True)
+        self._forwarder.start()
+
+    def _forward(self, prefix_output, output_file):
+        stream = output_file or sys.stdout
+        for line in self.proc.stdout:
+            if prefix_output:
+                stream.write("[%d]<stdout>: %s" % (self.rank, line))
+            else:
+                stream.write(line)
+            stream.flush()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        rc = self.proc.wait(timeout=timeout)
+        self._forwarder.join(timeout=5)
+        return rc
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def terminate(self, grace_sec: float = 5.0):
+        """SIGTERM the process group, escalate to SIGKILL after grace."""
+        if self.proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + grace_sec
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.1)
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass

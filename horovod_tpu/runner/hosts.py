@@ -1,0 +1,112 @@
+"""Host parsing and slot assignment.
+
+Mirrors the reference's host handling
+(reference: horovod/runner/common/util/hosts.py:100-160): hosts are given
+as ``host:slots`` entries; ranks are packed host-by-host in host order,
+``local_rank`` is the slot index on the host, ``cross_rank`` is the index
+of the host among hosts that have a slot at that local_rank. Elastic mode
+reuses the same function for stable reassignment.
+
+On TPU pods a "slot" is one chip's worth of host process (the
+one-process-per-chip model from BASELINE.json's north star).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass
+class HostInfo:
+    hostname: str
+    slots: int
+
+    @classmethod
+    def from_string(cls, spec: str) -> "HostInfo":
+        spec = spec.strip()
+        if ":" in spec:
+            host, slots = spec.rsplit(":", 1)
+            return cls(host, int(slots))
+        return cls(spec, 1)
+
+
+@dataclass
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+    def to_response_string(self) -> str:
+        return ",".join(str(v) for v in (
+            self.rank, self.size, self.local_rank, self.local_size,
+            self.cross_rank, self.cross_size))
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """Parse ``h1:4,h2:4`` into HostInfo list."""
+    return [HostInfo.from_string(h) for h in hosts_string.split(",") if h.strip()]
+
+
+def parse_hostfile(path: str) -> List[HostInfo]:
+    """Hostfile format: one ``hostname slots=N`` (or ``hostname:N`` or bare
+    hostname) per line; comments with #."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "slots=" in line:
+                name, _, slots = line.partition("slots=")
+                hosts.append(HostInfo(name.strip(), int(slots.strip())))
+            else:
+                hosts.append(HostInfo.from_string(line))
+    return hosts
+
+
+def get_host_assignments(hosts: List[HostInfo], min_np: int,
+                         max_np: int = None) -> List[SlotInfo]:
+    """Assign ranks to host slots (reference:
+    horovod/runner/common/util/hosts.py:100-160).
+
+    Raises if fewer than ``min_np`` slots are available; assigns at most
+    ``max_np`` ranks.
+    """
+    total_slots = sum(h.slots for h in hosts)
+    if total_slots < min_np:
+        raise ValueError(
+            "Requested %d processes but only %d slots are available on %s"
+            % (min_np, total_slots,
+               ",".join("%s:%d" % (h.hostname, h.slots) for h in hosts)))
+    np_ = min(total_slots, max_np) if max_np else min_np
+
+    assignments: List[SlotInfo] = []
+    rank = 0
+    local_sizes: Dict[str, int] = {}
+    for h in hosts:
+        for slot in range(h.slots):
+            if rank >= np_:
+                break
+            assignments.append(SlotInfo(
+                hostname=h.hostname, rank=rank, local_rank=slot,
+                cross_rank=-1, size=np_, local_size=-1, cross_size=-1))
+            local_sizes[h.hostname] = local_sizes.get(h.hostname, 0) + 1
+            rank += 1
+
+    # cross_rank: index of this host among hosts that own this local_rank,
+    # in host order; cross_size: number of such hosts.
+    host_order = [h.hostname for h in hosts]
+    by_local_rank: Dict[int, List[str]] = {}
+    for a in assignments:
+        by_local_rank.setdefault(a.local_rank, []).append(a.hostname)
+    for a in assignments:
+        peers = sorted(set(by_local_rank[a.local_rank]), key=host_order.index)
+        a.cross_rank = peers.index(a.hostname)
+        a.cross_size = len(peers)
+        a.local_size = local_sizes[a.hostname]
+    return assignments

@@ -1,0 +1,149 @@
+"""Threaded HTTP key-value store + rendezvous server.
+
+Mirrors the reference's launcher-side KV store
+(reference: horovod/runner/http/http_server.py:112-259): GET/PUT/DELETE on
+``/scope/key`` paths, used for bootstrap rendezvous and elastic rank
+reassignment (``RendezvousServer``), and for returning run-func results
+(``KVStoreServer``).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_GET(self):
+        scope, key = self._split()
+        store = self.server.store  # type: ignore[attr-defined]
+        with self.server.lock:  # type: ignore[attr-defined]
+            value = store.get(scope, {}).get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.store.setdefault(scope, {})[key] = value  # type: ignore[attr-defined]
+        callback = getattr(self.server, "put_callback", None)
+        if callback:
+            callback(scope, key, value)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        scope, key = self._split()
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.store.get(scope, {}).pop(key, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+
+class KVStoreServer:
+    """In-process threaded HTTP KV store."""
+
+    def __init__(self, port: int = 0, put_callback=None):
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._httpd.store = {}  # type: ignore[attr-defined]
+        self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.put_callback = put_callback  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="hvd-kvstore")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+    # Direct access helpers for in-process users (the driver).
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return self._httpd.store.get(scope, {}).get(key)  # type: ignore[attr-defined]
+
+    def put(self, scope: str, key: str, value: bytes):
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.setdefault(scope, {})[key] = value  # type: ignore[attr-defined]
+
+    def scope_items(self, scope: str) -> Dict[str, bytes]:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return dict(self._httpd.store.get(scope, {}))  # type: ignore[attr-defined]
+
+
+class RendezvousServer(KVStoreServer):
+    """KV store the elastic driver publishes slot assignments through
+    (reference: horovod/runner/http/http_server.py:192-219,
+    runner/elastic/rendezvous.py:22-55): workers GET
+    ``/rendezvous/<host>:<local_rank>`` to learn their (possibly new)
+    rank/size after a reset."""
+
+    SCOPE = "rendezvous"
+
+    def publish(self, assignments):
+        """Publish SlotInfo assignments keyed by host:local_rank."""
+        for a in assignments:
+            self.put(self.SCOPE, "%s:%d" % (a.hostname, a.local_rank),
+                     a.to_response_string().encode())
+
+
+def read_kv(addr: str, port: int, scope: str, key: str,
+            timeout: float = 10.0) -> Optional[bytes]:
+    """Small HTTP client helper (workers poll rendezvous)."""
+    import http.client
+
+    conn = http.client.HTTPConnection(addr, port, timeout=timeout)
+    try:
+        conn.request("GET", "/%s/%s" % (scope, key))
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            return None
+        return data
+    finally:
+        conn.close()
+
+
+def write_kv(addr: str, port: int, scope: str, key: str, value: bytes,
+             timeout: float = 10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection(addr, port, timeout=timeout)
+    try:
+        conn.request("PUT", "/%s/%s" % (scope, key), body=value)
+        conn.getresponse().read()
+    finally:
+        conn.close()

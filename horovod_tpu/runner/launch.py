@@ -1,0 +1,207 @@
+"""hvdrun — the launcher CLI.
+
+The ``horovodrun`` equivalent (reference: horovod/runner/launch.py:242-774):
+parses hosts/np/tuning flags, maps CLI flags onto the core's environment
+knobs (reference: runner/common/util/config_parser.py set_env_from_args),
+computes slot assignments, starts the rendezvous KV server, and fans out
+one worker process per slot (local subprocess or ssh), streaming output.
+
+Usage::
+
+    python -m horovod_tpu.runner -np 4 python train.py
+    python -m horovod_tpu.runner -np 8 -H host1:4,host2:4 python train.py
+    python -m horovod_tpu.runner -np 2 --min-np 2 --max-np 4 \
+        --host-discovery-script ./discover.sh python train.py   # elastic
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner.exec_util import SlotProcess, is_local
+from horovod_tpu.runner.hosts import (
+    HostInfo, get_host_assignments, parse_hostfile, parse_hosts,
+)
+from horovod_tpu.runner.http_server import RendezvousServer
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-np", "--num-proc", type=int, dest="np",
+                   help="Total number of worker processes.")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help="Comma-separated host:slots list.")
+    p.add_argument("--hostfile", dest="hostfile",
+                   help="Hostfile path (hostname slots=N per line).")
+    p.add_argument("--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("--start-timeout", type=int, default=120)
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--output-filename", dest="output_filename",
+                   help="Redirect worker output to this file.")
+    # Elastic (reference: launch.py elastic args).
+    p.add_argument("--min-np", type=int, dest="min_np")
+    p.add_argument("--max-np", type=int, dest="max_np")
+    p.add_argument("--host-discovery-script", dest="discovery_script")
+    p.add_argument("--slots-per-host", type=int, dest="slots_per_host",
+                   help="Elastic: slots per discovered host when the "
+                        "discovery script does not specify them.")
+    p.add_argument("--reset-limit", type=int, dest="reset_limit")
+    # Core tuning knobs → env (reference: config_parser.py).
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Command to run on every slot.")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _hosts_from_args(args) -> List[HostInfo]:
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    if args.hostfile:
+        return parse_hostfile(args.hostfile)
+    np_ = args.np or 1
+    return [HostInfo("localhost", np_)]
+
+
+def _tuning_env(args) -> Dict[str, str]:
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+        if args.autotune_log_file:
+            env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    return env
+
+
+def slot_env(a, controller_addr: str, controller_port: int,
+             rendezvous_addr: str, rendezvous_port: int,
+             extra: Dict[str, str]) -> Dict[str, str]:
+    """Per-slot environment (reference: gloo_run.py:65-76)."""
+    env = {
+        "HOROVOD_RANK": str(a.rank),
+        "HOROVOD_SIZE": str(a.size),
+        "HOROVOD_LOCAL_RANK": str(a.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(a.local_size),
+        "HOROVOD_CROSS_RANK": str(a.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(a.cross_size),
+        "HOROVOD_CONTROLLER_ADDR": controller_addr,
+        "HOROVOD_CONTROLLER_PORT": str(controller_port),
+        "HOROVOD_RENDEZVOUS_ADDR": rendezvous_addr,
+        "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
+        "HOROVOD_HOSTNAME": a.hostname,
+        "PYTHONUNBUFFERED": "1",
+    }
+    pythonpath = os.pathsep.join(
+        [os.getcwd()] + ([os.environ["PYTHONPATH"]]
+                         if "PYTHONPATH" in os.environ else []))
+    env["PYTHONPATH"] = pythonpath
+    env.update(extra)
+    return env
+
+
+def _run_static(args) -> int:
+    hosts = _hosts_from_args(args)
+    np_ = args.np or sum(h.slots for h in hosts)
+    assignments = get_host_assignments(hosts, np_, np_)
+
+    rendezvous = RendezvousServer()
+    rendezvous_port = rendezvous.start()
+    rendezvous.publish(assignments)
+
+    # Rank 0's host runs the controller; workers dial it there.
+    rank0_host = assignments[0].hostname
+    controller_addr = "127.0.0.1" if is_local(rank0_host) else rank0_host
+    controller_port = free_port()
+    launcher_host = (socket.gethostname()
+                     if any(not is_local(a.hostname) for a in assignments)
+                     else "127.0.0.1")
+
+    extra = _tuning_env(args)
+    output_file = (open(args.output_filename, "w")
+                   if args.output_filename else None)
+    procs: List[SlotProcess] = []
+    try:
+        for a in assignments:
+            env = slot_env(a, controller_addr, controller_port,
+                           launcher_host, rendezvous_port, extra)
+            procs.append(SlotProcess(
+                a.rank, args.command, env, hostname=a.hostname,
+                ssh_port=args.ssh_port, output_file=output_file))
+        # Wait; first failure kills the job (reference: gloo_run.py:259-271).
+        exit_code = 0
+        pending = set(range(len(procs)))
+        while pending:
+            for i in list(pending):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                pending.discard(i)
+                if rc != 0:
+                    exit_code = rc
+                    sys.stderr.write(
+                        "hvdrun: rank %d exited with code %d; terminating "
+                        "remaining workers\n" % (procs[i].rank, rc))
+                    for j in pending:
+                        procs[j].terminate()
+                    pending.clear()
+                    break
+            time.sleep(0.1)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.terminate()
+        return exit_code
+    finally:
+        if output_file:
+            output_file.close()
+        rendezvous.stop()
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.discovery_script or args.min_np or args.max_np:
+        from horovod_tpu.runner.elastic_run import run_elastic
+
+        return run_elastic(args)
+    return _run_static(args)
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

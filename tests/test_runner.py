@@ -1,0 +1,119 @@
+"""Launcher tests: CLI parsing, slot assignment, end-to-end local run.
+
+Mirrors the reference's test/single/test_run.py (CLI + assignment logic)
+and test/integration/test_static_run.py (real launcher end-to-end).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import (
+    HostInfo, get_host_assignments, parse_hosts, parse_args,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_hosts():
+    hosts = parse_hosts("a:4,b:2,c")
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("a", 4), ("b", 2), ("c", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("# comment\nhostA slots=4\nhostB:2\nhostC\n")
+    from horovod_tpu.runner import parse_hostfile
+
+    hosts = parse_hostfile(str(f))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("hostA", 4), ("hostB", 2), ("hostC", 1)]
+
+
+def test_host_assignments_single_host():
+    a = get_host_assignments([HostInfo("localhost", 4)], 4)
+    assert [x.rank for x in a] == [0, 1, 2, 3]
+    assert [x.local_rank for x in a] == [0, 1, 2, 3]
+    assert all(x.local_size == 4 and x.cross_size == 1 and x.cross_rank == 0
+               for x in a)
+
+
+def test_host_assignments_multi_host():
+    # Reference semantics (hosts.py:100-160): ranks packed host-by-host,
+    # cross_rank indexes hosts sharing a local_rank.
+    a = get_host_assignments([HostInfo("h1", 2), HostInfo("h2", 2)], 4)
+    assert [(x.hostname, x.rank, x.local_rank, x.cross_rank) for x in a] == [
+        ("h1", 0, 0, 0), ("h1", 1, 1, 0), ("h2", 2, 0, 1), ("h2", 3, 1, 1)]
+    assert all(x.local_size == 2 and x.cross_size == 2 for x in a)
+
+
+def test_host_assignments_uneven():
+    a = get_host_assignments([HostInfo("h1", 1), HostInfo("h2", 2)], 3)
+    assert [(x.hostname, x.local_rank, x.cross_rank, x.cross_size)
+            for x in a] == [
+        ("h1", 0, 0, 2), ("h2", 0, 1, 2), ("h2", 1, 0, 1)]
+
+
+def test_host_assignments_insufficient_slots():
+    with pytest.raises(ValueError):
+        get_host_assignments([HostInfo("h1", 2)], 4)
+
+
+def test_parse_args_tuning():
+    args = parse_args(["-np", "2", "--fusion-threshold-mb", "32",
+                       "--cycle-time-ms", "2.5", "python", "x.py"])
+    assert args.np == 2
+    assert args.command == ["python", "x.py"]
+    from horovod_tpu.runner.launch import _tuning_env
+
+    env = _tuning_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+
+
+def test_parse_args_requires_command(capsys):
+    with pytest.raises(SystemExit):
+        parse_args(["-np", "2"])
+
+
+def test_end_to_end_local_np2(tmp_path):
+    """Drive the real launcher: np=2 allreduce over the native core."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        out = hvd.allreduce(np.full(4, float(hvd.rank() + 1), np.float32),
+                            name="e2e", op=hvd.Sum)
+        np.testing.assert_allclose(out, 3.0)
+        print("E2E_OK rank=%d size=%d" % (hvd.rank(), hvd.size()))
+        hvd.shutdown()
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, str(script)],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "E2E_OK rank=0 size=2" in proc.stdout
+    assert "E2E_OK rank=1 size=2" in proc.stdout
+
+
+def test_end_to_end_failure_propagates(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.exit(3 if os.environ['HOROVOD_RANK'] == '1' else 0)\n")
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, str(script)],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 3

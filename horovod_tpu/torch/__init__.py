@@ -1,0 +1,31 @@
+"""PyTorch binding: ``import horovod_tpu.torch as hvd`` mirrors the
+reference's ``horovod.torch`` surface (reference: horovod/torch/__init__.py)."""
+
+from horovod_tpu.common import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt, ProcessSet,
+    add_process_set, global_process_set, remove_process_set,
+)
+from horovod_tpu.common.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_homogeneous, is_initialized,
+    local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
+    shutdown, size, start_timeline, stop_timeline, tpu_built,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, Sum,
+    allgather, allgather_async,
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    alltoall, alltoall_async,
+    barrier,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    grouped_allreduce, grouped_allreduce_async,
+    join, poll, reducescatter, synchronize,
+)
+from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401

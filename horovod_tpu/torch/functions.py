@@ -1,0 +1,130 @@
+"""Torch state synchronization helpers
+(reference: horovod/torch/functions.py:29-266)."""
+
+from __future__ import annotations
+
+import collections
+import io
+import pickle
+from typing import Any, List
+
+import numpy as np
+import torch
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.process_sets import global_process_set
+from horovod_tpu.torch import mpi_ops
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=global_process_set):
+    """In-place broadcast of a ``state_dict()`` or list of
+    ``named_parameters`` (reference: functions.py:29-72)."""
+    if isinstance(params, dict):
+        named = sorted(params.items())
+    elif isinstance(params, collections.abc.Iterable):
+        named = list(params)
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    handles = []
+    for name, p in named:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        handles.append(mpi_ops.broadcast_async_(
+            p.data, root_rank, name="broadcast_parameters.%s" % name,
+            process_set=process_set))
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0,
+                              process_set=global_process_set):
+    """Broadcast optimizer state from root (reference: functions.py:118-187):
+    non-tensor hyperparameters travel pickled; tensor state broadcasts
+    in place."""
+    if basics.size() == 1 and process_set is global_process_set:
+        return
+    state = optimizer.state_dict()
+    # Hyperparameters + structure from root.
+    meta = {k: v for k, v in state.items() if k != "state"}
+    tensor_meta = []
+    scalars = {}
+    for pid, pstate in state.get("state", {}).items():
+        for key, value in pstate.items():
+            if isinstance(value, torch.Tensor):
+                tensor_meta.append((pid, key, tuple(value.shape),
+                                    str(value.dtype)))
+            else:
+                scalars[(pid, key)] = value
+    payload = broadcast_object((meta, tensor_meta, scalars), root_rank,
+                               name="broadcast_optimizer_state.meta",
+                               process_set=process_set)
+    meta, tensor_meta, scalars = payload
+    if basics.rank() != root_rank:
+        new_state = dict(state)
+        new_state.update(meta)
+        st = new_state.setdefault("state", {})
+        for pid, key, shape, dtype in tensor_meta:
+            dt = getattr(torch, dtype.replace("torch.", ""))
+            st.setdefault(pid, {})[key] = torch.zeros(shape, dtype=dt)
+        for (pid, key), value in scalars.items():
+            st.setdefault(pid, {})[key] = value
+        optimizer.load_state_dict(new_state)
+    # Broadcast tensor state in place.
+    handles = []
+    for pid, key, _, _ in tensor_meta:
+        t = optimizer.state_dict()["state"][pid][key]
+        handles.append(mpi_ops.broadcast_async_(
+            t, root_rank,
+            name="broadcast_optimizer_state.%s.%s" % (pid, key),
+            process_set=process_set))
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = None,
+                     process_set=global_process_set) -> Any:
+    """(reference: functions.py:190-232)"""
+    basics._check_initialized()
+    if basics.size() == 1 and process_set is global_process_set:
+        return obj
+    name = name or "broadcast_object"
+    if basics.rank() == root_rank:
+        b = io.BytesIO()
+        pickle.dump(obj, b)
+        payload = torch.from_numpy(
+            np.frombuffer(b.getvalue(), dtype=np.uint8).copy())
+        sz = torch.tensor([payload.numel()], dtype=torch.long)
+    else:
+        payload = None
+        sz = torch.zeros(1, dtype=torch.long)
+    sz = mpi_ops.broadcast(sz, root_rank, name=name + ".sz",
+                           process_set=process_set)
+    if payload is None:
+        payload = torch.zeros(int(sz[0]), dtype=torch.uint8)
+    payload = mpi_ops.broadcast(payload, root_rank, name=name + ".data",
+                                process_set=process_set)
+    return pickle.loads(payload.numpy().tobytes())
+
+
+def allgather_object(obj: Any, name: str = None,
+                     process_set=global_process_set) -> List[Any]:
+    """(reference: functions.py:235-266)"""
+    basics._check_initialized()
+    if basics.size() == 1 and process_set is global_process_set:
+        return [obj]
+    name = name or "allgather_object"
+    b = io.BytesIO()
+    pickle.dump(obj, b)
+    payload = torch.from_numpy(
+        np.frombuffer(b.getvalue(), dtype=np.uint8).copy())
+    sizes = mpi_ops.allgather(
+        torch.tensor([payload.numel()], dtype=torch.long),
+        name=name + ".sz", process_set=process_set)
+    data = mpi_ops.allgather(payload, name=name + ".data",
+                             process_set=process_set)
+    out, off = [], 0
+    for s in sizes.tolist():
+        out.append(pickle.loads(data[off:off + s].numpy().tobytes()))
+        off += s
+    return out

@@ -1,0 +1,255 @@
+"""Collective ops on torch tensors.
+
+Parity with the reference's torch op surface
+(reference: horovod/torch/mpi_ops.py:98-266 allreduce family, :518-660
+allgather/broadcast, :700-860 alltoall, :865-901 synchronize/poll/join),
+bridged through the shared eager/native path. CPU torch tensors convert
+losslessly to numpy; autograd is provided via torch.autograd.Function
+with the reference's backward rules (gradient of an allreduce is an
+allreduce; gradient of broadcast reduces to the root).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import torch
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+from horovod_tpu.ops import collective_ops as C
+from horovod_tpu.ops import eager
+
+Average = C.Average
+Sum = C.Sum
+Adasum = C.Adasum
+Min = C.Min
+Max = C.Max
+Product = C.Product
+
+
+def _to_numpy(t: torch.Tensor) -> np.ndarray:
+    t = t.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        # numpy has no native bfloat16; reinterpret through ml_dtypes so
+        # the native core reduces true bf16 on the wire.
+        import ml_dtypes
+
+        return t.view(torch.int16).contiguous().numpy().view(
+            ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _to_torch(a, like: torch.Tensor) -> torch.Tensor:
+    a = np.ascontiguousarray(a)
+    if str(a.dtype) == "bfloat16":
+        return torch.from_numpy(a.view(np.int16)).view(torch.bfloat16).to(
+            like.dtype)
+    return torch.from_numpy(a).to(like.dtype)
+
+
+# --- handle-based async API -------------------------------------------------
+
+class _TorchHandle:
+    __slots__ = ("inner", "template", "inplace_target")
+
+    def __init__(self, inner, template, inplace_target=None):
+        self.inner = inner
+        self.template = template
+        self.inplace_target = inplace_target
+
+
+_handles = {}
+_next_handle = iter(range(1, 1 << 62))
+
+
+def _register(h: _TorchHandle) -> int:
+    hid = next(_next_handle)
+    _handles[hid] = h
+    return hid
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Wait for an async op; returns the output tensor
+    (reference: horovod/torch/mpi_ops.py:865-886)."""
+    h = _handles.pop(handle, None)
+    if h is None:
+        raise ValueError("Unknown handle %r" % handle)
+    result = eager.synchronize(h.inner)
+    if isinstance(result, tuple):  # alltoall
+        out = _to_torch(result[0], h.template)
+        splits = torch.from_numpy(np.asarray(result[1]).astype(np.int64))
+        return out, splits
+    out = _to_torch(result, h.template)
+    if h.inplace_target is not None:
+        h.inplace_target.copy_(out)
+        return h.inplace_target
+    return out
+
+
+def poll(handle: int) -> bool:
+    h = _handles.get(handle)
+    if h is None:
+        raise ValueError("Unknown handle %r" % handle)
+    return eager.poll(h.inner)
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=global_process_set) -> int:
+    inner = eager.allreduce_async(
+        _to_numpy(tensor), name=name, op=op, average=average,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+    return _register(_TorchHandle(inner, tensor))
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=global_process_set) -> int:
+    inner = eager.allreduce_async(
+        _to_numpy(tensor), name=name, op=op, average=average,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+    return _register(_TorchHandle(inner, tensor, inplace_target=tensor))
+
+
+class _AllreduceFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name, op, prescale, postscale, process_set):
+        ctx.op = op
+        ctx.prescale = prescale
+        ctx.postscale = postscale
+        ctx.process_set = process_set
+        return synchronize(allreduce_async(
+            tensor, name=name, op=op, prescale_factor=prescale,
+            postscale_factor=postscale, process_set=process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Gradient of allreduce is allreduce with the same op
+        # (reference: horovod/torch/mpi_ops.py:176-194).
+        g = synchronize(allreduce_async(
+            grad_output, op=ctx.op, prescale_factor=ctx.prescale,
+            postscale_factor=ctx.postscale, process_set=ctx.process_set))
+        return g, None, None, None, None, None
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set) -> torch.Tensor:
+    op = eager._effective_op(op, average)
+    if tensor.requires_grad:
+        return _AllreduceFunction.apply(tensor, name, op, prescale_factor,
+                                        postscale_factor, process_set)
+    return synchronize(allreduce_async(
+        tensor, name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set))
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=global_process_set) -> torch.Tensor:
+    return synchronize(allreduce_async_(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,
+                            name=None, op=None,
+                            process_set=global_process_set) -> int:
+    op = eager._effective_op(op, average)
+    inner = eager.grouped_allreduce_async(
+        [_to_numpy(t) for t in tensors], name=name, op=op,
+        process_set=process_set)
+    return _register(_TorchHandle(inner, tensors))
+
+
+def grouped_allreduce(tensors, **kwargs):
+    hid = grouped_allreduce_async(tensors, **kwargs)
+    h = _handles.pop(hid)
+    results = eager.synchronize(h.inner)
+    return [_to_torch(r, t) for r, t in zip(results, h.template)]
+
+
+def allgather_async(tensor, name=None,
+                    process_set=global_process_set) -> int:
+    inner = eager.allgather_async(_to_numpy(tensor), name=name,
+                                  process_set=process_set)
+    return _register(_TorchHandle(inner, tensor))
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    return synchronize(allgather_async(tensor, name=name,
+                                       process_set=process_set))
+
+
+def broadcast_async(tensor, root_rank, name=None,
+                    process_set=global_process_set) -> int:
+    inner = eager.broadcast_async(_to_numpy(tensor), root_rank, name=name,
+                                  process_set=process_set)
+    return _register(_TorchHandle(inner, tensor))
+
+
+def broadcast_async_(tensor, root_rank, name=None,
+                     process_set=global_process_set) -> int:
+    inner = eager.broadcast_async(_to_numpy(tensor), root_rank, name=name,
+                                  process_set=process_set)
+    return _register(_TorchHandle(inner, tensor, inplace_target=tensor))
+
+
+def broadcast(tensor, root_rank, name=None,
+              process_set=global_process_set):
+    return synchronize(broadcast_async(tensor, root_rank, name=name,
+                                       process_set=process_set))
+
+
+def broadcast_(tensor, root_rank, name=None,
+               process_set=global_process_set):
+    return synchronize(broadcast_async_(tensor, root_rank, name=name,
+                                        process_set=process_set))
+
+
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=global_process_set) -> int:
+    np_splits = None if splits is None else _to_numpy(torch.as_tensor(splits))
+    inner = eager.alltoall_async(_to_numpy(tensor), np_splits, name=name,
+                                 process_set=process_set)
+    return _register(_TorchHandle(inner, tensor))
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    """Returns (tensor, received_splits)."""
+    return synchronize(alltoall_async(tensor, splits, name=name,
+                                      process_set=process_set))
+
+
+def reducescatter(tensor, op=Sum, name=None,
+                  process_set=global_process_set):
+    inner = eager.reducescatter_async(_to_numpy(tensor), name=name, op=op,
+                                      process_set=process_set)
+    return synchronize(_register(_TorchHandle(inner, tensor)))
+
+
+def barrier(process_set=global_process_set):
+    eager.barrier(process_set)
+
+
+def join() -> int:
+    """(reference: horovod/torch/mpi_ops.py:888)"""
+    return eager.join()
+
+
+# Re-export shared lifecycle for `import horovod_tpu.torch as hvd` usage.
+init = basics.init
+shutdown = basics.shutdown
+rank = basics.rank
+size = basics.size
+local_rank = basics.local_rank
+local_size = basics.local_size
+cross_rank = basics.cross_rank
+cross_size = basics.cross_size
+is_initialized = basics.is_initialized

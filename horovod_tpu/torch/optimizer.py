@@ -1,0 +1,139 @@
+"""Distributed optimizer for PyTorch.
+
+Reproduces the reference's grad-hook machinery
+(reference: horovod/torch/optimizer.py:35-332 _DistributedOptimizer:
+per-parameter hooks fire an async named allreduce as gradients
+accumulate; step() synchronizes all handles before applying; supports
+backward_passes_per_step local aggregation and a skip_synchronize
+context).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import torch
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.process_sets import global_process_set
+from horovod_tpu.torch import mpi_ops
+from horovod_tpu.torch.compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters, compression, op,
+                 gradient_predivide_factor, backward_passes_per_step,
+                 process_set):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self.backward_passes_per_step = backward_passes_per_step
+        self._gradient_predivide_factor = gradient_predivide_factor
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                ("allreduce.noname.%s.%s" % (i, j), v)
+                for i, pg in enumerate(self.param_groups)
+                for j, v in enumerate(pg["params"])]
+        # Names must agree across ranks (dict order is deterministic).
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        self._passes_done = {}
+        if basics.size() > 1 or process_set is not global_process_set:
+            self._register_hooks()
+
+    def _register_hooks(self):
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._passes_done[p] = 0
+                    p.register_post_accumulate_grad_hook(self._make_hook(p))
+
+    def _make_hook(self, p):
+        def hook(param):
+            self._passes_done[p] += 1
+            if self._passes_done[p] == self.backward_passes_per_step:
+                handle, ctx = self._allreduce_grad_async(p)
+                self._handles[p] = (handle, ctx)
+
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(p)
+        grad = p.grad
+        if self.backward_passes_per_step > 1:
+            grad = grad / self.backward_passes_per_step
+        if self._gradient_predivide_factor != 1.0:
+            prescale = 1.0 / self._gradient_predivide_factor
+        else:
+            prescale = 1.0
+        tensor_compressed, ctx = self._compression.compress(grad)
+        handle = mpi_ops.allreduce_async_(
+            tensor_compressed, name=name, op=self._op,
+            prescale_factor=prescale,
+            process_set=self._process_set)
+        return handle, (ctx, tensor_compressed, p)
+
+    def synchronize(self):
+        """Complete all outstanding gradient allreduces
+        (reference: optimizer.py:249-292)."""
+        for p in self._requires_update:
+            if p not in self._handles and self._passes_done.get(p, 0) >= \
+                    self.backward_passes_per_step:
+                # Hook may have been missed (e.g. unused param): allreduce
+                # the existing grad so ranks stay in lockstep.
+                self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, (ctx, compressed, _)) in list(self._handles.items()):
+            output = mpi_ops.synchronize(handle)
+            p.grad.copy_(self._compression.decompress(output, ctx))
+            self._passes_done[p] = 0
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """(reference: optimizer.py:294-311)"""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(); "
+                "this is prohibited as it can cause a race condition "
+                "(reference: horovod/torch/optimizer.py:327-332).")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         op=mpi_ops.Average,
+                         gradient_predivide_factor=1.0,
+                         backward_passes_per_step=1,
+                         process_set=global_process_set):
+    """Wrap a torch optimizer so gradients are allreduced during backward
+    (reference: horovod/torch/optimizer.py:528-590)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression, op,
+               gradient_predivide_factor, backward_passes_per_step,
+               process_set)

@@ -1,0 +1,119 @@
+"""Synchronized BatchNorm for torch over horovod_tpu collectives.
+
+Faithful to the reference algorithm
+(reference: horovod/torch/sync_batch_norm.py:110-163): forward allgathers
+per-rank [count, mean, var-sum] and computes global moments; backward
+allreduces sum_dy / sum_dy_xmu so weight/bias/input grads match
+training on the combined batch.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.process_sets import global_process_set
+from horovod_tpu.torch import mpi_ops
+
+
+class _SyncBatchNormFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input_, weight, bias, running_mean, running_var,
+                eps, momentum, process_set):
+        input_ = input_.contiguous()
+        size = process_set.size()
+
+        reduce_dims = [0] + list(range(2, input_.dim()))
+        count = torch.tensor(
+            [float(input_.numel() / input_.shape[1])])
+        mean = input_.mean(dim=reduce_dims)
+        var = input_.var(dim=reduce_dims, unbiased=False)
+
+        # Gather per-rank statistics (one row per rank).
+        packed = torch.cat([count, mean, var * count])
+        gathered = mpi_ops.allgather(
+            packed.unsqueeze(0), name="sync_batch_norm.stats",
+            process_set=process_set)
+        counts = gathered[:, 0:1]
+        means = gathered[:, 1:1 + mean.numel()]
+        m2s = gathered[:, 1 + mean.numel():]
+
+        total = counts.sum()
+        global_mean = (means * counts).sum(0) / total
+        # Combine within-rank M2 with between-rank mean shift.
+        global_var = (m2s.sum(0) +
+                      (counts * (means - global_mean).pow(2)).sum(0)) / total
+        invstd = 1.0 / torch.sqrt(global_var + eps)
+
+        if running_mean is not None:
+            with torch.no_grad():
+                unbiased = global_var * (total / (total - 1.0)) \
+                    if total > 1 else global_var
+                running_mean.mul_(1 - momentum).add_(momentum * global_mean)
+                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+
+        shape = [1, -1] + [1] * (input_.dim() - 2)
+        normalized = (input_ - global_mean.view(shape)) * invstd.view(shape)
+        out = normalized * weight.view(shape) + bias.view(shape)
+        ctx.save_for_backward(input_, weight, global_mean, invstd, total)
+        ctx.process_set = process_set
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        input_, weight, mean, invstd, total = ctx.saved_tensors
+        process_set = ctx.process_set
+        shape = [1, -1] + [1] * (input_.dim() - 2)
+        reduce_dims = [0] + list(range(2, input_.dim()))
+
+        x_hat = (input_ - mean.view(shape)) * invstd.view(shape)
+        grad_weight = (grad_output * x_hat).sum(reduce_dims)
+        grad_bias = grad_output.sum(reduce_dims)
+
+        # Cross-rank reduction of the two moment terms
+        # (reference: sync_batch_norm.py backward allreduce of
+        # sum_dy / sum_dy_xmu).
+        sum_dy = grad_output.sum(reduce_dims)
+        sum_dy_xmu = (grad_output * x_hat).sum(reduce_dims)
+        packed = torch.stack([sum_dy, sum_dy_xmu])
+        packed = mpi_ops.allreduce(packed, op=mpi_ops.Sum,
+                                   name="sync_batch_norm.back",
+                                   process_set=process_set)
+        sum_dy, sum_dy_xmu = packed[0], packed[1]
+
+        gw = weight.view(shape) * invstd.view(shape)
+        grad_input = gw * (
+            grad_output - (sum_dy / total).view(shape)
+            - x_hat * (sum_dy_xmu / total).view(shape))
+        return grad_input, grad_weight, grad_bias, None, None, None, None, \
+            None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm synchronizing statistics across ranks
+    (reference: horovod/torch/sync_batch_norm.py:30-108)."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True, process_set=global_process_set):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        self.process_set = process_set
+
+    def _check_input_dim(self, input_):
+        if input_.dim() < 2:
+            raise ValueError("expected at least 2D input")
+
+    def forward(self, input_):
+        if (not self.training or
+                not basics.is_initialized() or
+                self.process_set.size() == 1):
+            return super().forward(input_)
+        self._check_input_dim(input_)
+        if self.momentum is None:
+            momentum = 0.0
+        else:
+            momentum = self.momentum
+        return _SyncBatchNormFunction.apply(
+            input_, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, momentum, self.process_set)

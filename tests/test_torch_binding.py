@@ -1,0 +1,152 @@
+"""Torch binding tests: single-process semantics + SyncBatchNorm math.
+
+Multi-process torch behavior is covered by tests/torch_worker.py through
+the launcher (see test_torch_multiproc).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvd
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+
+
+def test_allreduce_size1():
+    x = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = hvd.allreduce(x, name="t")
+    assert torch.allclose(out, x)
+    out = hvd.allreduce(x, name="t2", op=hvd.Sum, prescale_factor=2.0)
+    assert torch.allclose(out, 2 * x)
+
+
+def test_allreduce_inplace_and_async():
+    x = torch.ones(4)
+    h = hvd.allreduce_async_(x, name="ip", op=hvd.Sum)
+    out = hvd.synchronize(h)
+    assert out is x
+    assert torch.allclose(x, torch.ones(4))
+
+
+def test_allreduce_autograd():
+    x = torch.ones(3, requires_grad=True)
+    y = hvd.allreduce(x, name="ag", op=hvd.Sum)
+    y.sum().backward()
+    assert torch.allclose(x.grad, torch.ones(3))
+
+
+def test_grouped_and_other_ops():
+    xs = [torch.ones(2), torch.full((3,), 2.0)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="g")
+    assert torch.allclose(outs[0], xs[0]) and torch.allclose(outs[1], xs[1])
+    t = torch.arange(4, dtype=torch.int64)
+    assert torch.equal(hvd.allgather(t, name="ga"), t)
+    assert torch.equal(hvd.broadcast(t, 0, name="bc"), t)
+    out, splits = hvd.alltoall(t, name="a2a")
+    assert torch.equal(out, t)
+    hvd.barrier()
+    assert hvd.join() == 0
+
+
+def test_bf16_roundtrip():
+    x = torch.full((8,), 1.5, dtype=torch.bfloat16)
+    out = hvd.allreduce(x, name="bf", op=hvd.Sum)
+    assert out.dtype == torch.bfloat16
+    assert torch.allclose(out.float(), torch.full((8,), 1.5))
+
+
+def test_distributed_optimizer_size1_step():
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    x = torch.randn(8, 4)
+    loss = model(x).pow(2).mean()
+    loss.backward()
+    before = [p.detach().clone() for p in model.parameters()]
+    opt.step()
+    after = list(model.parameters())
+    assert any(not torch.allclose(b, a) for b, a in zip(before, after))
+    opt.zero_grad()
+
+
+def test_zero_grad_guard_multiproc_semantics():
+    # zero_grad between backward and step must raise once handles exist.
+    model = torch.nn.Linear(2, 1)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    opt._handles[next(iter(model.parameters()))] = (None, (None, None, None))
+    with pytest.raises(AssertionError):
+        opt.zero_grad()
+    opt._handles.clear()
+
+
+def test_broadcast_object_and_parameters_size1():
+    model = torch.nn.Linear(2, 2)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    assert hvd.broadcast_object({"a": 1}) == {"a": 1}
+    assert hvd.allgather_object(5) == [5]
+
+
+def test_sync_batch_norm_matches_batch_norm_size1():
+    torch.manual_seed(0)
+    x = torch.randn(6, 3, 4, 4)
+    sbn = hvd.SyncBatchNorm(3)
+    bn = torch.nn.BatchNorm2d(3)
+    bn.load_state_dict(sbn.state_dict())
+    sbn.train()
+    bn.train()
+    # size 1 → falls back to the plain path; same result.
+    assert torch.allclose(sbn(x), bn(x), atol=1e-6)
+
+
+def test_sync_batch_norm_function_math():
+    """Exercise the synchronized path directly (process set size 1 but
+    forced through _SyncBatchNormFunction): must match BatchNorm."""
+    from horovod_tpu.torch.sync_batch_norm import _SyncBatchNormFunction
+
+    torch.manual_seed(1)
+    x = torch.randn(5, 3, 4, requires_grad=True)
+    w = torch.ones(3, requires_grad=True)
+    b = torch.zeros(3, requires_grad=True)
+    rm = torch.zeros(3)
+    rv = torch.ones(3)
+    out = _SyncBatchNormFunction.apply(
+        x, w, b, rm, rv, 1e-5, 0.1, hvd.global_process_set)
+
+    x2 = x.detach().clone().requires_grad_(True)
+    bn = torch.nn.BatchNorm1d(3, eps=1e-5, momentum=0.1)
+    out2 = bn(x2)
+    assert torch.allclose(out, out2, atol=1e-5)
+
+    g = torch.randn_like(out)
+    out.backward(g)
+    out2.backward(g)
+    assert torch.allclose(x.grad, x2.grad, atol=1e-5)
+    assert torch.allclose(w.grad, bn.weight.grad, atol=1e-4)
+    assert torch.allclose(b.grad, bn.bias.grad, atol=1e-5)
+
+
+def test_torch_multiproc():
+    """np=2 torch DistributedOptimizer through the launcher: both ranks
+    converge to identical parameters equal to a mean-gradient step."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(_REPO, "tests", "torch_worker.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("TORCH_OK") == 2

@@ -1,0 +1,88 @@
+"""np=2 torch worker: DistributedOptimizer grad-hook correctness.
+
+Both ranks train one step on different data; the resulting parameters
+must (a) be identical across ranks, (b) equal a single-process SGD step
+on the mean gradient (the reference's core DistributedOptimizer
+invariant).
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    torch.manual_seed(42)  # same init everywhere
+
+    model = torch.nn.Linear(4, 2, bias=True)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    # Per-rank batch, deterministic.
+    g = torch.Generator().manual_seed(100 + r)
+    x = torch.randn(8, 4, generator=g)
+    loss = model(x).pow(2).mean()
+    loss.backward()
+    opt.step()
+
+    # Reference computation: mean gradient across both ranks' batches.
+    ref = torch.nn.Linear(4, 2, bias=True)
+    torch.manual_seed(42)
+    ref = torch.nn.Linear(4, 2, bias=True)
+    grads = []
+    for k in range(n):
+        gk = torch.Generator().manual_seed(100 + k)
+        xk = torch.randn(8, 4, generator=gk)
+        ref.zero_grad()
+        ref(xk).pow(2).mean().backward()
+        grads.append([p.grad.clone() for p in ref.parameters()])
+    mean_grads = [sum(gs) / n for gs in zip(*grads)]
+    expect = [p.detach() - 0.1 * g for p, g in
+              zip(ref.parameters(), mean_grads)]
+
+    for p, e in zip(model.parameters(), expect):
+        np.testing.assert_allclose(p.detach().numpy(), e.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    # Cross-rank identity check.
+    gathered = hvd.allgather_object(
+        [p.detach().numpy() for p in model.parameters()])
+    for other in gathered:
+        for a, b in zip(other, gathered[0]):
+            np.testing.assert_array_equal(a, b)
+
+    # SyncBatchNorm across ranks: stats must match the combined batch.
+    sbn = hvd.SyncBatchNorm(3)
+    sbn.train()
+    gg = torch.Generator().manual_seed(7 + r)
+    xb = torch.randn(4, 3, 5, generator=gg)
+    out = sbn(xb)
+    all_x = torch.cat([torch.randn(4, 3, 5,
+                                   generator=torch.Generator().manual_seed(7 + k))
+                       for k in range(n)], dim=0)
+    bn = torch.nn.BatchNorm1d(3)
+    bn.train()
+    expect_all = bn(all_x)
+    expect_mine = expect_all[r * 4:(r + 1) * 4]
+    np.testing.assert_allclose(out.detach().numpy(),
+                               expect_mine.detach().numpy(), atol=1e-5)
+
+    hvd.shutdown()
+    print("TORCH_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

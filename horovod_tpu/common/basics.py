@@ -97,6 +97,17 @@ def init(process_sets=None):
 
             _ctx.core = CoreSession.start(_ctx.topology)
         _ctx.initialized = True
+        timeline_path = os.environ.get("HOROVOD_TIMELINE")
+        if timeline_path:
+            # "{rank}" placeholder gives per-rank files on shared storage.
+            timeline_path = timeline_path.replace(
+                "{rank}", str(_ctx.topology.rank))
+            from horovod_tpu.utils.timeline import Timeline
+
+            _ctx.timeline = Timeline(
+                timeline_path,
+                mark_cycles=os.environ.get(
+                    "HOROVOD_TIMELINE_MARK_CYCLES", "") not in ("", "0"))
         if process_sets:
             from horovod_tpu.common import process_sets as ps_mod
 

@@ -96,6 +96,13 @@ class CoreSession:
         self._tags = itertools.count(1)
         self.backend = NativeBackend(self)
         self._timeline = None
+        self._autotune = None
+        if os.environ.get("HOROVOD_AUTOTUNE", "") not in ("", "0"):
+            from horovod_tpu.utils.autotune import ParameterManager
+
+            self._autotune = ParameterManager(
+                self.set_params,
+                log_file=os.environ.get("HOROVOD_AUTOTUNE_LOG") or None)
         # Keep the trampoline alive for the lib's lifetime; installed in
         # start() after hvd_core_init (the core ignores it before init).
         self._trampoline = _CALLBACK_TYPE(self._on_done)
@@ -115,8 +122,13 @@ class CoreSession:
             ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
             ctypes.c_int, ctypes.c_double, ctypes.c_double, ctypes.c_int,
-            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+            ctypes.c_longlong]
         lib.hvd_core_join.argtypes = [ctypes.c_longlong, ctypes.c_int]
+        lib.hvd_core_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvd_core_set_params.argtypes = [
+            ctypes.c_double, ctypes.c_longlong]
 
         addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
         port = int(os.environ.get("HOROVOD_CONTROLLER_PORT", "0"))
@@ -169,6 +181,10 @@ class CoreSession:
         except Exception as e:  # defensive: never throw into C
             pending.group.complete(pending.index, None, e)
             return
+        if self._autotune is not None and pending.kind == OP_ALLREDUCE:
+            import time as _time
+
+            self._autotune.record(int(out_bytes), _time.monotonic())
         pending.group.complete(pending.index, result)
 
     def _materialize(self, pending, out_ptr, out_bytes, splits_ptr, n_splits):
@@ -204,7 +220,8 @@ class CoreSession:
     # --- submission --------------------------------------------------------
 
     def submit(self, kind, name, array, *, group, index, op=1, root_rank=0,
-               prescale=1.0, postscale=1.0, ps_id=0, splits=None):
+               prescale=1.0, postscale=1.0, ps_id=0, splits=None,
+               group_id=-1):
         arr = np.ascontiguousarray(array)
         if kind in (OP_ALLREDUCE, OP_BROADCAST):
             arr = arr.copy()  # in-place target; result buffer
@@ -225,7 +242,8 @@ class CoreSession:
         rc = self._lib.hvd_core_enqueue(
             tag, kind, name.encode(), dtype_code,
             arr.ctypes.data_as(ctypes.c_void_p), shape, arr.ndim,
-            root_rank, prescale, postscale, ps_id, op, splits_c, nsplits)
+            root_rank, prescale, postscale, ps_id, op, splits_c, nsplits,
+            group_id)
         if rc != 0:
             with self._lock:
                 self._pending.pop(tag, None)
@@ -245,6 +263,22 @@ class CoreSession:
         fut = Future()
         _chain_first(group.future, fut)
         return fut
+
+    def counters(self) -> Dict[str, int]:
+        """Core observability counters (responses, cache hits, fusion,
+        bytes)."""
+        buf = (ctypes.c_longlong * 5)()
+        self._lib.hvd_core_counters(buf, 5)
+        return {
+            "responses": buf[0],
+            "cached_responses": buf[1],
+            "fused_tensors": buf[2],
+            "allreduced_tensors": buf[3],
+            "allreduce_bytes": buf[4],
+        }
+
+    def set_params(self, cycle_ms: float = -1.0, fusion_bytes: int = -1):
+        self._lib.hvd_core_set_params(cycle_ms, fusion_bytes)
 
     def add_process_set(self, ps_id: int, ranks: Sequence[int]):
         """Collective: all ranks must call in the same order."""
@@ -291,10 +325,18 @@ class NativeBackend:
                         process_set) -> Future:
         group = _Group(len(arrays))
         ps_id = self._ps_id(process_set)
+        # Explicit groups co-schedule all-or-nothing through the core's
+        # group table; the id is derived from the (rank-agreed) names.
+        group_id = -1
+        if len(arrays) > 1:
+            import zlib
+
+            group_id = zlib.crc32("|".join(names).encode())
         for i, (a, name) in enumerate(zip(arrays, names)):
             self._s.submit(OP_ALLREDUCE, name, np.asarray(a), group=group,
                            index=i, op=op, prescale=prescale,
-                           postscale=postscale, ps_id=ps_id)
+                           postscale=postscale, ps_id=ps_id,
+                           group_id=group_id)
         return group.future
 
     def allgather_async(self, arrays, names, process_set) -> Future:

@@ -145,6 +145,9 @@ struct Request {
   double prescale = 1.0;
   double postscale = 1.0;
   std::vector<int64_t> splits;  // alltoall send splits (may be empty)
+  // Explicit co-scheduling group: members become ready all-or-nothing
+  // (reference: GroupTable, horovod/common/group_table.h:30-59). -1 = none.
+  int64_t group_id = -1;
 
   void SerializeTo(std::string* out) const;
   static Request Parse(const char* data, size_t len, size_t* consumed);
@@ -193,6 +196,7 @@ struct TensorTableEntry {
   double prescale = 1.0;
   double postscale = 1.0;
   std::vector<int64_t> splits;
+  int64_t group_id = -1;
   int32_t process_set_id = 0;
   DoneCallback callback;
 };

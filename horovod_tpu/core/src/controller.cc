@@ -329,8 +329,10 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
 }
 
 Status Controller::ComputeResponseList(ProcessSetState& ps,
-                                       std::vector<Response>* out) {
+                                       std::vector<Response>* out,
+                                       size_t* n_cached) {
   out->clear();
+  if (n_cached) *n_cached = 0;
   const int me = comm_.rank();
   const int root = ps.coordinator();
   const bool coord = ps.is_coordinator(me);
@@ -392,6 +394,7 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
   for (size_t pos : agreed)
     cached_responses.push_back(ps.cache.GetByPosition(pos));
   FuseResponses(&cached_responses);
+  if (n_cached) *n_cached = cached_responses.size();
   for (auto& r : cached_responses) out->push_back(std::move(r));
 
   // 4. Slow path: negotiate uncached tensors through the coordinator.
@@ -420,8 +423,33 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
             ps.last_join_rank = req.request_rank;
             continue;
           }
-          if (IncrementTensorCount(ps, req))
-            ps.ready_order.push_back(req.tensor_name);
+          if (req.group_id >= 0) {
+            ps.group_members[req.group_id].insert(req.tensor_name);
+            ps.group_of[req.tensor_name] = req.group_id;
+          }
+          if (IncrementTensorCount(ps, req)) {
+            auto git = ps.group_of.find(req.tensor_name);
+            if (git == ps.group_of.end()) {
+              ps.ready_order.push_back(req.tensor_name);
+            } else {
+              // All-or-nothing groups: emit members contiguously only
+              // once the whole group is ready.
+              int64_t gid = git->second;
+              ps.ready_names.insert(req.tensor_name);
+              std::set<std::string> members = ps.group_members[gid];
+              bool all_ready = true;
+              for (auto& m : members)
+                if (!ps.ready_names.count(m)) all_ready = false;
+              if (all_ready) {
+                for (auto& m : members) {
+                  ps.ready_order.push_back(m);
+                  ps.ready_names.erase(m);
+                  ps.group_of.erase(m);
+                }
+                ps.group_members.erase(gid);
+              }
+            }
+          }
         }
       }
       // Joined ranks count implicitly: re-check previously-pending names.
@@ -458,10 +486,16 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
         ps.joined_ranks.clear();
         ps.last_join_rank = -1;
       }
+      // Adopt any staged fusion threshold before fusing, and ship the
+      // active value with the broadcast so all ranks stay in lockstep.
+      int64_t staged = pending_fusion_.exchange(0);
+      if (staged > 0) fusion_threshold_ = staged;
       FuseResponses(&negotiated);
       std::set<int> mem_set(ps.members.begin(), ps.members.end());
       ps.stall.Check(mem_set);
       std::string resp_blob;
+      int64_t ft = fusion_threshold_;
+      resp_blob.append(reinterpret_cast<const char*>(&ft), sizeof(ft));
       SerializeResponseList(negotiated, &resp_blob);
       s = comm_.Bcast(&resp_blob, root, ps.members);
       if (!s.ok()) return s;
@@ -471,7 +505,13 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
       std::string resp_blob;
       s = comm_.Bcast(&resp_blob, root, ps.members);
       if (!s.ok()) return s;
-      negotiated = ParseResponseList(resp_blob.data(), resp_blob.size());
+      if (resp_blob.size() < sizeof(int64_t))
+        return Status::Error("short response blob");
+      int64_t ft;
+      memcpy(&ft, resp_blob.data(), sizeof(ft));
+      fusion_threshold_ = ft;
+      negotiated = ParseResponseList(resp_blob.data() + sizeof(ft),
+                                     resp_blob.size() - sizeof(ft));
     }
     for (auto& r : negotiated) out->push_back(std::move(r));
   }

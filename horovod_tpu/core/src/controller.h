@@ -113,6 +113,12 @@ struct ProcessSetState {
   std::unordered_map<std::string, std::set<int>> message_table;
   std::unordered_map<std::string, std::vector<Request>> requests_by_name;
   std::deque<std::string> ready_order;
+  // Group table: all-or-nothing co-scheduling (reference:
+  // horovod/common/group_table.h:30-59). group id -> member names;
+  // a member only enters ready_order once every member is ready.
+  std::unordered_map<int64_t, std::set<std::string>> group_members;
+  std::unordered_map<std::string, int64_t> group_of;
+  std::set<std::string> ready_names;  // full count, awaiting group
 
   // Join state.
   bool joined_locally = false;
@@ -136,11 +142,18 @@ class Controller {
       : comm_(comm), fusion_threshold_(fusion_bytes) {}
 
   // One negotiation round for one process set. Returns the ordered list
-  // of responses every member must execute this cycle.
-  Status ComputeResponseList(ProcessSetState& ps,
-                             std::vector<Response>* out);
+  // of responses every member must execute this cycle; the first
+  // *n_cached entries came from the response-cache fast path.
+  Status ComputeResponseList(ProcessSetState& ps, std::vector<Response>* out,
+                             size_t* n_cached = nullptr);
 
-  void set_fusion_threshold(int64_t b) { fusion_threshold_ = b; }
+  // Fusion-threshold changes are *staged*: the coordinator adopts the
+  // pending value at its next slow-path round and ships it in the
+  // response broadcast, so every rank always fuses (including the cached
+  // fast path, which fuses locally) with an identical threshold.
+  // Directly mutating the threshold per-rank would diverge fused layouts
+  // and corrupt the wire protocol.
+  void stage_fusion_threshold(int64_t b) { pending_fusion_.store(b); }
   int64_t fusion_threshold() const { return fusion_threshold_; }
 
  private:
@@ -151,6 +164,7 @@ class Controller {
 
   TcpComm& comm_;
   int64_t fusion_threshold_;
+  std::atomic<int64_t> pending_fusion_{0};
 };
 
 }  // namespace hvd

@@ -119,6 +119,7 @@ void Request::SerializeTo(std::string* out) const {
   PutF64(out, prescale);
   PutF64(out, postscale);
   PutI64Vec(out, splits);
+  PutI64(out, group_id);
 }
 
 static Request ParseRequestFrom(Reader& r) {
@@ -135,6 +136,7 @@ static Request ParseRequestFrom(Reader& r) {
   req.prescale = r.F64();
   req.postscale = r.F64();
   req.splits = r.I64Vec();
+  req.group_id = r.I64();
   return req;
 }
 

@@ -46,6 +46,14 @@ struct Global {
   // table, so the erase must not happen mid-iteration.
   std::vector<int> pending_removals;
 
+  // Observability counters (reference analog: timeline + autotune
+  // byte scoring, horovod/common/parameter_manager.cc).
+  std::atomic<long long> ctr_responses{0};
+  std::atomic<long long> ctr_cached_responses{0};
+  std::atomic<long long> ctr_fused_tensors{0};
+  std::atomic<long long> ctr_allreduced_tensors{0};
+  std::atomic<long long> ctr_allreduce_bytes{0};
+
   DoneCb callback = nullptr;
 
   std::mutex init_mutex;
@@ -414,7 +422,9 @@ void BackgroundLoop() {
       // the same (id-sorted) order on the one background thread.
       if (ps->member_index(g->comm.rank()) < 0) continue;
       std::vector<Response> responses;
-      Status s = g->controller->ComputeResponseList(*ps, &responses);
+      size_t n_cached = 0;
+      Status s = g->controller->ComputeResponseList(*ps, &responses,
+                                                    &n_cached);
       if (!s.ok()) {
         HVD_LOG(LogLevel::ERROR,
                 "coordination failed: " + s.reason + "; failing pending ops");
@@ -423,7 +433,19 @@ void BackgroundLoop() {
         continue;
       }
       for (size_t i = 0; i < responses.size(); ++i) {
-        Status es = PerformOperation(*ps, responses[i], false);
+        bool from_cache = i < n_cached;
+        g->ctr_responses++;
+        if (from_cache) g->ctr_cached_responses++;
+        if (responses[i].op_type == OpType::ALLREDUCE) {
+          size_t nt = responses[i].tensor_names.size();
+          g->ctr_allreduced_tensors += (long long)nt;
+          if (nt > 1) g->ctr_fused_tensors += (long long)nt;
+          long long bytes = 0;
+          for (auto c : responses[i].tensor_sizes)
+            bytes += c * (long long)DataTypeSize(responses[i].dtype);
+          g->ctr_allreduce_bytes += bytes;
+        }
+        Status es = PerformOperation(*ps, responses[i], from_cache);
         if (!es.ok()) {
           HVD_LOG(LogLevel::ERROR, "collective failed: " + es.reason);
           g->failed.store(true);
@@ -506,7 +528,7 @@ int hvd_core_enqueue(long long tag, int op_type, const char* name, int dtype,
                      void* data, const long long* shape, int ndim,
                      int root_rank, double prescale, double postscale,
                      int ps_id, int reduce_op, const long long* splits,
-                     int nsplits) {
+                     int nsplits, long long group_id) {
   if (!g) return -1;
   ProcessSetState* ps;
   {
@@ -526,6 +548,7 @@ int hvd_core_enqueue(long long tag, int op_type, const char* name, int dtype,
   e.prescale = prescale;
   e.postscale = postscale;
   for (int i = 0; i < nsplits; ++i) e.splits.push_back(splits[i]);
+  e.group_id = group_id;
   e.process_set_id = ps_id;
   e.callback = MakeDone(tag);
 
@@ -540,6 +563,7 @@ int hvd_core_enqueue(long long tag, int op_type, const char* name, int dtype,
   req.prescale = e.prescale;
   req.postscale = e.postscale;
   req.splits = e.splits;
+  req.group_id = e.group_id;
 
   Status s = ps->queue.Add(std::move(e), req);
   if (!s.ok()) {
@@ -582,13 +606,26 @@ void hvd_core_set_params(double cycle_ms, long long fusion_bytes) {
   if (cycle_ms > 0) g->cycle_ms = cycle_ms;
   if (fusion_bytes > 0 && g->controller) {
     g->fusion_bytes = fusion_bytes;
-    g->controller->set_fusion_threshold(fusion_bytes);
+    // Staged: takes effect when the coordinator broadcasts it (keeps
+    // fusion layouts rank-identical; see controller.h).
+    g->controller->stage_fusion_threshold(fusion_bytes);
   }
 }
 
 double hvd_core_cycle_ms() { return g ? g->cycle_ms : 0.0; }
 long long hvd_core_fusion_bytes() {
   return g ? (long long)g->fusion_bytes : 0;
+}
+
+// Fills out[0..n): responses, cached_responses, fused_tensors,
+// allreduced_tensors, allreduce_bytes.
+void hvd_core_counters(long long* out, int n) {
+  if (!g || !out) return;
+  long long vals[5] = {
+      g->ctr_responses.load(), g->ctr_cached_responses.load(),
+      g->ctr_fused_tensors.load(), g->ctr_allreduced_tensors.load(),
+      g->ctr_allreduce_bytes.load()};
+  for (int i = 0; i < n && i < 5; ++i) out[i] = vals[i];
 }
 
 }  // extern "C"

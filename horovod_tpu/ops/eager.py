@@ -89,6 +89,12 @@ def _backend():
     return _LOCAL
 
 
+def _record_timeline(name: str, category: str, fut: Future):
+    tl = basics._timeline()
+    if tl is not None:
+        tl.record_future(name, category, fut)
+
+
 def _to_numpy(x) -> np.ndarray:
     if isinstance(x, np.ndarray):
         return x
@@ -189,6 +195,7 @@ def allreduce_async(tensor, *, name: Optional[str] = None, op: Optional[int] = N
                                      postscale_factor, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    _record_timeline(name, "allreduce", out)
     return _register(out)
 
 
@@ -210,6 +217,7 @@ def grouped_allreduce_async(tensors: Sequence, *, name: Optional[str] = None,
     out = Future()
     _chain(fut, out,
            lambda rs: [_like_input(r, t) for r, t in zip(rs, tensors)])
+    _record_timeline(base, "allreduce", out)
     return _register(out)
 
 
@@ -224,6 +232,7 @@ def allgather_async(tensor, *, name: Optional[str] = None,
     fut = _backend().allgather_async([tensor], [name], process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    _record_timeline(name, "allgather", out)
     return _register(out)
 
 
@@ -238,6 +247,7 @@ def broadcast_async(tensor, root_rank: int, *, name: Optional[str] = None,
     fut = _backend().broadcast_async([tensor], [name], root_rank, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    _record_timeline(name, "broadcast", out)
     return _register(out)
 
 
@@ -253,6 +263,7 @@ def alltoall_async(tensor, splits=None, *, name: Optional[str] = None,
     out = Future()
     _chain(fut, out,
            lambda r: (_like_input(r[0], tensor), r[1]))
+    _record_timeline(name, "alltoall", out)
     return _register(out)
 
 
@@ -269,6 +280,7 @@ def reducescatter_async(tensor, *, name: Optional[str] = None,
     fut = _backend().reducescatter_async([tensor], [name], op, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
+    _record_timeline(name, "reducescatter", out)
     return _register(out)
 
 

@@ -1,0 +1,74 @@
+"""Chrome-tracing timeline for collective operations.
+
+Analog of the reference's Horovod Timeline
+(reference: horovod/common/timeline.cc:496-678 — per-tensor negotiation
+and operation phases written as chrome://tracing JSON, toggled by
+``HOROVOD_TIMELINE`` or hvd.start_timeline). The eager layer records a
+span per submitted tensor from enqueue to completion; like the
+reference, the file is a JSON event array left open for streaming
+(chrome://tracing accepts an unterminated array).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    def __init__(self, file_path: str, mark_cycles: bool = False):
+        self._lock = threading.Lock()
+        self._f = open(file_path, "w")
+        self._f.write("[\n")
+        self._t0 = time.perf_counter()
+        self._mark_cycles = mark_cycles
+        self._closed = False
+        from horovod_tpu.common import basics
+
+        self._pid = basics.rank() if basics.is_initialized() else 0
+        self._write({"name": "process_name", "ph": "M", "pid": self._pid,
+                     "args": {"name": "horovod_tpu rank %d" % self._pid}})
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _write(self, event: dict):
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(json.dumps(event) + ",\n")
+            self._f.flush()
+
+    def begin(self, name: str, category: str):
+        self._write({"name": name, "cat": category, "ph": "B",
+                     "ts": self._now_us(), "pid": self._pid, "tid": category})
+
+    def end(self, name: str, category: str, args: Optional[dict] = None):
+        ev = {"name": name, "cat": category, "ph": "E",
+              "ts": self._now_us(), "pid": self._pid, "tid": category}
+        if args:
+            ev["args"] = args
+        self._write(ev)
+
+    def instant(self, name: str):
+        self._write({"name": name, "ph": "i", "ts": self._now_us(),
+                     "pid": self._pid, "s": "p"})
+
+    def record_future(self, name: str, category: str, future):
+        """Span from now until the future resolves."""
+        self.begin(name, category)
+
+        def _done(f):
+            err = f.exception()
+            self.end(name, category,
+                     args={"status": "error" if err else "ok"})
+
+        future.add_done_callback(_done)
+
+    def close(self):
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._f.close()

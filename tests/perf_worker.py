@@ -1,0 +1,64 @@
+"""np=2 worker exercising perf features: cache fast path, group fusion,
+autotune, timeline — validated through core counters."""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common import basics  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+
+    # Steady-state repetition → response-cache fast path.
+    for it in range(30):
+        out = hvd.allreduce(np.full(64, 1.0, np.float32), name="steady",
+                            op=hvd.Average)
+        np.testing.assert_allclose(out, 1.0)
+
+    # Grouped submission → fused execution.
+    for it in range(5):
+        outs = hvd.grouped_allreduce(
+            [np.full(16, float(i), np.float32) for i in range(4)],
+            name="fuse_me", op=hvd.Average)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o, float(i))
+
+    counters = basics.core_session().counters()
+    assert counters["responses"] > 0, counters
+    assert counters["cached_responses"] > 0, \
+        "cache fast path never used: %r" % counters
+    assert counters["fused_tensors"] >= 4, \
+        "grouped tensors were not fused: %r" % counters
+    assert counters["allreduce_bytes"] > 0
+
+    # Autotune must have recorded samples and kept params in bounds.
+    at = basics.core_session()._autotune
+    assert at is not None
+    fusion_mb, cycle_ms = at.current
+    assert 0 < fusion_mb <= 64 + 1e-6
+    assert 0 < cycle_ms <= 100
+
+    hvd.shutdown()
+
+    # Timeline: file must contain events for the named tensors.
+    path = os.environ["HOROVOD_TIMELINE"].replace("{rank}", str(r))
+    text = open(path).read().rstrip().rstrip(",")
+    events = json.loads(text + "]")
+    names = {e.get("name") for e in events}
+    assert "steady" in names, names
+    print("PERF_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""Timeline, autotuner, and cache/fusion observability tests.
+
+Timeline validation mirrors the reference's test_timeline.py (run a job
+with HOROVOD_TIMELINE set, validate the JSON; reference:
+test/parallel/test_timeline.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_timeline_single_process(tmp_path):
+    hvd.init()
+    path = str(tmp_path / "timeline.json")
+    hvd.start_timeline(path)
+    hvd.allreduce(np.ones(4, np.float32), name="tl.a")
+    hvd.allgather(np.ones(3, np.float32), name="tl.b")
+    hvd.stop_timeline()
+    text = open(path).read().rstrip().rstrip(",")
+    events = json.loads(text + "]")
+    names = [e.get("name") for e in events]
+    assert "tl.a" in names and "tl.b" in names
+    phases = {e["ph"] for e in events}
+    assert "B" in phases and "E" in phases
+
+
+def test_gp_regression_sane():
+    from horovod_tpu.utils.autotune import GaussianProcess
+
+    X = np.array([[0.0], [0.25], [0.5], [0.75], [1.0]])
+    y = np.sin(2 * X[:, 0])
+    gp = GaussianProcess(length_scale=0.3, noise=0.05)
+    gp.fit(X, y)
+    mu, sigma = gp.predict(np.array([[0.5]]))
+    assert abs(mu[0] - np.sin(1.0)) < 0.2
+    # Uncertainty should grow away from samples.
+    _, far_sigma = gp.predict(np.array([[3.0]]))
+    assert far_sigma[0] > sigma[0]
+
+
+def test_bayesian_optimizer_finds_peak():
+    from horovod_tpu.utils.autotune import BayesianOptimizer
+
+    def score(x):
+        return -((x[0] - 20.0) ** 2) / 100.0 - ((x[1] - 5.0) ** 2)
+
+    bo = BayesianOptimizer([(1.0, 64.0), (1.0, 25.0)], seed=7)
+    x = np.array([32.0, 12.0])
+    for _ in range(25):
+        bo.add_sample(x, score(x))
+        x = bo.suggest()
+    best = bo._denormalize(bo.X[int(np.argmax(bo.y))])
+    assert abs(best[0] - 20.0) < 15.0
+    assert abs(best[1] - 5.0) < 8.0
+
+
+def test_parameter_manager_state_machine(tmp_path):
+    from horovod_tpu.utils import autotune as at
+
+    applied = []
+    pm = at.ParameterManager(lambda c, f: applied.append((c, f)),
+                             log_file=str(tmp_path / "autotune.csv"))
+    t = 0.0
+    total = (at.WARMUP_SAMPLES + at.MAX_SAMPLES + 2) * at.STEPS_PER_SAMPLE
+    for i in range(total):
+        t += 0.01
+        pm.record(1 << 20, t)
+    assert pm.done
+    assert applied, "set_params was never called"
+    for cycle_ms, fusion_bytes in applied:
+        assert 0.5 <= cycle_ms <= 100.0
+        assert 1 << 20 <= fusion_bytes <= 65 << 20
+    log = open(str(tmp_path / "autotune.csv")).read().splitlines()
+    assert len(log) >= at.MAX_SAMPLES  # header + samples
+
+
+def test_perf_multiproc(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_TIMELINE": str(tmp_path / "tl-{rank}.json"),
+    })
+    # Per-rank timeline paths via env indirection handled in worker.
+    procs = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(_REPO, "tests", "perf_worker.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert procs.returncode == 0, procs.stdout + procs.stderr
+    assert procs.stdout.count("PERF_OK") == 2

@@ -198,6 +198,107 @@ void ScaleBuffer(void* data, int64_t count, DataType dtype, double factor) {
   }
 }
 
+namespace {
+
+bool ToDouble(const void* src, double* dst, int64_t n, DataType dt) {
+  switch (dt) {
+    case DataType::FLOAT32: {
+      const float* p = (const float*)src;
+      for (int64_t i = 0; i < n; ++i) dst[i] = p[i];
+      return true;
+    }
+    case DataType::FLOAT64:
+      memcpy(dst, src, (size_t)n * 8);
+      return true;
+    case DataType::FLOAT16: {
+      const uint16_t* p = (const uint16_t*)src;
+      for (int64_t i = 0; i < n; ++i) dst[i] = HalfToFloat(p[i]);
+      return true;
+    }
+    case DataType::BFLOAT16: {
+      const uint16_t* p = (const uint16_t*)src;
+      for (int64_t i = 0; i < n; ++i) dst[i] = Bf16ToFloat(p[i]);
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+void FromDouble(const double* src, void* dst, int64_t n, DataType dt) {
+  switch (dt) {
+    case DataType::FLOAT32: {
+      float* p = (float*)dst;
+      for (int64_t i = 0; i < n; ++i) p[i] = (float)src[i];
+      break;
+    }
+    case DataType::FLOAT64:
+      memcpy(dst, src, (size_t)n * 8);
+      break;
+    case DataType::FLOAT16: {
+      uint16_t* p = (uint16_t*)dst;
+      for (int64_t i = 0; i < n; ++i) p[i] = FloatToHalf((float)src[i]);
+      break;
+    }
+    case DataType::BFLOAT16: {
+      uint16_t* p = (uint16_t*)dst;
+      for (int64_t i = 0; i < n; ++i) p[i] = FloatToBf16((float)src[i]);
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+}  // namespace
+
+Status AdasumAllreduce(TcpComm& comm, void* data, int64_t count,
+                       DataType dtype, const std::vector<int>& members) {
+  int n = (int)members.size();
+  int idx = -1;
+  for (int i = 0; i < n; ++i)
+    if (members[(size_t)i] == comm.rank()) idx = i;
+  if (idx < 0) return Status::InvalidArgument("rank not in member list");
+
+  std::vector<double> mine((size_t)count);
+  if (!ToDouble(data, mine.data(), count, dtype))
+    return Status::InvalidArgument(
+        "Adasum requires a floating-point dtype, got " +
+        std::string(DataTypeName(dtype)));
+  if (n > 1) {
+    std::vector<double> theirs((size_t)count);
+    size_t bytes = (size_t)count * sizeof(double);
+    for (int d = 1; d < n; d <<= 1) {
+      if (idx % (2 * d) == 0) {
+        int partner = idx + d;
+        if (partner >= n) continue;  // odd carry: pass through unchanged
+        Status st = comm.RawSendRecv(-1, nullptr, 0, members[(size_t)partner],
+                                     theirs.data(), bytes);
+        if (!st.ok()) return st;
+        double dot = 0, asq = 0, bsq = 0;
+        for (int64_t i = 0; i < count; ++i) {
+          dot += mine[(size_t)i] * theirs[(size_t)i];
+          asq += mine[(size_t)i] * mine[(size_t)i];
+          bsq += theirs[(size_t)i] * theirs[(size_t)i];
+        }
+        double ca = asq > 1e-30 ? 1.0 - dot / (2.0 * asq) : 1.0;
+        double cb = bsq > 1e-30 ? 1.0 - dot / (2.0 * bsq) : 1.0;
+        for (int64_t i = 0; i < count; ++i)
+          mine[(size_t)i] = ca * mine[(size_t)i] + cb * theirs[(size_t)i];
+      } else if (idx % (2 * d) == d) {
+        Status st = comm.RawSendRecv(members[(size_t)(idx - d)], mine.data(),
+                                     bytes, -1, nullptr, 0);
+        if (!st.ok()) return st;
+        break;  // passive until the final broadcast
+      }
+    }
+    Status st = BroadcastData(comm, mine.data(), (int64_t)bytes, 0, members);
+    if (!st.ok()) return st;
+  }
+  FromDouble(mine.data(), data, count, dtype);
+  return Status::OK();
+}
+
 Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
                      ReduceOp op, const std::vector<int>& members) {
   int n = (int)members.size();

@@ -36,6 +36,14 @@ Status AlltoallvData(TcpComm& comm, const void* sendbuf,
                      const std::vector<int64_t>& recv_bytes,
                      const std::vector<int>& members);
 
+// Adasum allreduce (reference: horovod/common/ops/adasum/adasum.h:101-412
+// math; adasum_mpi.cc topology): binary merge tree over member indices
+// with pair coefficients  a' = (1 - dot/(2|a|^2)) a + (1 - dot/(2|b|^2)) b,
+// accumulated in double precision, result broadcast from members[0].
+// Float dtypes only.
+Status AdasumAllreduce(TcpComm& comm, void* data, int64_t count,
+                       DataType dtype, const std::vector<int>& members);
+
 // Elementwise dst = dst (op) src for `count` elements of `dtype`.
 void ReduceBuffer(void* dst, const void* src, int64_t count, DataType dtype,
                   ReduceOp op);

@@ -119,7 +119,26 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
   }
 
   Status st;
-  if (parts.size() == 1 && parts[0].present) {
+  if (resp.reduce_op == ReduceOp::ADASUM) {
+    // Adasum coefficients are per-tensor: run the merge tree tensor by
+    // tensor (reference: adasum.h FusedAllreduce per-layer dots).
+    for (auto& p : parts) {
+      std::vector<char> scratch;
+      void* data;
+      if (p.present) {
+        data = p.entry.data;
+      } else {
+        scratch.assign((size_t)(p.count * (int64_t)esize), 0);
+        data = scratch.data();
+      }
+      if (resp.prescale != 1.0)
+        ScaleBuffer(data, p.count, resp.dtype, resp.prescale);
+      st = AdasumAllreduce(g->comm, data, p.count, resp.dtype, ps.members);
+      if (!st.ok()) break;
+      if (resp.postscale != 1.0)
+        ScaleBuffer(data, p.count, resp.dtype, resp.postscale);
+    }
+  } else if (parts.size() == 1 && parts[0].present) {
     // Single tensor: reduce in place, no fusion copy.
     Part& p = parts[0];
     if (resp.prescale != 1.0)

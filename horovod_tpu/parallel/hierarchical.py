@@ -1,0 +1,59 @@
+"""Hierarchical (two-level) collectives: ICI intra-slice x DCN cross-slice.
+
+TPU-native rebuild of NCCLHierarchicalAllreduce
+(reference: horovod/common/ops/nccl_operations.cc:233-440 — intra-node
+ncclReduceScatter, cross-node MPI allreduce on the CROSS communicator,
+intra-node ncclAllGather; toggled by HOROVOD_HIERARCHICAL_ALLREDUCE,
+reference: horovod/common/operations.cc:514-551).
+
+On TPU the two levels are mesh axes: ``ici`` (fast intra-slice
+interconnect) and ``dcn`` (slower cross-slice links). The sequence
+reduce_scatter(ici) → allreduce(dcn) → all_gather(ici) moves only 1/ici_size
+of the bytes over the slow links — the same bandwidth argument as the
+reference's node-hierarchy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+ICI_AXIS = "data_ici"
+DCN_AXIS = "data_dcn"
+
+
+def make_hierarchical_axes(ici_size: int, dcn_size: int) -> Dict[str, int]:
+    """Axis spec for ``make_mesh``: the data dimension factored into
+    (dcn outer, ici inner) so ici neighbors are physically adjacent."""
+    return {DCN_AXIS: dcn_size, ICI_AXIS: ici_size}
+
+
+def hierarchical_allreduce(x, *, average: bool = True, ici_axis=ICI_AXIS,
+                           dcn_axis=DCN_AXIS, scatter_dim: int = 0):
+    """Two-level allreduce across ici x dcn axes.
+
+    Requires ``x.shape[scatter_dim]`` divisible by the ici axis size.
+    """
+    ici = lax.axis_size(ici_axis)
+    dcn = lax.axis_size(dcn_axis)
+    # 1. reduce-scatter across the fast axis: each chip owns 1/ici of the
+    #    intra-slice sum.
+    shard = lax.psum_scatter(x, ici_axis, scatter_dimension=scatter_dim,
+                             tiled=True)
+    # 2. cross-slice allreduce of the small shard (rides DCN).
+    shard = lax.psum(shard, dcn_axis)
+    # 3. all-gather across the fast axis to rebuild the full tensor.
+    out = lax.all_gather(shard, ici_axis, axis=scatter_dim, tiled=True)
+    if average:
+        out = out / jnp.asarray(ici * dcn, dtype=out.dtype)
+    return out
+
+
+def hierarchical_allgather(x, *, ici_axis=ICI_AXIS, dcn_axis=DCN_AXIS):
+    """Two-level allgather (reference analog: MPIHierarchicalAllgather,
+    horovod/common/ops/mpi_operations.cc): gather across ici, then across
+    dcn, preserving rank order (dcn outer, ici inner)."""
+    intra = lax.all_gather(x, ici_axis, tiled=True)
+    return lax.all_gather(intra, dcn_axis, tiled=True)

@@ -1,0 +1,41 @@
+"""np=N worker validating native CPU Adasum against the numpy reference."""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.parallel.adasum import adasum_reference  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    rngs = [np.random.RandomState(1000 + k) for k in range(n)]
+    tensors = [rng.randn(37).astype(np.float32) for rng in rngs]
+
+    out = hvd.allreduce(tensors[r], name="adasum", op=hvd.Adasum)
+    expect = adasum_reference(tensors)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    # Double precision too.
+    tensors64 = [rng.randn(16) for rng in rngs]
+    out = hvd.allreduce(tensors64[r], name="adasum64", op=hvd.Adasum)
+    np.testing.assert_allclose(out, adasum_reference(tensors64),
+                               rtol=1e-10, atol=1e-12)
+
+    # Int dtype must produce a clean error.
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    hvd.shutdown()
+    print("ADASUM_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

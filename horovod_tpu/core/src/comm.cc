@@ -34,6 +34,13 @@ void SetSockOpts(int fd) {
 
 TcpComm::~TcpComm() { Close(); }
 
+void TcpComm::Abort() {
+  for (auto fd : fds_) {
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+}
+
 void TcpComm::Close() {
   for (auto& fd : fds_) {
     if (fd >= 0) {

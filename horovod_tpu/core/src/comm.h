@@ -27,6 +27,10 @@ class TcpComm {
   // Establish the mesh. Returns non-OK on timeout/refusal.
   Status Init(int rank, int size, const std::string& controller_addr,
               int controller_port, double timeout_sec = 60.0);
+  // Unblock any thread stuck in send/recv (shutdown(2) on every socket,
+  // fds stay valid) — call before joining the background thread during
+  // teardown; a blocked peer exchange then fails with "peer closed".
+  void Abort();
   void Close();
 
   int rank() const { return rank_; }

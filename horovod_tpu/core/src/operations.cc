@@ -448,8 +448,15 @@ void BackgroundLoop() {
         HVD_LOG(LogLevel::ERROR,
                 "coordination failed: " + s.reason + "; failing pending ops");
         g->failed.store(true);
-        ps->queue.AbortAll(s);
-        continue;
+        // Cascade: break every connection so peers blocked in this
+        // cycle's gather/bcast fail immediately instead of hanging
+        // (the role NCCL's async-error abort plays in the reference,
+        // nccl_operations.cc:109-122). Elastic recovery restarts the
+        // whole communicator anyway.
+        g->comm.Abort();
+        for (auto* other : sets)
+          other->queue.AbortAll(s);
+        break;
       }
       for (size_t i = 0; i < responses.size(); ++i) {
         bool from_cache = i < n_cached;
@@ -531,6 +538,9 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
 void hvd_core_shutdown() {
   if (!g) return;
   g->shut_down.store(true);
+  // Unblock the background thread if it is parked in a socket op (e.g. a
+  // peer died mid-negotiation) so the join below cannot deadlock.
+  g->comm.Abort();
   if (g->background.joinable()) g->background.join();
   g->comm.Close();
   delete g;

@@ -1,0 +1,26 @@
+"""Elastic (fault-tolerant, auto-scaling) training.
+
+Usage (reference parity: horovod/common/elastic.py, hvd.elastic.run)::
+
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as elastic
+
+    state = elastic.TpuState(params=params, opt_state=opt_state, epoch=0)
+
+    @elastic.run
+    def train(state):
+        for state.epoch in range(state.epoch, epochs):
+            ...train step...
+            state.commit()
+
+    train(state)
+"""
+
+from horovod_tpu.elastic.state import (  # noqa: F401
+    ObjectState,
+    State,
+    TorchState,
+    TpuState,
+    current_rendezvous_version,
+)
+from horovod_tpu.elastic.worker import reinit_for_version, run  # noqa: F401

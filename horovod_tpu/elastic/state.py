@@ -1,0 +1,207 @@
+"""Elastic state objects: commit / restore / sync across resets.
+
+Rebuild of the reference's elastic state machine
+(reference: horovod/common/elastic.py:26-160 State/ObjectState,
+horovod/torch/elastic/state.py:27-160 model/optimizer handlers): user
+training state registers with a State object; ``commit()`` snapshots it
+and checks for host-set changes; ``restore()`` rolls back to the last
+commit after a failure; ``sync()`` broadcasts rank 0's state after a
+(re)rendezvous.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+
+
+def _rendezvous_endpoint():
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    if not addr or not port:
+        return None
+    return addr, int(port)
+
+
+def current_rendezvous_version() -> Optional[int]:
+    """Read the driver-published rendezvous version (None when not
+    running under the elastic driver)."""
+    ep = _rendezvous_endpoint()
+    if ep is None:
+        return None
+    from horovod_tpu.runner.http_server import read_kv
+
+    try:
+        raw = read_kv(ep[0], ep[1], "control", "meta", timeout=5)
+    except OSError:
+        return None
+    if raw is None:
+        return None
+    return json.loads(raw.decode()).get("version", 0)
+
+
+class State:
+    """Base elastic state (reference: common/elastic.py:26-113)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable] = []
+        self._known_version = int(os.environ.get(
+            "HOROVOD_RENDEZVOUS_VERSION", "0"))
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_updated = False
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt when the driver has published a new
+        rendezvous (reference: State.check_host_updates; delivery here is
+        by polling the rendezvous store rather than a push socket)."""
+        version = current_rendezvous_version()
+        if version is not None and version > self._known_version:
+            self._known_version = version
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    # --- to be implemented by subclasses ---
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """State of picklable attributes (reference: common/elastic.py:116-148)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved_state: Dict[str, Any] = dict(kwargs)
+        self.__dict__.update(kwargs)
+
+    def save(self):
+        for k in self._saved_state:
+            self._saved_state[k] = copy.deepcopy(getattr(self, k))
+
+    def restore(self):
+        self.__dict__.update(copy.deepcopy(self._saved_state))
+
+    def sync(self):
+        if basics.size() > 1:
+            from horovod_tpu.jax.functions import broadcast_object
+
+            synced = broadcast_object(self._saved_state, root_rank=0,
+                                      name="elastic.ObjectState")
+            self._saved_state = synced
+            self.__dict__.update(copy.deepcopy(synced))
+
+
+class TpuState(ObjectState):
+    """Elastic state for JAX pytrees (params / optimizer state / batch
+    stats plus arbitrary picklable attributes).
+
+    Pytrees are converted leaf-wise to numpy for the commit snapshot and
+    the rank-0 broadcast, then restored as jax arrays.
+    """
+
+    def __init__(self, **kwargs):
+        import jax
+        import numpy as np
+
+        self._tree_keys = [
+            k for k, v in kwargs.items()
+            if isinstance(v, (dict, list, tuple)) or hasattr(v, "shape")]
+        super().__init__(**kwargs)
+
+    def save(self):
+        import jax
+        import numpy as np
+
+        for k in self._saved_state:
+            v = getattr(self, k)
+            if k in self._tree_keys:
+                self._saved_state[k] = jax.tree.map(
+                    lambda l: np.asarray(l).copy()
+                    if hasattr(l, "shape") else l, v)
+            else:
+                self._saved_state[k] = copy.deepcopy(v)
+
+    def restore(self):
+        import jax.numpy as jnp
+
+        for k, v in self._saved_state.items():
+            if k in self._tree_keys:
+                import jax
+
+                setattr(self, k, jax.tree.map(
+                    lambda l: jnp.asarray(l) if hasattr(l, "shape") else l,
+                    v))
+            else:
+                setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        if basics.size() > 1:
+            from horovod_tpu.jax.functions import broadcast_object
+
+            self.save()
+            synced = broadcast_object(self._saved_state, root_rank=0,
+                                      name="elastic.TpuState")
+            self._saved_state = synced
+            self.restore()
+
+
+class TorchState(ObjectState):
+    """Elastic state for torch modules/optimizers
+    (reference: horovod/torch/elastic/state.py:27-160)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self._model = model
+        self._optimizer = optimizer
+        super().__init__(**kwargs)
+
+    def save(self):
+        super().save()
+        if self._model is not None:
+            self._saved_model = copy.deepcopy(self._model.state_dict())
+        if self._optimizer is not None:
+            self._saved_optimizer = copy.deepcopy(
+                self._optimizer.state_dict())
+
+    def restore(self):
+        super().restore()
+        if self._model is not None and hasattr(self, "_saved_model"):
+            self._model.load_state_dict(self._saved_model)
+        if self._optimizer is not None and hasattr(self, "_saved_optimizer"):
+            self._optimizer.load_state_dict(self._saved_optimizer)
+
+    def sync(self):
+        if basics.size() > 1:
+            from horovod_tpu.torch.functions import (
+                broadcast_object, broadcast_parameters,
+                broadcast_optimizer_state,
+            )
+
+            if self._model is not None:
+                broadcast_parameters(self._model.state_dict(), root_rank=0)
+            if self._optimizer is not None:
+                broadcast_optimizer_state(self._optimizer, root_rank=0)
+            synced = broadcast_object(self._saved_state, root_rank=0,
+                                      name="elastic.TorchState")
+            self._saved_state = synced
+            self.__dict__.update(copy.deepcopy(synced))
+        self.save()

@@ -1,0 +1,123 @@
+"""Worker-side elastic machinery: re-rendezvous and the run wrapper.
+
+Rebuild of the reference's recovery loop
+(reference: horovod/common/elastic.py:151-175 run wrapper — catch
+HorovodInternalError → restore committed state + full reinit; catch
+HostsUpdatedInterrupt → graceful reset; rank/size reassignment via the
+rendezvous server, horovod/runner/elastic/rendezvous.py:37-42).
+
+On TPU a topology change means slice re-acquisition, so recovery is
+restart-shaped: the core is shut down, the worker polls the rendezvous
+store for the next published version, adopts its new rank/size (or exits
+cleanly when its slot is gone), and re-initializes.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+
+
+def _rendezvous():
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    return addr, port
+
+
+def _poll_meta(min_version: int, timeout: float = 300.0) -> dict:
+    from horovod_tpu.runner.http_server import read_kv
+
+    addr, port = _rendezvous()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            raw = read_kv(addr, port, "control", "meta", timeout=5)
+        except OSError:
+            raw = None
+        if raw:
+            meta = json.loads(raw.decode())
+            if meta.get("version", 0) >= min_version:
+                return meta
+        time.sleep(0.5)
+    raise HorovodInternalError(
+        "Timed out waiting for rendezvous version >= %d" % min_version)
+
+
+def reinit_for_version(min_version: int):
+    """Shut down, take the next assignment, re-init. Exits(0) when this
+    worker's slot is not part of the new world."""
+    from horovod_tpu.runner.http_server import read_kv
+
+    basics.shutdown()
+    meta = _poll_meta(min_version)
+    addr, port = _rendezvous()
+    slot_key = os.environ["HOROVOD_SLOT_KEY"]
+    # Contract with the driver: slot assignments (including deletions of
+    # removed slots) are published before the meta version bump, so one
+    # read after the version is adopted is race-free.
+    raw = read_kv(addr, port, "rendezvous", slot_key, timeout=5)
+    if raw is None:
+        # Slot removed from the new world: clean exit
+        # (reference analog: worker not in new assignment terminates).
+        sys.exit(0)
+    rank, size, local_rank, local_size, cross_rank, cross_size = (
+        int(x) for x in raw.decode().split(","))
+    os.environ.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(local_rank),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_CROSS_RANK": str(cross_rank),
+        "HOROVOD_CROSS_SIZE": str(cross_size),
+        "HOROVOD_CONTROLLER_ADDR": meta["controller_addr"],
+        "HOROVOD_CONTROLLER_PORT": str(meta["controller_port"]),
+        "HOROVOD_RENDEZVOUS_VERSION": str(meta["version"]),
+    })
+    basics.init()
+    return meta["version"]
+
+
+def run(func):
+    """Elastic run wrapper (reference: common/elastic.py:151-175)::
+
+        @hvd.elastic.run
+        def train(state, ...):
+            ...
+        train(state)
+    """
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        reset_version = None
+        skip_sync = False
+        while True:
+            if reset_version is not None:
+                new_version = reinit_for_version(reset_version)
+                state._known_version = new_version
+                state.on_reset()
+                reset_version = None
+            try:
+                if not skip_sync:
+                    state.sync()
+                skip_sync = False
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                # A rank died mid-collective: roll back to the last
+                # commit, rejoin at the next published rendezvous.
+                state.restore()
+                reset_version = state._known_version + 1
+            except HostsUpdatedInterrupt as e:
+                # Graceful reset at a commit boundary.
+                skip_sync = e.skip_sync
+                reset_version = state._known_version
+
+    return wrapper

@@ -1,0 +1,76 @@
+"""Host discovery for elastic training.
+
+Rebuild of the reference's discovery layer
+(reference: horovod/runner/elastic/discovery.py:80-175 —
+HostDiscoveryScript runs a user script that prints ``hostname[:slots]``
+per line; HostManager diffs the result against the current set and holds
+the blacklist).
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict, List, Optional, Set
+
+from horovod_tpu.runner.hosts import HostInfo
+
+
+class HostDiscoveryScript:
+    def __init__(self, script: str, default_slots: int = 1,
+                 timeout: float = 30.0):
+        self.script = script
+        self.default_slots = default_slots
+        self.timeout = timeout
+
+    def find_available_hosts(self) -> List[HostInfo]:
+        try:
+            out = subprocess.run(
+                [self.script], shell=False, capture_output=True, text=True,
+                timeout=self.timeout)
+        except (subprocess.TimeoutExpired, OSError):
+            return []
+        if out.returncode != 0:
+            return []
+        hosts = []
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                hosts.append(HostInfo.from_string(line))
+            else:
+                hosts.append(HostInfo(line, self.default_slots))
+        return hosts
+
+
+class HostManager:
+    """Tracks the current host set and blacklisted slots
+    (reference: discovery.py HostManager + blacklist semantics)."""
+
+    def __init__(self, discovery: HostDiscoveryScript):
+        self._discovery = discovery
+        self.current: List[HostInfo] = []
+        self.blacklist: Set[str] = set()  # blacklisted slot keys host:slot
+
+    def blacklist_slot(self, slot_key: str):
+        self.blacklist.add(slot_key)
+
+    def refresh(self) -> bool:
+        """Re-run discovery; True when the effective host set changed."""
+        found = self._discovery.find_available_hosts()
+        if not found:
+            return False
+        if [(h.hostname, h.slots) for h in found] != \
+                [(h.hostname, h.slots) for h in self.current]:
+            self.current = found
+            return True
+        return False
+
+    def available_slot_keys(self) -> List[str]:
+        keys = []
+        for h in self.current:
+            for s in range(h.slots):
+                key = "%s:%d" % (h.hostname, s)
+                if key not in self.blacklist:
+                    keys.append(key)
+        return keys

@@ -1,0 +1,205 @@
+"""Elastic driver: dynamic world size with failure recovery.
+
+Rebuild of the reference's elastic launcher
+(reference: horovod/runner/elastic/driver.py:68-313 — discovery thread,
+stable slot assignment, worker spawn, failure recording/blacklisting,
+rendezvous-based rank reassignment; gloo_run.py:287-336 wiring).
+
+Protocol with workers (horovod_tpu.elastic.worker):
+1. Driver publishes per-slot assignments under ``rendezvous/<host:slot>``
+   and then a ``control/meta`` JSON {version, controller_addr,
+   controller_port}; the publish order makes a single worker read after
+   the version bump race-free.
+2. Workers poll the version at commit points; on change they shut down,
+   re-read their slot, and re-init (or exit cleanly when removed).
+3. On worker death the remaining ranks fail fast (socket cascade in the
+   core), restore committed state, and wait for the next version.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import sys
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner.discovery import HostDiscoveryScript, HostManager
+from horovod_tpu.runner.exec_util import SlotProcess
+from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.runner.launch import _tuning_env, free_port, slot_env
+
+
+class ElasticDriver:
+    POLL_SEC = 0.5
+    MAX_SLOT_FAILURES = 3
+
+    def __init__(self, args):
+        if not args.discovery_script:
+            raise ValueError(
+                "elastic mode requires --host-discovery-script")
+        self.min_np = args.min_np or args.np or 1
+        self.max_np = args.max_np
+        self.command = args.command
+        self.start_timeout = args.start_timeout
+        self.reset_limit = args.reset_limit
+        self.extra_env = _tuning_env(args)
+        self.host_manager = HostManager(HostDiscoveryScript(
+            args.discovery_script, args.slots_per_host or 1))
+        self.rendezvous = RendezvousServer()
+        self.version = 0
+        self.procs: Dict[str, SlotProcess] = {}
+        self.done: Dict[str, bool] = {}
+        self.fail_counts: Dict[str, int] = {}
+        self.exit_code: Optional[int] = None
+
+    # --- assignment ---------------------------------------------------------
+
+    def _compute_assignments(self, slot_keys: List[str]):
+        """Assignments over possibly-sparse slot keys: ranks pack in host
+        order; each SlotInfo keeps its *original* slot key as identity
+        (stable across resets, the reference's stable-ordering property,
+        driver.py:233-275)."""
+        by_host: Dict[str, List[str]] = {}
+        host_order: List[str] = []
+        for key in slot_keys:
+            host = key.rsplit(":", 1)[0]
+            if host not in by_host:
+                by_host[host] = []
+                host_order.append(host)
+            by_host[host].append(key)
+        hosts = [HostInfo(h, len(by_host[h])) for h in host_order]
+        np_ = sum(h.slots for h in hosts)
+        if self.max_np:
+            np_ = min(np_, self.max_np)
+        assignments = get_host_assignments(hosts, np_, np_)
+        keyed = {}
+        for a in assignments:
+            original_key = by_host[a.hostname][a.local_rank]
+            keyed[original_key] = a
+        return keyed
+
+    # --- rendezvous ---------------------------------------------------------
+
+    def _publish(self, keyed: Dict[str, SlotInfo], controller_port: int):
+        self.rendezvous.clear_scope("rendezvous")
+        for key, a in keyed.items():
+            self.rendezvous.put("rendezvous", key,
+                                a.to_response_string().encode())
+        rank0_host = min(keyed.values(), key=lambda a: a.rank).hostname
+        from horovod_tpu.runner.exec_util import is_local
+
+        controller_addr = "127.0.0.1" if is_local(rank0_host) else rank0_host
+        meta = {
+            "version": self.version,
+            "controller_addr": controller_addr,
+            "controller_port": controller_port,
+            "size": len(keyed),
+        }
+        self.rendezvous.put("control", "meta", json.dumps(meta).encode())
+        return controller_addr
+
+    def _reset(self) -> bool:
+        """New rendezvous round. False when min_np cannot be satisfied."""
+        deadline = time.time() + self.start_timeout
+        while True:
+            keys = [k for k in self.host_manager.available_slot_keys()
+                    if k not in self.done]
+            if len(keys) >= self.min_np:
+                break
+            if time.time() > deadline:
+                sys.stderr.write(
+                    "elastic: %d slots available, need min-np %d; giving "
+                    "up\n" % (len(keys), self.min_np))
+                return False
+            self.host_manager.refresh()
+            time.sleep(1.0)
+
+        keyed = self._compute_assignments(keys)
+        self.version += 1
+        controller_port = free_port()
+        controller_addr = self._publish(keyed, controller_port)
+
+        launcher_host = socket.gethostname()
+        for key, a in keyed.items():
+            if key in self.procs and self.procs[key].poll() is None:
+                continue  # live worker adopts the new version in-process
+            env = slot_env(
+                a, controller_addr, controller_port,
+                launcher_host if a.hostname != "localhost" else "127.0.0.1",
+                self.rendezvous.port, self.extra_env)
+            env["HOROVOD_SLOT_KEY"] = key
+            env["HOROVOD_RENDEZVOUS_VERSION"] = str(self.version)
+            env["HOROVOD_ELASTIC"] = "1"
+            slot_idx = int(key.rsplit(":", 1)[1])
+            self.procs[key] = SlotProcess(
+                a.rank, self.command, env, hostname=a.hostname)
+        return True
+
+    # --- main loop ----------------------------------------------------------
+
+    def run(self) -> int:
+        self.rendezvous.start()
+        try:
+            deadline = time.time() + self.start_timeout
+            while True:
+                self.host_manager.refresh()
+                if len(self.host_manager.available_slot_keys()) >= self.min_np:
+                    break
+                if time.time() > deadline:
+                    sys.stderr.write("elastic: discovery never provided "
+                                     "min-np slots\n")
+                    return 1
+                time.sleep(1.0)
+
+            if not self._reset():
+                return 1
+            resets = 0
+            while True:
+                time.sleep(self.POLL_SEC)
+                needs_reset = False
+                for key, proc in list(self.procs.items()):
+                    rc = proc.poll()
+                    if rc is None:
+                        continue
+                    proc.wait()
+                    del self.procs[key]
+                    if rc == 0:
+                        self.done[key] = True
+                    else:
+                        self.fail_counts[key] = \
+                            self.fail_counts.get(key, 0) + 1
+                        sys.stderr.write(
+                            "elastic: worker %s exited with code %d "
+                            "(failure %d)\n"
+                            % (key, rc, self.fail_counts[key]))
+                        if self.fail_counts[key] >= self.MAX_SLOT_FAILURES:
+                            self.host_manager.blacklist_slot(key)
+                        needs_reset = True
+
+                if not self.procs and self.done and not needs_reset:
+                    return 0
+                if self.host_manager.refresh():
+                    needs_reset = True
+                if needs_reset:
+                    resets += 1
+                    if self.reset_limit and resets > self.reset_limit:
+                        sys.stderr.write(
+                            "elastic: reset limit %d exceeded\n"
+                            % self.reset_limit)
+                        for p in self.procs.values():
+                            p.terminate()
+                        return 1
+                    if not self._reset():
+                        for p in self.procs.values():
+                            p.terminate()
+                        return 1
+        finally:
+            for p in self.procs.values():
+                p.terminate()
+            self.rendezvous.stop()
+
+
+def run_elastic(args) -> int:
+    return ElasticDriver(args).run()

@@ -103,6 +103,10 @@ class KVStoreServer:
         with self._httpd.lock:  # type: ignore[attr-defined]
             return dict(self._httpd.store.get(scope, {}))  # type: ignore[attr-defined]
 
+    def clear_scope(self, scope: str):
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.pop(scope, None)  # type: ignore[attr-defined]
+
 
 class RendezvousServer(KVStoreServer):
     """KV store the elastic driver publishes slot assignments through

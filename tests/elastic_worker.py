@@ -1,0 +1,77 @@
+"""Elastic training worker for integration tests.
+
+Mirrors the reference's elastic test mains
+(reference: test/integration/data/elastic_torch_main.py): runs a fixed
+number of global steps with per-step commit, logs
+``{rank, size, step}`` JSON lines, optionally self-terminates once at a
+scheduled step to exercise failure recovery.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.elastic as elastic  # noqa: E402
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "25"))
+LOG_DIR = os.environ["ELASTIC_LOG_DIR"]
+FAIL_RANK = os.environ.get("ELASTIC_FAIL_RANK")
+FAIL_STEP = int(os.environ.get("ELASTIC_FAIL_STEP", "-1"))
+FAIL_MARKER = os.path.join(LOG_DIR, "fail_marker")
+
+
+def log(step):
+    path = os.path.join(LOG_DIR, "slot_%s.log" %
+                        os.environ["HOROVOD_SLOT_KEY"].replace(":", "_"))
+    with open(path, "a") as f:
+        f.write(json.dumps({"rank": hvd.rank(), "size": hvd.size(),
+                            "step": step}) + "\n")
+
+
+def main():
+    import time
+
+    hvd.init()
+    state = elastic.TpuState(
+        weights=np.zeros(4, np.float32), step=0)
+
+    @elastic.run
+    def train(state):
+        while state.step < TOTAL_STEPS:
+            if (FAIL_RANK is not None and hvd.rank() == int(FAIL_RANK)
+                    and state.step == FAIL_STEP
+                    and not os.path.exists(FAIL_MARKER)):
+                open(FAIL_MARKER, "w").close()
+                os._exit(17)
+            # One "training step": allreduce a step-dependent value; all
+            # ranks must agree on the result.
+            out = hvd.allreduce(
+                np.full(4, float(state.step), np.float32),
+                name="elastic.step", op=hvd.Average)
+            np.testing.assert_allclose(out, float(state.step))
+            state.weights = state.weights + np.asarray(out)
+            state.step += 1
+            log(state.step)
+            time.sleep(0.15)
+            state.commit()
+
+    train(state)
+    # Final consistency: every rank ends with identical accumulated state.
+    gathered = hvd.allgather(
+        np.asarray(state.weights)[None, :], name="elastic.final")
+    for row in np.asarray(gathered):
+        np.testing.assert_allclose(row, np.asarray(state.weights))
+    hvd.shutdown()
+    print("ELASTIC_DONE rank_final")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Elastic integration tests: world growth and failure recovery.
+
+Uses the reference's multi-node-without-a-cluster technique
+(reference: test/integration/elastic_common.py:42-66): a generated
+discovery script whose output is a function of elapsed time simulates
+hosts joining; worker self-termination at a scheduled step simulates a
+rank failure.
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_discovery(tmp_path, schedule):
+    """schedule: list of (after_seconds, 'host:slots') entries."""
+    lines = ["#!/bin/sh", 'now=$(date +%s)',
+             "start=%d" % int(time.time()), "age=$((now - start))"]
+    for after, hosts in reversed(schedule):
+        lines.append('if [ $age -ge %d ]; then echo "%s"; exit 0; fi'
+                     % (after, hosts))
+    script = tmp_path / "discover.sh"
+    script.write_text("\n".join(lines) + "\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
+def _read_logs(log_dir):
+    records = []
+    for fn in os.listdir(log_dir):
+        if fn.startswith("slot_") and fn.endswith(".log"):
+            for line in open(os.path.join(log_dir, fn)):
+                records.append(json.loads(line))
+    return records
+
+
+def _run_elastic(tmp_path, discovery, min_np, max_np, extra_env=None,
+                 timeout=300):
+    log_dir = tmp_path / "logs"
+    log_dir.mkdir(exist_ok=True)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "ELASTIC_LOG_DIR": str(log_dir),
+        "ELASTIC_TOTAL_STEPS": "25",
+    })
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner",
+         "--min-np", str(min_np), "--max-np", str(max_np),
+         "--host-discovery-script", discovery,
+         sys.executable, os.path.join(_REPO, "tests", "elastic_worker.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    return proc, _read_logs(log_dir)
+
+
+def test_elastic_world_growth(tmp_path):
+    """Hosts grow from 2 to 3 slots mid-run; workers re-rendezvous and
+    training continues with size 3."""
+    discovery = _write_discovery(
+        tmp_path, [(0, "localhost:2"), (6, "localhost:3")])
+    proc, records = _run_elastic(tmp_path, discovery, min_np=2, max_np=4)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sizes = {r["size"] for r in records}
+    assert 2 in sizes, "never ran at size 2: %r" % sizes
+    assert 3 in sizes, "never grew to size 3: %r" % sizes
+    # Every rank reached the final step.
+    max_step = max(r["step"] for r in records)
+    assert max_step == 25
+    # After growth, steps ran with 3 distinct ranks.
+    ranks_at_3 = {r["rank"] for r in records if r["size"] == 3}
+    assert ranks_at_3 == {0, 1, 2}
+
+
+def test_elastic_failure_recovery(tmp_path):
+    """Rank 1 dies once at step 5; remaining ranks restore committed
+    state, the slot is respawned, training completes."""
+    discovery = _write_discovery(tmp_path, [(0, "localhost:3")])
+    proc, records = _run_elastic(
+        tmp_path, discovery, min_np=3, max_np=3,
+        extra_env={"ELASTIC_FAIL_RANK": "1", "ELASTIC_FAIL_STEP": "5"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    max_step = max(r["step"] for r in records)
+    assert max_step == 25
+    # The job kept world size 3 throughout (respawn, not shrink).
+    assert {r["size"] for r in records} == {3}
+    # Failure actually happened (marker exists) and steps around 5 were
+    # re-run after restore on some rank.
+    assert os.path.exists(str(tmp_path / "logs" / "fail_marker"))

@@ -31,6 +31,14 @@ from flax.linen import partitioning as nn_partitioning
 param_with_axes = nn.with_partitioning
 
 
+def _axis_bound(axis) -> bool:
+    try:
+        jax.lax.axis_size(axis)
+        return True
+    except NameError:
+        return False
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -145,7 +153,16 @@ class Transformer(nn.Module):
             "pos", param_with_axes(init, (None, None)),
             (cfg.max_seq_len, cfg.d_model), jnp.float32)
         x = embed.astype(cfg.dtype)[tokens]
-        x = x + pos.astype(cfg.dtype)[None, :tokens.shape[1]]
+        s_local = tokens.shape[1]
+        if cfg.seq_axis is not None and _axis_bound(cfg.seq_axis):
+            # Sequence-sharded (shard_map): this shard holds positions
+            # [idx * S_local, (idx+1) * S_local).
+            offset = jax.lax.axis_index(cfg.seq_axis) * s_local
+            pos_slice = jax.lax.dynamic_slice_in_dim(
+                pos.astype(cfg.dtype), offset, s_local)
+        else:
+            pos_slice = pos.astype(cfg.dtype)[:s_local]
+        x = x + pos_slice[None]
         block = Block
         if cfg.remat:
             block = nn.remat(Block)

@@ -1,0 +1,122 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` axis.
+
+The reference exposes alltoall as the building block users need for MoE
+sharding (SURVEY.md §2.3); here the full layer is provided TPU-first,
+in two composable forms:
+
+- ``MoeMlp`` — a flax module with Switch-style top-1 capacity routing and
+  ``expert``-axis partitioning metadata on the expert weights. Under
+  pjit auto-sharding XLA shards the expert einsums and inserts the
+  dispatch/return collectives from the annotations.
+- ``expert_parallel_moe`` — the explicit shard_map formulation: expert
+  weights arrive pre-sharded (E/n per chip), tokens are exchanged with
+  two ``all_to_all``s (dispatch and return) — the communication pattern
+  Ulysses/MoE systems build from the alltoall primitive.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import flax.linen as nn
+
+from horovod_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def top1_dispatch(router_logits, capacity: int):
+    """Switch-style top-1 routing tensors.
+
+    Returns (dispatch (T, E, C) one-hot, combine (T, E, C) gate-weighted).
+    Tokens overflowing an expert's capacity are dropped (standard Switch
+    behavior).
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (T, E)
+    # Position of each token within its expert's queue.
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (T, E)
+    keep = (pos < capacity) * onehot
+    pos_clipped = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    cap_onehot = jax.nn.one_hot(pos_clipped, capacity,
+                                dtype=jnp.float32)  # (T, E, C)
+    dispatch = keep[..., None] * cap_onehot
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_ffn(x, router_w, wi, wo, capacity: int, dtype=jnp.float32):
+    """Dense (single-device) MoE forward: the numerical reference.
+
+    x: (T, M); router_w: (M, E); wi: (E, M, F); wo: (E, F, M).
+    """
+    logits = x @ router_w.astype(dtype)
+    dispatch, combine = top1_dispatch(logits, capacity)
+    expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(dtype), x)
+    h = nn.gelu(jnp.einsum("ecm,emf->ecf", expert_in, wi.astype(dtype)))
+    expert_out = jnp.einsum("ecf,efm->ecm", h, wo.astype(dtype))
+    return jnp.einsum("tec,ecm->tm", combine.astype(dtype), expert_out)
+
+
+def expert_parallel_moe(x, router_w, wi_local, wo_local, capacity: int,
+                        *, axis=EXPERT_AXIS, dtype=jnp.float32):
+    """Expert-parallel MoE forward inside shard_map.
+
+    Per-chip inputs: x (T_local, M) token shard; wi_local/wo_local
+    (E/n, ...) expert-weight shards; router_w replicated. Tokens route to
+    all E experts; the dispatch all_to_all sends each chip's per-expert
+    queues to the expert's owner, the return all_to_all brings results
+    back.
+    """
+    n = lax.axis_size(axis)
+    e = router_w.shape[1]
+    if e % n:
+        raise ValueError("num experts (%d) must divide expert axis (%d)"
+                         % (e, n))
+    logits = x @ router_w.astype(dtype)
+    dispatch, combine = top1_dispatch(logits, capacity)
+    expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(dtype), x)
+    # (E, C, M) -> exchange -> (E/n, C*n, M): this chip now holds every
+    # chip's queue for its local experts.
+    expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                               concat_axis=1, tiled=True)
+    h = nn.gelu(jnp.einsum("ecm,emf->ecf", expert_in,
+                           wi_local.astype(dtype)))
+    expert_out = jnp.einsum("ecf,efm->ecm", h, wo_local.astype(dtype))
+    # Return: (E/n, C*n, M) -> (E, C, M) with each chip's own queue back.
+    expert_out = lax.all_to_all(expert_out, axis, split_axis=1,
+                                concat_axis=0, tiled=True)
+    return jnp.einsum("tec,ecm->tm", combine.astype(dtype), expert_out)
+
+
+class MoeMlp(nn.Module):
+    """MoE MLP block for the transformer: top-1 capacity routing, expert
+    weights annotated for ``expert``-axis sharding under pjit."""
+
+    cfg: object  # TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e = cfg.num_experts
+        b, s, m = x.shape
+        t = b * s
+        capacity = max(1, int(2 * t // e))
+        init = nn.initializers.normal(0.02)
+
+        wr = self.param("router", nn.with_partitioning(init, (None, None)),
+                        (m, e), jnp.float32)
+        wi = self.param(
+            "wi", nn.with_partitioning(init, ("expert", None, None)),
+            (e, m, cfg.d_ff), jnp.float32)
+        wo = self.param(
+            "wo", nn.with_partitioning(init, ("expert", None, None)),
+            (e, cfg.d_ff, m), jnp.float32)
+
+        out = moe_ffn(x.reshape(t, m), wr, wi, wo, capacity,
+                      dtype=cfg.dtype)
+        return out.reshape(b, s, m)

@@ -1,0 +1,125 @@
+"""Sequence/context parallelism: ring attention and Ulysses all_to_all.
+
+The reference has no sequence parallelism (SURVEY.md §5.7); its closest
+primitive is alltoall. This module makes long-context first-class on TPU:
+
+- **Ring attention**: K/V blocks rotate around the ``seq`` axis via
+  ``ppermute`` while each chip keeps its query shard, accumulating
+  attention with an online (flash-style) softmax. Communication overlaps
+  compute and per-chip memory stays O(S/n) — the blockwise ring
+  formulation of Liu et al.'s Ring Attention, mapped onto ICI neighbors.
+- **Ulysses attention**: two ``all_to_all`` reshards (seq-sharded ->
+  head-sharded and back) so dense attention runs locally over the full
+  sequence with H/n heads — DeepSpeed-Ulysses's communication pattern on
+  top of the same collective the reference exposes for MoE-style use.
+
+Both run inside ``jax.shard_map`` with the ``seq`` mesh axis and accept
+(B, S/n, H, D) shards.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG = -1e9
+
+
+def _block_attention(q, k, v, q_offset, k_offset, causal, m, l, o):
+    """One blockwise online-softmax accumulation step.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D); m, l: (B, H, Sq); o like q
+    (accumulated in f32).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG)
+    m_new = jnp.maximum(m, scores.max(-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + p.sum(-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, *, axis=SEQ_AXIS, causal: bool = True):
+    """Blockwise ring attention across the ``axis`` mesh axis.
+
+    Args: per-shard q, k, v of shape (B, S_local, H, D), sequence
+    sharded in rank order along the axis. Returns the attention output
+    shard (B, S_local, H, D).
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+
+    m = jnp.full((b, h, s_local), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    o = jnp.zeros((b, s_local, h, d), jnp.float32)
+
+    q_offset = idx * s_local
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    cur_k, cur_v = k, v
+    for step in range(n):
+        # At this step we hold the kv block originally owned by
+        # (idx - step) mod n.
+        kv_owner = (idx - step) % n
+        k_offset = kv_owner * s_local
+        m, l, o = _block_attention(q, cur_k, cur_v, q_offset, k_offset,
+                                   causal, m, l, o)
+        if step != n - 1:
+            cur_k = lax.ppermute(cur_k, axis, perm)
+            cur_v = lax.ppermute(cur_v, axis, perm)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis=SEQ_AXIS, causal: bool = True,
+                      attention_fn=None):
+    """All_to_all sequence parallelism: reshard (B, S/n, H, D) ->
+    (B, S, H/n, D), run dense attention locally, reshard back."""
+    n = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            "ulysses attention requires heads (%d) divisible by the seq "
+            "axis size (%d)" % (h, n))
+
+    def to_heads(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if attention_fn is None:
+        attention_fn = _dense_attention
+    ctx = attention_fn(qh, kh, vh, causal)
+    return to_seq(ctx)
+
+
+def _dense_attention(q, k, v, causal):
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        s = scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,92 @@
+"""Elastic MNIST in JAX — parity with the reference's
+examples/elastic/pytorch/pytorch_mnist_elastic.py: state commit loop
+with dynamic world size.
+
+Run:  python -m horovod_tpu.runner --min-np 2 --max-np 4 \\
+          --host-discovery-script ./discover.sh \\
+          python examples/elastic/jax/jax_mnist_elastic.py
+"""
+
+import argparse
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.elastic as elastic
+from horovod_tpu.models import MnistMLP
+
+
+def synthetic_batch(batch_size, seed):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(batch_size, 28, 28, 1).astype(np.float32),
+            rng.randint(0, 10, size=batch_size).astype(np.int32))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps-per-epoch", type=int, default=20)
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = MnistMLP()
+    x0 = jnp.zeros((args.batch_size, 28, 28, 1))
+    params = model.init(jax.random.PRNGKey(0), x0, train=False)
+    tx = optax.sgd(0.01 * hvd.size(), momentum=0.5)
+    opt_state = tx.init(params)
+
+    import horovod_tpu.jax as hvd_jax
+
+    state = elastic.TpuState(params=params, opt_state=opt_state, epoch=0,
+                             step=0)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x, train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = hvd_jax.allreduce_gradients(grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @elastic.run
+    def train(state):
+        while state.epoch < args.epochs:
+            while state.step < args.steps_per_epoch:
+                x, y = synthetic_batch(
+                    args.batch_size,
+                    state.epoch * 10000 + state.step * 100 + hvd.rank())
+                # Eager gradient allreduce path: grads leave jit, are
+                # averaged through the core, then applied.
+                grads = jax.grad(lambda p: optax.
+                                 softmax_cross_entropy_with_integer_labels(
+                                     model.apply(p, jnp.asarray(x),
+                                                 train=False),
+                                     jnp.asarray(y)).mean())(state.params)
+                grads = hvd_jax.allreduce_gradients(grads)
+                updates, state.opt_state = tx.update(
+                    grads, state.opt_state, state.params)
+                state.params = optax.apply_updates(state.params, updates)
+                state.step += 1
+                state.commit()
+            if hvd.rank() == 0:
+                print("epoch %d done (size=%d)" % (state.epoch, hvd.size()))
+            state.epoch += 1
+            state.step = 0
+            state.commit()
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Distributed MNIST in JAX (parity workload for
+examples/pytorch/pytorch_mnist.py in the reference).
+
+Run:  python -m horovod_tpu.runner -np 2 python examples/jax/jax_mnist.py
+
+Uses synthetic MNIST-shaped data (this environment has no dataset
+egress); swap ``synthetic_mnist`` for a real loader in production.
+"""
+
+import argparse
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu.models import MnistCNN
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--steps-per-epoch", type=int, default=20)
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = MnistCNN()
+    x0 = jnp.zeros((args.batch_size, 28, 28, 1), jnp.float32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x0, train=True)
+    # Identical start on every rank (reference: broadcast_parameters).
+    params = hvd_jax.broadcast_parameters(params, root_rank=0)
+
+    # LR scaled by world size (reference example convention).
+    tx = hvd_jax.DistributedOptimizer(optax.sgd(args.lr * hvd.size(),
+                                                momentum=0.5))
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y, dropout_key):
+        def loss_fn(p):
+            logits = model.apply(p, x, train=True,
+                                 rngs={"dropout": dropout_key})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(hvd.rank())
+    for epoch in range(args.epochs):
+        for step in range(args.steps_per_epoch):
+            # Each rank reads its own shard (seeded by rank+step).
+            x, y = synthetic_mnist(args.batch_size,
+                                   seed=epoch * 10000 + step * 100 + hvd.rank())
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = train_step(
+                params, opt_state, jnp.asarray(x), jnp.asarray(y), sub)
+        if hvd.rank() == 0:
+            print("epoch %d loss %.4f" % (epoch, float(loss)))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

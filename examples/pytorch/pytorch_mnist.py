@@ -1,0 +1,83 @@
+"""Distributed MNIST in PyTorch — direct parity with the reference's
+examples/pytorch/pytorch_mnist.py (same Net architecture, hook-based
+DistributedOptimizer, broadcast of params + optimizer state).
+
+Run:  python -m horovod_tpu.runner -np 2 python examples/pytorch/pytorch_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    """(reference: examples/pytorch/pytorch_mnist.py Net)"""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = nn.Dropout2d()
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def synthetic_batch(batch_size, seed):
+    rng = np.random.RandomState(seed)
+    x = torch.from_numpy(rng.rand(batch_size, 1, 28, 28).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, size=batch_size))
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--steps-per-epoch", type=int, default=20)
+    p.add_argument("--use-adasum", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = Net()
+    lr_scaler = 1 if args.use_adasum else hvd.size()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * lr_scaler, momentum=0.5)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        op=hvd.Adasum if args.use_adasum else hvd.Average)
+
+    model.train()
+    for epoch in range(args.epochs):
+        for step in range(args.steps_per_epoch):
+            x, y = synthetic_batch(
+                args.batch_size, epoch * 10000 + step * 100 + hvd.rank())
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(x), y)
+            loss.backward()
+            optimizer.step()
+        if hvd.rank() == 0:
+            print("epoch %d loss %.4f" % (epoch, loss.item()))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Keras MNIST — parity with the reference's
+examples/tensorflow2/tensorflow2_keras_mnist.py (DistributedOptimizer in
+model.compile, broadcast + metric-average callbacks).
+
+Run:  python -m horovod_tpu.runner -np 2 python examples/tensorflow2/tensorflow2_keras_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+from tensorflow import keras
+
+import horovod_tpu.keras as hvd
+from horovod_tpu.keras import callbacks as hvd_callbacks
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--samples", type=int, default=1024)
+    args = p.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(args.samples, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=args.samples)
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(10, 5, activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(50, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    opt = keras.optimizers.SGD(learning_rate=0.01 * hvd.size(), momentum=0.5)
+    opt = hvd.DistributedOptimizer(opt)
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"],
+                  # Gradients are averaged eagerly through the core.
+                  run_eagerly=True)
+
+    cbs = [
+        hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_callbacks.MetricAverageCallback(),
+    ]
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=cbs, verbose=1 if hvd.rank() == 0 else 0)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Async data loading: background-thread prefetch over any iterable
+loader.
+
+Rebuild of the reference's AsyncDataLoaderMixin
+(reference: horovod/data/data_loader_base.py:20-130): a producer thread
+fills a bounded queue ahead of the consumer; `close()` (or GC) shuts the
+thread down. On TPU the prefetch hides host-side batch prep behind
+device steps — the single-host analog of an input pipeline.
+
+Also provides ElasticSampler parity: shard a dataset across ranks with
+deterministic shuffling, and drop already-processed indices so an
+elastic reset resumes mid-epoch
+(reference: horovod/torch/elastic/sampler.py:24-140).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+class BaseDataLoader:
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class AsyncDataLoaderMixin:
+    """Mix into a loader class to add background prefetch::
+
+        class AsyncLoader(AsyncDataLoaderMixin, MyLoader):
+            pass
+
+    (reference: data/data_loader_base.py:48-130 — same MRO pattern).
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 4, **kwargs):
+        self.async_loader_queue_size = async_loader_queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._shutdown = threading.Event()
+        super().__init__(*args, **kwargs)
+
+    def close_async_loader(self):
+        self._shutdown.set()
+        if self._queue is not None:
+            try:  # unblock a full producer
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._worker is not None:
+            self._worker.join(timeout=10)
+            self._worker = None
+
+    def _producer(self):
+        try:
+            for batch in super().__iter__():
+                if self._shutdown.is_set():
+                    return
+                self._queue.put(batch)
+        except Exception as e:  # surface in consumer
+            self._queue.put(_LoaderError(e))
+        finally:
+            self._queue.put(_END)
+
+    def __iter__(self):
+        if self.async_loader_queue_size <= 0:
+            yield from super().__iter__()
+            return
+        self._shutdown.clear()
+        self._queue = queue.Queue(maxsize=self.async_loader_queue_size)
+        self._worker = threading.Thread(target=self._producer, daemon=True,
+                                        name="hvd-async-loader")
+        self._worker.start()
+        while True:
+            item = self._queue.get()
+            if item is _END:
+                break
+            if isinstance(item, _LoaderError):
+                raise item.error
+            yield item
+        self._worker.join(timeout=10)
+        self._worker = None
+
+
+class _LoaderError:
+    def __init__(self, error):
+        self.error = error
+
+
+_END = object()
+
+
+class ElasticSampler:
+    """Deterministic rank-sharded sampler that survives elastic resets
+    (reference: horovod/torch/elastic/sampler.py:24-140).
+
+    ``record_batch``/``record_indices`` mark samples as processed; after a
+    reset (new rank/size), ``set_epoch``-style reshuffling excludes the
+    processed set so the epoch resumes where it left off.
+    """
+
+    def __init__(self, dataset_size: int, shuffle: bool = True, seed: int = 0):
+        self.dataset_size = dataset_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set = set()
+        self._refresh()
+
+    def _topology(self):
+        from horovod_tpu.common import basics
+
+        if basics.is_initialized():
+            return basics.rank(), basics.size()
+        return 0, 1
+
+    def _refresh(self):
+        rank, size = self._topology()
+        remaining = np.array(
+            [i for i in range(self.dataset_size)
+             if i not in self.processed_indices], dtype=np.int64)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(remaining)
+        # Truncate so every rank yields the same number of samples.
+        per_rank = len(remaining) // size
+        self.num_samples = per_rank
+        self.indices: List[int] = remaining[
+            rank * per_rank:(rank + 1) * per_rank].tolist()
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.processed_indices.clear()
+        self._refresh()
+
+    def record_batch(self, batch_idx: int, batch_size: int):
+        start = batch_idx * batch_size
+        self.record_indices(self.indices[start:start + batch_size])
+
+    def record_indices(self, indices):
+        self.processed_indices.update(int(i) for i in indices)
+
+    def reset(self):
+        """Re-shard after a topology change, excluding processed samples
+        (called from an elastic reset callback)."""
+        self._refresh()
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,17 @@
+"""Keras binding (reference: horovod/keras/__init__.py:1-456).
+
+``import horovod_tpu.keras as hvd`` gives the Keras-flavored surface:
+``DistributedOptimizer`` for model.compile, broadcast/metric callbacks.
+"""
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_initialized, local_rank, local_size,
+    rank, shutdown, size,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    Adasum, Average, Sum,
+    DistributedOptimizer,
+    allgather, allgather_object, allreduce, broadcast, broadcast_object,
+    broadcast_variables,
+)
+from horovod_tpu.keras import callbacks  # noqa: F401

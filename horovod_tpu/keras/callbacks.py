@@ -1,0 +1,82 @@
+"""Keras callbacks (reference: horovod/_keras/callbacks.py:23-241,
+horovod/keras/callbacks.py:151-190)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import tensorflow as tf
+from tensorflow import keras
+
+import horovod_tpu.tensorflow as hvd
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast initial variable state from root_rank at train start
+    (reference: _keras/callbacks.py:23-48)."""
+
+    def __init__(self, root_rank=0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        if hvd.size() > 1:
+            hvd.broadcast_variables(self.model.trainable_variables,
+                                    root_rank=self.root_rank)
+            hvd.broadcast_variables(self.model.optimizer.variables,
+                                    root_rank=self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over ranks (reference:
+    _keras/callbacks.py:49-94)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs and hvd.size() > 1:
+            for k in list(logs.keys()):
+                value = np.asarray(float(logs[k]), dtype=np.float64)
+                logs[k] = float(np.asarray(hvd.allreduce(
+                    value, op=hvd.Average, name="metric.%s" % k)))
+
+
+class LearningRateWarmupCallback(keras.callbacks.Callback):
+    """Scale LR linearly from initial to initial*size over warmup epochs
+    (reference: _keras/callbacks.py:96-241)."""
+
+    def __init__(self, initial_lr, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self.current_epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.current_epoch >= self.warmup_epochs:
+            return
+        if not self.steps_per_epoch:
+            return
+        progress = (self.current_epoch * self.steps_per_epoch + batch) / \
+            float(self.warmup_epochs * self.steps_per_epoch)
+        scale = 1.0 + progress * (hvd.size() - 1.0)
+        self.model.optimizer.learning_rate.assign(self.initial_lr * scale)
+
+
+class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
+    """Checkpoint only on rank 0 (reference: keras/callbacks.py:151-190)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("save_best_only", True)
+        super().__init__(*args, **kwargs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if hvd.rank() == 0:
+            super().on_epoch_end(epoch, logs)

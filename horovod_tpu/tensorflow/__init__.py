@@ -1,0 +1,228 @@
+"""TensorFlow binding: ``import horovod_tpu.tensorflow as hvd``.
+
+Parity with the reference's TF surface
+(reference: horovod/tensorflow/__init__.py:55-855 — allreduce with
+Average/Sum/Adasum handling, DistributedOptimizer, DistributedGradientTape,
+broadcast_variables; horovod/tensorflow/mpi_ops.py op wrappers). Eager
+tensors bridge through numpy to the shared eager/native path;
+``tf.function`` graphs reach it through ``tf.numpy_function``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.tensorflow requires tensorflow to be installed"
+    ) from e
+
+from horovod_tpu.common import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt, ProcessSet,
+    add_process_set, global_process_set, remove_process_set,
+)
+from horovod_tpu.common.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_homogeneous, is_initialized,
+    local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
+    shutdown, size, start_timeline, stop_timeline, tpu_built,
+)
+from horovod_tpu.common import basics
+from horovod_tpu.ops import collective_ops as C
+from horovod_tpu.ops import eager
+
+Average = C.Average
+Sum = C.Sum
+Adasum = C.Adasum
+Min = C.Min
+Max = C.Max
+Product = C.Product
+
+
+def allreduce(tensor, average=None, op=None, name=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              compression=None, process_set=global_process_set):
+    """(reference: horovod/tensorflow/__init__.py:55-162)"""
+    op = eager._effective_op(op, average)
+    name = name or "HorovodAllreduce"
+
+    def _run(x):
+        return np.asarray(eager.synchronize(eager.allreduce_async(
+            x, name=name, op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)))
+
+    @tf.custom_gradient
+    def _fwd(x):
+        if tf.executing_eagerly():
+            y = tf.convert_to_tensor(_run(x.numpy()))
+        else:
+            y = tf.numpy_function(_run, [x], x.dtype)
+            y.set_shape(x.shape)
+
+        def grad(dy):
+            # Gradient of allreduce is allreduce with the same op
+            # (reference: tensorflow/mpi_ops.py:131-151).
+            return allreduce(dy, op=op, name=name + "_grad",
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             process_set=process_set)
+
+        return y, grad
+
+    return _fwd(tf.convert_to_tensor(tensor))
+
+
+def grouped_allreduce(tensors, average=None, op=None, name=None,
+                      process_set=global_process_set):
+    op = eager._effective_op(op, average)
+    name = name or "HorovodGroupedAllreduce"
+    arrays = [t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+              for t in tensors]
+    outs = eager.synchronize(eager.grouped_allreduce_async(
+        arrays, name=name, op=op, process_set=process_set))
+    return [tf.convert_to_tensor(np.asarray(o)) for o in outs]
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    name = name or "HorovodAllgather"
+    out = eager.synchronize(eager.allgather_async(
+        np.asarray(tensor), name=name, process_set=process_set))
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def broadcast(tensor, root_rank, name=None,
+              process_set=global_process_set):
+    name = name or "HorovodBroadcast"
+    out = eager.synchronize(eager.broadcast_async(
+        np.asarray(tensor), root_rank, name=name, process_set=process_set))
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    name = name or "HorovodAlltoall"
+    out, rsplits = eager.synchronize(eager.alltoall_async(
+        np.asarray(tensor),
+        None if splits is None else np.asarray(splits), name=name,
+        process_set=process_set))
+    return (tf.convert_to_tensor(np.asarray(out)),
+            tf.convert_to_tensor(np.asarray(rsplits)))
+
+
+def reducescatter(tensor, op=Sum, name=None,
+                  process_set=global_process_set):
+    name = name or "HorovodReducescatter"
+    out = eager.synchronize(eager.reducescatter_async(
+        np.asarray(tensor), name=name, op=op, process_set=process_set))
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def join():
+    return eager.join()
+
+
+def barrier(process_set=global_process_set):
+    eager.barrier(process_set)
+
+
+def broadcast_variables(variables, root_rank=0,
+                        process_set=global_process_set):
+    """In-place broadcast of tf.Variables
+    (reference: horovod/tensorflow/functions.py broadcast_variables)."""
+    for i, v in enumerate(variables):
+        out = eager.synchronize(eager.broadcast_async(
+            v.numpy(), root_rank,
+            name="broadcast_variables.%d" % i, process_set=process_set))
+        v.assign(np.asarray(out))
+
+
+def broadcast_object(obj, root_rank=0, name=None,
+                     process_set=global_process_set):
+    from horovod_tpu.jax.functions import broadcast_object as _bo
+
+    return _bo(obj, root_rank, name=name, process_set=process_set)
+
+
+def allgather_object(obj, name=None, process_set=global_process_set):
+    from horovod_tpu.jax.functions import allgather_object as _ao
+
+    return _ao(obj, name=name, process_set=process_set)
+
+
+class Compression:
+    """(reference: horovod/tensorflow/compression.py)"""
+
+    class none:
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        @staticmethod
+        def compress(t):
+            if t.dtype in (tf.float32, tf.float64):
+                return tf.cast(t, tf.float16), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return tf.cast(t, ctx) if ctx is not None else t
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """Tape whose ``gradient()`` allreduces the results
+    (reference: horovod/tensorflow/__init__.py:758-855)."""
+
+    def __init__(self, tape=None, op=Average, compression=None,
+                 process_set=global_process_set, persistent=False,
+                 watch_accessed_variables=True):
+        if tape is not None:
+            self.__dict__.update(tape.__dict__)
+        else:
+            super().__init__(persistent=persistent,
+                             watch_accessed_variables=watch_accessed_variables)
+        self._hvd_op = op
+        self._hvd_process_set = process_set
+
+    def gradient(self, target, sources, output_gradients=None, **kwargs):
+        grads = super().gradient(target, sources, output_gradients,
+                                 **kwargs)
+        if basics.size() <= 1:
+            return grads
+        flat = [g for g in grads if g is not None]
+        reduced = grouped_allreduce(flat, op=self._hvd_op,
+                                    name="DistributedGradientTape",
+                                    process_set=self._hvd_process_set)
+        it = iter(reduced)
+        return [None if g is None else next(it) for g in grads]
+
+
+def DistributedOptimizer(optimizer, op=Average, name=None,
+                         process_set=global_process_set,
+                         backward_passes_per_step=1):
+    """Wrap a Keras optimizer so apply_gradients allreduces first
+    (reference: horovod/tensorflow/__init__.py:627-757; keras wrapper
+    horovod/keras/__init__.py)."""
+    del backward_passes_per_step  # local aggregation: use tape-side accum
+
+    base = optimizer.__class__
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        grads_and_vars = list(grads_and_vars)
+        if basics.size() > 1:
+            grads = [g for g, _ in grads_and_vars]
+            reduced = grouped_allreduce(grads, op=op,
+                                        name="DistributedOptimizer",
+                                        process_set=process_set)
+            grads_and_vars = [(r, v) for r, (_, v) in
+                              zip(reduced, grads_and_vars)]
+        return base.apply_gradients(self, grads_and_vars, *args, **kwargs)
+
+    cls = type(base.__name__, (base,),
+               {"apply_gradients": apply_gradients})
+    return cls.from_config(optimizer.get_config())

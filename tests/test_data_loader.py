@@ -1,0 +1,70 @@
+"""Async data loader + ElasticSampler tests
+(reference analog: horovod/data/data_loader_base.py behaviors,
+horovod/torch/elastic/sampler.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.data import AsyncDataLoaderMixin, ElasticSampler
+
+
+class SlowLoader:
+    def __init__(self, n=10, delay=0.01):
+        self.n = n
+        self.delay = delay
+
+    def __iter__(self):
+        for i in range(self.n):
+            time.sleep(self.delay)
+            yield np.full(4, i)
+
+
+class AsyncSlowLoader(AsyncDataLoaderMixin, SlowLoader):
+    pass
+
+
+def test_async_loader_yields_everything_in_order():
+    loader = AsyncSlowLoader(n=12, async_loader_queue_size=3)
+    out = [int(b[0]) for b in loader]
+    assert out == list(range(12))
+    # Reusable for a second epoch.
+    out = [int(b[0]) for b in loader]
+    assert out == list(range(12))
+
+
+def test_async_loader_propagates_errors():
+    class FailingLoader:
+        def __iter__(self):
+            yield np.zeros(1)
+            raise RuntimeError("boom")
+
+    class AsyncFailing(AsyncDataLoaderMixin, FailingLoader):
+        pass
+
+    loader = AsyncFailing(async_loader_queue_size=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)
+
+
+def test_async_loader_disabled_queue():
+    loader = AsyncSlowLoader(n=3, delay=0.0, async_loader_queue_size=0)
+    assert len(list(loader)) == 3
+
+
+def test_elastic_sampler_sharding_and_resume():
+    hvd.init()
+    s = ElasticSampler(dataset_size=100, shuffle=True, seed=5)
+    assert len(s) == 100  # size-1 world
+    first_20 = list(s)[:20]
+    s.record_indices(first_20)
+    s.reset()
+    # After reset, the processed samples are excluded.
+    remaining = set(s)
+    assert not (set(first_20) & remaining)
+    assert len(remaining) == 80
+    # New epoch restores the full set.
+    s.set_epoch(1)
+    assert len(s) == 100

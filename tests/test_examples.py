@@ -1,0 +1,42 @@
+"""End-to-end example runs through the launcher (the reference treats
+examples/ as the de-facto acceptance suite, SURVEY.md §2.9)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(rel, np_, extra_args, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+         sys.executable, os.path.join(_REPO, rel)] + extra_args,
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_jax_mnist_example():
+    proc = _run_example("examples/jax/jax_mnist.py", 2,
+                        ["--epochs", "1", "--steps-per-epoch", "3",
+                         "--batch-size", "16"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "epoch 0 loss" in proc.stdout
+
+
+def test_pytorch_mnist_example():
+    proc = _run_example("examples/pytorch/pytorch_mnist.py", 2,
+                        ["--epochs", "1", "--steps-per-epoch", "3",
+                         "--batch-size", "16"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "epoch 0 loss" in proc.stdout
+
+
+def test_adasum_example():
+    proc = _run_example("examples/adasum/adasum_small_model.py", 2,
+                        ["--steps", "30"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "final ||w - w*||" in proc.stdout

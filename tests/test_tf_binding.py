@@ -1,0 +1,77 @@
+"""TensorFlow binding tests (single-process + np=2 worker)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+
+
+def test_allreduce_size1():
+    x = tf.constant([1.0, 2.0, 3.0])
+    out = hvd.allreduce(x, name="t")
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_allreduce_gradient():
+    x = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        y = hvd.allreduce(x, op=hvd.Sum, name="g")
+        loss = tf.reduce_sum(y * y)
+    g = tape.gradient(loss, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+
+def test_tape_and_optimizer_size1():
+    w = tf.Variable([1.0])
+    with hvd.DistributedGradientTape() as tape:
+        loss = tf.reduce_sum(w * 3.0)
+    (g,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(g.numpy(), [3.0])
+
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(learning_rate=1.0))
+    opt.apply_gradients([(tf.constant([1.0]), w)])
+    np.testing.assert_allclose(w.numpy(), [0.0])
+
+
+def test_other_ops_size1():
+    t = tf.constant([1, 2, 3], dtype=tf.int64)
+    np.testing.assert_array_equal(hvd.allgather(t, name="a").numpy(),
+                                  [1, 2, 3])
+    np.testing.assert_array_equal(hvd.broadcast(t, 0, name="b").numpy(),
+                                  [1, 2, 3])
+    out, splits = hvd.alltoall(t, name="c")
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+    hvd.barrier()
+
+
+def test_keras_callbacks_importable():
+    from horovod_tpu.keras import callbacks
+
+    assert callbacks.BroadcastGlobalVariablesCallback
+    assert callbacks.MetricAverageCallback
+    assert callbacks.LearningRateWarmupCallback
+    assert callbacks.BestModelCheckpoint
+
+
+def test_tf_multiproc():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(_REPO, "tests", "tf_worker.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("TF_OK") == 2

@@ -1,0 +1,56 @@
+"""np=2 TF worker: allreduce, DistributedGradientTape, broadcast."""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    out = hvd.allreduce(tf.constant([1.0, 2.0]) * (r + 1), op=hvd.Sum,
+                        name="tf.ar")
+    np.testing.assert_allclose(out.numpy(), np.array([1.0, 2.0]) * 3)
+
+    # Tape: per-rank grads averaged.
+    w = tf.Variable([1.0, 1.0])
+    with hvd.DistributedGradientTape(op=hvd.Average) as tape:
+        loss = tf.reduce_sum(w * float(r + 1))
+    (g,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(g.numpy(), [1.5, 1.5])
+
+    # broadcast_variables aligns variables with rank 0.
+    v = tf.Variable([float(r), float(r)])
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [0.0, 0.0])
+
+    # DistributedOptimizer: identical steps on both ranks.
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5))
+    w2 = tf.Variable([2.0, 2.0])
+    grads = [tf.constant([float(r + 1), float(r + 1)])]
+    opt.apply_gradients(zip(grads, [w2]))
+    np.testing.assert_allclose(w2.numpy(), [2.0 - 0.5 * 1.5] * 2)
+
+    # allgather + alltoall sanity.
+    g = hvd.allgather(tf.constant([[float(r)]]), name="tf.ag")
+    np.testing.assert_allclose(g.numpy().ravel(), [0.0, 1.0])
+    a2a, splits = hvd.alltoall(tf.constant([float(r), float(r)]),
+                               name="tf.a2a")
+    np.testing.assert_allclose(a2a.numpy(), [0.0, 1.0])
+
+    hvd.shutdown()
+    print("TF_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over the
+``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); this module
+makes it first-class TPU-style: every chip on the ``pipe`` axis owns one
+stage's parameters, microbatches stream through a ``lax.scan`` whose body
+runs each stage and hands activations to the next chip with
+``ppermute`` — compiler-visible, static-shape, and differentiable (the
+backward pass reverses the permutes automatically, giving the standard
+fill-and-drain schedule).
+
+Requirements: all stages share one function/parameter structure (e.g. a
+stack of identical transformer blocks with the layer dim sharded over
+``pipe``); microbatch count M >= 1; total steps = M + n_stages - 1.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import PIPE_AXIS
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
+                   *, axis=PIPE_AXIS):
+    """Run sharded stages over a stream of microbatches.
+
+    Args:
+        stage_fn: ``(stage_params, x) -> y`` applying this chip's stage;
+            input and output activation shapes must match across stages.
+        stage_params: this chip's stage parameters (under shard_map the
+            per-device shard of the stacked stage weights).
+        microbatches: (M, mb, ...) array, replicated on every stage;
+            stage 0 consumes them in order.
+
+    Returns: (M, mb, ...) outputs of the final stage, valid on the last
+    stage's chips (other stages see zeros — combine with a psum or read
+    from the last stage, as the caller prefers).
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    steps = m + n - 1
+    act0 = jnp.zeros_like(microbatches[0])
+    shift_perm = [(i, i + 1) for i in range(n - 1)]
+
+    def body(carry, t):
+        incoming = carry
+        # Stage 0 injects microbatch t (clamped during drain); later
+        # stages consume the activation shifted in from the left.
+        mb_idx = jnp.clip(t, 0, m - 1)
+        x = jnp.where(idx == 0, microbatches[mb_idx], incoming)
+        y = stage_fn(stage_params, x)
+        outgoing = lax.ppermute(y, axis, shift_perm)
+        emitted = jnp.where(idx == n - 1, y, jnp.zeros_like(y))
+        return outgoing, emitted
+
+    _, emitted = lax.scan(body, act0, jnp.arange(steps))
+    # The last stage emits microbatch j at step j + (n - 1).
+    return emitted[n - 1:]
+
+
+def pipeline_loss(stage_fn: Callable, stage_params, microbatches,
+                  loss_fn: Callable, *, axis=PIPE_AXIS):
+    """Pipeline forward + loss as a *per-stage local* scalar: the true
+    loss on the last stage, 0.0 elsewhere.
+
+    Differentiate THIS value under shard_map (``jax.grad`` of the local
+    scalar): the last stage seeds the single cotangent and the transposed
+    ppermutes deliver gradients to every stage's parameters. Replicating
+    the scalar first (psum/all_gather) and then differentiating would
+    seed one cotangent per stage and inflate gradients by the axis size.
+    To *read* the loss value, psum it outside the differentiated region:
+    ``lax.psum(pipeline_loss(...), axis)`` (stages other than the last
+    contribute zero)."""
+    outs = pipeline_apply(stage_fn, stage_params, microbatches, axis=axis)
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    return jnp.where(idx == n - 1, loss_fn(outs), 0.0)

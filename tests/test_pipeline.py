@@ -1,0 +1,104 @@
+"""Pipeline parallelism correctness: sharded stages == sequential stack."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import make_mesh
+from horovod_tpu.parallel.pipeline import pipeline_apply, pipeline_loss
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+
+
+def _stage_fn(w, x):
+    # One stage = one dense layer with tanh.
+    return jnp.tanh(x @ w)
+
+
+def _sequential(ws, x):
+    for i in range(ws.shape[0]):
+        x = _stage_fn(ws[i], x)
+    return x
+
+
+def test_pipeline_matches_sequential():
+    n_stages, m, mb, d = 4, 6, 3, 8
+    rng = np.random.RandomState(0)
+    ws = rng.randn(n_stages, d, d).astype(np.float32) * 0.5
+    xs = rng.randn(m, mb, d).astype(np.float32)
+
+    mesh = make_mesh({"pipe": n_stages},
+                     devices=jax.devices()[:n_stages])
+
+    def fn(ws_local, xs_rep):
+        out = pipeline_apply(lambda w, x: _stage_fn(w[0], x), ws_local,
+                             xs_rep)
+        # Share the last stage's outputs with everyone for comparison.
+        return jax.lax.psum(out, "pipe")
+
+    sm = shard_map(fn, mesh=mesh, in_specs=(P("pipe"), P()),
+                   out_specs=P(), check_vma=False)
+    out = np.asarray(jax.jit(sm)(ws, xs))
+
+    expect = np.stack([_sequential(ws, xs[j]) for j in range(m)])
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_gradients_flow_to_all_stages():
+    n_stages, m, mb, d = 4, 4, 2, 6
+    rng = np.random.RandomState(1)
+    ws = rng.randn(n_stages, d, d).astype(np.float32) * 0.5
+    xs = rng.randn(m, mb, d).astype(np.float32)
+
+    mesh = make_mesh({"pipe": n_stages},
+                     devices=jax.devices()[:n_stages])
+
+    def loss(ws_local, xs_rep):
+        # Per-stage local scalar (see pipeline_loss docstring): grad of
+        # the local value gives exact gradients on every stage.
+        return pipeline_loss(lambda w, x: _stage_fn(w[0], x), ws_local,
+                             xs_rep, lambda outs: jnp.mean(outs ** 2))
+
+    def grad_and_loss(ws_local, xs_rep):
+        g = jax.grad(loss)(ws_local, xs_rep)
+        value = jax.lax.psum(loss(ws_local, xs_rep), "pipe")
+        return g, value
+
+    sm = shard_map(grad_and_loss, mesh=mesh, in_specs=(P("pipe"), P()),
+                   out_specs=(P("pipe"), P()), check_vma=False)
+    g, value = jax.jit(sm)(ws, xs)
+    g = np.asarray(g)
+    assert g.shape == ws.shape
+
+    # Reference gradient: sequential network, mean over microbatches.
+    def ref_loss(ws_):
+        outs = jnp.stack([_sequential(ws_, xs[j]) for j in range(m)])
+        return jnp.mean(outs ** 2)
+
+    g_ref = np.asarray(jax.grad(ref_loss)(jnp.asarray(ws)))
+    np.testing.assert_allclose(g, g_ref, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(value), float(ref_loss(jnp.asarray(ws))),
+                               rtol=1e-5)
+
+
+def test_pipeline_single_stage_degenerates():
+    mesh = make_mesh({"pipe": 1}, devices=jax.devices()[:1])
+    xs = np.random.RandomState(2).randn(3, 2, 4).astype(np.float32)
+    w = np.random.RandomState(3).randn(1, 4, 4).astype(np.float32)
+
+    sm = shard_map(
+        lambda w_, x_: pipeline_apply(lambda wi, x: _stage_fn(wi[0], x),
+                                      w_, x_),
+        mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
+        check_vma=False)
+    out = np.asarray(jax.jit(sm)(w, xs))
+    expect = np.stack([_stage_fn(w[0], xs[j]) for j in range(3)])
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)

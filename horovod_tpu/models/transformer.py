@@ -48,8 +48,9 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
-    # 'dense' | 'ring' (ring attention over the seq axis, sequence
-    # parallelism) | 'ulysses' (all_to_all head/seq re-sharding).
+    # 'dense' | 'flash' (fused Pallas kernel, ops/pallas_attention.py) |
+    # 'ring' (ring attention over the seq axis, sequence parallelism) |
+    # 'ulysses' (all_to_all head/seq re-sharding).
     attention: str = "dense"
     seq_axis: Optional[str] = None  # mesh axis for ring/ulysses attention
     # MoE: 0 = dense MLP; >0 = top-1 routed experts over the 'expert' axis.
@@ -92,6 +93,10 @@ class SelfAttention(nn.Module):
         v = jnp.einsum("bsm,mhd->bshd", x, wqkv[2])
         if cfg.attention == "dense":
             ctx = _dense_causal_attention(q, k, v, cfg.dtype)
+        elif cfg.attention == "flash":
+            from horovod_tpu.ops.pallas_attention import flash_attention
+
+            ctx = flash_attention(q, k, v, causal=True).astype(cfg.dtype)
         elif cfg.attention == "ring":
             from horovod_tpu.parallel.sequence import ring_attention
 

@@ -15,6 +15,9 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     grouped_allreduce as grouped_allreduce_ingraph,
     reducescatter as reducescatter_ingraph,
 )
+from horovod_tpu.ops.pallas_attention import (  # noqa: F401
+    flash_attention,
+)
 from horovod_tpu.ops.eager import (  # noqa: F401
     allgather,
     allgather_async,

@@ -150,6 +150,11 @@ def allgather_object(obj, name=None, process_set=global_process_set):
     return _ao(obj, name=name, process_set=process_set)
 
 
+from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: F401,E402
+    SyncBatchNormalization,
+)
+
+
 class Compression:
     """(reference: horovod/tensorflow/compression.py)"""
 
@@ -204,24 +209,62 @@ class DistributedGradientTape(tf.GradientTape):
 
 def DistributedOptimizer(optimizer, op=Average, name=None,
                          process_set=global_process_set,
-                         backward_passes_per_step=1):
+                         backward_passes_per_step=1,
+                         sparse_as_dense=False,
+                         average_aggregated_gradients=True):
     """Wrap a Keras optimizer so apply_gradients allreduces first
     (reference: horovod/tensorflow/__init__.py:627-757; keras wrapper
-    horovod/keras/__init__.py)."""
-    del backward_passes_per_step  # local aggregation: use tape-side accum
+    horovod/keras/__init__.py). With ``backward_passes_per_step > 1``,
+    gradients aggregate locally and are communicated + applied only every
+    Nth step (reference: horovod/tensorflow/gradient_aggregation.py)."""
+    from horovod_tpu.tensorflow.gradient_aggregation import (
+        LocalGradientAggregationHelper,
+    )
 
     base = optimizer.__class__
 
-    def apply_gradients(self, grads_and_vars, *args, **kwargs):
-        grads_and_vars = list(grads_and_vars)
-        if basics.size() > 1:
-            grads = [g for g, _ in grads_and_vars]
-            reduced = grouped_allreduce(grads, op=op,
+    def _allreduce_list(grads):
+        """Allreduce a gradient list, passing None entries through.
+        Falls back to per-tensor allreduce (graph-safe via
+        tf.numpy_function) when not executing eagerly."""
+        if basics.size() <= 1:
+            return list(grads)
+        not_none = [g for g in grads if g is not None]
+        if tf.executing_eagerly():
+            reduced = grouped_allreduce(not_none, op=op,
                                         name="DistributedOptimizer",
                                         process_set=process_set)
-            grads_and_vars = [(r, v) for r, (_, v) in
-                              zip(reduced, grads_and_vars)]
-        return base.apply_gradients(self, grads_and_vars, *args, **kwargs)
+        else:
+            reduced = [allreduce(g, op=op,
+                                 name="DistributedOptimizer.%d" % i,
+                                 process_set=process_set)
+                       for i, g in enumerate(not_none)]
+        it = iter(reduced)
+        return [None if g is None else next(it) for g in grads]
+
+    agg_helper = None
+    if backward_passes_per_step > 1:
+        agg_helper = LocalGradientAggregationHelper(
+            backward_passes_per_step, _allreduce_list,
+            sparse_as_dense=sparse_as_dense,
+            average_aggregated_gradients=average_aggregated_gradients)
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        grads_and_vars = list(grads_and_vars)
+        grads = [g for g, _ in grads_and_vars]
+        variables = [v for _, v in grads_and_vars]
+        if agg_helper is None:
+            reduced = _allreduce_list(grads)
+            return base.apply_gradients(self, list(zip(reduced, variables)),
+                                        *args, **kwargs)
+        reduced = agg_helper.compute_aggregated_gradients(grads)
+        # Build slot variables outside the tf.cond branch — variable
+        # creation inside cond is illegal under tf.function.
+        if hasattr(self, "built") and not self.built:
+            self.build(variables)
+        return agg_helper.apply_gradients(
+            lambda: base.apply_gradients(
+                self, list(zip(reduced, variables)), *args, **kwargs))
 
     cls = type(base.__name__, (base,),
                {"apply_gradients": apply_gradients})

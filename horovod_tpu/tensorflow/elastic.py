@@ -1,0 +1,102 @@
+"""Elastic state for the TensorFlow binding.
+
+Parity with the reference's TF elastic states
+(reference: horovod/tensorflow/elastic.py:31-100 TensorFlowState /
+TensorFlowKerasState): snapshot tf.Variables (and Keras model/optimizer
+weights) on commit, broadcast rank 0's values on sync, restore the last
+commit on failure.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.common import basics
+from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.elastic import run  # noqa: F401  (re-export for hvd.elastic.run)
+
+
+class TensorFlowState(ObjectState):
+    """State of a list of tf.Variables (reference: tensorflow/elastic.py
+    TensorFlowState)."""
+
+    def __init__(self, variables=None, **kwargs):
+        self._variables = list(variables) if variables is not None else []
+        self._saved_variables = None
+        super().__init__(**kwargs)
+
+    def save(self):
+        super().save()
+        self._saved_variables = [v.numpy().copy() for v in self._variables]
+
+    def restore(self):
+        super().restore()
+        if self._saved_variables is not None:
+            for v, saved in zip(self._variables, self._saved_variables):
+                v.assign(saved)
+
+    def sync(self):
+        if basics.size() > 1:
+            from horovod_tpu import tensorflow as hvd_tf
+
+            hvd_tf.broadcast_variables(self._variables, root_rank=0)
+        super().sync()
+        self.save()
+
+
+class TensorFlowKerasState(ObjectState):
+    """State of a Keras model + optimizer (reference: tensorflow/elastic.py
+    TensorFlowKerasState)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self._model = model
+        self._optimizer = optimizer
+        self._saved_model_weights = None
+        self._saved_optimizer_vars = None
+        super().__init__(**kwargs)
+
+    def _optimizer_variables(self):
+        if self._optimizer is None:
+            return []
+        return list(getattr(self._optimizer, "variables", lambda: [])()
+                    if callable(getattr(self._optimizer, "variables", None))
+                    else self._optimizer.variables)
+
+    def save(self):
+        super().save()
+        if self._model is not None:
+            self._saved_model_weights = [w.copy() for w in
+                                         self._model.get_weights()]
+        ovars = self._optimizer_variables()
+        if ovars:
+            self._saved_optimizer_vars = [np.asarray(v).copy()
+                                          for v in ovars]
+
+    def restore(self):
+        super().restore()
+        if self._model is not None and self._saved_model_weights is not None:
+            self._model.set_weights(self._saved_model_weights)
+        ovars = self._optimizer_variables()
+        if ovars and self._saved_optimizer_vars is not None:
+            for v, saved in zip(ovars, self._saved_optimizer_vars):
+                v.assign(saved)
+
+    def sync(self):
+        if basics.size() > 1:
+            from horovod_tpu.jax.functions import broadcast_object
+
+            if self._model is not None:
+                weights = broadcast_object(
+                    [np.asarray(w) for w in self._model.get_weights()],
+                    root_rank=0, name="elastic.KerasModel")
+                self._model.set_weights(weights)
+            ovars = self._optimizer_variables()
+            if ovars:
+                vals = broadcast_object(
+                    [np.asarray(v) for v in ovars],
+                    root_rank=0, name="elastic.KerasOpt")
+                for v, val in zip(ovars, vals):
+                    v.assign(val)
+        super().sync()
+        self.save()

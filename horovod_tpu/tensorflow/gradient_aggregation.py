@@ -1,0 +1,122 @@
+"""Local gradient aggregation for the TensorFlow binding.
+
+TPU-native rework of the reference's local-aggregation helper
+(reference: horovod/tensorflow/gradient_aggregation.py:16-270 and
+gradient_aggregation_eager.py): gradients accumulate into per-variable
+``tf.Variable`` buffers and are allreduced + applied only every
+``backward_passes_per_step`` calls; other calls are local no-ops.
+
+All control flow is ``tf.cond`` on the counter variable, so the helper
+works both eagerly and inside a ``tf.function`` (e.g. Keras
+``model.fit`` train steps), where Python-level branching would bake a
+single branch into the trace.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class LocalGradientAggregationHelper:
+    """Aggregates gradients locally, communicating every N passes.
+
+    (reference: horovod/tensorflow/gradient_aggregation.py:16-270)
+    """
+
+    def __init__(self, backward_passes_per_step, allreduce_func,
+                 sparse_as_dense=False, average_aggregated_gradients=True):
+        if backward_passes_per_step <= 0:
+            raise ValueError("backward_passes_per_step must be > 0")
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_grads = allreduce_func
+        self.sparse_as_dense = sparse_as_dense
+        self.average_aggregated_gradients = average_aggregated_gradients
+        self.counter = None
+        self.locally_aggregated_grads = []
+        # Map original grad index -> index into locally_aggregated_grads
+        # (None grads are skipped, mirroring the reference's
+        # not_none_indexes bookkeeping).
+        self.not_none_indexes = {}
+        # Tensor (from the current trace/step) deciding whether this is a
+        # communicating step; consumed by apply_gradients' tf.cond.
+        self._should_communicate = None
+
+    def _maybe_convert_grad(self, grad):
+        if isinstance(grad, tf.IndexedSlices):
+            if self.sparse_as_dense:
+                return tf.convert_to_tensor(grad)
+            raise ValueError(
+                "IndexedSlices are not supported with "
+                "backward_passes_per_step > 1 unless sparse_as_dense=True")
+        return grad
+
+    def _init_aggregation_vars(self, grads):
+        if self.counter is not None:
+            return
+        self.counter = tf.Variable(0, dtype=tf.int32, trainable=False,
+                                   name="hvd_aggregation_counter")
+        for idx, grad in enumerate(grads):
+            grad = self._maybe_convert_grad(grad)
+            if grad is None:
+                continue
+            self.not_none_indexes[idx] = len(self.locally_aggregated_grads)
+            self.locally_aggregated_grads.append(
+                tf.Variable(tf.zeros_like(grad), trainable=False,
+                            name="hvd_agg_grad_%d" % idx))
+
+    def compute_aggregated_gradients(self, grads):
+        """Accumulate ``grads``; on every Nth call the returned tensors are
+        the allreduced accumulation (optionally averaged over N) and the
+        buffers reset; off-step calls return the local accumulators."""
+        self._init_aggregation_vars(grads)
+        accum_ops = []
+        for idx, grad in enumerate(grads):
+            grad = self._maybe_convert_grad(grad)
+            if grad is None:
+                continue
+            accum_ops.append(self.locally_aggregated_grads[
+                self.not_none_indexes[idx]].assign_add(grad))
+        with tf.control_dependencies(accum_ops):
+            count = self.counter.assign_add(1)
+        self._should_communicate = tf.equal(
+            count % self.backward_passes_per_step, 0)
+
+        def _communicate():
+            agg = [tf.identity(v) for v in self.locally_aggregated_grads]
+            if self.average_aggregated_gradients:
+                agg = [g / self.backward_passes_per_step for g in agg]
+            reduced = self._allreduce_grads(agg)
+            with tf.control_dependencies(reduced):
+                resets = [v.assign(tf.zeros_like(v))
+                          for v in self.locally_aggregated_grads]
+            with tf.control_dependencies(resets):
+                return [tf.identity(r) for r in reduced]
+
+        def _local():
+            return [tf.identity(v) for v in self.locally_aggregated_grads]
+
+        if not self.locally_aggregated_grads:
+            return list(grads)
+        outs = tf.cond(self._should_communicate, _communicate, _local)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        it = iter(outs)
+        return [None if idx not in self.not_none_indexes else next(it)
+                for idx in range(len(grads))]
+
+    def apply_gradients(self, apply_grads_closure):
+        """Run ``apply_grads_closure`` only on communicating steps
+        (reference: gradient_aggregation.py apply_gradients tf.cond).
+        Must be called after compute_aggregated_gradients in the same
+        step/trace."""
+        if self._should_communicate is None:
+            return apply_grads_closure()
+
+        def _apply():
+            apply_grads_closure()
+            return tf.constant(True)
+
+        def _skip():
+            return tf.constant(False)
+
+        return tf.cond(self._should_communicate, _apply, _skip)

@@ -1,0 +1,49 @@
+"""Synchronous batch normalization for the TensorFlow binding.
+
+Parity with the reference's TF sync BN
+(reference: horovod/tensorflow/sync_batch_norm.py:22-60): override the
+layer's moment computation to average first and second moments across
+workers with a Sum allreduce, then recompute the global variance as
+E[X^2] - E[X]^2.
+
+Written against Keras 3's ``_moments(self, inputs, mask)`` hook (the
+reference targets Keras 2's ``_moments(inputs, axes, keep_dims)``).
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+from horovod_tpu.common import basics
+
+
+class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+    """Batch norm whose training statistics are synchronized across all
+    workers (reference: horovod/tensorflow/sync_batch_norm.py:22-60)."""
+
+    def __init__(self, fused=False, **kwargs):
+        if fused in (True, None):
+            raise ValueError(
+                "SyncBatchNormalization does not support fused=True.")
+        if not kwargs.get("name", None):
+            kwargs["name"] = "sync_batch_normalization"
+        kwargs.pop("fused", None)
+        super().__init__(**kwargs)
+
+    def _moments(self, inputs, mask):
+        worker_mean, worker_variance = super()._moments(inputs, mask)
+        if basics.size() <= 1:
+            return worker_mean, worker_variance
+
+        from horovod_tpu import tensorflow as hvd_tf
+
+        # Var[X] = E[X^2] - E[X]^2, so averaging (mean, mean-of-square)
+        # across workers yields exact global moments.
+        worker_mean_of_square = worker_variance + tf.math.square(worker_mean)
+        stack = tf.stack([worker_mean, worker_mean_of_square])
+        group = hvd_tf.allreduce(stack, op=hvd_tf.Sum,
+                                 name="sync_batch_norm_moments")
+        group = group / float(basics.size())
+        group_mean, group_mean_of_square = tf.unstack(group)
+        group_variance = group_mean_of_square - tf.math.square(group_mean)
+        return group_mean, group_variance

@@ -66,6 +66,94 @@ def test_keras_callbacks_importable():
     assert callbacks.BestModelCheckpoint
 
 
+def test_local_gradient_aggregation_size1():
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+    w = tf.Variable([0.0])
+    # First pass: accumulate only, no apply.
+    opt.apply_gradients([(tf.constant([1.0]), w)])
+    np.testing.assert_allclose(w.numpy(), [0.0])
+    # Second pass: allreduce the averaged accumulation and apply.
+    opt.apply_gradients([(tf.constant([3.0]), w)])
+    np.testing.assert_allclose(w.numpy(), [-2.0])  # (1+3)/2 = 2
+
+
+def test_aggregation_helper_sum_mode():
+    from horovod_tpu.tensorflow.gradient_aggregation import (
+        LocalGradientAggregationHelper,
+    )
+
+    h = LocalGradientAggregationHelper(
+        2, lambda gs: gs, average_aggregated_gradients=False)
+    out = h.compute_aggregated_gradients([tf.constant([1.0]), None])
+    assert out[1] is None
+    out = h.compute_aggregated_gradients([tf.constant([2.0]), None])
+    np.testing.assert_allclose(out[0].numpy(), [3.0])
+    # Buffers reset after the communicating step.
+    out = h.compute_aggregated_gradients([tf.constant([5.0]), None])
+    np.testing.assert_allclose(out[0].numpy(), [5.0])
+
+
+def test_local_gradient_aggregation_tf_function():
+    """Aggregation must alternate correctly inside a tf.function trace."""
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+    w = tf.Variable([0.0])
+
+    @tf.function
+    def step(g):
+        opt.apply_gradients([(g, w)])
+
+    step(tf.constant([1.0]))
+    np.testing.assert_allclose(w.numpy(), [0.0])
+    step(tf.constant([3.0]))
+    np.testing.assert_allclose(w.numpy(), [-2.0])
+    step(tf.constant([10.0]))
+    np.testing.assert_allclose(w.numpy(), [-2.0])
+    step(tf.constant([10.0]))
+    np.testing.assert_allclose(w.numpy(), [-12.0])
+
+
+def test_sync_batch_norm_size1():
+    layer = hvd.SyncBatchNormalization(axis=-1)
+    x = tf.random.normal([8, 4])
+    out = layer(x, training=True)
+    # With one worker this must behave exactly like plain batch norm.
+    ref = tf.keras.layers.BatchNormalization(axis=-1)
+    ref.build(x.shape)
+    np.testing.assert_allclose(out.numpy(), ref(x, training=True).numpy(),
+                               atol=1e-5)
+    with pytest.raises(ValueError):
+        hvd.SyncBatchNormalization(fused=True)
+
+
+def test_tf_elastic_state_save_restore():
+    from horovod_tpu.tensorflow.elastic import (
+        TensorFlowKerasState, TensorFlowState,
+    )
+
+    v = tf.Variable([1.0, 2.0])
+    st = TensorFlowState(variables=[v], step=3)
+    st.save()
+    v.assign([9.0, 9.0])
+    st.step = 7
+    st.restore()
+    np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
+    assert st.step == 3
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(2, input_shape=(2,))])
+    opt = tf.keras.optimizers.SGD()
+    ks = TensorFlowKerasState(model=model, optimizer=opt, epoch=1)
+    ks.save()
+    orig = [w.copy() for w in model.get_weights()]
+    model.set_weights([w * 0 for w in model.get_weights()])
+    ks.restore()
+    for a, b in zip(model.get_weights(), orig):
+        np.testing.assert_allclose(a, b)
+
+
 def test_tf_multiproc():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

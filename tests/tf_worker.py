@@ -47,6 +47,37 @@ def main():
                                name="tf.a2a")
     np.testing.assert_allclose(a2a.numpy(), [0.0, 1.0])
 
+    # SyncBatchNormalization: global moments across both ranks.
+    layer = hvd.SyncBatchNormalization(axis=-1, epsilon=1e-5)
+    x = tf.ones([4, 2]) * float(r)  # rank 0 -> zeros, rank 1 -> ones
+    out = layer(x, training=True)
+    # Global mean 0.5, var 0.25 -> rank 0 normalizes to ~-1, rank 1 to ~+1.
+    expect = (float(r) - 0.5) / np.sqrt(0.25 + 1e-5)
+    np.testing.assert_allclose(out.numpy(), np.full((4, 2), expect),
+                               atol=1e-4)
+
+    # backward_passes_per_step=2: first apply is a local no-op, the
+    # second communicates the averaged accumulation.
+    opt2 = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+    w3 = tf.Variable([0.0])
+    opt2.apply_gradients([(tf.constant([float(r + 1)]), w3)])
+    np.testing.assert_allclose(w3.numpy(), [0.0])
+    opt2.apply_gradients([(tf.constant([float(r + 1)]), w3)])
+    # Each rank accumulates 2*(r+1), averaged over 2 passes -> (r+1),
+    # then averaged across ranks -> 1.5.
+    np.testing.assert_allclose(w3.numpy(), [-1.5])
+
+    # TensorFlowKerasState.sync aligns ranks with rank 0.
+    from horovod_tpu.tensorflow.elastic import TensorFlowState
+
+    v4 = tf.Variable([float(r) + 5.0])
+    st = TensorFlowState(variables=[v4], batch=r)
+    st.sync()
+    np.testing.assert_allclose(v4.numpy(), [5.0])
+    assert st.batch == 0
+
     hvd.shutdown()
     print("TF_OK rank=%d" % r)
     return 0

@@ -1,5 +1,5 @@
 from horovod_tpu.data.data_loader import (  # noqa: F401
     AsyncDataLoaderMixin,
     BaseDataLoader,
-    ElasticSampler,
 )
+from horovod_tpu.data.sampler import ElasticSampler  # noqa: F401

@@ -7,9 +7,9 @@ fills a bounded queue ahead of the consumer; `close()` (or GC) shuts the
 thread down. On TPU the prefetch hides host-side batch prep behind
 device steps — the single-host analog of an input pipeline.
 
-Also provides ElasticSampler parity: shard a dataset across ranks with
-deterministic shuffling, and drop already-processed indices so an
-elastic reset resumes mid-epoch
+ElasticSampler lives in horovod_tpu.data.sampler: shard a dataset across
+ranks with deterministic shuffling, dropping already-processed indices so
+an elastic reset resumes mid-epoch
 (reference: horovod/torch/elastic/sampler.py:24-140).
 """
 
@@ -92,65 +92,3 @@ class _LoaderError:
 
 
 _END = object()
-
-
-class ElasticSampler:
-    """Deterministic rank-sharded sampler that survives elastic resets
-    (reference: horovod/torch/elastic/sampler.py:24-140).
-
-    ``record_batch``/``record_indices`` mark samples as processed; after a
-    reset (new rank/size), ``set_epoch``-style reshuffling excludes the
-    processed set so the epoch resumes where it left off.
-    """
-
-    def __init__(self, dataset_size: int, shuffle: bool = True, seed: int = 0):
-        self.dataset_size = dataset_size
-        self.shuffle = shuffle
-        self.seed = seed
-        self.epoch = 0
-        self.processed_indices: set = set()
-        self._refresh()
-
-    def _topology(self):
-        from horovod_tpu.common import basics
-
-        if basics.is_initialized():
-            return basics.rank(), basics.size()
-        return 0, 1
-
-    def _refresh(self):
-        rank, size = self._topology()
-        remaining = np.array(
-            [i for i in range(self.dataset_size)
-             if i not in self.processed_indices], dtype=np.int64)
-        if self.shuffle:
-            rng = np.random.RandomState(self.seed + self.epoch)
-            rng.shuffle(remaining)
-        # Truncate so every rank yields the same number of samples.
-        per_rank = len(remaining) // size
-        self.num_samples = per_rank
-        self.indices: List[int] = remaining[
-            rank * per_rank:(rank + 1) * per_rank].tolist()
-
-    def set_epoch(self, epoch: int):
-        self.epoch = epoch
-        self.processed_indices.clear()
-        self._refresh()
-
-    def record_batch(self, batch_idx: int, batch_size: int):
-        start = batch_idx * batch_size
-        self.record_indices(self.indices[start:start + batch_size])
-
-    def record_indices(self, indices):
-        self.processed_indices.update(int(i) for i in indices)
-
-    def reset(self):
-        """Re-shard after a topology change, excluding processed samples
-        (called from an elastic reset callback)."""
-        self._refresh()
-
-    def __iter__(self) -> Iterator[int]:
-        return iter(self.indices)
-
-    def __len__(self) -> int:
-        return self.num_samples

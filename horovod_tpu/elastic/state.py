@@ -86,29 +86,73 @@ class State:
         raise NotImplementedError
 
 
+def _is_sampler(v) -> bool:
+    return (hasattr(v, "state_dict") and hasattr(v, "load_state_dict")
+            and hasattr(v, "processed_indices"))
+
+
 class ObjectState(State):
-    """State of picklable attributes (reference: common/elastic.py:116-148)."""
+    """State of picklable attributes (reference: common/elastic.py:116-148).
+
+    Attributes that look like elastic samplers (state_dict +
+    processed_indices) get handler semantics mirroring the reference's
+    SamplerStateHandler (reference: torch/elastic/state.py): commit
+    snapshots their state_dict, sync unions processed indices across all
+    workers then broadcasts, and load_state_dict re-shards."""
 
     def __init__(self, **kwargs):
         super().__init__()
-        self._saved_state: Dict[str, Any] = dict(kwargs)
+        self._samplers: Dict[str, Any] = {
+            k: v for k, v in kwargs.items() if _is_sampler(v)}
+        self._saved_state: Dict[str, Any] = {
+            k: v for k, v in kwargs.items() if k not in self._samplers}
+        self._saved_sampler_state: Dict[str, Any] = {}
         self.__dict__.update(kwargs)
 
     def save(self):
         for k in self._saved_state:
             self._saved_state[k] = copy.deepcopy(getattr(self, k))
+        for k, s in self._samplers.items():
+            self._saved_sampler_state[k] = copy.deepcopy(s.state_dict())
 
     def restore(self):
         self.__dict__.update(copy.deepcopy(self._saved_state))
+        for k, s in self._samplers.items():
+            if k in self._saved_sampler_state:
+                s.load_state_dict(self._saved_sampler_state[k])
 
     def sync(self):
         if basics.size() > 1:
-            from horovod_tpu.jax.functions import broadcast_object
+            from horovod_tpu.jax.functions import (
+                allgather_object, broadcast_object,
+            )
 
             synced = broadcast_object(self._saved_state, root_rank=0,
                                       name="elastic.ObjectState")
             self._saved_state = synced
             self.__dict__.update(copy.deepcopy(synced))
+            for k, s in self._samplers.items():
+                # Union processed indices from every worker (each shard
+                # advanced independently), then broadcast rank 0's view so
+                # the re-shard is identical everywhere.
+                world = set().union(*allgather_object(
+                    set(s.processed_indices),
+                    name="elastic.sampler.%s" % k))
+                sd = s.state_dict()
+                sd["processed_indices"] = world
+                synced_sd = broadcast_object(
+                    sd, root_rank=0, name="elastic.sampler_sd.%s" % k)
+                s.load_state_dict(synced_sd)
+                # Make the union the committed snapshot too — otherwise a
+                # restore() before the next commit would roll back to the
+                # pre-sync local-only progress and re-process other ranks'
+                # samples.
+                self._saved_sampler_state[k] = copy.deepcopy(synced_sd)
+
+    def on_reset(self):
+        super().on_reset()
+        for s in self._samplers.values():
+            s.reset()
 
 
 class TpuState(ObjectState):
@@ -156,12 +200,8 @@ class TpuState(ObjectState):
 
     def sync(self):
         if basics.size() > 1:
-            from horovod_tpu.jax.functions import broadcast_object
-
-            self.save()
-            synced = broadcast_object(self._saved_state, root_rank=0,
-                                      name="elastic.TpuState")
-            self._saved_state = synced
+            self.save()  # numpy-convert trees before the pickle broadcast
+            super().sync()
             self.restore()
 
 
@@ -192,16 +232,12 @@ class TorchState(ObjectState):
     def sync(self):
         if basics.size() > 1:
             from horovod_tpu.torch.functions import (
-                broadcast_object, broadcast_parameters,
-                broadcast_optimizer_state,
+                broadcast_parameters, broadcast_optimizer_state,
             )
 
             if self._model is not None:
                 broadcast_parameters(self._model.state_dict(), root_rank=0)
             if self._optimizer is not None:
                 broadcast_optimizer_state(self._optimizer, root_rank=0)
-            synced = broadcast_object(self._saved_state, root_rank=0,
-                                      name="elastic.TorchState")
-            self._saved_state = synced
-            self.__dict__.update(copy.deepcopy(synced))
+        super().sync()
         self.save()

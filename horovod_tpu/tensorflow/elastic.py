@@ -78,9 +78,21 @@ class TensorFlowKerasState(ObjectState):
         if self._model is not None and self._saved_model_weights is not None:
             self._model.set_weights(self._saved_model_weights)
         ovars = self._optimizer_variables()
-        if ovars and self._saved_optimizer_vars is not None:
-            for v, saved in zip(ovars, self._saved_optimizer_vars):
-                v.assign(saved)
+        saved = self._saved_optimizer_vars
+        if ovars and saved is not None and len(saved) != len(ovars):
+            # Optimizer built (or grew slots) after the last save: a
+            # silent partial rollback would leave model and optimizer at
+            # different steps.
+            import warnings
+
+            warnings.warn(
+                "TensorFlowKerasState.restore: optimizer has %d variables "
+                "but %d were saved; restoring the overlap only. Commit "
+                "after the optimizer is built to get full rollback."
+                % (len(ovars), len(saved)))
+        if ovars and saved is not None:
+            for v, s in zip(ovars, saved):
+                v.assign(s)
 
     def sync(self):
         if basics.size() > 1:

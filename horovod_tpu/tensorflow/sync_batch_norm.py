@@ -27,7 +27,6 @@ class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
                 "SyncBatchNormalization does not support fused=True.")
         if not kwargs.get("name", None):
             kwargs["name"] = "sync_batch_normalization"
-        kwargs.pop("fused", None)
         super().__init__(**kwargs)
 
     def _moments(self, inputs, mask):

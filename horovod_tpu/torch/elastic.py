@@ -1,0 +1,24 @@
+"""Torch elastic API: ``import horovod_tpu.torch.elastic as hvd_elastic``.
+
+Parity with the reference's torch elastic package
+(reference: horovod/torch/elastic/__init__.py, sampler.py:24-140):
+``TorchState``, ``ElasticSampler`` (a ``torch.utils.data.Sampler``), and
+the ``run`` decorator.
+"""
+
+from __future__ import annotations
+
+import torch.utils.data
+
+from horovod_tpu.data.sampler import ElasticSampler as _BaseElasticSampler
+from horovod_tpu.elastic.state import ObjectState, State, TorchState  # noqa: F401
+from horovod_tpu.elastic.worker import run  # noqa: F401
+
+
+class ElasticSampler(_BaseElasticSampler, torch.utils.data.Sampler):
+    """Elastic sampler usable as a DataLoader sampler
+    (reference: horovod/torch/elastic/sampler.py:24-140)."""
+
+    def __init__(self, dataset, shuffle=True, seed=0):
+        _BaseElasticSampler.__init__(self, dataset, shuffle=shuffle,
+                                     seed=seed)

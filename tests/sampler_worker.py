@@ -1,0 +1,42 @@
+"""np=2 worker: ElasticSampler sync unions progress across ranks."""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from horovod_tpu.common import basics  # noqa: E402
+from horovod_tpu.data.sampler import ElasticSampler  # noqa: E402
+from horovod_tpu.elastic.state import ObjectState  # noqa: E402
+
+
+def main():
+    basics.init()
+    r = basics.rank()
+
+    s = ElasticSampler(list(range(12)), shuffle=False)
+    st = ObjectState(sampler=s, step=0)
+    assert len(s) == 6
+
+    # Each rank processes its first batch of 3 from its own shard.
+    mine = list(iter(s))
+    s.record_indices(mine[:3])
+    st.save()
+
+    # Sync: union of both ranks' progress (6 indices) shared everywhere,
+    # remaining 6 re-sharded.
+    st.sync()
+    assert len(s.processed_indices) == 6, s.processed_indices
+    assert len(s) == 3
+
+    shard = set(iter(s))
+    assert not (shard & s.processed_indices)
+
+    basics.shutdown()
+    print("SAMPLER_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

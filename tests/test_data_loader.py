@@ -56,7 +56,7 @@ def test_async_loader_disabled_queue():
 
 def test_elastic_sampler_sharding_and_resume():
     hvd.init()
-    s = ElasticSampler(dataset_size=100, shuffle=True, seed=5)
+    s = ElasticSampler(100, shuffle=True, seed=5)
     assert len(s) == 100  # size-1 world
     first_20 = list(s)[:20]
     s.record_indices(first_20)

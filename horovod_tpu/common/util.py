@@ -1,0 +1,20 @@
+"""Shared small utilities (reference: horovod/common/util.py)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+def split_list(items: Sequence, num_parts: int) -> List[list]:
+    """Split ``items`` into ``num_parts`` contiguous chunks whose sizes
+    differ by at most one (reference: horovod/common/util.py split_list)."""
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    n = len(items)
+    base, extra = divmod(n, num_parts)
+    out, start = [], 0
+    for i in range(num_parts):
+        size = base + (1 if i < extra else 0)
+        out.append(list(items[start:start + size]))
+        start += size
+    return [c for c in out if c]

@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterable, Iterator, List, Optional
+from typing import Optional
 
-import numpy as np
+
 
 
 class BaseDataLoader:

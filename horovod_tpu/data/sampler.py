@@ -78,32 +78,42 @@ class ElasticSampler:
         self.reset()
 
     def reset(self) -> None:
-        """Re-shard the unprocessed indices over the current world size."""
-        self.num_replicas = max(basics.size(), 1)
-        self.rank = basics.rank()
+        """Re-shard the unprocessed indices over the current world size.
+        Rebuilds ``self.indices`` immediately so record_batch/get_indices
+        between a reset and the next ``__iter__`` see the new shard, not
+        the pre-reset topology's."""
+        if basics.is_initialized():
+            self.num_replicas = max(basics.size(), 1)
+            self.rank = basics.rank()
+        else:
+            # Sampler built before hvd.init() (e.g. during dataset setup)
+            # or plain single-process use.
+            self.num_replicas, self.rank = 1, 0
         self.remaining_indices = [
             i for i in range(self._dataset_len)
             if i not in self.processed_indices]
         self.num_samples = int(
             math.ceil(len(self.remaining_indices) / self.num_replicas))
         self.total_size = self.num_samples * self.num_replicas
+        self._reshard()
 
-    def __iter__(self) -> Iterator[int]:
-        self.indices = list(self.remaining_indices)
+    def _reshard(self) -> None:
+        indices = list(self.remaining_indices)
         if self.shuffle:
             # Same seed on every rank -> identical global order; each rank
             # then takes a strided slice, so shards are disjoint.
-            random.Random(self.seed + self.epoch).shuffle(self.indices)
+            random.Random(self.seed + self.epoch).shuffle(indices)
         # Pad to a multiple of the world size by wrapping around — looped,
         # because at an epoch tail the pad can exceed the remaining count
         # (e.g. 1 unprocessed index across 4 workers needs 3 repeats); a
         # single wrap would leave ranks with unequal shard lengths and
         # hang the next collective.
-        while self.indices and len(self.indices) < self.total_size:
-            self.indices += self.indices[
-                :self.total_size - len(self.indices)]
-        self.indices = self.indices[self.rank:self.total_size:
-                                    self.num_replicas]
+        while indices and len(indices) < self.total_size:
+            indices += indices[:self.total_size - len(indices)]
+        self.indices = indices[self.rank:self.total_size:self.num_replicas]
+
+    def __iter__(self) -> Iterator[int]:
+        self._reshard()
         return iter(self.indices)
 
     def __len__(self) -> int:

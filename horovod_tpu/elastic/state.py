@@ -109,17 +109,23 @@ class ObjectState(State):
         self._saved_sampler_state: Dict[str, Any] = {}
         self.__dict__.update(kwargs)
 
-    def save(self):
-        for k in self._saved_state:
-            self._saved_state[k] = copy.deepcopy(getattr(self, k))
+    def _save_samplers(self):
         for k, s in self._samplers.items():
             self._saved_sampler_state[k] = copy.deepcopy(s.state_dict())
 
-    def restore(self):
-        self.__dict__.update(copy.deepcopy(self._saved_state))
+    def _restore_samplers(self):
         for k, s in self._samplers.items():
             if k in self._saved_sampler_state:
                 s.load_state_dict(self._saved_sampler_state[k])
+
+    def save(self):
+        for k in self._saved_state:
+            self._saved_state[k] = copy.deepcopy(getattr(self, k))
+        self._save_samplers()
+
+    def restore(self):
+        self.__dict__.update(copy.deepcopy(self._saved_state))
+        self._restore_samplers()
 
     def sync(self):
         if basics.size() > 1:
@@ -184,6 +190,7 @@ class TpuState(ObjectState):
                     if hasattr(l, "shape") else l, v)
             else:
                 self._saved_state[k] = copy.deepcopy(v)
+        self._save_samplers()
 
     def restore(self):
         import jax.numpy as jnp
@@ -197,6 +204,7 @@ class TpuState(ObjectState):
                     v))
             else:
                 setattr(self, k, copy.deepcopy(v))
+        self._restore_samplers()
 
     def sync(self):
         if basics.size() > 1:

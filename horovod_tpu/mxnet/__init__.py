@@ -1,0 +1,220 @@
+"""MXNet binding: ``import horovod_tpu.mxnet as hvd``.
+
+Parity with the reference's MXNet surface
+(reference: horovod/mxnet/__init__.py:41-260 — DistributedOptimizer,
+DistributedTrainer, broadcast_parameters; horovod/mxnet/mpi_ops.py op
+wrappers). MXNet itself is optional: the op layer duck-types NDArrays, and
+the gluon ``DistributedTrainer`` is only defined when mxnet imports.
+"""
+
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict, defaultdict
+
+from horovod_tpu.common import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt, ProcessSet,
+    add_process_set, global_process_set, remove_process_set,
+)
+from horovod_tpu.common.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_homogeneous, is_initialized,
+    local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
+    rocm_built, shutdown, size, start_timeline, stop_timeline, tpu_built,
+)
+from horovod_tpu.common.util import split_list
+from horovod_tpu.mxnet.compression import Compression  # noqa: F401
+from horovod_tpu.mxnet.functions import (  # noqa: F401
+    allgather_object, broadcast_object,
+)
+from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
+    Adasum, Average, Sum, allgather, allreduce, allreduce_, alltoall,
+    broadcast, broadcast_, grouped_allreduce, grouped_allreduce_,
+)
+
+try:
+    import mxnet as mx
+
+    _HAVE_MXNET = True
+except ImportError:  # pragma: no cover - exercised via stub in tests
+    mx = None
+    _HAVE_MXNET = False
+
+
+class DistributedOptimizer:
+    """Wrap an mx.optimizer.Optimizer: allreduce gradients in update()
+    (reference: horovod/mxnet/__init__.py:41-94).
+
+    Averaging folds into the wrapped optimizer's ``rescale_grad`` (the
+    reference's trick: dividing the rescale by world size is cheaper than
+    an explicit average)."""
+
+    def __init__(self, optimizer, gradient_predivide_factor=1.0,
+                 num_groups=0):
+        self._optimizer = optimizer
+        self._optimizer.rescale_grad *= (
+            gradient_predivide_factor / max(size(), 1))
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self._num_groups = num_groups
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if size() == 1:
+            return
+        if isinstance(index, (tuple, list)):
+            if self._num_groups > 0:
+                grad_split = split_list(grad, self._num_groups)
+                index_split = split_list(index, self._num_groups)
+                for i, (grads, idxs) in enumerate(
+                        zip(grad_split, index_split)):
+                    grouped_allreduce_(
+                        tensors=grads, average=False,
+                        name="%s:%s" % (idxs[0], idxs[-1]), priority=-i,
+                        prescale_factor=1.0 /
+                        self._gradient_predivide_factor)
+            else:
+                for i in range(len(index)):
+                    allreduce_(grad[i], average=False, name=str(index[i]),
+                               priority=-i,
+                               prescale_factor=1.0 /
+                               self._gradient_predivide_factor)
+        else:
+            allreduce_(grad, average=False, name=str(index),
+                       prescale_factor=1.0 /
+                       self._gradient_predivide_factor)
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def broadcast_parameters(params, root_rank=0, prefix=None):
+    """Broadcast a dict of parameters (Module.get_params() /
+    Block.collect_params()) from root rank
+    (reference: horovod/mxnet/__init__.py:212-260)."""
+    assert prefix is None or isinstance(prefix, str)
+    prefix = prefix or ""
+    if not isinstance(params, dict):
+        raise ValueError("invalid params of type: %s" % type(params))
+    if size() == 1:
+        return
+
+    tensors, names = [], []
+    for name, p in sorted(params.items()):
+        data = p
+        if _HAVE_MXNET and isinstance(
+                p, mx.gluon.parameter.Parameter):  # pragma: no cover
+            try:
+                data = p.data()
+            except Exception:
+                # Deferred initialization: broadcast after init fires.
+                _append_broadcast_init(p, root_rank, prefix + str(name))
+                continue
+        tensors.append(data)
+        names.append(prefix + str(name))
+    for tensor, name in zip(tensors, names):
+        broadcast_(tensor, root_rank, name=name)
+
+
+def _append_broadcast_init(param, root_rank, name):  # pragma: no cover
+    """Wrap a deferred-init Parameter so the broadcast runs right after
+    its initialization (reference: mxnet/__init__.py:204-210)."""
+    import types
+
+    init_impl = getattr(param, "_init_impl")
+
+    def wrapped(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank=root_rank, name=name)
+
+    param._init_impl = types.MethodType(wrapped, param)
+
+
+def _make_distributed_trainer():
+    """DistributedTrainer needs a real mx.gluon.Trainer base class, so it
+    is built lazily (reference: horovod/mxnet/__init__.py:103-202)."""
+
+    class DistributedTrainer(mx.gluon.Trainer):
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     compression=Compression.none,
+                     gradient_predivide_factor=1.0, prefix=None,
+                     num_groups=0):
+            self._compression = compression
+            if isinstance(optimizer, DistributedOptimizer):
+                optimizer = optimizer._optimizer
+                warnings.warn(
+                    "DistributedTrainer does not take DistributedOptimizer "
+                    "as its optimizer. We have unwrapped it for you.")
+            if isinstance(params, dict):
+                params = OrderedDict(params)
+            elif isinstance(params, (list, tuple)):
+                params = sorted(params)
+            super().__init__(params, optimizer,
+                             optimizer_params=optimizer_params,
+                             kvstore=None)
+            # Average via the step scale rather than in the allreduce.
+            self._scale *= gradient_predivide_factor / max(size(), 1)
+            self._gradient_predivide_factor = gradient_predivide_factor
+            self._prefix = prefix or ""
+            self._num_groups = num_groups
+
+        def _allreduce_grads(self):
+            if size() == 1:
+                return
+            entries = []
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    compressed, ctx = self._compression.compress(
+                        param.list_grad()[0])
+                    entries.append((i, param, compressed, ctx))
+            if self._num_groups > 0:
+                groups = split_list(entries, self._num_groups)
+                for gi, group in enumerate(groups):
+                    by_dtype = defaultdict(list)
+                    for i, param, t, ctx in group:
+                        by_dtype[t.dtype].append((t, self._prefix + str(i)))
+                    for pairs in by_dtype.values():
+                        ts, names = zip(*pairs)
+                        grouped_allreduce_(
+                            tensors=list(ts), average=False,
+                            name="%s:%s" % (names[0], names[-1]),
+                            priority=-gi,
+                            prescale_factor=1.0 /
+                            self._gradient_predivide_factor)
+            else:
+                for i, param, t, ctx in entries:
+                    allreduce_(t, average=False,
+                               name=self._prefix + str(i), priority=-i,
+                               prescale_factor=1.0 /
+                               self._gradient_predivide_factor)
+            if self._compression is not Compression.none:
+                for i, param, t, ctx in entries:
+                    param.list_grad()[0][:] = \
+                        self._compression.decompress(t, ctx)
+
+    return DistributedTrainer
+
+
+if _HAVE_MXNET:
+    DistributedTrainer = _make_distributed_trainer()
+else:  # pragma: no cover
+    def DistributedTrainer(*args, **kwargs):  # noqa: N802
+        raise ImportError(
+            "horovod_tpu.mxnet.DistributedTrainer requires mxnet")

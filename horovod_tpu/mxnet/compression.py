@@ -1,0 +1,34 @@
+"""Gradient compression for the MXNet binding
+(reference: horovod/mxnet/compression.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NoneCompressor:
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    @staticmethod
+    def compress(tensor):
+        dtype = getattr(tensor, "dtype", None)
+        if dtype in (np.float32, np.float64, "float32", "float64"):
+            return tensor.astype("float16"), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor

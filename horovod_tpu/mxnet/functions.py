@@ -1,0 +1,19 @@
+"""Object broadcast/allgather for the MXNet binding
+(reference: horovod/mxnet/functions.py:27-100)."""
+
+from __future__ import annotations
+
+from horovod_tpu.common.process_sets import global_process_set
+
+
+def broadcast_object(obj, root_rank=0, name=None,
+                     process_set=global_process_set):
+    from horovod_tpu.jax.functions import broadcast_object as _bo
+
+    return _bo(obj, root_rank, name=name, process_set=process_set)
+
+
+def allgather_object(obj, name=None, process_set=global_process_set):
+    from horovod_tpu.jax.functions import allgather_object as _ao
+
+    return _ao(obj, name=name, process_set=process_set)

@@ -1,0 +1,148 @@
+"""MXNet collective ops: allreduce/allgather/broadcast/alltoall with a
+``priority`` argument.
+
+Parity with the reference's MXNet op surface
+(reference: horovod/mxnet/mpi_ops.py:69-400). The reference pushes each op
+into the MXNet dependency engine (reference: horovod/mxnet/mpi_ops.cc:262-271
+``MXEnginePushAsync``); here NDArrays bridge through numpy into the shared
+eager/native enqueue path, and ``priority`` orders the enqueue the same way
+the engine's priority hint would (higher priority first within a flush).
+
+Works against real MXNet or anything NDArray-shaped (``asnumpy()`` +
+in-place slice assignment), so the binding is testable without a GPU
+MXNet build.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.common.basics import rank, size  # noqa: F401
+from horovod_tpu.common.process_sets import global_process_set
+from horovod_tpu.ops import collective_ops as C
+from horovod_tpu.ops import eager
+
+Average = C.Average
+Sum = C.Sum
+Adasum = C.Adasum
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    if hasattr(tensor, "asnumpy"):
+        return tensor.asnumpy()
+    return np.asarray(tensor)
+
+
+def _from_numpy(arr: np.ndarray, template):
+    """Rebuild an array like ``template`` (mx.nd.array when available)."""
+    if hasattr(template, "asnumpy"):
+        try:
+            import mxnet as mx
+
+            return mx.nd.array(arr, dtype=arr.dtype)
+        except ImportError:
+            pass
+        cls = type(template)
+        try:
+            return cls(arr)
+        except TypeError:
+            pass
+    return arr
+
+
+def _assign_inplace(tensor, arr: np.ndarray):
+    tensor[:] = _from_numpy(arr, tensor)
+    return tensor
+
+
+def allreduce(tensor, average=True, name=None, priority=0,
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set):
+    """Out-of-place allreduce (reference: mxnet/mpi_ops.py:69-113)."""
+    del priority  # ordering hint; the enqueue below is already in order
+    out = eager.synchronize(eager.allreduce_async(
+        _to_numpy(tensor), name=name or eager._auto_name("mx.allreduce"),
+        op=Average if average else Sum,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+    return _from_numpy(np.asarray(out), tensor)
+
+
+def allreduce_(tensor, average=True, name=None, priority=0,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=global_process_set):
+    """In-place allreduce (reference: mxnet/mpi_ops.py:114-152)."""
+    out = allreduce(tensor, average=average, name=name, priority=priority,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set)
+    return _assign_inplace(tensor, _to_numpy(out))
+
+
+def grouped_allreduce(tensors, average=True, name=None, priority=0,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set):
+    """(reference: mxnet/mpi_ops.py:153-199)"""
+    del priority
+    outs = eager.synchronize(eager.grouped_allreduce_async(
+        [_to_numpy(t) for t in tensors],
+        name=name or eager._auto_name("mx.grouped_allreduce"),
+        op=Average if average else Sum,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+    return [_from_numpy(np.asarray(o), t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_(tensors, average=True, name=None, priority=0,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=global_process_set):
+    """(reference: mxnet/mpi_ops.py:200-244)"""
+    outs = grouped_allreduce(tensors, average=average, name=name,
+                             priority=priority,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             process_set=process_set)
+    for t, o in zip(tensors, outs):
+        _assign_inplace(t, _to_numpy(o))
+    return tensors
+
+
+def allgather(tensor, name=None, priority=0,
+              process_set=global_process_set):
+    """(reference: mxnet/mpi_ops.py:245-284)"""
+    del priority
+    out = eager.synchronize(eager.allgather_async(
+        _to_numpy(tensor), name=name or eager._auto_name("mx.allgather"),
+        process_set=process_set))
+    return _from_numpy(np.asarray(out), tensor)
+
+
+def broadcast(tensor, root_rank, name=None, priority=0,
+              process_set=global_process_set):
+    """(reference: mxnet/mpi_ops.py:285-327)"""
+    del priority
+    out = eager.synchronize(eager.broadcast_async(
+        _to_numpy(tensor), root_rank,
+        name=name or eager._auto_name("mx.broadcast"),
+        process_set=process_set))
+    return _from_numpy(np.asarray(out), tensor)
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0,
+               process_set=global_process_set):
+    """(reference: mxnet/mpi_ops.py:328-360)"""
+    out = broadcast(tensor, root_rank, name=name, priority=priority,
+                    process_set=process_set)
+    return _assign_inplace(tensor, _to_numpy(out))
+
+
+def alltoall(tensor, splits=None, name=None, priority=0,
+             process_set=global_process_set):
+    """(reference: mxnet/mpi_ops.py:361-400)"""
+    del priority
+    out, _rsplits = eager.synchronize(eager.alltoall_async(
+        _to_numpy(tensor),
+        None if splits is None else _to_numpy(splits),
+        name=name or eager._auto_name("mx.alltoall"),
+        process_set=process_set))
+    return _from_numpy(np.asarray(out), tensor)

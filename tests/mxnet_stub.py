@@ -1,0 +1,132 @@
+"""Minimal NDArray/gluon stub standing in for mxnet in binding tests.
+
+Provides just enough surface for horovod_tpu.mxnet: ``nd.array`` /
+NDArray (asnumpy, slice assign, astype, dtype), ``optimizer.Optimizer``,
+``gluon.Trainer`` and ``gluon.parameter.Parameter``.
+"""
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, dtype=None):
+        self._arr = np.array(data, dtype=dtype)
+
+    def asnumpy(self):
+        return self._arr.copy()
+
+    def astype(self, dtype):
+        return NDArray(self._arr.astype(dtype))
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    def __getitem__(self, key):
+        return self._arr[key]
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._arr
+        self._arr[key] = np.asarray(value)
+
+    def __repr__(self):
+        return "NDArray(%r)" % (self._arr,)
+
+
+def _nd_array(data, dtype=None):
+    if isinstance(data, NDArray):
+        return NDArray(data._arr, dtype=dtype)
+    return NDArray(data, dtype=dtype)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.1, rescale_grad=1.0):
+        self.learning_rate = learning_rate
+        self.rescale_grad = rescale_grad
+        self.updates = []
+
+    def update(self, index, weight, grad, state):
+        self.updates.append(index)
+        weight[:] = weight.asnumpy() - self.learning_rate * (
+            self.rescale_grad * grad.asnumpy())
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+
+class Parameter:
+    def __init__(self, name, data, grad=None, grad_req="write"):
+        self.name = name
+        self._data = data
+        self._grad = grad if grad is not None else NDArray(
+            np.zeros_like(data.asnumpy()))
+        self.grad_req = grad_req
+
+    def data(self):
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        if isinstance(params, dict):
+            params = list(params.values())
+        self._params = list(params)
+        self._optimizer = optimizer
+        self._scale = 1.0
+
+    def step(self, batch_size):
+        self._allreduce_grads()
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                g = p.list_grad()[0]
+                p.data()[:] = (p.data().asnumpy()
+                               - 0.1 * self._scale * g.asnumpy()
+                               / batch_size)
+
+    def _allreduce_grads(self):
+        pass
+
+
+def install():
+    """Insert the stub as ``mxnet`` in sys.modules (no-op if real mxnet
+    is importable)."""
+    if "mxnet" in sys.modules:
+        return sys.modules["mxnet"]
+    mx = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = _nd_array
+    nd.NDArray = NDArray
+    opt_mod = types.ModuleType("mxnet.optimizer")
+    opt_mod.Optimizer = Optimizer
+    gluon = types.ModuleType("mxnet.gluon")
+    gluon.Trainer = Trainer
+    parameter = types.ModuleType("mxnet.gluon.parameter")
+    parameter.Parameter = Parameter
+    gluon.parameter = parameter
+    mx.nd = nd
+    mx.optimizer = opt_mod
+    mx.gluon = gluon
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.nd"] = nd
+    sys.modules["mxnet.optimizer"] = opt_mod
+    sys.modules["mxnet.gluon"] = gluon
+    sys.modules["mxnet.gluon.parameter"] = parameter
+    return mx

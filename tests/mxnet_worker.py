@@ -1,0 +1,69 @@
+"""np=2 MXNet-binding worker (runs against the NDArray stub)."""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import mxnet_stub  # noqa: E402
+
+mx = mxnet_stub.install()
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu.mxnet as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    t = mx.nd.array([1.0, 2.0])
+    out = hvd.allreduce(t, average=False, name="mx.ar")
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 4.0])
+
+    # In-place: both ranks converge to the sum.
+    t2 = mx.nd.array([float(r + 1)])
+    hvd.allreduce_(t2, average=False, name="mx.ar2")
+    np.testing.assert_allclose(t2.asnumpy(), [3.0])
+
+    # broadcast_parameters aligns with rank 0.
+    params = {"w": mx.nd.array([float(r) + 10.0])}
+    hvd.broadcast_parameters(params)
+    np.testing.assert_allclose(params["w"].asnumpy(), [10.0])
+
+    # DistributedOptimizer normalizes rescale_grad by world size and
+    # sums gradients -> identical updates on both ranks.
+    opt = mx.optimizer.Optimizer(learning_rate=1.0, rescale_grad=1.0)
+    dopt = hvd.DistributedOptimizer(opt)
+    assert abs(dopt.rescale_grad - 0.5) < 1e-12
+    w = mx.nd.array([1.0])
+    g = mx.nd.array([float(r + 1)])  # sum = 3, averaged via rescale = 1.5
+    dopt.update(0, w, g, None)
+    np.testing.assert_allclose(w.asnumpy(), [-0.5])
+
+    # DistributedTrainer grouped path.
+    p = mx.gluon.parameter.Parameter(
+        "w", mx.nd.array([0.0]), grad=mx.nd.array([float(r + 1)]))
+    trainer = hvd.DistributedTrainer({"w": p}, mx.optimizer.Optimizer(),
+                                     num_groups=1)
+    trainer._allreduce_grads()
+    np.testing.assert_allclose(p.list_grad()[0].asnumpy(), [3.0])
+
+    # alltoall + allgather.
+    ag = hvd.allgather(mx.nd.array([[float(r)]]), name="mx.ag")
+    np.testing.assert_allclose(ag.asnumpy().ravel(), [0.0, 1.0])
+    a2a = hvd.alltoall(mx.nd.array([float(r), float(r)]), name="mx.a2a")
+    np.testing.assert_allclose(a2a.asnumpy(), [0.0, 1.0])
+
+    hvd.shutdown()
+    print("MX_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

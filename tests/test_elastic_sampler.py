@@ -109,6 +109,33 @@ def test_object_state_tracks_sampler():
     assert s.processed_indices == {0, 1}
 
 
+def test_tpu_state_tracks_sampler():
+    """TpuState (tree-aware save/restore) must also snapshot samplers."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.elastic.state import TpuState
+
+    s = ElasticSampler(list(range(6)), shuffle=False)
+    st = TpuState(params={"w": jnp.ones(2)}, sampler=s, epoch=0)
+    s.record_indices({0, 1})
+    st.commit()
+    s.record_indices({2, 3})
+    st.restore()
+    assert s.processed_indices == {0, 1}
+
+
+def test_record_batch_after_reset_uses_new_shard(monkeypatch):
+    s = ElasticSampler(8, shuffle=False)
+    list(iter(s))
+    s.record_indices({0, 1, 2, 3})
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    monkeypatch.setattr(basics, "rank", lambda: 0)
+    s.reset()
+    # indices rebuilt immediately: record_batch marks from the NEW shard.
+    s.record_batch(0, 1)
+    assert s.processed_indices == {0, 1, 2, 3, 4}
+
+
 def test_sampler_sync_multiproc():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

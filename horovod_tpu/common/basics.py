@@ -64,15 +64,48 @@ def _int_env(name: str, default: int) -> int:
     return int(v)
 
 
+def _first_int_env(names, default: int) -> int:
+    for n in names:
+        v = os.environ.get(n)
+        if v not in (None, ""):
+            return int(v)
+    return default
+
+
 def _topology_from_env() -> Topology:
-    size = _int_env("HOROVOD_SIZE", 1)
+    """Read the launcher environment. HOROVOD_* takes priority; under a
+    bare ``mpirun`` (hvdrun --use-mpi) the standard MPI launcher vars
+    (OpenMPI/PMI/Slurm) supply rank/size instead (the reference gets these
+    from MPI_Comm_rank after MPI_Init; we read the launcher's env)."""
+    size = _first_int_env(
+        ["HOROVOD_SIZE", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+         "SLURM_NTASKS"], 1)
+    rank = _first_int_env(
+        ["HOROVOD_RANK", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+         "SLURM_PROCID"], 0)
+    local_rank = _first_int_env(
+        ["HOROVOD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_RANK",
+         "MPI_LOCALRANKID", "SLURM_LOCALID"], 0)
+    local_size = _first_int_env(
+        ["HOROVOD_LOCAL_SIZE", "OMPI_COMM_WORLD_LOCAL_SIZE",
+         "MPI_LOCALNRANKS", "SLURM_NTASKS_PER_NODE"],
+        1 if size == 1 else size)
+    # Derive the cross (inter-node) coordinates when the launcher didn't
+    # provide them: with homogeneous nodes rank = cross_rank*local_size +
+    # local_rank.
+    if ("HOROVOD_CROSS_RANK" in os.environ
+            or "HOROVOD_CROSS_SIZE" in os.environ):
+        cross_rank = _int_env("HOROVOD_CROSS_RANK", 0)
+        cross_size = _int_env("HOROVOD_CROSS_SIZE", 1)
+    elif local_size > 0 and size % local_size == 0:
+        cross_rank = rank // local_size
+        cross_size = size // local_size
+    else:
+        cross_rank, cross_size = 0, 1
     return Topology(
-        rank=_int_env("HOROVOD_RANK", 0),
-        size=size,
-        local_rank=_int_env("HOROVOD_LOCAL_RANK", 0),
-        local_size=_int_env("HOROVOD_LOCAL_SIZE", 1 if size == 1 else size),
-        cross_rank=_int_env("HOROVOD_CROSS_RANK", 0),
-        cross_size=_int_env("HOROVOD_CROSS_SIZE", 1),
+        rank=rank, size=size, local_rank=local_rank,
+        local_size=local_size, cross_rank=cross_rank,
+        cross_size=cross_size,
     )
 
 

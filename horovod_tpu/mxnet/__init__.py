@@ -165,7 +165,10 @@ def _make_distributed_trainer():
             if isinstance(params, dict):
                 params = OrderedDict(params)
             elif isinstance(params, (list, tuple)):
-                params = sorted(params)
+                # Sort for cross-worker ordering stability; Parameter
+                # objects aren't orderable, so key on their name.
+                params = sorted(params,
+                                key=lambda p: getattr(p, "name", str(p)))
             super().__init__(params, optimizer,
                              optimizer_params=optimizer_params,
                              kvstore=None)

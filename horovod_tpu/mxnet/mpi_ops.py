@@ -51,8 +51,19 @@ def _from_numpy(arr: np.ndarray, template):
 
 
 def _assign_inplace(tensor, arr: np.ndarray):
-    tensor[:] = _from_numpy(arr, tensor)
+    # Slice-assign the raw numpy result; NDArray accepts ndarray on the
+    # right-hand side, so no intermediate NDArray is built.
+    tensor[:] = arr
     return tensor
+
+
+def _allreduce_numpy(tensor, average, name, prescale_factor,
+                     postscale_factor, process_set) -> np.ndarray:
+    return np.asarray(eager.synchronize(eager.allreduce_async(
+        _to_numpy(tensor), name=name or eager._auto_name("mx.allreduce"),
+        op=Average if average else Sum,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)))
 
 
 def allreduce(tensor, average=True, name=None, priority=0,
@@ -60,23 +71,30 @@ def allreduce(tensor, average=True, name=None, priority=0,
               process_set=global_process_set):
     """Out-of-place allreduce (reference: mxnet/mpi_ops.py:69-113)."""
     del priority  # ordering hint; the enqueue below is already in order
-    out = eager.synchronize(eager.allreduce_async(
-        _to_numpy(tensor), name=name or eager._auto_name("mx.allreduce"),
-        op=Average if average else Sum,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set=process_set))
-    return _from_numpy(np.asarray(out), tensor)
+    out = _allreduce_numpy(tensor, average, name, prescale_factor,
+                           postscale_factor, process_set)
+    return _from_numpy(out, tensor)
 
 
 def allreduce_(tensor, average=True, name=None, priority=0,
                prescale_factor=1.0, postscale_factor=1.0,
                process_set=global_process_set):
     """In-place allreduce (reference: mxnet/mpi_ops.py:114-152)."""
-    out = allreduce(tensor, average=average, name=name, priority=priority,
-                    prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor,
-                    process_set=process_set)
-    return _assign_inplace(tensor, _to_numpy(out))
+    del priority
+    out = _allreduce_numpy(tensor, average, name, prescale_factor,
+                           postscale_factor, process_set)
+    return _assign_inplace(tensor, out)
+
+
+def _grouped_allreduce_numpy(tensors, average, name, prescale_factor,
+                             postscale_factor, process_set):
+    outs = eager.synchronize(eager.grouped_allreduce_async(
+        [_to_numpy(t) for t in tensors],
+        name=name or eager._auto_name("mx.grouped_allreduce"),
+        op=Average if average else Sum,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+    return [np.asarray(o) for o in outs]
 
 
 def grouped_allreduce(tensors, average=True, name=None, priority=0,
@@ -84,26 +102,22 @@ def grouped_allreduce(tensors, average=True, name=None, priority=0,
                       process_set=global_process_set):
     """(reference: mxnet/mpi_ops.py:153-199)"""
     del priority
-    outs = eager.synchronize(eager.grouped_allreduce_async(
-        [_to_numpy(t) for t in tensors],
-        name=name or eager._auto_name("mx.grouped_allreduce"),
-        op=Average if average else Sum,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set=process_set))
-    return [_from_numpy(np.asarray(o), t) for o, t in zip(outs, tensors)]
+    outs = _grouped_allreduce_numpy(tensors, average, name,
+                                    prescale_factor, postscale_factor,
+                                    process_set)
+    return [_from_numpy(o, t) for o, t in zip(outs, tensors)]
 
 
 def grouped_allreduce_(tensors, average=True, name=None, priority=0,
                        prescale_factor=1.0, postscale_factor=1.0,
                        process_set=global_process_set):
     """(reference: mxnet/mpi_ops.py:200-244)"""
-    outs = grouped_allreduce(tensors, average=average, name=name,
-                             priority=priority,
-                             prescale_factor=prescale_factor,
-                             postscale_factor=postscale_factor,
-                             process_set=process_set)
+    del priority
+    outs = _grouped_allreduce_numpy(tensors, average, name,
+                                    prescale_factor, postscale_factor,
+                                    process_set)
     for t, o in zip(tensors, outs):
-        _assign_inplace(t, _to_numpy(o))
+        _assign_inplace(t, o)
     return tensors
 
 
@@ -131,9 +145,12 @@ def broadcast(tensor, root_rank, name=None, priority=0,
 def broadcast_(tensor, root_rank, name=None, priority=0,
                process_set=global_process_set):
     """(reference: mxnet/mpi_ops.py:328-360)"""
-    out = broadcast(tensor, root_rank, name=name, priority=priority,
-                    process_set=process_set)
-    return _assign_inplace(tensor, _to_numpy(out))
+    del priority
+    out = np.asarray(eager.synchronize(eager.broadcast_async(
+        _to_numpy(tensor), root_rank,
+        name=name or eager._auto_name("mx.broadcast"),
+        process_set=process_set)))
+    return _assign_inplace(tensor, out)
 
 
 def alltoall(tensor, splits=None, name=None, priority=0,

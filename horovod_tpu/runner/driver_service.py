@@ -1,0 +1,143 @@
+"""Driver/task services: NIC probing across hosts before launch.
+
+Parity with the reference's pre-launch discovery
+(reference: horovod/runner/driver/driver_service.py:162-257,
+runner/task/task_service.py, runner/common/service/*): the driver starts
+an RPC service, fans a small task server out to every host, each task
+registers its (interface -> addresses) map with the driver, and the
+driver intersects the sets to find interfaces routable from all hosts
+(used to pin the control plane and to warn on heterogeneous fabrics).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from horovod_tpu.runner.network import (
+    BasicClient, BasicService, common_interfaces, local_addresses,
+)
+
+
+class RegisterTaskRequest:
+    def __init__(self, index: int, task_addresses):
+        self.index = index
+        self.task_addresses = task_addresses
+
+
+class RegisterTaskResponse:
+    pass
+
+
+class AllTasksRegisteredRequest:
+    pass
+
+
+class AllTasksRegisteredResponse:
+    def __init__(self, done: bool):
+        self.done = done
+
+
+class TaskAddressesRequest:
+    def __init__(self, index: int):
+        self.index = index
+
+
+class TaskAddressesResponse:
+    def __init__(self, task_addresses):
+        self.task_addresses = task_addresses
+
+
+class HorovodRunDriverService(BasicService):
+    """Collects task registrations (reference: driver_service.py
+    HorovodRunDriverService)."""
+
+    NAME = "horovod driver service"
+
+    def __init__(self, num_hosts: int, key: bytes):
+        super().__init__(self.NAME, key)
+        self._num_hosts = num_hosts
+        self._task_addresses: Dict[int, Dict] = {}
+        self._lock = threading.Lock()
+
+    def _handle(self, req, client_address):
+        if isinstance(req, RegisterTaskRequest):
+            with self._lock:
+                self._task_addresses[req.index] = req.task_addresses
+            return RegisterTaskResponse()
+        if isinstance(req, AllTasksRegisteredRequest):
+            with self._lock:
+                return AllTasksRegisteredResponse(
+                    len(self._task_addresses) == self._num_hosts)
+        if isinstance(req, TaskAddressesRequest):
+            with self._lock:
+                return TaskAddressesResponse(
+                    self._task_addresses.get(req.index))
+        return super()._handle(req, client_address)
+
+    def wait_for_initial_registration(self, timeout_s: float = 120.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                if len(self._task_addresses) == self._num_hosts:
+                    return
+            time.sleep(0.1)
+        raise TimeoutError(
+            "only %d/%d hosts registered with the driver"
+            % (len(self._task_addresses), self._num_hosts))
+
+    def task_addresses_for_driver(self) -> Dict[int, Dict]:
+        with self._lock:
+            return dict(self._task_addresses)
+
+    def common_interfaces(self) -> Set[str]:
+        per_host = {
+            str(i): set(addrs.keys())
+            for i, addrs in self.task_addresses_for_driver().items()}
+        # The driver's own interfaces participate too.
+        per_host["__driver__"] = set(local_addresses().keys())
+        return common_interfaces(per_host)
+
+
+class HorovodRunTaskService(BasicService):
+    """Per-host probe server (reference: task/task_service.py)."""
+
+    NAME = "horovod task service"
+
+    def __init__(self, index: int, key: bytes):
+        super().__init__(self.NAME, key)
+        self.index = index
+
+
+def register_task(index: int, driver_addresses, key: bytes) -> None:
+    """Run on each host: start a task service, register its addresses
+    with the driver (reference: task_fn.py)."""
+    task = HorovodRunTaskService(index, key)
+    try:
+        client = BasicClient(driver_addresses, key)
+        client.request(RegisterTaskRequest(index, task.addresses()))
+    finally:
+        task.shutdown()
+
+
+def get_common_interfaces(num_hosts: int, key: bytes,
+                          register_fn=None,
+                          timeout_s: float = 120.0,
+                          ) -> Tuple[Set[str], "HorovodRunDriverService"]:
+    """Drive the probe: start the driver service, invoke ``register_fn``
+    (driver_addresses -> launches per-host registration, defaults to
+    local-only), wait for all hosts, and intersect interface sets
+    (reference: driver_service.py:218-257 _driver_fn)."""
+    driver = HorovodRunDriverService(num_hosts, key)
+    try:
+        if register_fn is None:
+            for i in range(num_hosts):
+                register_task(i, driver.addresses(), key)
+        else:
+            register_fn(driver.addresses())
+        driver.wait_for_initial_registration(timeout_s)
+        return driver.common_interfaces(), driver
+    except Exception:
+        driver.shutdown()
+        raise

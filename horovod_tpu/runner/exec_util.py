@@ -10,11 +10,9 @@ from __future__ import annotations
 
 import os
 import shlex
-import signal
 import subprocess
 import sys
 import threading
-import time
 from typing import Dict, List, Optional
 
 LOCAL_HOSTS = {"localhost", "127.0.0.1", "0.0.0.0"}
@@ -78,19 +76,13 @@ class SlotProcess:
         return self.proc.poll()
 
     def terminate(self, grace_sec: float = 5.0):
-        """SIGTERM the process group, escalate to SIGKILL after grace."""
+        """SIGTERM the process group, escalate to SIGKILL after grace
+        (shared logic: safe_shell_exec)."""
         if self.proc.poll() is not None:
             return
-        try:
-            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            return
-        deadline = time.time() + grace_sec
-        while time.time() < deadline:
-            if self.proc.poll() is not None:
-                return
-            time.sleep(0.1)
-        try:
-            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
+        from horovod_tpu.runner.safe_shell_exec import (
+            terminate_executor_shell_and_children,
+        )
+
+        terminate_executor_shell_and_children(self.proc.pid,
+                                              grace_s=grace_sec)

@@ -1,0 +1,97 @@
+"""jsrun-backed launch path for LSF clusters.
+
+Parity with the reference's Summit-style launcher
+(reference: horovod/runner/js_run.py:1-146, runner/util/lsf.py:1-103):
+derive host/slot topology from the LSF allocation (LSB_* env / CSM), and
+build a single ``jsrun`` command with one resource set per host.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+
+class LSFUtils:
+    """(reference: runner/util/lsf.py)"""
+
+    @staticmethod
+    def using_lsf() -> bool:
+        return "LSB_JOBID" in os.environ
+
+    @staticmethod
+    def get_compute_hosts() -> List[str]:
+        # LSB_HOSTS: "batch host1 host1 host2 ..." (one entry per slot);
+        # LSB_MCPU_HOSTS: "batch 1 host1 16 host2 16".
+        hosts = os.environ.get("LSB_HOSTS", "").split()
+        if hosts:
+            seen, out = set(), []
+            for h in hosts[1:]:  # skip the batch/launch node
+                if h not in seen:
+                    seen.add(h)
+                    out.append(h)
+            return out
+        mcpu = os.environ.get("LSB_MCPU_HOSTS", "").split()
+        return [mcpu[i] for i in range(2, len(mcpu), 2)]
+
+    @staticmethod
+    def get_num_gpus() -> int:
+        # On LSF systems the per-host accelerator count rides in
+        # CUDA_VISIBLE_DEVICES or the RS layout; default 1 (TPU chip).
+        cvd = os.environ.get("CUDA_VISIBLE_DEVICES", "")
+        return len([d for d in cvd.split(",") if d != ""]) or 1
+
+    @staticmethod
+    def get_num_processes() -> int:
+        return (len(LSFUtils.get_compute_hosts())
+                * LSFUtils.get_num_gpus())
+
+
+def is_jsrun_installed() -> bool:
+    return shutil.which("jsrun") is not None
+
+
+def build_jsrun_command(num_proc: int, num_hosts: int,
+                        command: List[str], env: Dict[str, str],
+                        gpus_per_host: int = 1,
+                        extra_args: Optional[str] = None) -> List[str]:
+    """One resource set per host, all slots in it
+    (reference: js_run.py:58-118). Exposed for testing without LSF."""
+    num_hosts = max(num_hosts, 1)
+    if num_proc % num_hosts != 0:
+        raise ValueError(
+            "num_proc=%d must divide evenly across %d hosts (uniform "
+            "jsrun resource sets)" % (num_proc, num_hosts))
+    procs_per_host = num_proc // num_hosts
+    args = ["jsrun",
+            "--nrs", str(num_hosts),
+            "--tasks_per_rs", str(procs_per_host),
+            "--cpu_per_rs", "ALL_CPUS",
+            "--gpu_per_rs", "ALL_GPUS",
+            "--rs_per_host", "1"]
+    for key, val in sorted(env.items()):
+        args += ["--env", "%s=%s" % (key, val)]
+    if extra_args:
+        args += shlex.split(extra_args)
+    args += command
+    return args
+
+
+def js_run(num_proc: int, command: List[str],
+           extra_env: Dict[str, str],
+           extra_args: Optional[str] = None) -> int:
+    """(reference: js_run.py js_run)"""
+    if not is_jsrun_installed():
+        raise RuntimeError("jsrun is not installed on this system")
+    hosts = LSFUtils.get_compute_hosts()
+    argv = build_jsrun_command(num_proc, len(hosts) or 1, command,
+                               extra_env, extra_args=extra_args)
+    env = dict(os.environ)
+    env.update(extra_env)
+    sys.stderr.write("hvdrun: %s\n" % " ".join(shlex.quote(a)
+                                               for a in argv))
+    return subprocess.run(argv, env=env).returncode

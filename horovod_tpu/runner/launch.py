@@ -68,14 +68,55 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    # Controller selection (reference: launch.py run_controller
+    # gloo/mpi/jsrun dispatch).
+    p.add_argument("--use-gloo", action="store_true", dest="use_gloo",
+                   help="Force the built-in TCP (gloo-style) launcher.")
+    p.add_argument("--use-mpi", action="store_true", dest="use_mpi",
+                   help="Launch through a single mpirun command.")
+    p.add_argument("--use-jsrun", action="store_true", dest="use_jsrun",
+                   help="Launch through LSF jsrun.")
+    p.add_argument("--mpi-args", dest="mpi_args", default=None,
+                   help="Extra arguments passed through to mpirun.")
+    p.add_argument("--network-interfaces", dest="nics", default=None,
+                   help="Comma-separated NIC allowlist for the data/"
+                        "control plane.")
+    p.add_argument("--config-file", dest="config_file", default=None,
+                   help="YAML file whose keys mirror the long CLI flags "
+                        "(reference: launch.py --config-file).")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="Command to run on every slot.")
     args = p.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(p, args)
     if not args.command:
         p.error("no command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     return args
+
+
+def _apply_config_file(parser: argparse.ArgumentParser, args) -> None:
+    """Overlay YAML config onto args: CLI flags explicitly given win;
+    unset flags take the file's value (reference: launch.py:293-297 +
+    runner/common/util/config_parser.py). Keys use the long flag names
+    with dashes or underscores."""
+    import yaml
+
+    with open(args.config_file) as f:
+        cfg = yaml.safe_load(f) or {}
+    if not isinstance(cfg, dict):
+        raise ValueError("--config-file must contain a YAML mapping")
+    defaults = parser.parse_args(["dummy"])  # all-default namespace
+    for raw_key, value in cfg.items():
+        key = raw_key.replace("-", "_")
+        if key in ("command", "config_file"):
+            continue
+        if not hasattr(args, key):
+            raise ValueError("unknown config-file key: %s" % raw_key)
+        # Only fill in values the CLI left at default.
+        if getattr(args, key) == getattr(defaults, key):
+            setattr(args, key, value)
 
 
 def _hosts_from_args(args) -> List[HostInfo]:
@@ -143,12 +184,20 @@ def _run_static(args) -> int:
     # Rank 0's host runs the controller; workers dial it there.
     rank0_host = assignments[0].hostname
     controller_addr = "127.0.0.1" if is_local(rank0_host) else rank0_host
+    launcher_default = (socket.gethostname()
+                        if any(not is_local(a.hostname)
+                               for a in assignments)
+                        else "127.0.0.1")
+    # --network-interfaces pins the rendezvous/controller endpoints (and
+    # thus all control-plane traffic) to the named NICs.
+    launcher_host = _launcher_addr(args.nics, launcher_default)
+    if args.nics and is_local(rank0_host):
+        controller_addr = launcher_host
     controller_port = free_port()
-    launcher_host = (socket.gethostname()
-                     if any(not is_local(a.hostname) for a in assignments)
-                     else "127.0.0.1")
 
     extra = _tuning_env(args)
+    if args.nics:
+        extra["HOROVOD_IFACE"] = args.nics
     output_file = (open(args.output_filename, "w")
                    if args.output_filename else None)
     procs: List[SlotProcess] = []
@@ -190,12 +239,105 @@ def _run_static(args) -> int:
         rendezvous.stop()
 
 
+def _launcher_addr(nics: Optional[str], default: str) -> str:
+    """Pick the launcher-side address workers should dial. With
+    --network-interfaces, resolve an address on one of those NICs."""
+    if not nics:
+        return default
+    from horovod_tpu.runner.network import local_addresses
+
+    addrs = local_addresses()
+    for nic in nics.split(","):
+        if nic in addrs and addrs[nic]:
+            return addrs[nic][0]
+    raise ValueError(
+        "--network-interfaces %r matched no local interface with an IPv4 "
+        "address (have: %s)" % (nics, ", ".join(sorted(addrs))))
+
+
+def _run_mpi(args) -> int:
+    """Single-mpirun path (reference: launch.py run_controller mpi)."""
+    from horovod_tpu.runner.mpi_run import run_mpi
+
+    np_ = args.np or 1
+    rendezvous = RendezvousServer()
+    rendezvous_port = rendezvous.start()
+    hosts = _hosts_from_args(args)
+    assignments = get_host_assignments(hosts, np_, np_)
+    rendezvous.publish(assignments)
+    # Reconstruct the -H string from the parsed hosts so --hostfile works
+    # identically to -H.
+    hosts_str = ",".join("%s:%d" % (h.hostname, h.slots) for h in hosts) \
+        if (args.hosts or args.hostfile) else None
+    rank0_host = assignments[0].hostname
+    all_local = all(is_local(h.hostname) for h in hosts)
+    env = _tuning_env(args)
+    env.update({
+        "HOROVOD_CONTROLLER_ADDR": ("127.0.0.1" if is_local(rank0_host)
+                                    else rank0_host),
+        "HOROVOD_CONTROLLER_PORT": str(free_port()),
+        "HOROVOD_RENDEZVOUS_ADDR": _launcher_addr(
+            args.nics,
+            "127.0.0.1" if all_local else socket.gethostname()),
+        "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
+        "PYTHONUNBUFFERED": "1",
+    })
+    try:
+        return run_mpi(np_, hosts_str, args.command, env,
+                       nics=args.nics.split(",") if args.nics else None,
+                       extra_mpi_args=args.mpi_args,
+                       output_filename=args.output_filename)
+    finally:
+        rendezvous.stop()
+
+
+def _run_jsrun(args) -> int:
+    from horovod_tpu.runner.js_run import LSFUtils, js_run
+
+    np_ = args.np or LSFUtils.get_num_processes()
+    compute_hosts = LSFUtils.get_compute_hosts()
+    num_hosts = max(len(compute_hosts), 1)
+    if np_ % num_hosts != 0:
+        # jsrun resource sets are uniform; a silent floor would launch
+        # fewer workers than HOROVOD_SIZE and hang the first collective.
+        raise ValueError(
+            "-np %d does not divide evenly across %d LSF hosts; pick a "
+            "multiple of the host count" % (np_, num_hosts))
+    per_host = np_ // num_hosts
+    hosts = ([HostInfo(h, per_host) for h in compute_hosts]
+             or [HostInfo("localhost", np_)])
+    rendezvous = RendezvousServer()
+    rendezvous_port = rendezvous.start()
+    assignments = get_host_assignments(hosts, np_, np_)
+    rendezvous.publish(assignments)
+    env = _tuning_env(args)
+    env.update({
+        "HOROVOD_CONTROLLER_ADDR": assignments[0].hostname,
+        "HOROVOD_CONTROLLER_PORT": str(free_port()),
+        "HOROVOD_RENDEZVOUS_ADDR": _launcher_addr(args.nics,
+                                                  socket.gethostname()),
+        "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
+        "PYTHONUNBUFFERED": "1",
+    })
+    try:
+        return js_run(np_, args.command, env)
+    finally:
+        rendezvous.stop()
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    if sum([args.use_gloo, args.use_mpi, args.use_jsrun]) > 1:
+        raise ValueError(
+            "--use-gloo, --use-mpi and --use-jsrun are mutually exclusive")
     if args.discovery_script or args.min_np or args.max_np:
         from horovod_tpu.runner.elastic_run import run_elastic
 
         return run_elastic(args)
+    if args.use_mpi:
+        return _run_mpi(args)
+    if args.use_jsrun:
+        return _run_jsrun(args)
     return _run_static(args)
 
 

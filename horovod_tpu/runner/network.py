@@ -1,0 +1,172 @@
+"""Network interface discovery and HMAC-authenticated socket RPC.
+
+Parity with the reference's runner networking layer
+(reference: horovod/runner/common/util/network.py:1-306 — pickled request/
+response messages over TCP signed with an HMAC secret;
+horovod/runner/driver/driver_service.py:162-257 — every host reports its
+routable (interface, address) set and the driver intersects them to pick
+NICs common to all hosts).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import pickle
+import secrets as _secrets
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+import psutil
+
+
+def make_secret_key() -> bytes:
+    """(reference: runner/common/util/secret.py make_secret_key)"""
+    return _secrets.token_bytes(32)
+
+
+def local_addresses() -> Dict[str, List[str]]:
+    """Map interface name -> IPv4 addresses, loopback excluded
+    (reference: driver_service.py:162-190 via psutil.net_if_addrs)."""
+    out: Dict[str, List[str]] = {}
+    for iface, addrs in psutil.net_if_addrs().items():
+        v4 = [a.address for a in addrs
+              if a.family == socket.AF_INET
+              and not a.address.startswith("127.")]
+        if v4:
+            out[iface] = v4
+    return out
+
+
+def common_interfaces(per_host: Dict[str, Set[str]]) -> Set[str]:
+    """Intersect interface-name sets across hosts
+    (reference: driver_service.py:218-257)."""
+    ifaces: Optional[Set[str]] = None
+    for host, s in per_host.items():
+        ifaces = set(s) if ifaces is None else (ifaces & set(s))
+    return ifaces or set()
+
+
+# --- wire format: len-prefixed HMAC-signed pickle --------------------------
+
+def _sign(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+def write_message(sock: socket.socket, obj, key: bytes) -> None:
+    payload = pickle.dumps(obj)
+    digest = _sign(key, payload)
+    sock.sendall(struct.pack("!I", len(payload)) + digest + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-message")
+        buf += chunk
+    return buf
+
+
+def read_message(sock: socket.socket, key: bytes):
+    (length,) = struct.unpack("!I", _recv_exact(sock, 4))
+    digest = _recv_exact(sock, 32)
+    payload = _recv_exact(sock, length)
+    if not hmac.compare_digest(digest, _sign(key, payload)):
+        raise PermissionError("message failed HMAC verification")
+    return pickle.loads(payload)
+
+
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    def __init__(self, service_name: str, source_address: str):
+        self.service_name = service_name
+        self.source_address = source_address
+
+
+class BasicService:
+    """Threaded TCP service dispatching pickled requests to ``_handle``
+    (reference: network.py BasicService)."""
+
+    def __init__(self, service_name: str, key: bytes):
+        self.name = service_name
+        self._key = key
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = read_message(self.request, outer._key)
+                except (ConnectionError, PermissionError):
+                    return
+                resp = outer._handle(req, self.client_address)
+                try:
+                    write_message(self.request, resp, outer._key)
+                except ConnectionError:
+                    pass
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("0.0.0.0", 0), Handler, bind_and_activate=True)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def addresses(self) -> Dict[str, List[Tuple[str, int]]]:
+        """All (address, port) pairs this service is reachable on, keyed
+        by interface (reference: network.py BasicService.addresses)."""
+        return {iface: [(a, self.port) for a in addrs]
+                for iface, addrs in local_addresses().items()}
+
+    def _handle(self, req, client_address):
+        if isinstance(req, PingRequest):
+            return PingResponse(self.name, client_address[0])
+        raise NotImplementedError(type(req))
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class BasicClient:
+    """(reference: network.py BasicClient)"""
+
+    def __init__(self, addresses, key: bytes,
+                 service_name: str = "", probe_timeout: float = 5.0):
+        """``addresses``: iface -> [(addr, port)] as produced by
+        BasicService.addresses(); the first address that answers a Ping
+        is used for all subsequent requests."""
+        self._key = key
+        self._timeout = probe_timeout
+        self._addr: Optional[Tuple[str, int]] = None
+        candidates = [ap for aps in addresses.values() for ap in aps]
+        for addr in candidates:
+            try:
+                resp = self._request_to(addr, PingRequest())
+                if isinstance(resp, PingResponse):
+                    self._addr = addr
+                    break
+            except OSError:
+                continue
+        if self._addr is None:
+            raise ConnectionError(
+                "no reachable address among %r" % (candidates,))
+
+    def _request_to(self, addr: Tuple[str, int], req):
+        with socket.create_connection(addr, timeout=self._timeout) as s:
+            write_message(s, req, self._key)
+            return read_message(s, self._key)
+
+    def request(self, req):
+        return self._request_to(self._addr, req)

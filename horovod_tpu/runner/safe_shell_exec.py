@@ -1,0 +1,115 @@
+"""Process execution with reliable cleanup.
+
+Parity with the reference's safe shell executor
+(reference: horovod/runner/common/util/safe_shell_exec.py:1-270): child
+processes run in their own session (setsid) so the whole process *group*
+can be terminated; termination sends SIGTERM, waits a grace period, then
+SIGKILLs survivors; stdout/stderr are forwarded line-by-line with an
+optional index/timestamp prefix.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from datetime import datetime
+from typing import Dict, IO, List, Optional, Union
+
+GRACEFUL_TERMINATION_TIME_S = 5.0
+
+
+def terminate_executor_shell_and_children(pid: int,
+                                          grace_s: float =
+                                          GRACEFUL_TERMINATION_TIME_S):
+    """SIGTERM the process group, give it ``grace_s`` seconds, then
+    SIGKILL whatever is left (reference: safe_shell_exec.py terminate)."""
+    try:
+        pgid = os.getpgid(pid)
+    except OSError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except OSError:
+        return
+    # NOTE: do not waitpid(pid) here — the direct child belongs to the
+    # caller's Popen object; reaping it would steal its exit status.
+    deadline = time.time() + grace_s
+    while time.time() < deadline:
+        try:
+            os.killpg(pgid, 0)
+        except OSError:
+            return  # group is gone
+        time.sleep(0.1)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except OSError:
+        pass
+
+
+def _forward(stream: IO[bytes], sink, prefix: Optional[str],
+             prefix_timestamp: bool):
+    for raw in iter(stream.readline, b""):
+        line = raw.decode(errors="replace")
+        if prefix is not None:
+            stamp = (datetime.now().strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+                     if prefix_timestamp else None)
+            tag = ("[%s]<%s>" % (prefix, stamp) if stamp
+                   else "[%s]" % prefix)
+            line = "%s: %s" % (tag, line)
+        sink.write(line)
+        sink.flush()
+    stream.close()
+
+
+def execute(command: Union[str, List[str]],
+            env: Optional[Dict[str, str]] = None,
+            stdout=None, stderr=None,
+            index: Optional[int] = None,
+            prefix_output_with_timestamp: bool = False,
+            events=None) -> int:
+    """Run ``command`` in its own session, forwarding output; on any event
+    in ``events`` (threading.Event) terminate the whole process tree.
+    Returns the exit code."""
+    shell = isinstance(command, str)
+    proc = subprocess.Popen(
+        command, shell=shell, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+
+    prefix = str(index) if index is not None else None
+    threads = [
+        threading.Thread(target=_forward,
+                         args=(proc.stdout, stdout or sys.stdout, prefix,
+                               prefix_output_with_timestamp)),
+        threading.Thread(target=_forward,
+                         args=(proc.stderr, stderr or sys.stderr, prefix,
+                               prefix_output_with_timestamp)),
+    ]
+    for t in threads:
+        t.daemon = True
+        t.start()
+
+    stop = threading.Event()
+    watchers = []
+    for ev in (events or []):
+        def _watch(ev=ev):
+            while not stop.is_set():
+                if ev.wait(0.1):
+                    terminate_executor_shell_and_children(proc.pid)
+                    return
+        w = threading.Thread(target=_watch)
+        w.daemon = True
+        w.start()
+        watchers.append(w)
+
+    try:
+        exit_code = proc.wait()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    return exit_code

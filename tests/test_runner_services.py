@@ -1,0 +1,167 @@
+"""Runner services: mpirun/jsrun command construction, config file,
+NIC-probe RPC, safe shell exec."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner import launch
+from horovod_tpu.runner.js_run import LSFUtils, build_jsrun_command
+from horovod_tpu.runner.mpi_run import (
+    _IMPI_IMPL, _OMPI_IMPL, build_mpirun_command,
+)
+from horovod_tpu.runner.network import (
+    BasicClient, BasicService, common_interfaces, local_addresses,
+    make_secret_key, read_message, write_message,
+)
+from horovod_tpu.runner import safe_shell_exec
+from horovod_tpu.runner.driver_service import get_common_interfaces
+
+
+def test_build_mpirun_command_openmpi():
+    argv = build_mpirun_command(
+        4, "h1:2,h2:2", ["python", "train.py"],
+        {"HOROVOD_RENDEZVOUS_ADDR": "1.2.3.4"}, impl=_OMPI_IMPL,
+        nics=["eth0"])
+    cmd = " ".join(argv)
+    assert cmd.startswith("mpirun --allow-run-as-root --tag-output")
+    assert "-np 4" in cmd
+    assert "-H h1:2,h2:2" in cmd
+    assert "-mca btl_tcp_if_include eth0" in cmd
+    assert "-x HOROVOD_RENDEZVOUS_ADDR" in cmd
+    assert cmd.endswith("python train.py")
+
+
+def test_build_mpirun_command_intel_differs():
+    argv = build_mpirun_command(
+        2, "h1:1,h2:1", ["python", "x.py"], {"A": "1"}, impl=_IMPI_IMPL)
+    cmd = " ".join(argv)
+    assert "-hosts h1:1,h2:1" in cmd
+    assert "-x" not in argv  # IMPI passes env directly, not via -x
+    assert "--tag-output" not in cmd
+
+
+def test_build_jsrun_command():
+    argv = build_jsrun_command(
+        8, 2, ["python", "t.py"], {"HOROVOD_RENDEZVOUS_PORT": "99"})
+    cmd = " ".join(argv)
+    assert "--nrs 2" in cmd
+    assert "--tasks_per_rs 4" in cmd
+    assert "--env HOROVOD_RENDEZVOUS_PORT=99" in cmd
+
+
+def test_lsf_utils_hosts(monkeypatch):
+    monkeypatch.setenv("LSB_JOBID", "1")
+    monkeypatch.setenv("LSB_HOSTS", "batch h1 h1 h2 h2")
+    assert LSFUtils.using_lsf()
+    assert LSFUtils.get_compute_hosts() == ["h1", "h2"]
+
+
+def test_config_file_yaml(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("fusion-threshold-mb: 32\nverbose: true\n"
+                   "cache-capacity: 512\n")
+    args = launch.parse_args(
+        ["--config-file", str(cfg), "--cache-capacity", "99",
+         "python", "x.py"])
+    assert args.fusion_threshold_mb == 32     # from file
+    assert args.verbose is True               # from file
+    assert args.cache_capacity == 99          # CLI wins over file
+
+
+def test_config_file_unknown_key(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("no-such-flag: 1\n")
+    with pytest.raises(ValueError):
+        launch.parse_args(["--config-file", str(cfg), "python", "x.py"])
+
+
+def test_hmac_rpc_roundtrip_and_tamper():
+    import socket as sock_mod
+
+    key = make_secret_key()
+    a, b = sock_mod.socketpair()
+    try:
+        write_message(a, {"x": 1}, key)
+        assert read_message(b, key) == {"x": 1}
+        # Wrong key must be rejected.
+        write_message(a, {"x": 2}, key)
+        with pytest.raises(PermissionError):
+            read_message(b, make_secret_key())
+    finally:
+        a.close()
+        b.close()
+
+
+def test_basic_service_ping():
+    key = make_secret_key()
+    svc = BasicService("test service", key)
+    try:
+        addrs = {"lo": [("127.0.0.1", svc.port)]}
+        client = BasicClient(addrs, key)
+        from horovod_tpu.runner.network import PingRequest, PingResponse
+
+        resp = client.request(PingRequest())
+        assert isinstance(resp, PingResponse)
+        assert resp.service_name == "test service"
+    finally:
+        svc.shutdown()
+
+
+def test_common_interfaces_intersection():
+    per_host = {"h1": {"eth0", "eth1", "lo"}, "h2": {"eth0", "ib0"}}
+    assert common_interfaces(per_host) == {"eth0"}
+    assert common_interfaces({}) == set()
+
+
+def test_driver_task_nic_probe():
+    key = make_secret_key()
+    ifaces, driver = get_common_interfaces(2, key)
+    try:
+        # All "hosts" are this machine: every real interface intersects.
+        assert ifaces == set(local_addresses().keys())
+    finally:
+        driver.shutdown()
+
+
+def test_safe_shell_exec_basic(tmp_path):
+    out = tmp_path / "o.txt"
+    with open(out, "w") as f:
+        rc = safe_shell_exec.execute("echo hello", stdout=f, index=3)
+    assert rc == 0
+    assert open(out).read() == "[3]: hello\n"
+
+
+def test_safe_shell_exec_kills_process_group():
+    ev = threading.Event()
+    start = time.time()
+
+    def trigger():
+        time.sleep(0.5)
+        ev.set()
+
+    threading.Thread(target=trigger, daemon=True).start()
+    # A shell that spawns a child sleeping 60s: termination must take the
+    # whole group down well before that.
+    rc = safe_shell_exec.execute("sleep 60", events=[ev])
+    assert time.time() - start < 30
+    assert rc != 0
+
+
+def test_mpi_env_rank_fallback():
+    code = ("import os;"
+            "os.environ.update(OMPI_COMM_WORLD_RANK='1',"
+            "OMPI_COMM_WORLD_SIZE='1',OMPI_COMM_WORLD_LOCAL_RANK='1');"
+            "from horovod_tpu.common import basics;"
+            "t = basics._topology_from_env();"
+            "assert t.rank == 1 and t.size == 1 and t.local_rank == 1;"
+            "print('ENV_OK')")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert "ENV_OK" in proc.stdout, proc.stderr

@@ -90,3 +90,42 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     rdd = sc.parallelize(range(num_proc), num_proc).barrier()
     results = rdd.mapPartitions(_task).collect()
     return [r for _, r in sorted(results)]
+
+
+def run_elastic(fn, args=(), kwargs=None, num_proc=None,
+                min_np=None, max_np=None, retries: int = 3,
+                extra_env=None, verbose: int = 1):
+    """Fault-tolerant variant (reference: spark/runner.py:309-429).
+
+    Spark owns the executor set, so unlike the hvdrun elastic driver the
+    world size is FIXED at ``num_proc`` for the lifetime of the barrier
+    job (min_np/max_np only validate that num_proc is inside the
+    allowed range). Fault tolerance is retry-from-committed-state: the
+    first positional argument is expected to be an elastic ``State``;
+    on ``HorovodInternalError`` each rank restores the last commit and
+    the step loop retries, up to ``retries`` times. Executor loss beyond
+    that surfaces as a failed Spark job (Spark's own task retry
+    resubmits the barrier stage)."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    if num_proc is not None:
+        if min_np is not None and num_proc < min_np:
+            raise ValueError("num_proc=%d < min_np=%d" % (num_proc, min_np))
+        if max_np is not None and num_proc > max_np:
+            raise ValueError("num_proc=%d > max_np=%d" % (num_proc, max_np))
+
+    def resilient(*a, **kw):
+        state = a[0] if a else None
+        for attempt in range(retries + 1):
+            try:
+                if state is not None and hasattr(state, "sync"):
+                    state.sync()
+                return fn(*a, **kw)
+            except HorovodInternalError:
+                if attempt == retries:
+                    raise
+                if state is not None and hasattr(state, "restore"):
+                    state.restore()
+
+    return run(resilient, args=args, kwargs=kwargs, num_proc=num_proc,
+               extra_env=extra_env, verbose=verbose)

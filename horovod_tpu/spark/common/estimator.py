@@ -1,0 +1,154 @@
+"""HorovodEstimator core: materialize data, train distributed, return a
+fitted model transformer.
+
+Parity with the reference's estimator flow
+(reference: horovod/spark/common/estimator.py + util.py:
+``fit`` materializes the DataFrame to Parquet under the Store, ships a
+picklable remote-store view + serialized model spec to every rank via
+the backend, each rank trains on its shard with a DistributedOptimizer,
+rank 0 checkpoints into the run directory, and fit returns a Model
+object usable for prediction / Spark ``transform``).
+
+DataFrames: with pyspark installed, a Spark DataFrame is written with
+``df.write.parquet``; pandas DataFrames are written with pyarrow. The
+training side always reads Parquet with pandas, sharding rows by rank —
+the petastorm role in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, List, Optional
+
+from horovod_tpu.spark.common.backend import Backend, LocalBackend
+from horovod_tpu.spark.common.params import EstimatorParams
+from horovod_tpu.spark.common.store import FilesystemStore, Store
+
+
+def _is_spark_df(df) -> bool:
+    mod = type(df).__module__
+    return mod.startswith("pyspark.")
+
+
+def materialize_dataframe(df, path: str, validation=None) -> None:
+    """Write ``df`` (pandas or Spark) as a Parquet dataset at ``path``;
+    with ``validation`` a float fraction, rows are tagged with a
+    __validation__ 0/1 column first (reference: spark/common/util.py
+    prepare_data/check_validation)."""
+    if _is_spark_df(df):  # pragma: no cover - needs pyspark
+        from pyspark.sql import functions as F
+
+        if isinstance(validation, float):
+            df = df.withColumn(
+                "__validation__",
+                (F.rand(seed=0) < validation).cast("int"))
+        df.write.mode("overwrite").parquet("file://" + path)
+        return
+    import numpy as np
+    import pandas as pd
+
+    pdf = pd.DataFrame(df).copy()
+    if isinstance(validation, float):
+        rng = np.random.RandomState(0)
+        pdf["__validation__"] = (
+            rng.rand(len(pdf)) < validation).astype("int64")
+    os.makedirs(path, exist_ok=True)
+    pdf.to_parquet(os.path.join(path, "part-00000.parquet"))
+
+
+def read_shard(path: str, rank: int, size: int,
+               validation_col: Optional[str] = None):
+    """Read this rank's row shard of a Parquet dataset as
+    (train_pdf, val_pdf)."""
+    import pandas as pd
+
+    files = sorted(f for f in os.listdir(path) if f.endswith(".parquet"))
+    pdf = pd.concat(
+        [pd.read_parquet(os.path.join(path, f)) for f in files],
+        ignore_index=True)
+    if validation_col and validation_col in pdf.columns:
+        val = pdf[pdf[validation_col] == 1].drop(columns=[validation_col])
+        train = pdf[pdf[validation_col] == 0].drop(
+            columns=[validation_col])
+    else:
+        val, train = None, pdf
+    train = train.iloc[rank::size].reset_index(drop=True)
+    return train, val
+
+
+class HorovodEstimator(EstimatorParams):
+    """Common fit orchestration
+    (reference: spark/common/estimator.py HorovodEstimator)."""
+
+    def _backend(self) -> Backend:
+        if self.backend is not None:
+            return self.backend
+        return LocalBackend(num_proc=self.num_proc or 1)
+
+    def _store(self) -> Store:
+        if self.store is not None:
+            return self.store
+        import tempfile
+
+        return FilesystemStore(tempfile.mkdtemp(prefix="hvd_estimator_"))
+
+    def fit(self, df) -> "HorovodModel":
+        """Materialize ``df``, train across the backend's ranks, return
+        the fitted model."""
+        self._validate_fit()
+        store = self._store()
+        run_id = self.run_id or ("run_" + uuid.uuid4().hex[:12])
+        data_path = store.get_train_data_path()
+        materialize_dataframe(df, data_path, validation=self.validation)
+        if hasattr(store, "make_run_dirs"):
+            store.make_run_dirs(run_id)
+        remote_store = store.to_remote(run_id)
+        train_fn = self._train_fn(remote_store)
+        backend = self._backend()
+        results = backend.run(train_fn, args=())
+        return self._create_model(results, run_id, store)
+
+    # --- framework-specific hooks ---
+    def _train_fn(self, remote_store):
+        """Return a picklable fn() run on every rank; must train and (on
+        rank 0) write the checkpoint to remote_store.checkpoint_path, and
+        return per-rank history/metadata."""
+        raise NotImplementedError()
+
+    def _create_model(self, results: List[Any], run_id: str,
+                      store: Store) -> "HorovodModel":
+        raise NotImplementedError()
+
+
+class HorovodModel:
+    """Fitted model wrapper (reference: spark/common/estimator.py
+    HorovodModel): predicts locally; with pyspark, ``transform`` adds an
+    output column per label."""
+
+    def __init__(self, history, run_id: str, store: Store):
+        self.history = history
+        self.run_id = run_id
+        self.store = store
+
+    def predict(self, features):
+        raise NotImplementedError()
+
+    def transform(self, df):  # pragma: no cover - needs pyspark
+        import pandas as pd
+        from pyspark.sql.functions import pandas_udf
+
+        model = self
+
+        @pandas_udf("double")
+        def _predict(*cols: pd.Series) -> pd.Series:
+            import numpy as np
+
+            x = np.stack([c.to_numpy() for c in cols], axis=1)
+            return pd.Series(
+                np.asarray(model.predict(x)).reshape(len(cols[0]), -1)[:, 0])
+
+        out_col = "prediction"
+        feature_cols = [c for c in df.columns]
+        return df.withColumn(out_col, _predict(*[df[c]
+                                                 for c in feature_cols]))

@@ -1,0 +1,80 @@
+"""Estimator hyper-parameter plumbing.
+
+Parity with the reference's params layer
+(reference: horovod/spark/common/params.py — a pyspark.ml.param.Params
+mixin defining model/loss/optimizer/cols/epochs/... with getters and
+setters). Here the params are plain attributes with validation so the
+estimator API works with or without pyspark; when pyspark is installed
+the estimator additionally registers itself with the Spark ML pipeline
+machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+class EstimatorParams:
+    """(reference: spark/common/params.py EstimatorParams)"""
+
+    _param_names = [
+        "num_proc", "model", "backend", "store", "loss", "loss_weights",
+        "metrics", "optimizer", "feature_cols", "label_cols",
+        "sample_weight_col", "batch_size", "epochs", "verbose", "shuffle",
+        "callbacks", "random_seed", "train_steps_per_epoch",
+        "validation_steps_per_epoch", "validation", "custom_objects",
+        "run_id", "transformation_fn",
+    ]
+
+    def __init__(self, **kwargs):
+        self.num_proc: Optional[int] = None
+        self.model: Any = None
+        self.backend: Any = None
+        self.store: Any = None
+        self.loss: Any = None
+        self.loss_weights: Optional[List[float]] = None
+        self.metrics: List[Any] = []
+        self.optimizer: Any = None
+        self.feature_cols: Optional[List[str]] = None
+        self.label_cols: Optional[List[str]] = None
+        self.sample_weight_col: Optional[str] = None
+        self.batch_size: int = 32
+        self.epochs: int = 1
+        self.verbose: int = 1
+        self.shuffle: bool = True
+        self.callbacks: List[Any] = []
+        self.random_seed: Optional[int] = None
+        self.train_steps_per_epoch: Optional[int] = None
+        self.validation_steps_per_epoch: Optional[int] = None
+        # float in (0,1): split fraction; str: name of a 0/1 column.
+        self.validation: Any = None
+        self.custom_objects: Dict[str, Any] = {}
+        self.run_id: Optional[str] = None
+        # fn(pandas row-batch) -> transformed batch, applied at read time.
+        self.transformation_fn: Optional[Callable] = None
+        self.set_params(**kwargs)
+
+    def set_params(self, **kwargs) -> "EstimatorParams":
+        for k, v in kwargs.items():
+            if k not in self._param_names:
+                raise ValueError(
+                    "unknown estimator param %r (valid: %s)"
+                    % (k, ", ".join(self._param_names)))
+            setattr(self, k, v)
+        return self
+
+    def _validate_fit(self) -> None:
+        if self.model is None:
+            raise ValueError("model is required")
+        if self.epochs <= 0:
+            raise ValueError("epochs must be > 0")
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be > 0")
+        if isinstance(self.validation, float) and not (
+                0.0 < self.validation < 1.0):
+            raise ValueError("validation fraction must be in (0, 1)")
+
+    # Reference-style getters (reference exposes getModel()-style
+    # accessors via pyspark Params; keep the snake_case surface).
+    def get_params(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._param_names}

@@ -1,0 +1,229 @@
+"""Storage layer for materialized datasets and training artifacts.
+
+Parity with the reference's Store abstraction
+(reference: horovod/spark/common/store.py:36-550): a Store owns an
+intermediate-data prefix (materialized DataFrames as Parquet) plus
+per-run directories for checkpoints and logs. ``Store.create(prefix)``
+picks the backend from the path scheme (hdfs:// -> HDFSStore, otherwise
+filesystem). ``to_remote`` produces a picklable view shipped to training
+processes.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+
+class Store:
+    """(reference: spark/common/store.py:36-160)"""
+
+    def __init__(self):
+        self._train_data_to_key = {}
+        self._val_data_to_key = {}
+
+    # --- dataset paths ---
+    def is_parquet_dataset(self, path: str) -> bool:
+        raise NotImplementedError()
+
+    def get_train_data_path(self, idx=None) -> str:
+        raise NotImplementedError()
+
+    def get_val_data_path(self, idx=None) -> str:
+        raise NotImplementedError()
+
+    def get_test_data_path(self, idx=None) -> str:
+        raise NotImplementedError()
+
+    # --- run artifacts ---
+    def saving_runs(self) -> bool:
+        raise NotImplementedError()
+
+    def get_runs_path(self) -> str:
+        raise NotImplementedError()
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def get_checkpoints(self, run_id: str,
+                        suffix: str = ".ckpt") -> List[str]:
+        raise NotImplementedError()
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def get_checkpoint_filename(self) -> str:
+        raise NotImplementedError()
+
+    def get_logs_subdir(self) -> str:
+        raise NotImplementedError()
+
+    # --- io ---
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError()
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError()
+
+    def write_text(self, path: str, text: str) -> None:
+        raise NotImplementedError()
+
+    def to_remote(self, run_id: str, dataset_idx=None):
+        """Picklable view for training processes
+        (reference: store.py:130-160)."""
+        attrs = {
+            "train_data_path": self.get_train_data_path(dataset_idx),
+            "val_data_path": self.get_val_data_path(dataset_idx),
+            "test_data_path": self.get_test_data_path(dataset_idx),
+            "saving_runs": self.saving_runs(),
+            "runs_path": self.get_runs_path(),
+            "run_path": self.get_run_path(run_id),
+            "checkpoint_path": self.get_checkpoint_path(run_id),
+            "logs_path": self.get_logs_path(run_id),
+            "checkpoint_filename": self.get_checkpoint_filename(),
+            "logs_subdir": self.get_logs_subdir(),
+        }
+
+        class RemoteStore:
+            def __init__(self):
+                self.__dict__.update(attrs)
+
+        return RemoteStore()
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        if HDFSStore.matches(prefix_path):
+            return HDFSStore(prefix_path, *args, **kwargs)
+        return FilesystemStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Store on a mounted filesystem
+    (reference: store.py:165-350 AbstractFilesystemStore/FilesystemStore)."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 test_path: Optional[str] = None,
+                 runs_path: Optional[str] = None,
+                 save_runs: bool = True):
+        super().__init__()
+        self.prefix_path = self._normalize(prefix_path)
+        self._train_path = (self._normalize(train_path)
+                            or os.path.join(self.prefix_path,
+                                            "intermediate_train_data"))
+        self._val_path = (self._normalize(val_path)
+                          or os.path.join(self.prefix_path,
+                                          "intermediate_val_data"))
+        self._test_path = (self._normalize(test_path)
+                           or os.path.join(self.prefix_path,
+                                           "intermediate_test_data"))
+        self._runs_path = (self._normalize(runs_path)
+                           or os.path.join(self.prefix_path, "runs"))
+        self._save_runs = save_runs
+
+    @staticmethod
+    def _normalize(path: Optional[str]) -> Optional[str]:
+        if path is None:
+            return None
+        if path.startswith("file://"):
+            path = path[len("file://"):]
+        return path
+
+    @staticmethod
+    def _with_idx(path: str, idx) -> str:
+        return path if idx is None else "%s.%s" % (path, idx)
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        path = self._normalize(path)
+        if not os.path.isdir(path):
+            return False
+        return any(f.endswith(".parquet") for f in os.listdir(path))
+
+    def get_train_data_path(self, idx=None) -> str:
+        return self._with_idx(self._train_path, idx)
+
+    def get_val_data_path(self, idx=None) -> str:
+        return self._with_idx(self._val_path, idx)
+
+    def get_test_data_path(self, idx=None) -> str:
+        return self._with_idx(self._test_path, idx)
+
+    def saving_runs(self) -> bool:
+        return self._save_runs
+
+    def get_runs_path(self) -> str:
+        return self._runs_path
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self._runs_path, run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id),
+                            self.get_checkpoint_filename())
+
+    def get_checkpoints(self, run_id: str,
+                        suffix: str = ".ckpt") -> List[str]:
+        run_path = self.get_run_path(run_id)
+        if not os.path.isdir(run_path):
+            return []
+        return sorted(
+            os.path.join(run_path, f) for f in os.listdir(run_path)
+            if f.endswith(suffix))
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id),
+                            self.get_logs_subdir())
+
+    def get_checkpoint_filename(self) -> str:
+        return "checkpoint.ckpt"
+
+    def get_logs_subdir(self) -> str:
+        return "logs"
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._normalize(path))
+
+    def read(self, path: str) -> bytes:
+        with open(self._normalize(path), "rb") as f:
+            return f.read()
+
+    def write_text(self, path: str, text: str) -> None:
+        path = self._normalize(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+
+    def copy_dir(self, src: str, dst: str) -> None:
+        shutil.copytree(self._normalize(src), self._normalize(dst),
+                        dirs_exist_ok=True)
+
+    def make_run_dirs(self, run_id: str) -> None:
+        os.makedirs(self.get_run_path(run_id), exist_ok=True)
+        os.makedirs(self.get_logs_path(run_id), exist_ok=True)
+
+
+class LocalStore(FilesystemStore):
+    """(reference: store.py:341-350)"""
+
+
+class HDFSStore(Store):
+    """HDFS-backed store (reference: store.py:351-486). Requires a
+    pyarrow HDFS connection; constructing without one raises."""
+
+    PREFIX = "hdfs://"
+
+    @classmethod
+    def matches(cls, path: str) -> bool:
+        return bool(path) and path.startswith(cls.PREFIX)
+
+    def __init__(self, prefix_path: str, *args, **kwargs):
+        super().__init__()
+        raise NotImplementedError(
+            "HDFSStore requires an HDFS client (pyarrow.hdfs); mount the "
+            "cluster path and use FilesystemStore, or extend HDFSStore "
+            "with your connector")

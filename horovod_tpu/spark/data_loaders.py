@@ -1,0 +1,53 @@
+"""Data loaders for estimator training processes.
+
+Parity with the reference's Spark data loaders
+(reference: horovod/spark/data_loaders/pytorch_data_loaders.py:1-156 —
+Petastorm reader wrappers with an async-prefetch variant). Reading here
+is Parquet-via-pandas shards (see spark.common.estimator.read_shard);
+these loaders batch a pandas shard and optionally prefetch batches on a
+background thread via AsyncDataLoaderMixin.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from horovod_tpu.data.data_loader import AsyncDataLoaderMixin, BaseDataLoader
+
+
+class PandasShardDataLoader(BaseDataLoader):
+    """Batches (features, labels) numpy arrays out of a pandas shard
+    (reference: pytorch_data_loaders.py PytorchDataLoader)."""
+
+    def __init__(self, pdf, feature_cols: List[str], label_cols: List[str],
+                 batch_size: int = 32, shuffle: bool = True,
+                 seed: Optional[int] = None):
+        self._x = np.stack([pdf[c].to_numpy() for c in feature_cols],
+                           axis=1)
+        self._y = np.stack([pdf[c].to_numpy() for c in label_cols],
+                           axis=1)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return (len(self._x) + self.batch_size - 1) // self.batch_size
+
+    def _iterate(self) -> Iterator:
+        order = np.arange(len(self._x))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start:start + self.batch_size]
+            yield self._x[idx], self._y[idx]
+
+    def __iter__(self) -> Iterator:
+        return self._iterate()
+
+
+class AsyncPandasShardDataLoader(AsyncDataLoaderMixin,
+                                 PandasShardDataLoader):
+    """Background-thread prefetching variant
+    (reference: pytorch_data_loaders.py PytorchAsyncDataLoader)."""

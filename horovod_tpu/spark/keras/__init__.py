@@ -1,0 +1,108 @@
+"""KerasEstimator: Spark-ML-style distributed Keras training.
+
+Parity with the reference's Keras estimator
+(reference: horovod/spark/keras/estimator.py + remote.py: serialize the
+compiled model, train per-rank shards with hvd.keras callbacks +
+DistributedOptimizer, checkpoint on rank 0, return a KerasModel that
+predicts / transforms).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from horovod_tpu.spark.common.estimator import (
+    HorovodEstimator, HorovodModel, read_shard,
+)
+
+
+class KerasEstimator(HorovodEstimator):
+    """(reference: spark/keras/estimator.py KerasEstimator)"""
+
+    def _train_fn(self, remote_store):
+        import tensorflow as tf  # noqa: F401
+
+        model_json = self.model.to_json()
+        weights = self.model.get_weights()
+        optimizer = self.optimizer or "sgd"
+        opt_config = (optimizer if isinstance(optimizer, str)
+                      else type(optimizer).__name__.lower())
+        loss = self.loss or "mse"
+        metrics = list(self.metrics)
+        feature_cols = list(self.feature_cols or [])
+        label_cols = list(self.label_cols or [])
+        batch_size, epochs = self.batch_size, self.epochs
+        steps = self.train_steps_per_epoch
+        verbose = self.verbose
+        custom_objects = dict(self.custom_objects)
+        transformation_fn = self.transformation_fn
+
+        def train():
+            import tensorflow as tf
+
+            import horovod_tpu.tensorflow as hvd
+
+            hvd.init()
+            rank, size = hvd.rank(), hvd.size()
+            train_pdf, val_pdf = read_shard(
+                remote_store.train_data_path, rank, size,
+                validation_col="__validation__")
+            if transformation_fn is not None:
+                train_pdf = transformation_fn(train_pdf)
+            x = np.stack([train_pdf[c].to_numpy()
+                          for c in feature_cols], axis=1)
+            y = np.stack([train_pdf[c].to_numpy()
+                          for c in label_cols], axis=1)
+            model = tf.keras.models.model_from_json(
+                model_json, custom_objects=custom_objects)
+            model.set_weights(weights)
+            opt = tf.keras.optimizers.get(opt_config)
+            model.compile(optimizer=hvd.DistributedOptimizer(opt)
+                          if size > 1 else opt,
+                          loss=loss, metrics=metrics)
+            if size > 1:
+                hvd.broadcast_variables(
+                    model.trainable_variables, root_rank=0)
+            kwargs = {}
+            if val_pdf is not None and len(val_pdf):
+                xv = np.stack([val_pdf[c].to_numpy()
+                               for c in feature_cols], axis=1)
+                yv = np.stack([val_pdf[c].to_numpy()
+                               for c in label_cols], axis=1)
+                kwargs["validation_data"] = (xv, yv)
+            history = model.fit(x, y, batch_size=batch_size,
+                                epochs=epochs, steps_per_epoch=steps,
+                                verbose=verbose, **kwargs)
+            if rank == 0:
+                os.makedirs(os.path.dirname(
+                    remote_store.checkpoint_path), exist_ok=True)
+                model.save_weights(
+                    remote_store.checkpoint_path + ".weights.h5")
+            return {"history": {k: [float(v) for v in vs]
+                                for k, vs in history.history.items()},
+                    "weights": model.get_weights() if rank == 0 else None}
+
+        return train
+
+    def _create_model(self, results: List, run_id, store):
+        import tensorflow as tf
+
+        rank0 = next(r for r in results if r["weights"] is not None)
+        model = tf.keras.models.model_from_json(
+            self.model.to_json(), custom_objects=self.custom_objects)
+        model.set_weights(rank0["weights"])
+        return KerasModel(model, rank0["history"], run_id, store)
+
+
+class KerasModel(HorovodModel):
+    """(reference: spark/keras/estimator.py KerasModel)"""
+
+    def __init__(self, model, history, run_id, store):
+        super().__init__(history, run_id, store)
+        self.model = model
+
+    def predict(self, features):
+        return self.model.predict(np.asarray(features), verbose=0)

@@ -1,0 +1,159 @@
+"""Spark estimator framework: store, params, materialization, fit."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.common import basics
+from horovod_tpu.spark.common import (
+    EstimatorParams, FilesystemStore, LocalBackend, Store,
+)
+from horovod_tpu.spark.common.estimator import (
+    materialize_dataframe, read_shard,
+)
+from horovod_tpu.spark.data_loaders import (
+    AsyncPandasShardDataLoader, PandasShardDataLoader,
+)
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    basics.init()
+
+
+def _toy_pdf(n=64):
+    rng = np.random.RandomState(0)
+    x1 = rng.rand(n)
+    x2 = rng.rand(n)
+    return pd.DataFrame({
+        "x1": x1, "x2": x2, "y": 2.0 * x1 - 1.0 * x2 + 0.5})
+
+
+def test_store_paths_and_io(tmp_path):
+    store = Store.create(str(tmp_path / "store"))
+    assert isinstance(store, FilesystemStore)
+    assert store.get_train_data_path().endswith("intermediate_train_data")
+    assert store.get_train_data_path(3).endswith(".3")
+    store.make_run_dirs("run1")
+    assert os.path.isdir(store.get_logs_path("run1"))
+    store.write_text(os.path.join(store.get_run_path("run1"), "a.txt"),
+                     "hello")
+    assert store.read(
+        os.path.join(store.get_run_path("run1"), "a.txt")) == b"hello"
+    remote = store.to_remote("run1")
+    assert remote.checkpoint_path.startswith(str(tmp_path))
+    assert remote.checkpoint_filename == "checkpoint.ckpt"
+
+
+def test_store_create_hdfs_refused():
+    with pytest.raises(NotImplementedError):
+        Store.create("hdfs://namenode/path")
+
+
+def test_estimator_params_validation():
+    p = EstimatorParams(batch_size=16, epochs=2)
+    assert p.batch_size == 16
+    with pytest.raises(ValueError):
+        EstimatorParams(no_such_param=1)
+    with pytest.raises(ValueError):
+        EstimatorParams(model=object(), epochs=0)._validate_fit()
+    with pytest.raises(ValueError):
+        EstimatorParams(model=object(),
+                        validation=1.5)._validate_fit()
+
+
+def test_materialize_and_shard(tmp_path):
+    pdf = _toy_pdf(50)
+    path = str(tmp_path / "data")
+    materialize_dataframe(pdf, path, validation=0.2)
+    train0, val = read_shard(path, 0, 2,
+                             validation_col="__validation__")
+    train1, _ = read_shard(path, 1, 2, validation_col="__validation__")
+    assert val is not None and len(val) > 0
+    assert abs(len(train0) - len(train1)) <= 1
+    assert len(train0) + len(train1) + len(val) == 50
+    assert "__validation__" not in train0.columns
+
+
+def test_pandas_shard_loader():
+    pdf = _toy_pdf(10)
+    loader = PandasShardDataLoader(pdf, ["x1", "x2"], ["y"],
+                                   batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(loader) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[-1][0].shape == (2, 2)
+    aloader = AsyncPandasShardDataLoader(
+        pdf, ["x1", "x2"], ["y"], batch_size=4, shuffle=False,
+        async_loader_queue_size=2)
+    abatches = list(aloader)
+    np.testing.assert_allclose(abatches[0][1], batches[0][1])
+    aloader.close_async_loader()
+
+
+def test_keras_estimator_fit_predict(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)),
+        tf.keras.layers.Dense(1),
+    ])
+    from horovod_tpu.spark.keras import KerasEstimator
+
+    est = KerasEstimator(
+        model=model, optimizer="adam", loss="mse",
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=30, verbose=0,
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(256))
+    pred = fitted.predict([[0.5, 0.5]])
+    assert pred.shape == (1, 1)
+    assert "loss" in fitted.history
+    # Checkpoint landed in the store's run dir.
+    runs = os.listdir(str(tmp_path / "store" / "runs"))
+    assert len(runs) == 1
+
+
+def test_torch_estimator_fit_predict(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    model = torch.nn.Linear(2, 1)
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    est = TorchEstimator(
+        model=model, loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=20, verbose=0,
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(256))
+    pred = fitted.predict([[0.25, 0.75]])
+    assert pred.shape == (1, 1)
+    assert len(fitted.history) == 20
+    ckpt = est._store().get_checkpoint_path(fitted.run_id)
+    del ckpt  # store() makes a fresh temp dir; use the fitted one:
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "store"), "runs", fitted.run_id,
+                     "checkpoint.ckpt"))
+
+
+def test_torch_estimator_fit_np2(tmp_path):
+    """Distributed fit through the LocalBackend subprocess launcher."""
+    torch = pytest.importorskip("torch")
+
+    model = torch.nn.Linear(2, 1)
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    est = TorchEstimator(
+        model=model, loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=3, verbose=0,
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=2,
+                             env={"JAX_PLATFORMS": "cpu"}))
+    fitted = est.fit(_toy_pdf(64))
+    assert fitted.predict([[0.1, 0.9]]).shape == (1, 1)
+    assert len(fitted.history) == 3

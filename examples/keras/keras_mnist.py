@@ -1,0 +1,74 @@
+"""Distributed Keras MNIST with horovod_tpu callbacks.
+
+Parity workload for the reference's Keras example
+(reference: examples/keras/keras_mnist.py): DistributedOptimizer wrap,
+broadcast + metric-average + LR-warmup callbacks, rank-0 checkpointing.
+
+Run: bin/hvdrun -np 2 python examples/keras/keras_mnist.py --epochs 1
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+from horovod_tpu.keras import callbacks as hvd_callbacks
+
+
+def synthetic_mnist(n=2048):
+    rng = np.random.RandomState(42)
+    x = rng.rand(n, 28, 28).astype("float32")
+    y = rng.randint(0, 10, size=n).astype("int64")
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+
+    x, y = synthetic_mnist()
+    # Shard the dataset across ranks.
+    x = x[hvd.rank()::hvd.size()]
+    y = y[hvd.rank()::hvd.size()]
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(28, 28)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    # Warmup ramps the LR from the base value to base*size over the
+    # first epoch (large-batch stability); start at the base LR.
+    opt = tf.keras.optimizers.SGD(learning_rate=args.lr)
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(opt),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"])
+
+    steps_per_epoch = (len(x) + args.batch_size - 1) // args.batch_size
+    cbs = [
+        hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_callbacks.MetricAverageCallback(),
+        hvd_callbacks.LearningRateWarmupCallback(
+            initial_lr=args.lr, warmup_epochs=1,
+            steps_per_epoch=steps_per_epoch, verbose=0),
+    ]
+    if hvd.rank() == 0:
+        cbs.append(hvd_callbacks.BestModelCheckpoint(
+            filepath="/tmp/keras_mnist_best.weights.h5",
+            save_weights_only=True, monitor="loss"))
+
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=cbs, verbose=1 if hvd.rank() == 0 else 0)
+    print("rank %d done" % hvd.rank())
+
+
+if __name__ == "__main__":
+    main()

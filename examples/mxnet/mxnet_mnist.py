@@ -1,0 +1,60 @@
+"""Distributed MXNet-style MNIST with horovod_tpu.
+
+Parity workload for the reference's MXNet example
+(reference: examples/mxnet/mxnet_mnist.py): DistributedOptimizer,
+broadcast_parameters, rank-sharded data. Runs against real mxnet when
+installed; the op surface also accepts any NDArray-shaped array type.
+
+Run: bin/hvdrun -np 2 python examples/mxnet/mxnet_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu.mxnet as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=64)
+    args = p.parse_args()
+
+    try:
+        import mxnet as mx
+    except ImportError:
+        raise SystemExit(
+            "this example needs mxnet installed; see tests/mxnet_stub.py "
+            "for the binding exercised without it")
+
+    hvd.init()
+    rng = np.random.RandomState(hvd.rank())
+
+    net = mx.gluon.nn.Sequential()
+    net.add(mx.gluon.nn.Dense(128, activation="relu"),
+            mx.gluon.nn.Dense(10))
+    net.initialize()
+    params = net.collect_params()
+    hvd.broadcast_parameters(params, root_rank=0)
+
+    trainer = hvd.DistributedTrainer(
+        params, "sgd", {"learning_rate": 0.01 * hvd.size()})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        for _step in range(64):
+            x = mx.nd.array(rng.rand(args.batch_size, 784))
+            y = mx.nd.array(rng.randint(0, 10, args.batch_size))
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+        if hvd.rank() == 0:
+            print("epoch %d loss %.4f" % (epoch, float(loss.mean()
+                                                       .asscalar())))
+
+
+if __name__ == "__main__":
+    main()

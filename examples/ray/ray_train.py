@@ -1,0 +1,39 @@
+"""RayExecutor training run.
+
+Parity workload for the reference's Ray example
+(reference: examples/ray/ray_train.py): actor-per-slot execution of a
+horovod_tpu training function, colocated placement.
+
+Requires a ray installation: python examples/ray/ray_train.py
+"""
+
+import argparse
+
+
+def train_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    x = np.ones(4) * (hvd.rank() + 1)
+    total = hvd.allreduce(x, op=hvd.Sum, name="ray.demo")
+    return float(np.asarray(total)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-workers", type=int, default=2)
+    args = p.parse_args()
+
+    from horovod_tpu.ray import RayExecutor
+
+    executor = RayExecutor(num_workers=args.num_workers)
+    executor.start()
+    results = executor.run(train_fn)
+    print("per-rank allreduce results:", results)
+    executor.shutdown()
+
+
+if __name__ == "__main__":
+    main()

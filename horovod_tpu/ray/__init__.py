@@ -9,8 +9,6 @@ when absent so the API stays introspectable.
 
 from __future__ import annotations
 
-import os
-import socket
 from typing import Any, Callable, List, Optional
 
 
@@ -36,63 +34,42 @@ class RayExecutor:
     """
 
     def __init__(self, num_workers: int, cpus_per_worker: int = 1,
-                 use_gpu: bool = False, env_vars=None):
+                 use_gpu: bool = False, gpus_per_worker: int = 1,
+                 env_vars=None):
         self.num_workers = num_workers
         self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker if use_gpu else 0
         self.env_vars = dict(env_vars or {})
         self._workers = []
 
     def start(self):
         ray = _require_ray()
+        from horovod_tpu.ray.utils import assign_topology, make_worker_cls
 
-        @ray.remote(num_cpus=self.cpus_per_worker)
-        class _Worker:
-            def __init__(self, env):
-                os.environ.update(env)
+        Worker = make_worker_cls(ray, num_cpus=self.cpus_per_worker,
+                                 num_gpus=self.gpus_per_worker)
+        actors = [Worker.remote(self.env_vars)
+                  for _ in range(self.num_workers)]
+        hostnames = ray.get([w.hostname.remote() for w in actors])
 
-            def hostname(self):
-                return socket.gethostname()
+        # Rank assignment packs host-by-host (launcher slot rule); the
+        # topology helper returns envs in rank order with the original
+        # actor index attached.
+        envs = assign_topology(hostnames)
+        controller_actor = actors[envs[0]["actor_index"]]
+        controller_port = ray.get(controller_actor.pick_port.remote())
+        controller_host = envs[0]["HOROVOD_HOSTNAME"]
 
-            def pick_port(self):
-                s = socket.socket()
-                s.bind(("0.0.0.0", 0))
-                port = s.getsockname()[1]
-                s.close()
-                return port
-
-            def setup(self, env):
-                os.environ.update(env)
-                return True
-
-            def execute(self, fn, args, kwargs):
-                return fn(*args, **kwargs)
-
-        self._workers = [
-            _Worker.remote(self.env_vars) for _ in range(self.num_workers)]
-        ray = _require_ray()
-        hostnames = ray.get([w.hostname.remote() for w in self._workers])
-        controller_port = ray.get(self._workers[0].pick_port.remote())
-        controller_host = hostnames[0]
-
-        # Rank assignment: pack by hostname order of first appearance
-        # (reference: ray/runner.py Coordinator.establish_rendezvous).
-        local_counts = {}
+        self._workers = []
         setups = []
-        for rank, (w, host) in enumerate(zip(self._workers, hostnames)):
-            local_rank = local_counts.get(host, 0)
-            local_counts[host] = local_rank + 1
-            env = {
-                "HOROVOD_RANK": str(rank),
-                "HOROVOD_SIZE": str(self.num_workers),
-                "HOROVOD_LOCAL_RANK": str(local_rank),
-                "HOROVOD_LOCAL_SIZE": str(hostnames.count(host)),
-                "HOROVOD_CROSS_RANK": "0",
-                "HOROVOD_CROSS_SIZE": "1",
+        for env in envs:
+            w = actors[env.pop("actor_index")]
+            env.update({
                 "HOROVOD_CONTROLLER_ADDR": controller_host,
                 "HOROVOD_CONTROLLER_PORT": str(controller_port),
-                "HOROVOD_HOSTNAME": host,
-            }
+            })
             env.update(self.env_vars)
+            self._workers.append(w)  # ordered by rank
             setups.append(w.setup.remote(env))
         ray.get(setups)
 

@@ -1,0 +1,176 @@
+"""Elastic training on Ray: cluster-resource host discovery + executor.
+
+Parity with the reference's elastic Ray layer
+(reference: horovod/ray/elastic.py:38-465 — RayHostDiscovery reads
+ray.available_resources() to produce host:slots, ElasticRayExecutor
+drives the elastic driver with that discovery and spawns actor workers
+on rendezvous updates).
+
+The executor runs a spawn/execute/reset loop against the discovery
+object (host tracking via horovod_tpu.runner.discovery.HostManager):
+actor loss tears the world down, re-discovers hosts, and retries at the
+new size up to ``reset_limit`` resets.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from horovod_tpu.runner.discovery import HostManager
+
+
+class RayHostDiscovery:
+    """Map ray cluster nodes -> slot counts
+    (reference: ray/elastic.py:38-70)."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        import ray
+
+        hosts: Dict[str, int] = {}
+        for node in ray.nodes():
+            if not node.get("Alive", False):
+                continue
+            resources = node.get("Resources", {})
+            hostname = node.get("NodeManagerHostname",
+                                node.get("NodeManagerAddress", ""))
+            if self.use_gpu:
+                slots = int(resources.get("GPU", 0) // self.gpus_per_slot)
+            else:
+                slots = int(resources.get("CPU", 0) // self.cpus_per_slot)
+            if slots > 0:
+                hosts[hostname] = slots
+        return hosts
+
+    def find_available_hosts(self):
+        """Adapter to the hvdrun HostManager protocol
+        (List[HostInfo])."""
+        from horovod_tpu.runner.hosts import HostInfo
+
+        return [HostInfo(h, s)
+                for h, s in sorted(
+                    self.find_available_hosts_and_slots().items())]
+
+
+class StaticHostDiscovery:
+    """Fixed host map; useful for tests and fixed-size Ray clusters."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self.hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self.hosts)
+
+    def find_available_hosts(self):
+        from horovod_tpu.runner.hosts import HostInfo
+
+        return [HostInfo(h, s) for h, s in sorted(self.hosts.items())]
+
+
+class ElasticRayExecutor:
+    """(reference: ray/elastic.py:149-465)
+
+    Usage::
+
+        executor = ElasticRayExecutor(min_np=1, max_np=4)
+        executor.start()
+        results = executor.run(train_fn)
+    """
+
+    def __init__(self, min_np: int = 1, max_np: Optional[int] = None,
+                 cpus_per_slot: int = 1, use_gpu: bool = False,
+                 gpus_per_slot: int = 1, env_vars=None,
+                 discovery: Optional[object] = None,
+                 reset_limit: Optional[int] = None):
+        self.min_np = min_np
+        self.max_np = max_np
+        self.cpus_per_slot = cpus_per_slot
+        self.use_gpu = use_gpu
+        self.gpus_per_slot = gpus_per_slot
+        self.env_vars = dict(env_vars or {})
+        self.discovery = discovery
+        self.reset_limit = reset_limit
+        self._host_manager: Optional[HostManager] = None
+
+    def start(self):
+        import ray
+
+        if not ray.is_initialized():
+            ray.init()
+        if self.discovery is None:
+            self.discovery = RayHostDiscovery(
+                use_gpu=self.use_gpu, cpus_per_slot=self.cpus_per_slot)
+        self._host_manager = HostManager(self.discovery)
+
+    def _spawn_world(self, ray, num_proc: int):
+        """Spawn num_proc actors, compute the packed topology, wire the
+        controller endpoint; returns rank-ordered actors."""
+        from horovod_tpu.ray.utils import assign_topology, make_worker_cls
+
+        Worker = make_worker_cls(
+            ray, num_cpus=self.cpus_per_slot,
+            num_gpus=self.gpus_per_slot if self.use_gpu else 0)
+        actors = [Worker.remote(self.env_vars)
+                  for _ in range(num_proc)]
+        hostnames = ray.get([w.hostname.remote() for w in actors])
+        envs = assign_topology(hostnames)
+        controller_actor = actors[envs[0]["actor_index"]]
+        controller_port = ray.get(controller_actor.pick_port.remote())
+        controller_host = envs[0]["HOROVOD_HOSTNAME"]
+        workers, setups = [], []
+        for env in envs:
+            w = actors[env.pop("actor_index")]
+            env.update({
+                "HOROVOD_CONTROLLER_ADDR": controller_host,
+                "HOROVOD_CONTROLLER_PORT": str(controller_port),
+            })
+            env.update(self.env_vars)
+            workers.append(w)
+            setups.append(w.setup.remote(env))
+        ray.get(setups)
+        return workers
+
+    def run(self, fn: Callable, args=(), kwargs=None) -> List:
+        """Elastic execution loop: discover the current slot set, spawn a
+        world, run ``fn`` on every rank. When an actor dies mid-run
+        (node loss), the surviving actors are torn down, hosts are
+        re-discovered, and a fresh (possibly differently-sized) world
+        retries — up to ``reset_limit`` resets (default 3). ``fn`` is
+        responsible for resuming from committed elastic State on rank 0
+        broadcast (hvd.elastic semantics)."""
+        if self._host_manager is None:
+            self.start()
+        import ray
+
+        kwargs = kwargs or {}
+        resets = 0
+        limit = self.reset_limit if self.reset_limit is not None else 3
+        while True:
+            hosts = self.discovery.find_available_hosts_and_slots()
+            num_proc = sum(hosts.values())
+            if self.max_np is not None:
+                num_proc = min(num_proc, self.max_np)
+            if num_proc < self.min_np:
+                raise RuntimeError(
+                    "only %d slots available, need min_np=%d"
+                    % (num_proc, self.min_np))
+            workers = self._spawn_world(ray, num_proc)
+            try:
+                return ray.get([w.execute.remote(fn, args, kwargs)
+                                for w in workers])
+            except ray.exceptions.RayError:
+                resets += 1
+                if resets > limit:
+                    raise
+                self._host_manager.refresh()
+            finally:
+                for w in workers:
+                    try:
+                        ray.kill(w)
+                    except Exception:
+                        pass

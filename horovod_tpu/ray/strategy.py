@@ -1,0 +1,119 @@
+"""Placement strategies for Ray workers.
+
+Parity with the reference's placement layer
+(reference: horovod/ray/strategy.py:12-204 — ColocatedStrategy packs
+num_hosts x workers_per_host into one bundle per host with a PACK
+placement group; PackStrategy/SpreadStrategy place free-form worker
+counts). Bundle computation is pure and testable without ray; placement
+group creation requires ray.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def resources_per_bundle(cpus_per_worker: int, gpus_per_worker: int,
+                         workers_per_bundle: int) -> Dict[str, int]:
+    """One bundle's resource dict (reference: strategy.py:81-95)."""
+    bundle = {"CPU": cpus_per_worker * workers_per_bundle}
+    if gpus_per_worker:
+        bundle["GPU"] = gpus_per_worker * workers_per_bundle
+    return bundle
+
+
+def bundles_for(num_workers: int, workers_per_host: Optional[int],
+                cpus_per_worker: int = 1, gpus_per_worker: int = 0,
+                ) -> Tuple[List[Dict[str, int]], str]:
+    """Compute (bundles, ray placement strategy name).
+
+    With ``workers_per_host`` set, mirrors ColocatedStrategy: one bundle
+    per host holding all that host's workers, STRICT_PACK per bundle,
+    SPREAD across hosts. Otherwise PackStrategy: one bundle per worker,
+    PACK so they land close together."""
+    if workers_per_host:
+        if num_workers % workers_per_host != 0:
+            raise ValueError(
+                "num_workers=%d must be a multiple of workers_per_host=%d"
+                % (num_workers, workers_per_host))
+        num_hosts = num_workers // workers_per_host
+        bundle = resources_per_bundle(cpus_per_worker, gpus_per_worker,
+                                      workers_per_host)
+        return [dict(bundle) for _ in range(num_hosts)], "STRICT_SPREAD"
+    bundle = resources_per_bundle(cpus_per_worker, gpus_per_worker, 1)
+    return [dict(bundle) for _ in range(num_workers)], "PACK"
+
+
+def create_placement_group(bundles: List[Dict[str, int]],
+                           strategy: str, timeout_s: float = 100.0):
+    """(reference: strategy.py:12-30) Requires ray."""
+    import ray
+    from ray.util.placement_group import placement_group
+
+    pg = placement_group(bundles, strategy=strategy)
+    ray.get(pg.ready(), timeout=timeout_s)
+    return pg
+
+
+class BaseStrategy:
+    """(reference: strategy.py:32-63)"""
+
+    placement_group = None
+
+    def create_workers(self):
+        raise NotImplementedError()
+
+    @property
+    def num_workers(self) -> int:
+        raise NotImplementedError()
+
+    def shutdown(self):
+        if self.placement_group is not None:
+            import ray
+
+            ray.util.remove_placement_group(self.placement_group)
+            self.placement_group = None
+
+
+class ColocatedStrategy(BaseStrategy):
+    """Fixed hosts x slots layout (reference: strategy.py:65-140)."""
+
+    def __init__(self, *, num_hosts: int, num_workers_per_host: int,
+                 cpus_per_worker: int = 1, use_gpu: bool = False,
+                 gpus_per_worker: int = 0):
+        self.num_hosts = num_hosts
+        self.num_workers_per_host = num_workers_per_host
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker if use_gpu else 0
+
+    @property
+    def num_workers(self) -> int:
+        return self.num_hosts * self.num_workers_per_host
+
+    def create_workers(self):
+        bundles, strategy = bundles_for(
+            self.num_workers, self.num_workers_per_host,
+            self.cpus_per_worker, self.gpus_per_worker)
+        self.placement_group = create_placement_group(bundles, strategy)
+        return self.placement_group
+
+
+class PackStrategy(BaseStrategy):
+    """Free-form worker count packed close (reference: strategy.py:142+)."""
+
+    def __init__(self, *, num_workers: int, cpus_per_worker: int = 1,
+                 use_gpu: bool = False, gpus_per_worker: int = 0):
+        self._num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker if use_gpu else 0
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def create_workers(self):
+        bundles, strategy = bundles_for(
+            self.num_workers, None, self.cpus_per_worker,
+            self.gpus_per_worker)
+        self.placement_group = create_placement_group(bundles, strategy)
+        return self.placement_group

@@ -1,0 +1,81 @@
+"""Shared Ray actor + topology helpers
+(reference: horovod/ray/utils.py, ray/runner.py Coordinator).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Dict, List
+
+
+def free_port() -> int:
+    from horovod_tpu.runner.launch import free_port as _fp
+
+    return _fp()
+
+
+def make_worker_cls(ray, num_cpus: int = 1, num_gpus: int = 0):
+    """One actor class shared by RayExecutor and ElasticRayExecutor."""
+
+    @ray.remote(num_cpus=num_cpus, num_gpus=num_gpus)
+    class Worker:
+        def __init__(self, env=None):
+            if env:
+                os.environ.update(env)
+
+        def hostname(self) -> str:
+            return socket.gethostname()
+
+        def pick_port(self) -> int:
+            return free_port()
+
+        def setup(self, env: Dict[str, str]) -> bool:
+            os.environ.update(env)
+            return True
+
+        def execute(self, fn, args=(), kwargs=None):
+            return fn(*args, **(kwargs or {}))
+
+    return Worker
+
+
+def assign_topology(hostnames: List[str]) -> List[Dict[str, str]]:
+    """Compute HOROVOD_* topology env for actors already placed on hosts.
+
+    Ranks pack host-by-host in order of first appearance (the launcher's
+    slot rule, reference: runner/common/util/hosts.py:100-160 /
+    horovod_tpu.runner.hosts.get_host_assignments): local_rank is the
+    slot index on the host, cross_rank the index of the host among hosts
+    that have that local_rank. Returns one env dict per actor, in a
+    NEW rank order: entry i is for rank i, with "actor_index" recording
+    which original actor gets it.
+    """
+    host_order: List[str] = []
+    by_host: Dict[str, List[int]] = {}
+    for idx, h in enumerate(hostnames):
+        if h not in by_host:
+            host_order.append(h)
+            by_host[h] = []
+        by_host[h].append(idx)
+
+    size = len(hostnames)
+    envs: List[Dict[str, str]] = []
+    rank = 0
+    for host in host_order:
+        local_size = len(by_host[host])
+        for local_rank, actor_index in enumerate(by_host[host]):
+            cross_hosts = [h for h in host_order
+                           if len(by_host[h]) > local_rank]
+            envs.append({
+                "actor_index": actor_index,
+                "HOROVOD_RANK": str(rank),
+                "HOROVOD_SIZE": str(size),
+                "HOROVOD_LOCAL_RANK": str(local_rank),
+                "HOROVOD_LOCAL_SIZE": str(local_size),
+                "HOROVOD_CROSS_RANK": str(cross_hosts.index(host)),
+                "HOROVOD_CROSS_SIZE": str(len(cross_hosts)),
+                "HOROVOD_HOSTNAME": host,
+            })
+            rank += 1
+    return envs

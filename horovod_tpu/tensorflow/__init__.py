@@ -134,7 +134,8 @@ def broadcast_variables(variables, root_rank=0,
         out = eager.synchronize(eager.broadcast_async(
             v.numpy(), root_rank,
             name="broadcast_variables.%d" % i, process_set=process_set))
-        v.assign(np.asarray(out))
+        # The native path flattens 0-d tensors; restore the exact shape.
+        v.assign(np.asarray(out).reshape(v.shape))
 
 
 def broadcast_object(obj, root_rank=0, name=None,

@@ -35,6 +35,30 @@ def test_pytorch_mnist_example():
     assert "epoch 0 loss" in proc.stdout
 
 
+def test_keras_mnist_example():
+    proc = _run_example("examples/keras/keras_mnist.py", 2,
+                        ["--epochs", "1", "--batch-size", "64"],
+                        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("done") == 2
+
+
+def test_spark_keras_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Direct script run (no -m horovod_tpu.runner): put the repo on the
+    # path, preserving any existing entries (e.g. the TPU site dir).
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples/spark/keras_spark_mnist.py"),
+         "--num-proc", "1", "--epochs", "2"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "predict([1,0,0,0])" in proc.stdout
+
+
 def test_adasum_example():
     proc = _run_example("examples/adasum/adasum_small_model.py", 2,
                         ["--steps", "30"])

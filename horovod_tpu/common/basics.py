@@ -287,6 +287,11 @@ def start_timeline(file_path: str, mark_cycles: bool = False):
         _ctx.timeline = Timeline(file_path, mark_cycles=mark_cycles)
         if _ctx.core is not None:
             _ctx.core.attach_timeline(_ctx.timeline)
+            # The native loop writes its own spans (negotiation, fused op
+            # execution) beside the op-level Python timeline. Stop any
+            # previous core writer first so a restart switches files.
+            _ctx.core.stop_core_timeline()
+            _ctx.core.start_core_timeline(file_path + ".core.json")
 
 
 def stop_timeline():
@@ -297,3 +302,4 @@ def stop_timeline():
             _ctx.timeline = None
         if _ctx.core is not None:
             _ctx.core.attach_timeline(None)
+            _ctx.core.stop_core_timeline()

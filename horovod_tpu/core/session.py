@@ -97,7 +97,12 @@ class CoreSession:
         self.backend = NativeBackend(self)
         self._timeline = None
         self._autotune = None
-        if os.environ.get("HOROVOD_AUTOTUNE", "") not in ("", "0"):
+        # HOROVOD_AUTOTUNE=native runs the C++ Bayesian autotuner inside
+        # the background loop (reference parity: parameter_manager.cc is
+        # native); any other truthy value keeps the Python manager, which
+        # scores from the enqueue side.
+        self._autotune_mode = os.environ.get("HOROVOD_AUTOTUNE", "")
+        if self._autotune_mode not in ("", "0", "native"):
             from horovod_tpu.utils.autotune import ParameterManager
 
             self._autotune = ParameterManager(
@@ -129,6 +134,12 @@ class CoreSession:
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
         lib.hvd_core_set_params.argtypes = [
             ctypes.c_double, ctypes.c_longlong]
+        lib.hvd_core_autotune_start.restype = ctypes.c_int
+        lib.hvd_core_autotune_start.argtypes = [ctypes.c_char_p]
+        lib.hvd_core_autotune_state.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+        lib.hvd_core_timeline_start.restype = ctypes.c_int
+        lib.hvd_core_timeline_start.argtypes = [ctypes.c_char_p]
 
         addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
         port = int(os.environ.get("HOROVOD_CONTROLLER_PORT", "0"))
@@ -152,7 +163,31 @@ class CoreSession:
                 "ranks are running and the controller address %s:%d is "
                 "reachable." % (rc, addr, port))
         lib.hvd_core_set_callback(session._trampoline)
+        if session._autotune_mode == "native":
+            log = os.environ.get("HOROVOD_AUTOTUNE_LOG")
+            lib.hvd_core_autotune_start(
+                log.encode() if log else None)
         return session
+
+    # --- native perf subsystem --------------------------------------------
+
+    def start_core_timeline(self, path: str) -> bool:
+        """Chrome-trace spans of the native background loop (negotiation
+        + per-response execution); written next to the Python timeline."""
+        return self._lib.hvd_core_timeline_start(path.encode()) == 0
+
+    def stop_core_timeline(self):
+        self._lib.hvd_core_timeline_stop()
+
+    def autotune_state(self):
+        """(fusion_mb, cycle_ms, done, samples) of the native autotuner,
+        or None when it is not running."""
+        if self._autotune_mode != "native":
+            return None
+        buf = (ctypes.c_double * 4)()
+        self._lib.hvd_core_autotune_state(buf, 4)
+        return {"fusion_mb": buf[0], "cycle_ms": buf[1],
+                "done": bool(buf[2]), "samples": int(buf[3])}
 
     def shutdown(self):
         self._lib.hvd_core_shutdown()

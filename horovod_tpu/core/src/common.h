@@ -122,6 +122,20 @@ enum class OpType : int {
   ERROR_OP = 7,
 };
 
+inline const char* OpTypeName(OpType t) {
+  switch (t) {
+    case OpType::ALLREDUCE: return "ALLREDUCE";
+    case OpType::ALLGATHER: return "ALLGATHER";
+    case OpType::BROADCAST: return "BROADCAST";
+    case OpType::ALLTOALL: return "ALLTOALL";
+    case OpType::JOIN: return "JOIN";
+    case OpType::BARRIER: return "BARRIER";
+    case OpType::REDUCESCATTER: return "REDUCESCATTER";
+    case OpType::ERROR_OP: return "ERROR";
+  }
+  return "UNKNOWN";
+}
+
 // Reduction ops matching horovod_tpu.ops (Average/Sum/.../Product).
 enum class ReduceOp : int {
   AVERAGE = 0,

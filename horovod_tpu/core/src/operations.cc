@@ -9,10 +9,12 @@
 // loop decides.
 
 #include "controller.h"
+#include "perf.h"
 
 #include <algorithm>
 #include <chrono>
 #include <cstring>
+#include <memory>
 #include <thread>
 
 namespace hvd {
@@ -55,6 +57,16 @@ struct Global {
   std::atomic<long long> ctr_allreduce_bytes{0};
 
   DoneCb callback = nullptr;
+
+  // Native perf subsystem (reference: parameter_manager.cc, timeline.cc).
+  // autotune_mutex guards the pointer (installed from the Python thread
+  // after the loop is already running) and the manager's non-atomic
+  // sample state.
+  std::mutex autotune_mutex;
+  std::unique_ptr<ParameterManager> autotune;
+  std::mutex timeline_mutex;
+  std::unique_ptr<TimelineWriter> timeline;
+  Clock::time_point t_origin = Clock::now();
 
   std::mutex init_mutex;
   std::condition_variable init_cv;
@@ -442,8 +454,21 @@ void BackgroundLoop() {
       if (ps->member_index(g->comm.rank()) < 0) continue;
       std::vector<Response> responses;
       size_t n_cached = 0;
+      auto neg_start = Clock::now();
       Status s = g->controller->ComputeResponseList(*ps, &responses,
                                                     &n_cached);
+      {
+        std::lock_guard<std::mutex> tlk(g->timeline_mutex);
+        if (g->timeline && !responses.empty()) {
+          auto us = [&](Clock::time_point t) {
+            return (long long)std::chrono::duration_cast<
+                       std::chrono::microseconds>(t - g->t_origin)
+                .count();
+          };
+          g->timeline->Event("NEGOTIATE", "negotiate", us(neg_start),
+                             us(Clock::now()) - us(neg_start));
+        }
+      }
       if (!s.ok()) {
         HVD_LOG(LogLevel::ERROR,
                 "coordination failed: " + s.reason + "; failing pending ops");
@@ -458,6 +483,7 @@ void BackgroundLoop() {
           other->queue.AbortAll(s);
         break;
       }
+      long long cycle_bytes = 0;
       for (size_t i = 0; i < responses.size(); ++i) {
         bool from_cache = i < n_cached;
         g->ctr_responses++;
@@ -470,11 +496,45 @@ void BackgroundLoop() {
           for (auto c : responses[i].tensor_sizes)
             bytes += c * (long long)DataTypeSize(responses[i].dtype);
           g->ctr_allreduce_bytes += bytes;
+          cycle_bytes += bytes;
         }
+        auto op_start = Clock::now();
         Status es = PerformOperation(*ps, responses[i], from_cache);
+        {
+          std::lock_guard<std::mutex> tlk(g->timeline_mutex);
+          if (g->timeline) {
+            auto us = [&](Clock::time_point t) {
+              return (long long)std::chrono::duration_cast<
+                         std::chrono::microseconds>(t - g->t_origin)
+                  .count();
+            };
+            std::string nm = responses[i].tensor_names.empty()
+                                 ? std::string("op")
+                                 : responses[i].tensor_names[0];
+            if (responses[i].tensor_names.size() > 1)
+              nm += "(+" +
+                    std::to_string(responses[i].tensor_names.size() - 1) +
+                    " fused)";
+            g->timeline->Event(nm, OpTypeName(responses[i].op_type),
+                               us(op_start),
+                               us(Clock::now()) - us(op_start));
+          }
+        }
         if (!es.ok()) {
           HVD_LOG(LogLevel::ERROR, "collective failed: " + es.reason);
           g->failed.store(true);
+        }
+      }
+      // Autotune scores coordinator-observed payload bytes per wall
+      // second (reference: parameter_manager.cc Update).
+      if (cycle_bytes > 0 && ps->is_coordinator(g->comm.rank())) {
+        std::lock_guard<std::mutex> alk(g->autotune_mutex);
+        if (g->autotune) {
+          double now_s = std::chrono::duration_cast<
+                             std::chrono::duration<double>>(
+                             Clock::now() - g->t_origin)
+                             .count();
+          g->autotune->Record(cycle_bytes, now_s);
         }
       }
     }
@@ -535,8 +595,11 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
   return 0;
 }
 
+void hvd_core_timeline_stop();  // defined below; used during shutdown
+
 void hvd_core_shutdown() {
   if (!g) return;
+  hvd_core_timeline_stop();
   g->shut_down.store(true);
   // Unblock the background thread if it is parked in a socket op (e.g. a
   // peer died mid-negotiation) so the join below cannot deadlock.
@@ -639,6 +702,59 @@ void hvd_core_set_params(double cycle_ms, long long fusion_bytes) {
     // fusion layouts rank-identical; see controller.h).
     g->controller->stage_fusion_threshold(fusion_bytes);
   }
+}
+
+// Native Bayesian autotuner (reference: parameter_manager.cc:28-66).
+// Runs on the coordinator; fusion-threshold changes are staged through
+// the controller broadcast, cycle-time changes apply locally.
+int hvd_core_autotune_start(const char* log_path) {
+  if (!g) return -1;
+  std::lock_guard<std::mutex> alk(g->autotune_mutex);
+  if (g->autotune) return -1;
+  double fusion_mb = (double)g->fusion_bytes / (1024.0 * 1024.0);
+  g->autotune.reset(new ParameterManager(
+      fusion_mb, g->cycle_ms,
+      [](long long fusion_bytes, double cycle_ms) {
+        if (!g) return;
+        g->cycle_ms = cycle_ms;
+        g->fusion_bytes = fusion_bytes;
+        if (g->controller)
+          g->controller->stage_fusion_threshold(fusion_bytes);
+      },
+      log_path ? log_path : ""));
+  return 0;
+}
+
+// out[0]=fusion_mb out[1]=cycle_ms out[2]=done out[3]=samples
+void hvd_core_autotune_state(double* out, int n) {
+  if (!g || !out) return;
+  std::lock_guard<std::mutex> alk(g->autotune_mutex);
+  if (!g->autotune) return;
+  double vals[4] = {g->autotune->fusion_mb(), g->autotune->cycle_ms(),
+                    g->autotune->done() ? 1.0 : 0.0,
+                    (double)g->autotune->samples()};
+  for (int i = 0; i < n && i < 4; ++i) out[i] = vals[i];
+}
+
+// Native chrome-trace timeline of the background loop
+// (reference: timeline.cc TimelineWriter; dynamic start/stop analog of
+// horovod_start_timeline, operations.cc:1011-1041).
+int hvd_core_timeline_start(const char* path) {
+  if (!g || !path) return -1;
+  std::lock_guard<std::mutex> lk(g->timeline_mutex);
+  if (g->timeline) return -2;
+  g->timeline.reset(new TimelineWriter(path, g->rank));
+  return 0;
+}
+
+void hvd_core_timeline_stop() {
+  if (!g) return;
+  std::unique_ptr<TimelineWriter> dead;
+  {
+    std::lock_guard<std::mutex> lk(g->timeline_mutex);
+    dead = std::move(g->timeline);
+  }
+  if (dead) dead->Stop();
 }
 
 double hvd_core_cycle_ms() { return g ? g->cycle_ms : 0.0; }

@@ -1,0 +1,288 @@
+// Native autotuner + timeline (see perf.h for the reference map).
+
+#include "perf.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvd {
+
+// --- GaussianProcess ------------------------------------------------------
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-0.5 * d2 / (ls_ * ls_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& X,
+                          const std::vector<double>& y) {
+  X_ = X;
+  const size_t n = X.size();
+  // K + noise*I
+  std::vector<std::vector<double>> K(n, std::vector<double>(n));
+  for (size_t i = 0; i < n; ++i)
+    for (size_t j = 0; j < n; ++j)
+      K[i][j] = Kernel(X[i], X[j]) + (i == j ? noise_ : 0.0);
+  // Cholesky K = L L^T.
+  L_.assign(n, std::vector<double>(n, 0.0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double s = K[i][j];
+      for (size_t k = 0; k < j; ++k) s -= L_[i][k] * L_[j][k];
+      if (i == j)
+        L_[i][j] = std::sqrt(std::max(s, 1e-12));
+      else
+        L_[i][j] = s / L_[j][j];
+    }
+  }
+  // alpha = L^-T (L^-1 y)
+  std::vector<double> z(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = y[i];
+    for (size_t k = 0; k < i; ++k) s -= L_[i][k] * z[k];
+    z[i] = s / L_[i][i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double s = z[ii];
+    for (size_t k = ii + 1; k < n; ++k) s -= L_[k][ii] * alpha_[k];
+    alpha_[ii] = s / L_[ii][ii];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mu,
+                              double* sigma) const {
+  const size_t n = X_.size();
+  std::vector<double> k(n);
+  for (size_t i = 0; i < n; ++i) k[i] = Kernel(x, X_[i]);
+  double m = 0.0;
+  for (size_t i = 0; i < n; ++i) m += k[i] * alpha_[i];
+  // v = L^-1 k;  var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = k[i];
+    for (size_t kk = 0; kk < i; ++kk) s -= L_[i][kk] * v[kk];
+    v[i] = s / L_[i][i];
+  }
+  double var = 1.0 + noise_;
+  for (size_t i = 0; i < n; ++i) var -= v[i] * v[i];
+  *mu = m;
+  *sigma = std::sqrt(std::max(var, 1e-12));
+}
+
+// --- BayesianOptimizer ----------------------------------------------------
+
+static double NormCdf(double x) { return 0.5 * std::erfc(-x * M_SQRT1_2); }
+static double NormPdf(double x) {
+  return std::exp(-0.5 * x * x) / std::sqrt(2.0 * M_PI);
+}
+
+std::vector<double> BayesianOptimizer::Denorm(
+    const std::vector<double>& u) const {
+  std::vector<double> x(u.size());
+  for (size_t i = 0; i < u.size(); ++i)
+    x[i] = bounds_[i].first + u[i] * (bounds_[i].second - bounds_[i].first);
+  return x;
+}
+
+void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
+  std::vector<double> u(x.size());
+  for (size_t i = 0; i < x.size(); ++i)
+    u[i] = (x[i] - bounds_[i].first) /
+           (bounds_[i].second - bounds_[i].first);
+  X_.push_back(u);
+  y_.push_back(y);
+}
+
+std::vector<double> BayesianOptimizer::Suggest() {
+  std::uniform_real_distribution<double> U(0.0, 1.0);
+  const size_t d = bounds_.size();
+  if (X_.size() < 2) {
+    std::vector<double> u(d);
+    for (auto& v : u) v = U(rng_);
+    return Denorm(u);
+  }
+  // Normalize scores (z-score) like the python/reference search.
+  double mean = 0.0;
+  for (double v : y_) mean += v;
+  mean /= y_.size();
+  double var = 0.0;
+  for (double v : y_) var += (v - mean) * (v - mean);
+  double sd = std::sqrt(var / y_.size());
+  if (sd <= 0) sd = 1.0;
+  std::vector<double> yn(y_.size());
+  double best = -1e300;
+  for (size_t i = 0; i < y_.size(); ++i) {
+    yn[i] = (y_[i] - mean) / sd;
+    best = std::max(best, yn[i]);
+  }
+  GaussianProcess gp(0.3, 0.05);
+  gp.Fit(X_, yn);
+  const double xi = 0.01;
+  double best_ei = -1e300;
+  std::vector<double> best_u(d, 0.5);
+  for (int c = 0; c < 256; ++c) {
+    std::vector<double> u(d);
+    for (auto& v : u) v = U(rng_);
+    double mu, sigma;
+    gp.Predict(u, &mu, &sigma);
+    double imp = mu - best - xi;
+    double z = imp / sigma;
+    double ei = imp * NormCdf(z) + sigma * NormPdf(z);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_u = u;
+    }
+  }
+  return Denorm(best_u);
+}
+
+// --- ParameterManager -----------------------------------------------------
+
+ParameterManager::ParameterManager(double init_fusion_mb,
+                                   double init_cycle_ms, ApplyFn apply,
+                                   const std::string& log_path)
+    : bo_({{kFusionMbLo, kFusionMbHi}, {kCycleMsLo, kCycleMsHi}},
+          1234),
+      apply_(std::move(apply)),
+      current_{init_fusion_mb, init_cycle_ms},
+      best_{init_fusion_mb, init_cycle_ms} {
+  if (!log_path.empty()) {
+    log_ = std::fopen(log_path.c_str(), "w");
+    if (log_)
+      std::fprintf(log_, "sample,fusion_mb,cycle_ms,score_bytes_per_sec\n");
+  }
+}
+
+ParameterManager::~ParameterManager() {
+  if (log_) std::fclose(log_);
+}
+
+void ParameterManager::Record(long long bytes, double now_s) {
+  if (done_.load()) return;
+  if (t0_ < 0) t0_ = now_s;
+  bytes_ += bytes;
+  if (++steps_ < kStepsPerSample) return;
+  CloseSample(now_s);
+}
+
+void ParameterManager::CloseSample(double now_s) {
+  double dt = std::max(now_s - t0_, 1e-9);
+  double score = (double)bytes_ / dt;
+  if (warmup_left_ > 0) {
+    --warmup_left_;  // discard the sample, keep current params
+  } else {
+    bo_.AddSample(current_, score);
+    ++samples_;
+    if (log_)
+      std::fprintf(log_, "%d,%.3f,%.3f,%.1f\n", samples_, current_[0],
+                   current_[1], score);
+    if (score > best_score_) {
+      best_score_ = score;
+      best_ = current_;
+    }
+    if (samples_ >= kMaxSamples) {
+      current_ = best_;
+      done_.store(true);
+    } else {
+      current_ = bo_.Suggest();
+    }
+    apply_((long long)(current_[0] * 1024 * 1024), current_[1]);
+    if (log_) std::fflush(log_);
+  }
+  steps_ = 0;
+  bytes_ = 0;
+  t0_ = now_s;
+}
+
+// --- TimelineWriter -------------------------------------------------------
+
+TimelineWriter::TimelineWriter(const std::string& path, int rank)
+    : rank_(rank), f_(std::fopen(path.c_str(), "w")) {
+  if (f_) std::fprintf(f_, "[\n");
+  thread_ = std::thread(&TimelineWriter::Loop, this);
+}
+
+TimelineWriter::~TimelineWriter() { Stop(); }
+
+void TimelineWriter::Event(const std::string& name,
+                           const std::string& category, long long ts_us,
+                           long long dur_us) {
+  if (!f_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_) return;
+    q_.push_back({name, category, ts_us, dur_us});
+  }
+  cv_.notify_one();
+}
+
+void TimelineWriter::Stop() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_) return;
+    stop_ = true;
+  }
+  cv_.notify_one();
+  if (thread_.joinable()) thread_.join();
+  if (f_) {
+    std::fprintf(f_, "\n]\n");
+    std::fclose(f_);
+    f_ = nullptr;
+  }
+}
+
+// Escape a string for embedding in a JSON value (tensor names are
+// user-supplied; an unescaped quote would corrupt the whole trace).
+static std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  return out;
+}
+
+void TimelineWriter::Loop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  while (true) {
+    cv_.wait(lk, [&] { return stop_ || !q_.empty(); });
+    while (!q_.empty()) {
+      Rec r = std::move(q_.front());
+      q_.pop_front();
+      lk.unlock();
+      if (f_) {
+        std::fprintf(
+            f_,
+            "%s{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"X\", "
+            "\"ts\": %lld, \"dur\": %lld, \"pid\": %d, \"tid\": 0}",
+            first_ ? "" : ",\n", JsonEscape(r.name).c_str(),
+            JsonEscape(r.cat).c_str(), r.ts, r.dur, rank_);
+        first_ = false;
+      }
+      lk.lock();
+    }
+    if (stop_ && q_.empty()) return;
+  }
+}
+
+}  // namespace hvd

@@ -1,0 +1,143 @@
+// Native performance subsystem: Bayesian-autotuned parameter manager and
+// Chrome-trace timeline writer.
+//
+// Native equivalents of the reference's C++ perf components
+// (reference: horovod/common/parameter_manager.cc:28-66 warmup/steps/
+// joint fusion-MB x cycle-ms search scored by bytes/sec;
+// horovod/common/optim/{bayesian_optimization,gaussian_process}.cc GP
+// with expected improvement; horovod/common/timeline.cc:48-188 queued
+// writer thread emitting chrome://tracing JSON).
+
+#ifndef HVD_TPU_PERF_H
+#define HVD_TPU_PERF_H
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace hvd {
+
+// --- Gaussian process (RBF kernel, Cholesky solve) ------------------------
+class GaussianProcess {
+ public:
+  explicit GaussianProcess(double length_scale = 0.3, double noise = 0.05)
+      : ls_(length_scale), noise_(noise) {}
+
+  void Fit(const std::vector<std::vector<double>>& X,
+           const std::vector<double>& y);
+  void Predict(const std::vector<double>& x, double* mu,
+               double* sigma) const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+  double ls_, noise_;
+  std::vector<std::vector<double>> X_;
+  std::vector<std::vector<double>> L_;  // Cholesky factor of K + noise*I
+  std::vector<double> alpha_;           // (K + nI)^-1 y
+};
+
+// --- Bayesian optimizer (expected improvement) ----------------------------
+class BayesianOptimizer {
+ public:
+  BayesianOptimizer(std::vector<std::pair<double, double>> bounds,
+                    unsigned seed = 1234)
+      : bounds_(std::move(bounds)), rng_(seed) {}
+
+  void AddSample(const std::vector<double>& x, double y);
+  // Next candidate in original (denormalized) coordinates.
+  std::vector<double> Suggest();
+
+ private:
+  std::vector<double> Denorm(const std::vector<double>& u) const;
+  std::vector<std::pair<double, double>> bounds_;
+  std::mt19937 rng_;
+  std::vector<std::vector<double>> X_;  // normalized samples
+  std::vector<double> y_;
+};
+
+// --- Parameter manager ----------------------------------------------------
+// Drives (fusion_bytes, cycle_ms) from observed allreduce throughput.
+// Matches the reference's sampling discipline: WARMUP_SAMPLES discarded,
+// STEPS_PER_SAMPLE records per score, MAX_SAMPLES then freeze at best
+// (reference: parameter_manager.cc:28-66). Apply is a callback so the
+// owner decides coordination (fusion is staged through the controller
+// broadcast; cycle time applies locally).
+class ParameterManager {
+ public:
+  using ApplyFn = std::function<void(long long fusion_bytes,
+                                     double cycle_ms)>;
+
+  ParameterManager(double init_fusion_mb, double init_cycle_ms,
+                   ApplyFn apply, const std::string& log_path = "");
+  ~ParameterManager();
+
+  // Record one completed step's payload bytes. Thread: background loop.
+  void Record(long long bytes, double now_s);
+  bool done() const { return done_.load(); }
+  double fusion_mb() const { return current_[0]; }
+  double cycle_ms() const { return current_[1]; }
+  int samples() const { return samples_; }
+
+  static constexpr double kFusionMbLo = 1.0, kFusionMbHi = 64.0;
+  static constexpr double kCycleMsLo = 1.0, kCycleMsHi = 25.0;
+  static constexpr int kWarmupSamples = 3;
+  static constexpr int kStepsPerSample = 10;
+  static constexpr int kMaxSamples = 20;
+
+ private:
+  void CloseSample(double now_s);
+  BayesianOptimizer bo_;
+  ApplyFn apply_;
+  std::vector<double> current_;  // {fusion_mb, cycle_ms}
+  std::vector<double> best_;
+  double best_score_ = -1.0;
+  int steps_ = 0;
+  long long bytes_ = 0;
+  double t0_ = -1.0;
+  int samples_ = 0;
+  int warmup_left_ = kWarmupSamples;
+  std::atomic<bool> done_{false};
+  std::FILE* log_ = nullptr;
+};
+
+// --- Timeline writer ------------------------------------------------------
+// Complete-event ("ph":"X") chrome trace records drained by a writer
+// thread (reference: timeline.cc TimelineWriter + lock-free queue; a
+// mutex + condvar deque suffices at control-plane event rates).
+class TimelineWriter {
+ public:
+  TimelineWriter(const std::string& path, int rank);
+  ~TimelineWriter();
+
+  // ts/dur in microseconds since Start; thread-safe.
+  void Event(const std::string& name, const std::string& category,
+             long long ts_us, long long dur_us);
+  void Stop();
+
+ private:
+  struct Rec {
+    std::string name, cat;
+    long long ts, dur;
+  };
+  void Loop();
+  int rank_;
+  std::FILE* f_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Rec> q_;
+  bool stop_ = false;
+  bool first_ = true;
+  std::thread thread_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TPU_PERF_H

@@ -35,12 +35,18 @@ class RayExecutor:
 
     def __init__(self, num_workers: int, cpus_per_worker: int = 1,
                  use_gpu: bool = False, gpus_per_worker: int = 1,
+                 workers_per_host: Optional[int] = None,
                  env_vars=None):
         self.num_workers = num_workers
         self.cpus_per_worker = cpus_per_worker
         self.gpus_per_worker = gpus_per_worker if use_gpu else 0
+        # With workers_per_host, actors are pinned through a placement
+        # group: one STRICT bundle per host (reference: ray/strategy.py
+        # ColocatedStrategy).
+        self.workers_per_host = workers_per_host
         self.env_vars = dict(env_vars or {})
         self._workers = []
+        self._placement_group = None
 
     def start(self):
         ray = _require_ray()
@@ -48,7 +54,26 @@ class RayExecutor:
 
         Worker = make_worker_cls(ray, num_cpus=self.cpus_per_worker,
                                  num_gpus=self.gpus_per_worker)
-        actors = [Worker.remote(self.env_vars)
+        options = {}
+        if self.workers_per_host:
+            from ray.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy,
+            )
+
+            from horovod_tpu.ray.strategy import (
+                bundles_for, create_placement_group,
+            )
+
+            bundles, strategy = bundles_for(
+                self.num_workers, self.workers_per_host,
+                self.cpus_per_worker, self.gpus_per_worker)
+            self._placement_group = create_placement_group(bundles,
+                                                           strategy)
+            options["scheduling_strategy"] = \
+                PlacementGroupSchedulingStrategy(
+                    placement_group=self._placement_group)
+        actors = [Worker.options(**options).remote(self.env_vars)
+                  if options else Worker.remote(self.env_vars)
                   for _ in range(self.num_workers)]
         hostnames = ray.get([w.hostname.remote() for w in actors])
 
@@ -84,3 +109,6 @@ class RayExecutor:
         for w in self._workers:
             ray.kill(w)
         self._workers = []
+        if self._placement_group is not None:
+            ray.util.remove_placement_group(self._placement_group)
+            self._placement_group = None

@@ -7,16 +7,14 @@ drives the elastic driver with that discovery and spawns actor workers
 on rendezvous updates).
 
 The executor runs a spawn/execute/reset loop against the discovery
-object (host tracking via horovod_tpu.runner.discovery.HostManager):
-actor loss tears the world down, re-discovers hosts, and retries at the
-new size up to ``reset_limit`` resets.
+object: actor loss tears the world down, re-discovers hosts (ray drops
+dead nodes from the next world), and retries at the new size up to
+``reset_limit`` resets.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
-
-from horovod_tpu.runner.discovery import HostManager
 
 
 class RayHostDiscovery:
@@ -95,7 +93,6 @@ class ElasticRayExecutor:
         self.env_vars = dict(env_vars or {})
         self.discovery = discovery
         self.reset_limit = reset_limit
-        self._host_manager: Optional[HostManager] = None
 
     def start(self):
         import ray
@@ -105,7 +102,6 @@ class ElasticRayExecutor:
         if self.discovery is None:
             self.discovery = RayHostDiscovery(
                 use_gpu=self.use_gpu, cpus_per_slot=self.cpus_per_slot)
-        self._host_manager = HostManager(self.discovery)
 
     def _spawn_world(self, ray, num_proc: int):
         """Spawn num_proc actors, compute the packed topology, wire the
@@ -143,7 +139,7 @@ class ElasticRayExecutor:
         retries — up to ``reset_limit`` resets (default 3). ``fn`` is
         responsible for resuming from committed elastic State on rank 0
         broadcast (hvd.elastic semantics)."""
-        if self._host_manager is None:
+        if self.discovery is None:
             self.start()
         import ray
 
@@ -151,6 +147,9 @@ class ElasticRayExecutor:
         resets = 0
         limit = self.reset_limit if self.reset_limit is not None else 3
         while True:
+            # World sizing comes straight from discovery each attempt;
+            # ray marks dead nodes Alive=False so lost hosts drop out of
+            # the next world automatically.
             hosts = self.discovery.find_available_hosts_and_slots()
             num_proc = sum(hosts.values())
             if self.max_np is not None:
@@ -163,11 +162,16 @@ class ElasticRayExecutor:
             try:
                 return ray.get([w.execute.remote(fn, args, kwargs)
                                 for w in workers])
-            except ray.exceptions.RayError:
+            except ray.exceptions.RayError as e:
+                if isinstance(e, getattr(ray.exceptions, "RayTaskError",
+                                         ())):
+                    # The user's fn raised (application bug) — failing
+                    # deterministically; resetting the world would just
+                    # replay it.
+                    raise
                 resets += 1
                 if resets > limit:
                     raise
-                self._host_manager.refresh()
             finally:
                 for w in workers:
                     try:

@@ -1,0 +1,56 @@
+"""np=2 worker: native C++ autotuner + native core timeline."""
+
+import json
+import os
+import sys
+import tempfile
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common import basics  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    tl_path = os.path.join(tempfile.gettempdir(),
+                           "native_tl_rank%d.json" % r)
+    basics.start_timeline(tl_path)
+
+    # Enough steady steps for warmup + several autotune samples
+    # (3 warmup + N samples at 10 steps each, scored on the coordinator).
+    for it in range(120):
+        out = hvd.allreduce(np.full(256, 2.0, np.float32),
+                            name="tune_me", op=hvd.Average)
+        np.testing.assert_allclose(out, 2.0)
+
+    state = basics.core_session().autotune_state()
+    assert state is not None, "native autotune not running"
+    if r == 0:
+        assert state["samples"] >= 2, state
+        assert 1.0 <= state["fusion_mb"] <= 64.0, state
+        assert 1.0 <= state["cycle_ms"] <= 25.0, state
+        log = os.environ.get("HOROVOD_AUTOTUNE_LOG")
+        if log:
+            lines = open(log).read().strip().splitlines()
+            assert lines[0].startswith("sample,"), lines[:2]
+            assert len(lines) >= 3, lines
+
+    basics.stop_timeline()
+    core_tl = tl_path + ".core.json"
+    events = json.load(open(core_tl))
+    assert any(e["name"] == "NEGOTIATE" for e in events), events[:3]
+    assert any(e["cat"] == "ALLREDUCE" for e in events), events[:3]
+
+    hvd.shutdown()
+    print("NATIVE_PERF_OK rank=%d samples=%d" % (r, state["samples"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,24 @@ def test_parameter_manager_state_machine(tmp_path):
     assert len(log) >= at.MAX_SAMPLES  # header + samples
 
 
+def test_native_perf_multiproc(tmp_path):
+    """Native C++ autotuner (HOROVOD_AUTOTUNE=native) + core timeline."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_AUTOTUNE": "native",
+        "HOROVOD_AUTOTUNE_LOG": str(tmp_path / "autotune.csv"),
+        "HOROVOD_CYCLE_TIME": "1.0",
+    })
+    procs = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable,
+         os.path.join(_REPO, "tests", "native_perf_worker.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert procs.returncode == 0, procs.stdout + procs.stderr
+    assert procs.stdout.count("NATIVE_PERF_OK") == 2
+
+
 def test_perf_multiproc(tmp_path):
     env = dict(os.environ)
     env.update({

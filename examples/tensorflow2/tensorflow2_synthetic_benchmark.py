@@ -1,0 +1,70 @@
+"""Synthetic benchmark for the TF binding: images/sec with
+DistributedGradientTape (reference workload:
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py).
+
+Run: bin/hvdrun -np 2 python examples/tensorflow2/tensorflow2_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=3)
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = tf.keras.applications.ResNet50(weights=None)
+    opt = tf.keras.optimizers.SGD(learning_rate=0.01 * hvd.size())
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy()
+
+    data = tf.random.uniform([args.batch_size, 224, 224, 3])
+    target = tf.random.uniform([args.batch_size], minval=0, maxval=999,
+                               dtype=tf.int64)
+
+    first = [True]
+
+    def benchmark_step():
+        with hvd.DistributedGradientTape() as tape:
+            probs = model(data, training=True)
+            loss = loss_fn(target, probs)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first[0]:
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            first[0] = False
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.time()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.time() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter / dt
+        img_secs.append(img_sec)
+        if hvd.rank() == 0:
+            print("Iter img/sec per rank: %.1f" % img_sec)
+
+    mean = np.mean(img_secs)
+    if hvd.rank() == 0:
+        print("Img/sec per rank: %.1f +- %.1f" % (mean,
+                                                  1.96 * np.std(img_secs)))
+        print("Total img/sec on %d rank(s): %.1f"
+              % (hvd.size(), hvd.size() * mean))
+
+
+if __name__ == "__main__":
+    main()

@@ -156,6 +156,15 @@ def shutdown():
             return
         if _ctx.core is not None:
             try:
+                # Barrier first so no rank tears the TCP mesh down while a
+                # peer is still mid-cycle (avoids spurious "broken pipe"
+                # coordination errors on clean exits).
+                from horovod_tpu.ops import eager
+
+                try:
+                    eager.barrier()
+                except Exception:
+                    pass  # peers may already be gone; close anyway
                 _ctx.core.shutdown()
             finally:
                 _ctx.core = None

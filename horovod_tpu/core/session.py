@@ -282,9 +282,19 @@ class CoreSession:
         if rc != 0:
             with self._lock:
                 self._pending.pop(tag, None)
-            group.complete(index, None,
-                           RuntimeError("enqueue failed rc=%d (%s)" %
-                                        (rc, name)))
+            if rc == -5:
+                # Core stopped (peer exit or coordination failure): this
+                # is the restartable condition elastic wrappers catch.
+                from horovod_tpu.common.exceptions import (
+                    HorovodInternalError,
+                )
+
+                group.complete(index, None, HorovodInternalError(
+                    "coordination core is shut down (%s)" % name))
+            else:
+                group.complete(index, None,
+                               RuntimeError("enqueue failed rc=%d (%s)" %
+                                            (rc, name)))
 
     def submit_join(self, ps_id=0) -> Future:
         group = _Group(1)

@@ -470,6 +470,20 @@ void BackgroundLoop() {
         }
       }
       if (!s.ok()) {
+        // A connection error while every queue is idle is the normal
+        // signature of a peer exiting cleanly (each cycle does a network
+        // round even with no work): stop coordinating quietly instead of
+        // declaring failure with nothing to fail.
+        bool idle = true;
+        for (auto* other : sets)
+          if (other->queue.pending_count() > 0) idle = false;
+        if (idle) {
+          HVD_LOG(LogLevel::DEBUG,
+                  "peer closed during idle cycle; stopping coordination");
+          g->shut_down.store(true);
+          g->comm.Abort();
+          break;
+        }
         HVD_LOG(LogLevel::ERROR,
                 "coordination failed: " + s.reason + "; failing pending ops");
         g->failed.store(true);
@@ -622,6 +636,9 @@ int hvd_core_enqueue(long long tag, int op_type, const char* name, int dtype,
                      int ps_id, int reduce_op, const long long* splits,
                      int nsplits, long long group_id) {
   if (!g) return -1;
+  // After the loop stopped (peer exit / failure) nothing will ever pop
+  // the queue again — fail fast instead of letting the caller hang.
+  if (g->shut_down.load() || g->failed.load()) return -5;
   ProcessSetState* ps;
   {
     std::lock_guard<std::mutex> lk(g->ps_mutex);

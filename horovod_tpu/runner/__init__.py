@@ -12,3 +12,65 @@ from horovod_tpu.runner.hosts import (  # noqa: F401
     parse_hosts,
 )
 from horovod_tpu.runner.launch import parse_args, run_commandline  # noqa: F401
+
+
+def run(fn, args=(), kwargs=None, np=1, hosts=None, env=None,
+        use_mpi=False, verbose=False):
+    """Programmatic launch API: run ``fn(*args, **kwargs)`` as ``np``
+    horovod_tpu ranks and return the per-rank results
+    (reference: horovod/runner/__init__.py:92-210 ``horovod.run``).
+
+    Results cross the process boundary via cloudpickle files, so ``fn``
+    may be any picklable callable/closure.
+    """
+    import os
+    import pickle
+    import subprocess
+    import sys
+    import tempfile
+
+    import cloudpickle
+
+    kwargs = kwargs or {}
+    with tempfile.TemporaryDirectory() as tmp:
+        payload = os.path.join(tmp, "fn.pkl")
+        with open(payload, "wb") as f:
+            cloudpickle.dump((fn, args, kwargs), f)
+        out_dir = os.path.join(tmp, "out")
+        os.makedirs(out_dir)
+        worker_src = (
+            "import os, pickle\n"
+            "fn, args, kwargs = pickle.load(open(%r, 'rb'))\n"
+            "res = fn(*args, **kwargs)\n"
+            "rank = os.environ.get('HOROVOD_RANK', '0')\n"
+            "pickle.dump(res, open(os.path.join(%r, rank), 'wb'))\n"
+            "try:\n"
+            "    import horovod_tpu\n"
+            "    horovod_tpu.shutdown()  # orderly core teardown\n"
+            "except Exception:\n"
+            "    pass\n"
+            % (payload, out_dir))
+        script = os.path.join(tmp, "run_fn.py")
+        with open(script, "w") as f:
+            f.write(worker_src)
+        argv = ["-np", str(np)]
+        if hosts:
+            argv += ["-H", hosts]
+        if use_mpi:
+            argv += ["--use-mpi"]
+        if verbose:
+            argv += ["--verbose"]
+        argv += [sys.executable, script]
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner"] + argv,
+            env=full_env)
+        if proc.returncode != 0:
+            raise RuntimeError("hvdrun failed with exit code %d"
+                               % proc.returncode)
+        results = []
+        for rank in range(np):
+            with open(os.path.join(out_dir, str(rank)), "rb") as f:
+                results.append(pickle.load(f))
+        return results

@@ -18,7 +18,23 @@ Top-level usage mirrors Horovod::
     g = hvd.allreduce_ingraph(g, op=hvd.Average, axis="data")
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+import os as _os
+
+if _os.environ.get("HOROVOD_WORKER_PLATFORM") == "cpu":
+    # Launcher-spawned worker pinned to the CPU backend (see
+    # runner/launch.py worker_platform_env). The env vars set there
+    # handle a freshly-started interpreter; this config update is the
+    # second line of defense for hosts whose site hook registered a TPU
+    # plugin anyway. It is effective as long as jax backends have not
+    # initialized yet (i.e. before the first jax.devices()).
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 from horovod_tpu.common import (  # noqa: F401
     HorovodInternalError,

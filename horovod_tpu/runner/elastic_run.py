@@ -39,6 +39,7 @@ class ElasticDriver:
         if not args.discovery_script:
             raise ValueError(
                 "elastic mode requires --host-discovery-script")
+        self.args = args
         self.min_np = args.min_np or args.np or 1
         self.max_np = args.max_np
         self.command = args.command
@@ -128,7 +129,8 @@ class ElasticDriver:
             env = slot_env(
                 a, controller_addr, controller_port,
                 launcher_host if a.hostname != "localhost" else "127.0.0.1",
-                self.rendezvous.port, self.extra_env)
+                self.rendezvous.port, self.extra_env,
+                platform=getattr(self.args, "platform", "cpu"))
             env["HOROVOD_SLOT_KEY"] = key
             env["HOROVOD_RENDEZVOUS_VERSION"] = str(self.version)
             env["HOROVOD_ELASTIC"] = "1"

@@ -8,14 +8,91 @@ command construction and per-slot output forwarding).
 
 from __future__ import annotations
 
+import atexit
+import ctypes
 import os
 import shlex
+import signal
 import subprocess
 import sys
 import threading
+import weakref
 from typing import Dict, List, Optional
 
 LOCAL_HOSTS = {"localhost", "127.0.0.1", "0.0.0.0"}
+
+# Every live SlotProcess registers here so that *any* driver exit path —
+# normal return, exception, SIGTERM/SIGINT from a timeout wrapper —
+# tears down the worker process groups. Round-1 postmortem: a timed-out
+# launcher leaked its slots, which kept the (single) TPU chip claimed
+# and wedged the backend for every later process.
+_live_slots: "weakref.WeakSet[SlotProcess]" = weakref.WeakSet()
+_atexit_registered = False
+_signals_installed = False
+
+
+def _kill_all_slots():
+    for sp in list(_live_slots):
+        try:
+            sp.terminate(grace_sec=2.0)
+        except Exception:
+            pass
+
+
+def _install_cleanup_handlers():
+    """atexit + SIGTERM/SIGINT handlers that kill every slot group.
+
+    Only installed from the launcher main thread; signal handlers chain
+    to any previously-installed handler. A signal the launcher was
+    deliberately ignoring (SIG_IGN, e.g. a backgrounded job's SIGINT)
+    stays non-fatal: slots are cleaned up but the launcher lives on.
+    """
+    global _atexit_registered, _signals_installed
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(_kill_all_slots)
+    # Signal handlers can only be set from the main thread; if the first
+    # SlotProcess was created off-main (elastic spawn threads), keep
+    # trying on later calls rather than latching "installed".
+    if (_signals_installed
+            or threading.current_thread() is not threading.main_thread()):
+        return
+    _signals_installed = True
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev = signal.getsignal(sig)
+
+        def handler(signum, frame, _prev=prev):
+            _kill_all_slots()
+            if callable(_prev):
+                _prev(signum, frame)
+            elif _prev is not signal.SIG_IGN:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        try:
+            signal.signal(sig, handler)
+        except ValueError:
+            pass
+
+
+# Resolved once at import: calling dlopen (ctypes.CDLL) between fork and
+# exec in a multithreaded parent can deadlock the child on the loader
+# lock — the launcher always has forwarder threads running by slot 2.
+try:
+    _libc_prctl = ctypes.CDLL(None, use_errno=True).prctl
+except Exception:  # non-Linux / no libc symbol
+    _libc_prctl = None
+_PR_SET_PDEATHSIG = 1
+
+
+def _child_preexec():
+    """In the forked child (after the C-level setsid from
+    start_new_session): Linux parent-death signal so the direct child
+    gets SIGTERM even if the launcher is SIGKILLed. Only the
+    pre-resolved prctl symbol is called here — nothing that can touch
+    the allocator or loader."""
+    if _libc_prctl is not None:
+        _libc_prctl(_PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
 
 
 def is_local(hostname: str) -> bool:
@@ -52,7 +129,10 @@ class SlotProcess:
             proc_env = dict(os.environ)
         self.proc = subprocess.Popen(
             full_cmd, env=proc_env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True, start_new_session=True)
+            stderr=subprocess.STDOUT, text=True, start_new_session=True,
+            preexec_fn=_child_preexec)
+        _live_slots.add(self)
+        _install_cleanup_handlers()
         self._forwarder = threading.Thread(
             target=self._forward, args=(prefix_output, output_file),
             daemon=True)

@@ -81,6 +81,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--network-interfaces", dest="nics", default=None,
                    help="Comma-separated NIC allowlist for the data/"
                         "control plane.")
+    p.add_argument("--platform", choices=["cpu", "tpu"], default="cpu",
+                   help="JAX backend for the spawned workers. Default "
+                        "'cpu': launcher-spawned workers cannot share "
+                        "one local TPU chip, so the launcher pins them "
+                        "to the CPU backend. Pass 'tpu' for real "
+                        "multi-host TPU jobs where each worker owns "
+                        "its host's chips.")
     p.add_argument("--config-file", dest="config_file", default=None,
                    help="YAML file whose keys mirror the long CLI flags "
                         "(reference: launch.py --config-file).")
@@ -146,11 +153,38 @@ def _tuning_env(args) -> Dict[str, str]:
     return env
 
 
+def worker_platform_env(platform: str = "cpu") -> Dict[str, str]:
+    """Env entries pinning a spawned worker's JAX backend.
+
+    Default forces the CPU backend. Rationale (round-1 postmortem): N
+    launcher-spawned workers on one host cannot share the single local
+    TPU chip; a worker that tries to claim an already-claimed chip
+    hangs, and the leaked claim wedges the TPU backend machine-wide.
+    ``JAX_PLATFORMS=cpu`` alone is not sufficient on hosts whose site
+    hook pre-registers a TPU PJRT plugin and overrides the config, so
+    we also clear the hook's trigger (``PALLAS_AXON_POOL_IPS``) — with
+    no plugin registered, ``JAX_PLATFORMS=cpu`` selects the portable
+    CPU backend cleanly. ``HOROVOD_WORKER_PLATFORM`` is read back by
+    ``horovod_tpu`` at import time as a second line of defense.
+
+    ``platform='tpu'`` leaves the inherited environment alone for real
+    multi-host TPU jobs (one worker per host, each owning its chips).
+    """
+    if platform == "tpu":
+        return {"HOROVOD_WORKER_PLATFORM": "tpu"}
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "HOROVOD_WORKER_PLATFORM": "cpu",
+    }
+
+
 def slot_env(a, controller_addr: str, controller_port: int,
              rendezvous_addr: str, rendezvous_port: int,
-             extra: Dict[str, str]) -> Dict[str, str]:
+             extra: Dict[str, str], platform: str = "cpu") -> Dict[str, str]:
     """Per-slot environment (reference: gloo_run.py:65-76)."""
-    env = {
+    env = worker_platform_env(platform)
+    env.update({
         "HOROVOD_RANK": str(a.rank),
         "HOROVOD_SIZE": str(a.size),
         "HOROVOD_LOCAL_RANK": str(a.local_rank),
@@ -163,7 +197,7 @@ def slot_env(a, controller_addr: str, controller_port: int,
         "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
         "HOROVOD_HOSTNAME": a.hostname,
         "PYTHONUNBUFFERED": "1",
-    }
+    })
     pythonpath = os.pathsep.join(
         [os.getcwd()] + ([os.environ["PYTHONPATH"]]
                          if "PYTHONPATH" in os.environ else []))
@@ -204,7 +238,8 @@ def _run_static(args) -> int:
     try:
         for a in assignments:
             env = slot_env(a, controller_addr, controller_port,
-                           launcher_host, rendezvous_port, extra)
+                           launcher_host, rendezvous_port, extra,
+                           platform=args.platform)
             procs.append(SlotProcess(
                 a.rank, args.command, env, hostname=a.hostname,
                 ssh_port=args.ssh_port, output_file=output_file))
@@ -272,6 +307,7 @@ def _run_mpi(args) -> int:
     rank0_host = assignments[0].hostname
     all_local = all(is_local(h.hostname) for h in hosts)
     env = _tuning_env(args)
+    env.update(worker_platform_env(args.platform))
     env.update({
         "HOROVOD_CONTROLLER_ADDR": ("127.0.0.1" if is_local(rank0_host)
                                     else rank0_host),
@@ -311,6 +347,7 @@ def _run_jsrun(args) -> int:
     assignments = get_host_assignments(hosts, np_, np_)
     rendezvous.publish(assignments)
     env = _tuning_env(args)
+    env.update(worker_platform_env(args.platform))
     env.update({
         "HOROVOD_CONTROLLER_ADDR": assignments[0].hostname,
         "HOROVOD_CONTROLLER_PORT": str(free_port()),

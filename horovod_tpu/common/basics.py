@@ -68,7 +68,16 @@ def _first_int_env(names, default: int) -> int:
     for n in names:
         v = os.environ.get(n)
         if v not in (None, ""):
-            return int(v)
+            # Slurm counts can carry a repeat suffix ("4(x2)"): take the
+            # leading integer.
+            digits = ""
+            for ch in v:
+                if ch.isdigit():
+                    digits += ch
+                else:
+                    break
+            if digits:
+                return int(digits)
     return default
 
 
@@ -77,19 +86,35 @@ def _topology_from_env() -> Topology:
     bare ``mpirun`` (hvdrun --use-mpi) the standard MPI launcher vars
     (OpenMPI/PMI/Slurm) supply rank/size instead (the reference gets these
     from MPI_Comm_rank after MPI_Init; we read the launcher's env)."""
-    size = _first_int_env(
-        ["HOROVOD_SIZE", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
-         "SLURM_NTASKS"], 1)
-    rank = _first_int_env(
-        ["HOROVOD_RANK", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
-         "SLURM_PROCID"], 0)
-    local_rank = _first_int_env(
-        ["HOROVOD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_RANK",
-         "MPI_LOCALRANKID", "SLURM_LOCALID"], 0)
-    local_size = _first_int_env(
-        ["HOROVOD_LOCAL_SIZE", "OMPI_COMM_WORLD_LOCAL_SIZE",
-         "MPI_LOCALNRANKS", "SLURM_NTASKS_PER_NODE"],
-        1 if size == 1 else size)
+    # Launcher fallbacks are accepted only as rank+size *pairs* from the
+    # same launcher: a plain `python train.py` inside an sbatch/salloc
+    # allocation has SLURM_NTASKS but no per-task step vars, and must
+    # stay a size-1 run rather than hang waiting for phantom peers —
+    # and conversely a rank var must never be honored without its size
+    # counterpart (rank 3 of size 1 silently trains standalone).
+    size_vars, rank_vars = ["HOROVOD_SIZE"], ["HOROVOD_RANK"]
+    lsize_vars, lrank_vars = ["HOROVOD_LOCAL_SIZE"], ["HOROVOD_LOCAL_RANK"]
+    if ("OMPI_COMM_WORLD_RANK" in os.environ
+            and "OMPI_COMM_WORLD_SIZE" in os.environ):
+        size_vars.append("OMPI_COMM_WORLD_SIZE")
+        rank_vars.append("OMPI_COMM_WORLD_RANK")
+        lsize_vars.append("OMPI_COMM_WORLD_LOCAL_SIZE")
+        lrank_vars.append("OMPI_COMM_WORLD_LOCAL_RANK")
+    if "PMI_RANK" in os.environ and "PMI_SIZE" in os.environ:
+        size_vars.append("PMI_SIZE")
+        rank_vars.append("PMI_RANK")
+        lsize_vars.append("MPI_LOCALNRANKS")
+        lrank_vars.append("MPI_LOCALRANKID")
+    if ("SLURM_PROCID" in os.environ
+            and "SLURM_STEP_NUM_TASKS" in os.environ):
+        size_vars.append("SLURM_STEP_NUM_TASKS")
+        rank_vars.append("SLURM_PROCID")
+        lsize_vars.append("SLURM_STEP_TASKS_PER_NODE")
+        lrank_vars.append("SLURM_LOCALID")
+    size = _first_int_env(size_vars, 1)
+    rank = _first_int_env(rank_vars, 0)
+    local_rank = _first_int_env(lrank_vars, 0)
+    local_size = _first_int_env(lsize_vars, 1 if size == 1 else size)
     # Derive the cross (inter-node) coordinates when the launcher didn't
     # provide them: with homogeneous nodes rank = cross_rank*local_size +
     # local_rank.

@@ -115,6 +115,8 @@ def run_elastic(fn, args=(), kwargs=None, num_proc=None,
             raise ValueError("num_proc=%d > max_np=%d" % (num_proc, max_np))
 
     def resilient(*a, **kw):
+        from horovod_tpu.common import basics
+
         state = a[0] if a else None
         for attempt in range(retries + 1):
             try:
@@ -126,6 +128,15 @@ def run_elastic(fn, args=(), kwargs=None, num_proc=None,
                     raise
                 if state is not None and hasattr(state, "restore"):
                     state.restore()
+                # HorovodInternalError means the native core shut itself
+                # down (abort cascade); every rank sees it. Re-initialize
+                # cooperatively before the next sync() or the retry fails
+                # deterministically (mirrors elastic/worker.py
+                # reinit_for_version's shutdown→init sequence; the
+                # barrier world is fixed so the env/topology is reused
+                # as-is).
+                basics.shutdown()
+                basics.init()
 
     return run(resilient, args=args, kwargs=kwargs, num_proc=num_proc,
                extra_env=extra_env, verbose=verbose)

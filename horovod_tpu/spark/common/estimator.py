@@ -126,10 +126,16 @@ class HorovodModel:
     HorovodModel): predicts locally; with pyspark, ``transform`` adds an
     output column per label."""
 
-    def __init__(self, history, run_id: str, store: Store):
+    def __init__(self, history, run_id: str, store: Store,
+                 feature_cols: Optional[List[str]] = None):
         self.history = history
         self.run_id = run_id
         self.store = store
+        # The columns the model was trained on — transform must feed
+        # exactly these (in order), never every DataFrame column (which
+        # would include the label and give the feature matrix the wrong
+        # width).
+        self.feature_cols = list(feature_cols) if feature_cols else None
 
     def predict(self, features):
         raise NotImplementedError()
@@ -149,6 +155,6 @@ class HorovodModel:
                 np.asarray(model.predict(x)).reshape(len(cols[0]), -1)[:, 0])
 
         out_col = "prediction"
-        feature_cols = [c for c in df.columns]
+        feature_cols = self.feature_cols or [c for c in df.columns]
         return df.withColumn(out_col, _predict(*[df[c]
                                                  for c in feature_cols]))

@@ -28,8 +28,12 @@ class KerasEstimator(HorovodEstimator):
         model_json = self.model.to_json()
         weights = self.model.get_weights()
         optimizer = self.optimizer or "sgd"
+        # Ship the FULL optimizer config (class + every hyperparameter),
+        # not just the class name — Adam(learning_rate=0.1) must train
+        # remotely as configured, not as default-lr 'adam' (reference
+        # ships the compiled optimizer state the same way).
         opt_config = (optimizer if isinstance(optimizer, str)
-                      else type(optimizer).__name__.lower())
+                      else tf.keras.optimizers.serialize(optimizer))
         loss = self.loss or "mse"
         metrics = list(self.metrics)
         feature_cols = list(self.feature_cols or [])
@@ -59,7 +63,9 @@ class KerasEstimator(HorovodEstimator):
             model = tf.keras.models.model_from_json(
                 model_json, custom_objects=custom_objects)
             model.set_weights(weights)
-            opt = tf.keras.optimizers.get(opt_config)
+            opt = (tf.keras.optimizers.deserialize(opt_config)
+                   if isinstance(opt_config, dict)
+                   else tf.keras.optimizers.get(opt_config))
             model.compile(optimizer=hvd.DistributedOptimizer(opt)
                           if size > 1 else opt,
                           loss=loss, metrics=metrics)
@@ -94,14 +100,15 @@ class KerasEstimator(HorovodEstimator):
         model = tf.keras.models.model_from_json(
             self.model.to_json(), custom_objects=self.custom_objects)
         model.set_weights(rank0["weights"])
-        return KerasModel(model, rank0["history"], run_id, store)
+        return KerasModel(model, rank0["history"], run_id, store,
+                          feature_cols=self.feature_cols)
 
 
 class KerasModel(HorovodModel):
     """(reference: spark/keras/estimator.py KerasModel)"""
 
-    def __init__(self, model, history, run_id, store):
-        super().__init__(history, run_id, store)
+    def __init__(self, model, history, run_id, store, feature_cols=None):
+        super().__init__(history, run_id, store, feature_cols=feature_cols)
         self.model = model
 
     def predict(self, features):

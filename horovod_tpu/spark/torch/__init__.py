@@ -99,7 +99,8 @@ class TorchEstimator(HorovodEstimator):
                            weights_only=False)
         model.load_state_dict(torch.load(io.BytesIO(rank0["state"]),
                                          weights_only=False))
-        return TorchModel(model, rank0["loss"], run_id, store)
+        return TorchModel(model, rank0["loss"], run_id, store,
+                          feature_cols=self.feature_cols)
 
     def _model_bytes(self) -> bytes:
         import torch
@@ -112,8 +113,8 @@ class TorchEstimator(HorovodEstimator):
 class TorchModel(HorovodModel):
     """(reference: spark/torch/estimator.py TorchModel)"""
 
-    def __init__(self, model, history, run_id, store):
-        super().__init__(history, run_id, store)
+    def __init__(self, model, history, run_id, store, feature_cols=None):
+        super().__init__(history, run_id, store, feature_cols=feature_cols)
         self.model = model
 
     def predict(self, features):

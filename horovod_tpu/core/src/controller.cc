@@ -131,7 +131,14 @@ StallInspector::StallInspector() {
   warn_sec_ = 60.0;
   if (const char* env = getenv("HOROVOD_STALL_CHECK_TIME_SECONDS"))
     warn_sec_ = atof(env);
-  last_check_ = std::chrono::steady_clock::now();
+  shutdown_sec_ = 0.0;
+  if (const char* env = getenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"))
+    shutdown_sec_ = atof(env);
+  // Enforcement below the warning threshold makes no sense (the
+  // reference raises shutdown to the check interval the same way).
+  if (shutdown_sec_ > 0 && shutdown_sec_ < warn_sec_)
+    shutdown_sec_ = warn_sec_;
+  last_warn_ = std::chrono::steady_clock::now();
 }
 
 void StallInspector::Record(const std::string& name, int rank) {
@@ -147,29 +154,44 @@ void StallInspector::Remove(const std::string& name) {
   reported_.erase(name);
 }
 
-void StallInspector::Check(const std::set<int>& members) {
-  if (warn_sec_ <= 0) return;
+std::string StallInspector::Describe(const std::string& name, double age,
+                                     const std::set<int>& members) const {
+  std::string missing, have;
+  const auto& ranks = reported_.at(name).second;
+  for (int m : members) {
+    if (ranks.count(m))
+      have += std::to_string(m) + " ";
+    else
+      missing += std::to_string(m) + " ";
+  }
+  return "Stalled tensor " + name + " (" + std::to_string((int)age) +
+         "s): ready on ranks [" + have + "], missing on ranks [" + missing +
+         "]. One or more ranks may have exited or diverged.";
+}
+
+Status StallInspector::Check(const std::set<int>& members) {
+  if (warn_sec_ <= 0 && shutdown_sec_ <= 0) return Status::OK();
   auto now = std::chrono::steady_clock::now();
-  if (std::chrono::duration<double>(now - last_check_).count() < warn_sec_)
-    return;
-  last_check_ = now;
+  bool warn_due =
+      warn_sec_ > 0 &&
+      std::chrono::duration<double>(now - last_warn_).count() >= warn_sec_;
+  if (warn_due) last_warn_ = now;
   for (auto& kv : reported_) {
     double age =
         std::chrono::duration<double>(now - kv.second.first).count();
-    if (age < warn_sec_) continue;
-    std::string missing, have;
-    for (int m : members) {
-      if (kv.second.second.count(m))
-        have += std::to_string(m) + " ";
-      else
-        missing += std::to_string(m) + " ";
+    if (shutdown_sec_ > 0 && age >= shutdown_sec_) {
+      // Enforcement (reference: stall_inspector shutdown path): the
+      // caller turns this into a job-wide abort so the healthy ranks
+      // error out instead of waiting forever on a diverged peer.
+      return Status::Error(Describe(kv.first, age, members) +
+                           " Stall shutdown threshold (" +
+                           std::to_string((int)shutdown_sec_) +
+                           "s) exceeded; aborting the job.");
     }
-    HVD_LOG(LogLevel::WARN,
-            "Stalled tensor " + kv.first + " (" +
-                std::to_string((int)age) + "s): ready on ranks [" + have +
-                "], missing on ranks [" + missing +
-                "]. One or more ranks may have exited or diverged.");
+    if (warn_due && age >= warn_sec_)
+      HVD_LOG(LogLevel::WARN, Describe(kv.first, age, members));
   }
+  return Status::OK();
 }
 
 // -------------------------------------------------------------- Controller ---
@@ -328,6 +350,30 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
   responses->swap(fused);
 }
 
+// Rebuild this rank's negotiation Request for a tensor still sitting in
+// the tensor queue (used when a cached fast-path tensor must re-enter
+// the slow path: LRU eviction or stalled-cache invalidation). The cached
+// Response-cache signature is NOT usable for this — it carries the
+// Put()-time defaults (request_rank 0, flattened shape), which would
+// corrupt the coordinator's readiness counting.
+static bool RebuildRequest(ProcessSetState& ps, const std::string& name,
+                           int my_rank, Request* out) {
+  TensorTableEntry entry;
+  if (!ps.queue.Lookup(name, &entry)) return false;
+  out->request_rank = my_rank;
+  out->op_type = entry.op_type;
+  out->reduce_op = entry.reduce_op;
+  out->dtype = entry.dtype;
+  out->tensor_name = entry.name;
+  out->shape = entry.shape;
+  out->root_rank = entry.root_rank;
+  out->prescale = entry.prescale;
+  out->postscale = entry.postscale;
+  out->splits = entry.splits;
+  out->group_id = entry.group_id;
+  return true;
+}
+
 Status Controller::ComputeResponseList(ProcessSetState& ps,
                                        std::vector<Response>* out,
                                        size_t* n_cached) {
@@ -338,8 +384,29 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
   const bool coord = ps.is_coordinator(me);
   const size_t cap = ps.cache.capacity();
 
+  // Stall check runs EVERY cycle on the coordinator (reference:
+  // controller.cc:133-143) — a stalled tensor lives in message_table
+  // with no new traffic, so gating the check on the slow path would
+  // never enforce. On stall shutdown the coordinator aborts before
+  // this cycle's reductions; workers blocked in them get the
+  // connection-abort cascade, so every rank errors within the window
+  // instead of hanging.
+  if (coord) {
+    std::set<int> mem_set(ps.members.begin(), ps.members.end());
+    Status st = ps.stall.Check(mem_set);
+    if (!st.ok()) return Status{StatusType::PRECONDITION_ERROR, st.reason};
+  }
+
   // 1. Pop newly-submitted requests; classify against the cache.
-  std::vector<Request> popped = ps.queue.PopMessages();
+  //    Requests requeued by stalled-cache invalidation re-enter here
+  //    (their cache entries are gone, so they classify as MISS and take
+  //    the slow path where the stall inspector can see them).
+  std::vector<Request> popped = ps.requeue;
+  ps.requeue.clear();
+  {
+    std::vector<Request> fresh = ps.queue.PopMessages();
+    popped.insert(popped.end(), fresh.begin(), fresh.end());
+  }
   std::vector<Request> uncached;
   for (auto& req : popped) {
     if (req.op_type == OpType::JOIN) {
@@ -357,35 +424,73 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
   }
 
   // 2. Sync cache bits + status flags across members.
-  //    Layout: [0] = has-uncached flag (OR), [1] = join flag (OR),
-  //    [2 .. 2+cap) = cache-hit bits (AND).
-  std::vector<uint8_t> bits(2 + cap, 0);
-  bits[0] = uncached.empty() ? 0 : 1;
-  bits[1] = ps.joined_locally ? 1 : 0;
-  for (auto& name : ps.pending_hits)
-    bits[2 + ps.cache.PositionOf(name)] = 1;
-  // Two logical reductions in one message round: flags use OR, hit bits
-  // use AND. Do them as separate reductions for protocol clarity.
-  std::vector<uint8_t> flags(bits.begin(), bits.begin() + 2);
+  //    Flags: [0] = has-uncached (OR), [1] = join (OR),
+  //    [2] = has-stalled-pending-hit (OR); then the cache-hit bit
+  //    vector (AND), and — only when flag[2] agreed — a stalled-bit
+  //    vector (OR) for coordinated invalidation.
+  auto now = std::chrono::steady_clock::now();
+  double inval_sec = ps.stall.warn_seconds() > 0
+                         ? ps.stall.warn_seconds()
+                         : ps.stall.shutdown_seconds();
+  std::vector<size_t> my_stalled;
+  {
+    // A pending hit can lose its cache entry to LRU eviction while it
+    // waits for global agreement; its position is gone, so it can never
+    // complete via the fast path. Rebuild its request from the tensor
+    // queue entry and push it through the slow path instead (touching
+    // PositionOf for an evicted name would throw out of the background
+    // thread).
+    std::vector<std::string> keep;
+    for (auto& name : ps.pending_hits) {
+      if (!ps.cache.Has(name)) {
+        Request rr;
+        if (RebuildRequest(ps, name, me, &rr))
+          ps.requeue.push_back(std::move(rr));
+        ps.pending_hit_since.erase(name);
+        continue;
+      }
+      keep.push_back(name);
+    }
+    ps.pending_hits.swap(keep);
+  }
+  for (auto& name : ps.pending_hits) {
+    auto it = ps.pending_hit_since.find(name);
+    if (it == ps.pending_hit_since.end()) {
+      ps.pending_hit_since.emplace(name, now);
+    } else if (inval_sec > 0 &&
+               std::chrono::duration<double>(now - it->second).count() >=
+                   inval_sec) {
+      my_stalled.push_back(ps.cache.PositionOf(name));
+    }
+  }
+  std::vector<uint8_t> flags(3, 0);
+  flags[0] = uncached.empty() ? 0 : 1;
+  flags[1] = ps.joined_locally ? 1 : 0;
+  flags[2] = my_stalled.empty() ? 0 : 1;
   Status s = comm_.BitAllreduce(&flags, /*is_and=*/false, root, ps.members);
   if (!s.ok()) return s;
-  std::vector<uint8_t> hit_bits(bits.begin() + 2, bits.end());
+  std::vector<uint8_t> hit_bits(cap, 0);
+  for (auto& name : ps.pending_hits)
+    hit_bits[ps.cache.PositionOf(name)] = 1;
   if (cap > 0) {
     s = comm_.BitAllreduce(&hit_bits, /*is_and=*/true, root, ps.members);
     if (!s.ok()) return s;
   }
   bool any_uncached = flags[0] != 0;
   bool any_join = flags[1] != 0;
+  bool any_stalled = flags[2] != 0;
 
   // 3. Fast path: globally-agreed cache hits execute without coordination.
   std::vector<std::string> still_pending;
   std::vector<size_t> agreed;
   for (auto& name : ps.pending_hits) {
     size_t pos = ps.cache.PositionOf(name);
-    if (hit_bits[pos])
+    if (hit_bits[pos]) {
       agreed.push_back(pos);
-    else
+      ps.pending_hit_since.erase(name);
+    } else {
       still_pending.push_back(name);
+    }
   }
   ps.pending_hits.swap(still_pending);
   std::sort(agreed.begin(), agreed.end());
@@ -396,6 +501,37 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
   FuseResponses(&cached_responses);
   if (n_cached) *n_cached = cached_responses.size();
   for (auto& r : cached_responses) out->push_back(std::move(r));
+
+  // 3b. Coordinated invalidation of stalled cached tensors (reference:
+  //     stall_inspector InvalidateStalledCachedTensors): every member
+  //     erases the agreed positions in the same cycle and ascending
+  //     order so cache bit-index spaces stay identical across ranks;
+  //     members holding the request requeue it through the slow path,
+  //     where the coordinator's stall inspector tracks (and eventually
+  //     enforces) it.
+  if (any_stalled && cap > 0) {
+    std::vector<uint8_t> stalled_bits(cap, 0);
+    for (size_t pos : my_stalled) stalled_bits[pos] = 1;
+    s = comm_.BitAllreduce(&stalled_bits, /*is_and=*/false, root,
+                           ps.members);
+    if (!s.ok()) return s;
+    for (size_t pos = 0; pos < cap; ++pos) {
+      if (!stalled_bits[pos] || !ps.cache.HasPosition(pos)) continue;
+      const std::string name = ps.cache.RequestByPosition(pos).tensor_name;
+      ps.cache.EraseByName(name);
+      auto pit =
+          std::find(ps.pending_hits.begin(), ps.pending_hits.end(), name);
+      if (pit != ps.pending_hits.end()) {
+        ps.pending_hits.erase(pit);
+        // Rebuild from the tensor queue — the cache's stored signature
+        // is not this rank's real request (see RebuildRequest).
+        Request rr;
+        if (RebuildRequest(ps, name, me, &rr))
+          ps.requeue.push_back(std::move(rr));
+      }
+      ps.pending_hit_since.erase(name);
+    }
+  }
 
   // 4. Slow path: negotiate uncached tensors through the coordinator.
   if (any_uncached || any_join) {
@@ -491,8 +627,6 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
       int64_t staged = pending_fusion_.exchange(0);
       if (staged > 0) fusion_threshold_ = staged;
       FuseResponses(&negotiated);
-      std::set<int> mem_set(ps.members.begin(), ps.members.end());
-      ps.stall.Check(mem_set);
       std::string resp_blob;
       int64_t ft = fusion_threshold_;
       resp_blob.append(reinterpret_cast<const char*>(&ft), sizeof(ft));

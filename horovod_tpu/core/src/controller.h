@@ -60,6 +60,13 @@ class ResponseCache {
   void Put(const Request& req, const Response& resp);
   const Response& GetByPosition(size_t pos) const;
   size_t PositionOf(const std::string& name) const;
+  bool Has(const std::string& name) const {
+    return position_.count(name) != 0;
+  }
+  bool HasPosition(size_t pos) const { return entries_.count(pos) != 0; }
+  const Request& RequestByPosition(size_t pos) const {
+    return entries_.at(pos).request;
+  }
   void EraseByName(const std::string& name);
   size_t size() const { return entries_.size(); }
 
@@ -78,19 +85,32 @@ class ResponseCache {
 
 // --------------------------------------------------------- stall inspector ---
 
+// Coordinator-side stall detection + enforcement (reference:
+// horovod/common/stall_inspector.h:41-80 — warn after
+// HOROVOD_STALL_CHECK_TIME_SECONDS, *shut down the job* after
+// HOROVOD_STALL_SHUTDOWN_TIME_SECONDS so a diverged rank cannot hang
+// the remaining ranks forever).
 class StallInspector {
  public:
   StallInspector();
   // Record that `name` was first reported by `rank` (coordinator side).
   void Record(const std::string& name, int rank);
   void Remove(const std::string& name);
-  // Log a warning for tensors pending longer than the warn threshold;
-  // lists which members have/haven't reported.
-  void Check(const std::set<int>& members);
+  // Scan every call. Warnings (which members have/haven't reported)
+  // are rate-limited to the warn period; returns a non-OK status when
+  // any tensor has been stalled past the shutdown threshold, which the
+  // background loop escalates into an abort cascade.
+  Status Check(const std::set<int>& members);
+  double warn_seconds() const { return warn_sec_; }
+  double shutdown_seconds() const { return shutdown_sec_; }
 
  private:
+  std::string Describe(const std::string& name, double age,
+                       const std::set<int>& members) const;
+
   double warn_sec_ = 60.0;
-  std::chrono::steady_clock::time_point last_check_;
+  double shutdown_sec_ = 0.0;  // 0 = warn-only (reference default)
+  std::chrono::steady_clock::time_point last_warn_;
   std::unordered_map<std::string,
                      std::pair<std::chrono::steady_clock::time_point,
                                std::set<int>>>
@@ -108,6 +128,15 @@ struct ProcessSetState {
 
   // Names whose cache bits are set locally but not yet globally agreed.
   std::vector<std::string> pending_hits;
+  // First time each pending hit was seen un-agreed; a hit pending past
+  // the stall-warn window means some rank never submitted — its cache
+  // entry is invalidated via a coordinated bit sync and the request is
+  // requeued through the slow path so the stall inspector sees it
+  // (reference: stall_inspector.cc InvalidateStalledCachedTensors).
+  std::unordered_map<std::string, std::chrono::steady_clock::time_point>
+      pending_hit_since;
+  // Requests re-entering negotiation next cycle after invalidation.
+  std::vector<Request> requeue;
 
   // Coordinator-only negotiation state.
   std::unordered_map<std::string, std::set<int>> message_table;

@@ -473,8 +473,11 @@ void BackgroundLoop() {
         // A connection error while every queue is idle is the normal
         // signature of a peer exiting cleanly (each cycle does a network
         // round even with no work): stop coordinating quietly instead of
-        // declaring failure with nothing to fail.
-        bool idle = true;
+        // declaring failure with nothing to fail. PRECONDITION_ERROR is
+        // exempt — it carries a deliberate enforcement decision (stall
+        // shutdown) that must cascade loudly even from an idle
+        // coordinator.
+        bool idle = s.type != StatusType::PRECONDITION_ERROR;
         for (auto* other : sets)
           if (other->queue.pending_count() > 0) idle = false;
         if (idle) {
@@ -679,6 +682,15 @@ int hvd_core_enqueue(long long tag, int op_type, const char* name, int dtype,
     FireCallback(tag, s);
     return -4;
   }
+  // Close the TOCTOU with the loop's exit drain: if shutdown/failure
+  // landed after the fail-fast check above, the background thread may
+  // already have run its final AbortAll and will never pop this op.
+  // Draining here makes the op's callback fire (entries abort exactly
+  // once — the queue pops under its own lock), so the caller's future
+  // resolves with the same HorovodInternalError it would have gotten
+  // from the fail-fast path instead of hanging forever.
+  if (g->shut_down.load() || g->failed.load())
+    ps->queue.AbortAll(Status::Aborted("horovod_tpu core is shut down"));
   return 0;
 }
 

@@ -25,6 +25,13 @@ LOG_DIR = os.environ["ELASTIC_LOG_DIR"]
 FAIL_RANK = os.environ.get("ELASTIC_FAIL_RANK")
 FAIL_STEP = int(os.environ.get("ELASTIC_FAIL_STEP", "-1"))
 FAIL_MARKER = os.path.join(LOG_DIR, "fail_marker")
+# Step-anchored discovery trigger (the reference anchors its discovery
+# schedules on observed progress, not wall clock — elastic_common.py's
+# schedule technique): when rank 0 commits TRIGGER_STEP, it touches
+# TRIGGER_FILE; the test's discovery script flips its host list on the
+# file's existence, so growth cannot race worker startup time.
+TRIGGER_FILE = os.environ.get("ELASTIC_TRIGGER_FILE")
+TRIGGER_STEP = int(os.environ.get("ELASTIC_TRIGGER_STEP", "-1"))
 
 
 def log(step):
@@ -59,6 +66,10 @@ def main():
             state.weights = state.weights + np.asarray(out)
             state.step += 1
             log(state.step)
+            if (TRIGGER_FILE and hvd.rank() == 0
+                    and state.step >= TRIGGER_STEP
+                    and not os.path.exists(TRIGGER_FILE)):
+                open(TRIGGER_FILE, "w").close()
             time.sleep(0.15)
             state.commit()
 

@@ -1,0 +1,114 @@
+"""Worker for stall-enforcement tests (test_stall.py).
+
+Exercises the two no-hang guarantees (reference:
+horovod/common/stall_inspector.h:41-80 shutdown enforcement; the
+execution-phase guarantee comes from the socket abort cascade):
+
+MODE=negotiation — every rank except STALL_RANK submits an allreduce;
+STALL_RANK never does. The healthy ranks must receive an error within
+the stall-shutdown window instead of hanging.
+
+MODE=execution — ranks run a few successful allreduces, then FAIL_RANK
+enqueues one more and hard-exits mid-flight. The survivors must error
+out promptly via the connection-abort cascade.
+
+Exit code 0 = this rank observed the expected outcome in time.
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402
+
+MODE = os.environ["STALL_MODE"]
+WINDOW = float(os.environ.get("STALL_EXPECT_WINDOW", "45"))
+
+
+def expect_error(fn):
+    t0 = time.time()
+    try:
+        fn()
+    except (HorovodInternalError, RuntimeError) as e:
+        dt = time.time() - t0
+        assert dt < WINDOW, "error arrived after %.1fs (> %.1fs window): %s" \
+            % (dt, WINDOW, e)
+        print("OK got error in %.1fs: %s" % (dt, e))
+        return 0
+    print("FAIL collective unexpectedly succeeded")
+    return 1
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    if MODE == "negotiation":
+        stall_rank = n - 1
+        if r == stall_rank:
+            # Diverged rank: alive, connected, but never submits. The
+            # stall shutdown must kill the job out from under it; its
+            # own next submit then fails fast on the shut-down core.
+            time.sleep(float(os.environ.get("STALL_SLEEP", "10")))
+            rc = expect_error(lambda: hvd.allreduce(
+                np.ones(4, np.float32), name="stall.late"))
+            return rc
+        return expect_error(lambda: hvd.allreduce(
+            np.ones(4, np.float32), name="stall.t"))
+
+    if MODE == "execution":
+        fail_rank = n - 1
+        for i in range(3):
+            out = hvd.allreduce(np.full(4, float(r), np.float32),
+                                name="warm.%d" % i, op=hvd.Sum)
+            np.testing.assert_allclose(out, sum(range(n)))
+        if r == fail_rank:
+            # Die with a large collective in flight (async handle never
+            # synchronized) — peers are mid-transfer when the socket
+            # drops.
+            hvd.allreduce_async(np.ones(8 << 20, np.float32),
+                                name="doomed.0")
+            os._exit(19)
+
+        def survivors():
+            # Depending on how far the ring got before the peer died,
+            # the in-flight collective may complete; the guarantee under
+            # test is that a post-death collective errors within the
+            # window rather than hanging.
+            for i in range(4):
+                hvd.allreduce(np.ones(8 << 20, np.float32),
+                              name="doomed.%d" % i)
+
+        return expect_error(survivors)
+
+    if MODE == "cached":
+        # Round 1: everyone submits -> negotiated, then cached.
+        out = hvd.allreduce(np.full(8, float(r), np.float32),
+                            name="cached.t", op=hvd.Sum)
+        np.testing.assert_allclose(out, sum(range(n)))
+        stall_rank = n - 1
+        if r == stall_rank:
+            time.sleep(float(os.environ.get("STALL_SLEEP", "10")))
+            rc = expect_error(lambda: hvd.allreduce(
+                np.ones(4, np.float32), name="stall.late"))
+            return rc
+        # Round 2: healthy ranks resubmit (cache HIT), the stalled rank
+        # never does — the hit can never agree. The coordinated
+        # invalidation must erase the cache entry, requeue through the
+        # slow path, and the stall shutdown must fail us within the
+        # window (reference: InvalidateStalledCachedTensors).
+        return expect_error(lambda: hvd.allreduce(
+            np.full(8, float(r), np.float32), name="cached.t", op=hvd.Sum))
+
+    raise ValueError("unknown STALL_MODE %r" % MODE)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,12 +62,30 @@ def _run_elastic(tmp_path, discovery, min_np, max_np, extra_env=None,
     return proc, _read_logs(log_dir)
 
 
+def _write_triggered_discovery(tmp_path, before, after, trigger_file):
+    """Discovery output flips from ``before`` to ``after`` host lists
+    when ``trigger_file`` appears — step-anchored, not wall-clock
+    (reference technique: elastic_common.py discovery schedules keyed to
+    observed progress)."""
+    script = tmp_path / "discover.sh"
+    script.write_text(
+        "#!/bin/sh\n"
+        'if [ -f "%s" ]; then echo "%s"; else echo "%s"; fi\n'
+        % (trigger_file, after, before))
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
 def test_elastic_world_growth(tmp_path):
-    """Hosts grow from 2 to 3 slots mid-run; workers re-rendezvous and
-    training continues with size 3."""
-    discovery = _write_discovery(
-        tmp_path, [(0, "localhost:2"), (6, "localhost:3")])
-    proc, records = _run_elastic(tmp_path, discovery, min_np=2, max_np=4)
+    """Hosts grow from 2 to 3 slots once rank 0 reports step 5 at size
+    2; workers re-rendezvous and training continues with size 3."""
+    trigger = str(tmp_path / "grow_trigger")
+    discovery = _write_triggered_discovery(
+        tmp_path, "localhost:2", "localhost:3", trigger)
+    proc, records = _run_elastic(
+        tmp_path, discovery, min_np=2, max_np=4,
+        extra_env={"ELASTIC_TRIGGER_FILE": trigger,
+                   "ELASTIC_TRIGGER_STEP": "5"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     sizes = {r["size"] for r in records}
     assert 2 in sizes, "never ran at size 2: %r" % sizes

@@ -40,8 +40,10 @@ def _launch(np_, script, extra_env=None, timeout=180):
             "HOROVOD_CONTROLLER_PORT": str(port),
             "HOROVOD_CYCLE_TIME": "1.0",
             "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
-            # Workers must not claim the real TPU.
+            # Workers must not claim the real TPU; clearing the plugin
+            # trigger also skips TPU plugin registration entirely.
             "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
         })
         if extra_env:
             env.update(extra_env)

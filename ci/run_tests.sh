@@ -1,0 +1,34 @@
+#!/bin/sh
+# CI entry point: both test tiers with per-tier wall budgets.
+#
+# Analog of the reference's CI stages (reference: Dockerfile.test.cpu:86
+# runs the parallel suite under mpirun; docker-compose.test.yml +
+# .buildkite fan the heavyweight matrix out to separate stages): tier 1
+# is the default `pytest tests/` run, tier 2 holds the heavyweight
+# integration jobs whose code paths tier 1 already covers.
+#
+# Usage: ci/run_tests.sh [tier1|tier2|all]
+set -e
+cd "$(dirname "$0")/.."
+
+TIER="${1:-all}"
+
+run_tier1() {
+    echo "=== tier 1 (default suite) ==="
+    timeout "${HVD_CI_TIER1_BUDGET:-720}" \
+        python -m pytest tests/ -q -p no:cacheprovider
+}
+
+run_tier2() {
+    echo "=== tier 2 (heavyweight integration) ==="
+    timeout "${HVD_CI_TIER2_BUDGET:-720}" \
+        python -m pytest tests/ -q -p no:cacheprovider \
+        --override-ini 'addopts=' -m tier2
+}
+
+case "$TIER" in
+    tier1) run_tier1 ;;
+    tier2) run_tier2 ;;
+    all) run_tier1; run_tier2 ;;
+    *) echo "usage: $0 [tier1|tier2|all]" >&2; exit 2 ;;
+esac

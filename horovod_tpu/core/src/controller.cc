@@ -196,6 +196,21 @@ Status StallInspector::Check(const std::set<int>& members) {
 
 // -------------------------------------------------------------- Controller ---
 
+static bool RebuildRequest(ProcessSetState& ps, const std::string& name,
+                           int my_rank, Request* out);
+
+Controller::Controller(TcpComm& comm, int64_t fusion_bytes)
+    : comm_(comm), fusion_threshold_(fusion_bytes) {
+  if (const char* env = getenv("HOROVOD_DISABLE_GROUP_FUSION"))
+    disable_group_fusion_ = *env && *env != '0';
+  // Env-pinned starting values; the autotuner chain may override later
+  // (staged + broadcast like any other change).
+  if (const char* env = getenv("HOROVOD_CACHE_CAPACITY"))
+    cache_enabled_ = atoll(env) != 0;
+  if (const char* env = getenv("HOROVOD_HIERARCHICAL_ALLREDUCE"))
+    hierarchical_ = *env && *env != '0';
+}
+
 bool Controller::IncrementTensorCount(ProcessSetState& ps,
                                       const Request& req) {
   auto& ranks = ps.message_table[req.tensor_name];
@@ -318,10 +333,20 @@ Response Controller::ConstructResponse(ProcessSetState& ps,
   return resp;
 }
 
-void Controller::FuseResponses(std::vector<Response>* responses) {
+void Controller::FuseResponses(
+    std::vector<Response>* responses,
+    const std::unordered_map<std::string, int64_t>* groups) {
   // Greedy bin-packing of adjacent-compatible allreduces under the fusion
   // threshold (reference: horovod/common/controller.cc:793-930, including
-  // the lookahead: later responses may join an open bin).
+  // the lookahead: later responses may join an open bin). With
+  // HOROVOD_DISABLE_GROUP_FUSION, tensors from an explicit group only
+  // fuse with members of the same group.
+  auto gid_of = [&](const Response& r) -> int64_t {
+    if (!disable_group_fusion_ || !groups || r.tensor_names.empty())
+      return -1;
+    auto it = groups->find(r.tensor_names[0]);
+    return it == groups->end() ? -1 : it->second;
+  };
   std::vector<Response> fused;
   std::vector<bool> used(responses->size(), false);
   for (size_t i = 0; i < responses->size(); ++i) {
@@ -329,6 +354,7 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
     Response r = (*responses)[i];
     used[i] = true;
     if (r.op_type == OpType::ALLREDUCE) {
+      int64_t my_gid = gid_of(r);
       int64_t bytes = r.tensor_sizes[0] * (int64_t)DataTypeSize(r.dtype);
       for (size_t j = i + 1; j < responses->size(); ++j) {
         if (used[j]) continue;
@@ -337,6 +363,7 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
             c.reduce_op != r.reduce_op || c.prescale != r.prescale ||
             c.postscale != r.postscale)
           continue;
+        if (disable_group_fusion_ && gid_of(c) != my_gid) continue;
         int64_t cb = c.tensor_sizes[0] * (int64_t)DataTypeSize(c.dtype);
         if (bytes + cb > fusion_threshold_) continue;
         r.tensor_names.push_back(c.tensor_names[0]);
@@ -348,6 +375,24 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
     fused.push_back(std::move(r));
   }
   responses->swap(fused);
+}
+
+void Controller::ApplyCategoricals(ProcessSetState& ps, bool cache_enabled,
+                                   bool hierarchical, int my_rank) {
+  hierarchical_ = hierarchical;
+  if (cache_enabled == cache_enabled_) return;
+  cache_enabled_ = cache_enabled;
+  if (!cache_enabled_) {
+    // Pending fast-path hits can never agree once the cache is off:
+    // flush them through the slow path (rebuilt from the tensor queue).
+    for (auto& name : ps.pending_hits) {
+      Request rr;
+      if (RebuildRequest(ps, name, my_rank, &rr))
+        ps.requeue.push_back(std::move(rr));
+    }
+    ps.pending_hits.clear();
+    ps.pending_hit_since.clear();
+  }
 }
 
 // Rebuild this rank's negotiation Request for a tensor still sitting in
@@ -413,7 +458,9 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
       ps.joined_locally = true;
       continue;
     }
-    auto state = ps.cache.Cached(req);
+    auto state = (cache_enabled_ && cap > 0)
+                     ? ps.cache.Cached(req)
+                     : ResponseCache::State::MISS;
     if (state == ResponseCache::State::HIT) {
       ps.pending_hits.push_back(req.tensor_name);
     } else {
@@ -464,7 +511,13 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
     }
   }
   std::vector<uint8_t> flags(3, 0);
-  flags[0] = uncached.empty() ? 0 : 1;
+  // Staged parameter changes (fusion threshold / categorical knobs)
+  // only ship in the slow-path response broadcast; with pure fast-path
+  // traffic no such round would ever run, so the coordinator forces
+  // one when something is staged.
+  bool force_sync =
+      coord && (pending_fusion_.load() > 0 || pending_cats_.load() >= 0);
+  flags[0] = (uncached.empty() && !force_sync) ? 0 : 1;
   flags[1] = ps.joined_locally ? 1 : 0;
   flags[2] = my_stalled.empty() ? 0 : 1;
   Status s = comm_.BitAllreduce(&flags, /*is_and=*/false, root, ps.members);
@@ -548,6 +601,7 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
     }
 
     std::vector<Response> negotiated;
+    std::unordered_map<std::string, int64_t> emitted_groups;
     if (coord) {
       std::vector<std::string> blobs;
       s = comm_.Gatherv(my_blob, &blobs, root, ps.members);
@@ -580,6 +634,7 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
                 for (auto& m : members) {
                   ps.ready_order.push_back(m);
                   ps.ready_names.erase(m);
+                  emitted_groups[m] = gid;
                   ps.group_of.erase(m);
                 }
                 ps.group_members.erase(gid);

@@ -167,8 +167,7 @@ struct ProcessSetState {
 
 class Controller {
  public:
-  Controller(TcpComm& comm, int64_t fusion_bytes)
-      : comm_(comm), fusion_threshold_(fusion_bytes) {}
+  Controller(TcpComm& comm, int64_t fusion_bytes);
 
   // One negotiation round for one process set. Returns the ordered list
   // of responses every member must execute this cycle; the first
@@ -185,15 +184,38 @@ class Controller {
   void stage_fusion_threshold(int64_t b) { pending_fusion_.store(b); }
   int64_t fusion_threshold() const { return fusion_threshold_; }
 
+  // Categorical knobs (autotuner chain / env): staged exactly like the
+  // fusion threshold — the coordinator adopts at its next slow-path
+  // round and ships the values in the response broadcast, so every rank
+  // flips in the same cycle. Disabling the cache flushes pending hits
+  // back through the slow path (they could otherwise never agree).
+  void stage_categoricals(bool cache_enabled, bool hierarchical) {
+    pending_cats_.store(4 | (cache_enabled ? 1 : 0) |
+                        (hierarchical ? 2 : 0));
+  }
+  bool cache_enabled() const { return cache_enabled_; }
+  bool hierarchical() const { return hierarchical_; }
+
  private:
   // Coordinator: all members reported (joined ranks count implicitly)?
   bool IncrementTensorCount(ProcessSetState& ps, const Request& req);
   Response ConstructResponse(ProcessSetState& ps, const std::string& name);
-  void FuseResponses(std::vector<Response>* responses);
+  void FuseResponses(std::vector<Response>* responses,
+                     const std::unordered_map<std::string, int64_t>*
+                         groups = nullptr);
+  void ApplyCategoricals(ProcessSetState& ps, bool cache_enabled,
+                         bool hierarchical, int my_rank);
 
   TcpComm& comm_;
   int64_t fusion_threshold_;
   std::atomic<int64_t> pending_fusion_{0};
+  // bit2 = staged marker, bit0 = cache_enabled, bit1 = hierarchical.
+  std::atomic<int> pending_cats_{-1};
+  bool cache_enabled_ = true;
+  bool hierarchical_ = false;
+  // HOROVOD_DISABLE_GROUP_FUSION: explicit groups stay their own fusion
+  // unit (reference: common.h knob; group_table semantics).
+  bool disable_group_fusion_ = false;
 };
 
 }  // namespace hvd

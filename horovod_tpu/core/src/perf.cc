@@ -4,6 +4,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <cstdlib>
 
 namespace hvd {
 
@@ -121,7 +122,7 @@ std::vector<double> BayesianOptimizer::Suggest() {
     yn[i] = (y_[i] - mean) / sd;
     best = std::max(best, yn[i]);
   }
-  GaussianProcess gp(0.3, 0.05);
+  GaussianProcess gp(0.3, gp_noise_);
   gp.Fit(X_, yn);
   const double xi = 0.01;
   double best_ei = -1e300;
@@ -144,18 +145,34 @@ std::vector<double> BayesianOptimizer::Suggest() {
 
 // --- ParameterManager -----------------------------------------------------
 
+static int IntEnv(const char* name, int dflt) {
+  const char* v = getenv(name);
+  return (v && *v) ? atoi(v) : dflt;
+}
+
+static double DoubleEnv(const char* name, double dflt) {
+  const char* v = getenv(name);
+  return (v && *v) ? atof(v) : dflt;
+}
+
 ParameterManager::ParameterManager(double init_fusion_mb,
                                    double init_cycle_ms, ApplyFn apply,
                                    const std::string& log_path)
-    : bo_({{kFusionMbLo, kFusionMbHi}, {kCycleMsLo, kCycleMsHi}},
-          1234),
+    : warmup_samples_(IntEnv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3)),
+      steps_per_sample_(IntEnv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10)),
+      max_samples_(IntEnv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20)),
+      bo_({{kFusionMbLo, kFusionMbHi}, {kCycleMsLo, kCycleMsHi}}, 1234,
+          DoubleEnv("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.05)),
       apply_(std::move(apply)),
       current_{init_fusion_mb, init_cycle_ms},
-      best_{init_fusion_mb, init_cycle_ms} {
+      best_{init_fusion_mb, init_cycle_ms},
+      warmup_left_(warmup_samples_) {
   if (!log_path.empty()) {
     log_ = std::fopen(log_path.c_str(), "w");
     if (log_)
-      std::fprintf(log_, "sample,fusion_mb,cycle_ms,score_bytes_per_sec\n");
+      std::fprintf(log_,
+                   "sample,fusion_mb,cycle_ms,cache,hierarchical,"
+                   "score_bytes_per_sec\n");
   }
 }
 
@@ -167,8 +184,13 @@ void ParameterManager::Record(long long bytes, double now_s) {
   if (done_.load()) return;
   if (t0_ < 0) t0_ = now_s;
   bytes_ += bytes;
-  if (++steps_ < kStepsPerSample) return;
+  if (++steps_ < steps_per_sample_) return;
   CloseSample(now_s);
+}
+
+void ParameterManager::Apply() {
+  apply_((long long)(current_[0] * 1024 * 1024), current_[1],
+         cats_[0] != 0, cats_[1] != 0);
 }
 
 void ParameterManager::CloseSample(double now_s) {
@@ -176,23 +198,49 @@ void ParameterManager::CloseSample(double now_s) {
   double score = (double)bytes_ / dt;
   if (warmup_left_ > 0) {
     --warmup_left_;  // discard the sample, keep current params
-  } else {
+  } else if (cat_index_ < 0) {
+    // Joint GP phase over (fusion_mb, cycle_ms).
     bo_.AddSample(current_, score);
     ++samples_;
     if (log_)
-      std::fprintf(log_, "%d,%.3f,%.3f,%.1f\n", samples_, current_[0],
-                   current_[1], score);
+      std::fprintf(log_, "%d,%.3f,%.3f,%d,%d,%.1f\n", samples_, current_[0],
+                   current_[1], (int)cats_[0], (int)cats_[1], score);
     if (score > best_score_) {
       best_score_ = score;
       best_ = current_;
     }
-    if (samples_ >= kMaxSamples) {
+    if (samples_ >= max_samples_) {
+      // Freeze the continuous knobs at the best and start the
+      // categorical chain (reference: parameter_manager.cc tunes the
+      // bool params after the joint BayesianParameter).
       current_ = best_;
-      done_.store(true);
+      cat_index_ = 0;
+      cat_trial_ = false;
+      cat_baseline_ = -1.0;
     } else {
       current_ = bo_.Suggest();
     }
-    apply_((long long)(current_[0] * 1024 * 1024), current_[1]);
+    Apply();
+    if (log_) std::fflush(log_);
+  } else {
+    // Categorical chain: knob cat_index_, baseline then flipped trial.
+    ++cat_samples_;
+    if (log_)
+      std::fprintf(log_, "cat%d,%.3f,%.3f,%d,%d,%.1f\n", cat_index_,
+                   current_[0], current_[1], (int)cats_[0], (int)cats_[1],
+                   score);
+    if (!cat_trial_) {
+      cat_baseline_ = score;
+      cats_[(size_t)cat_index_] ^= 1;  // try the flipped value
+      cat_trial_ = true;
+    } else {
+      if (score <= cat_baseline_)
+        cats_[(size_t)cat_index_] ^= 1;  // flip back: baseline won
+      cat_trial_ = false;
+      cat_baseline_ = -1.0;
+      if (++cat_index_ >= (int)cats_.size()) done_.store(true);
+    }
+    Apply();
     if (log_) std::fflush(log_);
   }
   steps_ = 0;

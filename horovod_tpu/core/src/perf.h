@@ -48,8 +48,8 @@ class GaussianProcess {
 class BayesianOptimizer {
  public:
   BayesianOptimizer(std::vector<std::pair<double, double>> bounds,
-                    unsigned seed = 1234)
-      : bounds_(std::move(bounds)), rng_(seed) {}
+                    unsigned seed = 1234, double gp_noise = 0.05)
+      : bounds_(std::move(bounds)), rng_(seed), gp_noise_(gp_noise) {}
 
   void AddSample(const std::vector<double>& x, double y);
   // Next candidate in original (denormalized) coordinates.
@@ -59,21 +59,28 @@ class BayesianOptimizer {
   std::vector<double> Denorm(const std::vector<double>& u) const;
   std::vector<std::pair<double, double>> bounds_;
   std::mt19937 rng_;
+  double gp_noise_;
   std::vector<std::vector<double>> X_;  // normalized samples
   std::vector<double> y_;
 };
 
 // --- Parameter manager ----------------------------------------------------
-// Drives (fusion_bytes, cycle_ms) from observed allreduce throughput.
-// Matches the reference's sampling discipline: WARMUP_SAMPLES discarded,
-// STEPS_PER_SAMPLE records per score, MAX_SAMPLES then freeze at best
-// (reference: parameter_manager.cc:28-66). Apply is a callback so the
-// owner decides coordination (fusion is staged through the controller
-// broadcast; cycle time applies locally).
+// Drives (fusion_bytes, cycle_ms) plus the categorical knobs
+// (response-cache on/off, hierarchical allreduce on/off) from observed
+// allreduce throughput. Matches the reference's discipline
+// (reference: parameter_manager.cc:28-66): WARMUP_SAMPLES discarded,
+// STEPS_PER_SAMPLE records per score, joint GP search up to MAX_SAMPLES,
+// then the categorical booleans are tuned *in a chain* — each knob gets
+// a baseline sample and a flipped sample, the better value sticks, and
+// the chain advances. Sampling constants are env-tunable
+// (HOROVOD_AUTOTUNE_WARMUP_SAMPLES / _STEPS_PER_SAMPLE /
+// _BAYES_OPT_MAX_SAMPLES / _GAUSSIAN_PROCESS_NOISE). Apply is a
+// callback so the owner decides coordination (fusion + categoricals are
+// staged through the controller broadcast; cycle time applies locally).
 class ParameterManager {
  public:
-  using ApplyFn = std::function<void(long long fusion_bytes,
-                                     double cycle_ms)>;
+  using ApplyFn = std::function<void(long long fusion_bytes, double cycle_ms,
+                                     bool cache_enabled, bool hierarchical)>;
 
   ParameterManager(double init_fusion_mb, double init_cycle_ms,
                    ApplyFn apply, const std::string& log_path = "");
@@ -85,15 +92,17 @@ class ParameterManager {
   double fusion_mb() const { return current_[0]; }
   double cycle_ms() const { return current_[1]; }
   int samples() const { return samples_; }
+  bool cache_enabled() const { return cats_[0] != 0; }
+  bool hierarchical() const { return cats_[1] != 0; }
+  int categorical_samples() const { return cat_samples_; }
 
   static constexpr double kFusionMbLo = 1.0, kFusionMbHi = 64.0;
   static constexpr double kCycleMsLo = 1.0, kCycleMsHi = 25.0;
-  static constexpr int kWarmupSamples = 3;
-  static constexpr int kStepsPerSample = 10;
-  static constexpr int kMaxSamples = 20;
 
  private:
   void CloseSample(double now_s);
+  void Apply();
+  int warmup_samples_, steps_per_sample_, max_samples_;
   BayesianOptimizer bo_;
   ApplyFn apply_;
   std::vector<double> current_;  // {fusion_mb, cycle_ms}
@@ -103,7 +112,13 @@ class ParameterManager {
   long long bytes_ = 0;
   double t0_ = -1.0;
   int samples_ = 0;
-  int warmup_left_ = kWarmupSamples;
+  int warmup_left_;
+  // Categorical chain state: -1 = GP phase, else index into cats_.
+  int cat_index_ = -1;
+  int cat_samples_ = 0;
+  double cat_baseline_ = -1.0;
+  bool cat_trial_ = false;  // false: measuring baseline; true: flipped
+  std::vector<uint8_t> cats_{1, 0};  // {cache_enabled, hierarchical}
   std::atomic<bool> done_{false};
   std::FILE* log_ = nullptr;
 };

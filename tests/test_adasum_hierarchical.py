@@ -97,7 +97,8 @@ def test_hierarchical_allgather():
         np.testing.assert_allclose(out[r], np.arange(8.0))
 
 
-@pytest.mark.parametrize("np_", [2, 3])
+@pytest.mark.parametrize(
+    "np_", [2, pytest.param(3, marks=pytest.mark.tier2)])
 def test_adasum_native_multiproc(np_):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

@@ -27,6 +27,7 @@ def test_jax_mnist_example():
     assert "epoch 0 loss" in proc.stdout
 
 
+@pytest.mark.tier2
 def test_pytorch_mnist_example():
     proc = _run_example("examples/pytorch/pytorch_mnist.py", 2,
                         ["--epochs", "1", "--steps-per-epoch", "3",
@@ -35,6 +36,7 @@ def test_pytorch_mnist_example():
     assert "epoch 0 loss" in proc.stdout
 
 
+@pytest.mark.tier2
 def test_keras_mnist_example():
     proc = _run_example("examples/keras/keras_mnist.py", 2,
                         ["--epochs", "1", "--batch-size", "64"],
@@ -59,6 +61,7 @@ def test_spark_keras_example():
     assert "predict([1,0,0,0])" in proc.stdout
 
 
+@pytest.mark.tier2
 def test_adasum_example():
     proc = _run_example("examples/adasum/adasum_small_model.py", 2,
                         ["--steps", "30"])

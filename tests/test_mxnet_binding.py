@@ -82,6 +82,7 @@ def test_compression_fp16_roundtrip():
     np.testing.assert_allclose(d.asnumpy(), [1.5, 2.5])
 
 
+@pytest.mark.tier2
 def test_mxnet_multiproc():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

@@ -140,6 +140,7 @@ def test_torch_estimator_fit_predict(tmp_path):
                      "checkpoint.ckpt"))
 
 
+@pytest.mark.tier2
 def test_torch_estimator_fit_np2(tmp_path):
     """Distributed fit through the LocalBackend subprocess launcher."""
     torch = pytest.importorskip("torch")

@@ -154,6 +154,7 @@ def test_tf_elastic_state_save_restore():
         np.testing.assert_allclose(a, b)
 
 
+@pytest.mark.tier2
 def test_tf_multiproc():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

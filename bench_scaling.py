@@ -1,0 +1,295 @@
+"""Scaling-efficiency + bus-bandwidth harness (virtual mesh).
+
+The reference's headline claim is scaling efficiency — 90% at 512 GPUs
+on Inception V3 / ResNet-101 (reference: docs/benchmarks.rst:8-14).
+Real multi-chip hardware is not available here, so this harness proves
+the *scaling path* two ways:
+
+1. in-graph data parallelism on 1/2/4/8 virtual XLA devices
+   (``--xla_force_host_platform_device_count``): fixed per-device batch
+   (weak scaling), pjit-sharded train step of a small MLP classifier.
+   Efficiency(N) = throughput(N) / (N * throughput(1)).
+2. allreduce bus bandwidth on the 8-device mesh (the BASELINE.json
+   north-star microbench) plus the native TCP ring at np=2 (the
+   CPU control-plane data path used by the eager API).
+
+Run on TPU pods unchanged: the same code paths scale to real meshes —
+only the device list differs.
+
+Writes SCALING.json (committed; asserted by tests/test_scaling.py) and
+prints each record as a JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+N_DEVICES = 8
+WORLD_SIZES = (1, 2, 4, 8)
+
+
+# --------------------------------------------------------------------------
+# Children (run in fresh interpreters: XLA_FLAGS must precede jax import)
+# --------------------------------------------------------------------------
+
+def mesh_child() -> int:
+    """Weak-scaling DP throughput at 1/2/4/8 virtual devices."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.jax import DistributedOptimizer
+    from horovod_tpu.parallel.mesh import DATA_AXIS
+
+    per_device_batch = 64
+    dim, classes = 256, 10
+    records = []
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        logits = h @ params["w2"] + params["b2"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    def make_step(mesh, distributed):
+        tx = (DistributedOptimizer(optax.sgd(0.01), axis=DATA_AXIS)
+              if distributed else optax.sgd(0.01))
+
+        def step(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        data_spec = jax.sharding.PartitionSpec(DATA_AXIS)
+        repl = jax.sharding.PartitionSpec()
+        return jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(repl, repl, data_spec, data_spec),
+            out_specs=(repl, repl, repl), check_vma=False)), tx
+
+    rng = np.random.RandomState(0)
+
+    def time_step(mesh, distributed, batch, iters=30):
+        params = {
+            "w1": jnp.asarray(rng.randn(dim, dim) * 0.05, jnp.float32),
+            "b1": jnp.zeros((dim,), jnp.float32),
+            "w2": jnp.asarray(rng.randn(dim, classes) * 0.05, jnp.float32),
+            "b2": jnp.zeros((classes,), jnp.float32),
+        }
+        step, tx = make_step(mesh, distributed)
+        opt_state = tx.init(params)
+        x = jnp.asarray(rng.randn(batch, dim), jnp.float32)
+        y = jnp.asarray(rng.randint(0, classes, batch))
+        for _ in range(3):  # warmup + compile
+            params, opt_state, loss = step(params, opt_state, x, y)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, x, y)
+        float(loss)
+        return (time.perf_counter() - t0) / iters
+
+    host_cores = len(os.sched_getaffinity(0))
+    base_tp = None
+    for n in WORLD_SIZES:
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:n]), (DATA_AXIS,))
+        batch = n * per_device_batch
+        t_dp = time_step(mesh, True, batch)
+        # Identical sharded step minus the gradient psum: isolates the
+        # collective overhead the framework adds. On a shared-core host
+        # this, not raw weak-scaling throughput, is the meaningful
+        # efficiency signal (virtual devices contend for the same
+        # cores; see the "note" field).
+        t_local = time_step(mesh, False, batch)
+        tp = batch / t_dp
+        if n == 1:
+            base_tp = tp
+        records.append({
+            "metric": "dp_weak_scaling", "world_size": n,
+            "value": round(tp, 1), "unit": "samples/sec",
+            "host_cores": host_cores,
+            "throughput_ratio_vs_1dev": round(tp / (n * base_tp), 3),
+            "collective_overhead_pct": round(
+                max(t_dp / t_local - 1.0, 0.0) * 100, 1),
+            "efficiency_proxy": round(min(t_local / t_dp, 1.0), 3),
+        })
+    print(json.dumps(records))
+    return 0
+
+
+def busbw_child() -> int:
+    """In-graph psum bus bandwidth on the full virtual mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = len(jax.devices())
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+    elems = 4 * 1024 * 1024  # 16 MB fp32 per device
+    x = jnp.ones((n, elems), jnp.float32)
+    spec = jax.sharding.PartitionSpec("data")
+
+    step = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+        in_specs=spec, out_specs=spec))
+    step(x).block_until_ready()
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    nbytes = elems * 4
+    # Ring-allreduce bus bandwidth convention: 2(n-1)/n * payload / time.
+    busbw = 2 * (n - 1) / n * nbytes / dt
+    print(json.dumps([{
+        "metric": "allreduce_bus_bandwidth_ingraph", "world_size": n,
+        "value": round(busbw / 1e9, 3), "unit": "GB/s",
+        "payload_mb": nbytes / 1e6,
+    }]))
+    return 0
+
+
+def native_child() -> int:
+    """Native TCP ring allreduce bandwidth (rank 0 reports)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    elems = 2 * 1024 * 1024  # 8 MB fp32
+    x = np.ones(elems, np.float32)
+    for _ in range(3):
+        hvd.allreduce(x, name="busbw_warm", op=hvd.Sum)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        # Same name every step: steady-state reuse rides the response
+        # cache's coordinator-skip fast path, like a real training loop.
+        hvd.allreduce(x, name="busbw", op=hvd.Sum)
+    dt = (time.perf_counter() - t0) / iters
+    n = hvd.size()
+    nbytes = elems * 4
+    if hvd.rank() == 0:
+        busbw = 2 * (n - 1) / n * nbytes / dt
+        print(json.dumps([{
+            "metric": "allreduce_bus_bandwidth_native_tcp",
+            "world_size": n, "value": round(busbw / 1e9, 3),
+            "unit": "GB/s", "payload_mb": nbytes / 1e6,
+        }]))
+    hvd.shutdown()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Supervisor
+# --------------------------------------------------------------------------
+
+def _cpu_env(n_devices=N_DEVICES):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
+                      " --xla_force_host_platform_device_count=%d"
+                      % n_devices).strip(),
+        "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    return env
+
+
+def _run_child(mode, timeout=600):
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), mode],
+        env=_cpu_env(), capture_output=True, text=True, timeout=timeout)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError("child %s produced no JSON: rc=%d\n%s\n%s"
+                       % (mode, out.returncode, out.stdout[-2000:],
+                          out.stderr[-2000:]))
+
+
+def _run_native(np_=2, timeout=300):
+    port_s = socket.socket()
+    port_s.bind(("127.0.0.1", 0))
+    port = port_s.getsockname()[1]
+    port_s.close()
+    procs = []
+    for r in range(np_):
+        env = _cpu_env(1)
+        env.update({
+            "HOROVOD_RANK": str(r), "HOROVOD_SIZE": str(np_),
+            "HOROVOD_LOCAL_RANK": str(r), "HOROVOD_LOCAL_SIZE": str(np_),
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "native-child"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    for out in outs:
+        for line in reversed(out.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    raise RuntimeError("native children produced no JSON:\n%s"
+                       % "\n---\n".join(o[-1500:] for o in outs))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("mode", nargs="?", default="all",
+                   choices=["all", "mesh-child", "busbw-child",
+                            "native-child"])
+    p.add_argument("--output", default=os.path.join(_REPO, "SCALING.json"))
+    args = p.parse_args()
+    if args.mode == "mesh-child":
+        return mesh_child()
+    if args.mode == "busbw-child":
+        return busbw_child()
+    if args.mode == "native-child":
+        return native_child()
+
+    records = []
+    records += _run_child("mesh-child")
+    records += _run_child("busbw-child")
+    for np_ in (2, 4):
+        records += _run_native(np_)
+    payload = {
+        "generated_by": "bench_scaling.py",
+        "device_kind": "virtual-cpu-%d" % N_DEVICES,
+        "records": records,
+        "note": (
+            "Virtual XLA devices share this host's CPU cores, so raw "
+            "weak-scaling throughput measures host contention, not the "
+            "framework (throughput_ratio_vs_1dev is reported for "
+            "transparency, not as efficiency). The framework signal is "
+            "collective_overhead_pct / efficiency_proxy: the cost the "
+            "gradient psum adds to an otherwise identical sharded "
+            "step. On real ICI meshes the same harness reports true "
+            "scaling efficiency vs the reference's 90%-at-512 target."),
+    }
+    with open(args.output, "w") as f:
+        json.dump(payload, f, indent=1)
+    for r in records:
+        print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

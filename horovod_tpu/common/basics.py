@@ -149,6 +149,13 @@ def init(process_sets=None):
     with _ctx.lock:
         if _ctx.initialized:
             return
+        # Env-knob registry: translate reference-named aliases
+        # (HOROVOD_GLOO_*) and warn about set-but-meaningless knobs
+        # (reference knob surface: horovod/common/common.h:107-139).
+        from horovod_tpu.common import knobs
+
+        knobs.apply_aliases()
+        knobs.warn_rejected()
         _ctx.topology = _topology_from_env()
         if _ctx.topology.size > 1:
             from horovod_tpu.core import CoreSession

@@ -1,0 +1,184 @@
+"""HOROVOD_* environment-knob registry.
+
+The reference exposes ~40 ``HOROVOD_*`` environment variables
+(reference: horovod/common/common.h:107-139 name constants,
+horovod/common/utils/env_parser.cc parsing, horovod/common/operations.cc
+:432-588 consumption at init). This registry accounts for every one of
+them: each knob is either HONORED (consumed by this framework, with the
+consuming module recorded), ALIASED (accepted under the reference name
+and mapped onto this framework's equivalent), or REJECTED (meaningless
+on TPU — the hardware/runtime it configures does not exist here — with
+the reason recorded).
+
+``apply_aliases()`` translates aliased names into their native
+equivalents and ``warn_rejected()`` logs any rejected knob the user has
+set, so a reference user migrating an environment gets an explicit
+signal instead of a silently ignored variable. Both run during
+``hvd.init()`` (common/basics.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, NamedTuple, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+HONORED = "honored"
+ALIASED = "aliased"
+REJECTED = "rejected"
+
+
+class Knob(NamedTuple):
+    name: str
+    status: str
+    # HONORED: module that consumes it. ALIASED: the native name it maps
+    # to. REJECTED: why it has no TPU meaning.
+    detail: str
+
+
+# Every knob named in reference common.h:107-139 plus the env_parser.cc
+# extras, in reference order.
+REGISTRY: Dict[str, Knob] = {k.name: k for k in [
+    # --- logging / observability ---
+    Knob("HOROVOD_LOG_LEVEL", HONORED,
+         "core/src/common.cc CurrentLogLevel + python logging"),
+    Knob("HOROVOD_LOG_TIMESTAMP", HONORED,
+         "core/src/common.cc LogMessage timestamp prefix"),
+    Knob("HOROVOD_LOG_HIDE_TIME", ALIASED,
+         "HOROVOD_LOG_TIMESTAMP=0"),
+    Knob("HOROVOD_TIMELINE", HONORED,
+         "common/basics.py -> utils/timeline.py + native TimelineWriter"),
+    Knob("HOROVOD_TIMELINE_MARK_CYCLES", HONORED,
+         "utils/timeline.py cycle markers"),
+    Knob("HOROVOD_DISABLE_NVTX_RANGES", REJECTED,
+         "NVTX is a CUDA profiler annotation library; TPU profiling "
+         "goes through the timeline + XLA/jax.profiler instead"),
+    # --- core coordination loop ---
+    Knob("HOROVOD_FUSION_THRESHOLD", HONORED,
+         "core/session.py + core/src/operations.cc (default 128 MB, "
+         "reference operations.cc:488)"),
+    Knob("HOROVOD_CYCLE_TIME", HONORED,
+         "core/session.py + background loop cadence"),
+    Knob("HOROVOD_CACHE_CAPACITY", HONORED,
+         "core/src/controller.cc response cache"),
+    Knob("HOROVOD_HIERARCHICAL_ALLREDUCE", HONORED,
+         "core/src/controller.cc + parallel/hierarchical.py"),
+    Knob("HOROVOD_HIERARCHICAL_ALLGATHER", HONORED,
+         "parallel/hierarchical.py hierarchical_all_gather default"),
+    Knob("HOROVOD_STALL_CHECK_DISABLE", HONORED,
+         "core/src/controller.cc StallInspector"),
+    Knob("HOROVOD_STALL_CHECK_TIME_SECONDS", HONORED,
+         "core/src/controller.cc StallInspector warn threshold"),
+    Knob("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", HONORED,
+         "core/src/controller.cc StallInspector enforcement"),
+    Knob("HOROVOD_ELASTIC", HONORED,
+         "runner/elastic_run.py + elastic/worker.py"),
+    Knob("HOROVOD_DISABLE_GROUP_FUSION", HONORED,
+         "core/src/controller.cc FuseResponses"),
+    Knob("HOROVOD_DYNAMIC_PROCESS_SETS", HONORED,
+         "common/process_sets.py (default ON here: dynamic sets have no "
+         "extra cost without MPI communicator splitting)"),
+    Knob("HOROVOD_THREAD_AFFINITY", HONORED,
+         "core/src/operations.cc background-thread CPU pin"),
+    # --- autotuner ---
+    Knob("HOROVOD_AUTOTUNE", HONORED,
+         "core/session.py (python manager) / =native (C++ manager)"),
+    Knob("HOROVOD_AUTOTUNE_LOG", HONORED, "autotune CSV log path"),
+    Knob("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", HONORED,
+         "core/src/perf.cc sampling constants"),
+    Knob("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", HONORED,
+         "core/src/perf.cc sampling constants"),
+    Knob("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", HONORED,
+         "core/src/perf.cc sampling constants"),
+    Knob("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", HONORED,
+         "core/src/perf.cc GP noise"),
+    # --- backend selection (reference compile/runtime backend matrix) ---
+    Knob("HOROVOD_CONTROLLER", REJECTED,
+         "the reference chooses MPI vs Gloo for the control plane; this "
+         "framework has exactly one control plane (native TCP full mesh "
+         "+ HTTP rendezvous), so there is nothing to select"),
+    Knob("HOROVOD_CPU_OPERATIONS", REJECTED,
+         "selects MPI/Gloo/oneCCL for CPU collectives in the reference; "
+         "CPU collectives here are always the native TCP ring"),
+    Knob("HOROVOD_MPI_THREADS_DISABLE", REJECTED,
+         "MPI threading level — no MPI in the runtime"),
+    Knob("HOROVOD_NUM_NCCL_STREAMS", REJECTED,
+         "NCCL stream pool sizing — no NCCL; device collectives are XLA "
+         "programs scheduled by the TPU runtime"),
+    Knob("HOROVOD_CCL_CACHE", REJECTED, "oneCCL-specific cache knob"),
+    Knob("HOROVOD_CCL_BGT_AFFINITY", REJECTED,
+         "oneCCL background-thread affinity; use "
+         "HOROVOD_THREAD_AFFINITY"),
+    Knob("HOROVOD_DDL_OPTIONS", REJECTED, "IBM DDL backend options"),
+    Knob("HOROVOD_ADASUM_MPI_CHUNK_SIZE", REJECTED,
+         "chunking for MPI point-to-point Adasum; Adasum here is the "
+         "native ring / in-graph reduction (parallel/adasum.py)"),
+    Knob("HOROVOD_ENABLE_ASYNC_COMPLETION", REJECTED,
+         "GPU event-polling completion mode; completion here is always "
+         "asynchronous via the core callback trampoline"),
+    Knob("HOROVOD_BATCH_D2D_MEMCOPIES", REJECTED,
+         "batched CUDA D2D fusion-buffer copies; XLA fuses device "
+         "copies at compile time"),
+    Knob("HOROVOD_ENABLE_XLA_OPS", REJECTED,
+         "opt-in XLA lowering for the reference's TF ops; collectives "
+         "here are always XLA-native"),
+    # --- gloo/bootstrap aliases (reference gloo_context.cc:150-230) ---
+    Knob("HOROVOD_GLOO_RENDEZVOUS_ADDR", ALIASED,
+         "HOROVOD_RENDEZVOUS_ADDR"),
+    Knob("HOROVOD_GLOO_RENDEZVOUS_PORT", ALIASED,
+         "HOROVOD_RENDEZVOUS_PORT"),
+    Knob("HOROVOD_GLOO_IFACE", ALIASED, "HOROVOD_IFACE"),
+    Knob("HOROVOD_GLOO_TIMEOUT_SECONDS", REJECTED,
+         "gloo transport timeout; the native TCP control plane uses the "
+         "stall inspector for liveness enforcement"),
+    Knob("HOROVOD_HOSTNAME", HONORED, "core/src/comm.cc advertise addr"),
+    Knob("HOROVOD_RANK", HONORED, "common/basics.py topology"),
+    Knob("HOROVOD_SIZE", HONORED, "common/basics.py topology"),
+    Knob("HOROVOD_LOCAL_RANK", HONORED, "common/basics.py topology"),
+    Knob("HOROVOD_LOCAL_SIZE", HONORED, "common/basics.py topology"),
+    Knob("HOROVOD_CROSS_RANK", HONORED, "common/basics.py topology"),
+    Knob("HOROVOD_CROSS_SIZE", HONORED, "common/basics.py topology"),
+]}
+
+
+def apply_aliases(env: Optional[Dict[str, str]] = None) -> None:
+    """Copy reference-named aliases onto their native knobs (without
+    overriding an explicitly set native value)."""
+    env = os.environ if env is None else env
+    for knob in REGISTRY.values():
+        if knob.status != ALIASED or knob.name not in env:
+            continue
+        if "=" in knob.detail:  # fixed-value alias, e.g. X -> Y=0
+            target, value = knob.detail.split("=", 1)
+            env.setdefault(target, value)
+        else:
+            env.setdefault(knob.detail, env[knob.name])
+
+
+def warn_rejected(env: Optional[Dict[str, str]] = None) -> list:
+    """Log a warning for every set-but-rejected knob; returns the list
+    of (name, reason) that fired (for tests)."""
+    env = os.environ if env is None else env
+    fired = []
+    for knob in REGISTRY.values():
+        if knob.status == REJECTED and env.get(knob.name):
+            fired.append((knob.name, knob.detail))
+            logger.warning(
+                "%s is set but has no effect on TPU: %s",
+                knob.name, knob.detail)
+    return fired
+
+
+def knob_table() -> str:
+    """Human-readable registry dump (``python -m horovod_tpu.common.knobs``)."""
+    rows = ["%-42s %-8s %s" % ("knob", "status", "detail"),
+            "-" * 100]
+    for knob in REGISTRY.values():
+        rows.append("%-42s %-8s %s" % knob)
+    return "\n".join(rows)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    print(knob_table())

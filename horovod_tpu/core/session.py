@@ -148,8 +148,10 @@ class CoreSession:
                 "HOROVOD_CONTROLLER_PORT must be set for multi-process runs "
                 "(the hvdrun launcher sets it).")
         cycle_ms = float(os.environ.get("HOROVOD_CYCLE_TIME", "1.0"))
+        # 128 MB default matches the reference
+        # (reference: horovod/common/operations.cc:488).
         fusion = int(os.environ.get("HOROVOD_FUSION_THRESHOLD",
-                                    str(64 * 1024 * 1024)))
+                                    str(128 * 1024 * 1024)))
         cache_cap = int(os.environ.get("HOROVOD_CACHE_CAPACITY", "1024"))
 
         session = cls.__new__(cls)

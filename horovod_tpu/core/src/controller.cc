@@ -129,6 +129,16 @@ void ResponseCache::EraseByName(const std::string& name) {
 
 StallInspector::StallInspector() {
   warn_sec_ = 60.0;
+  // Full disable, reference parity
+  // (reference: horovod/common/utils/env_parser.cc
+  // ParseStallInspectorFromEnv, HOROVOD_STALL_CHECK_DISABLE).
+  if (const char* env = getenv("HOROVOD_STALL_CHECK_DISABLE")) {
+    if (*env && *env != '0') {
+      warn_sec_ = 0.0;
+      shutdown_sec_ = 0.0;
+      return;
+    }
+  }
   if (const char* env = getenv("HOROVOD_STALL_CHECK_TIME_SECONDS"))
     warn_sec_ = atof(env);
   shutdown_sec_ = 0.0;

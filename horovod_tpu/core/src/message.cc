@@ -7,6 +7,7 @@
 
 #include "common.h"
 
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <ctime>
@@ -233,9 +234,30 @@ LogLevel CurrentLogLevel() {
 
 void LogMessage(LogLevel level, const std::string& msg) {
   static const char* names[] = {"TRACE", "DEBUG", "INFO", "WARN", "ERROR"};
+  // Timestamp prefix knob (reference: horovod/common/logging.cc,
+  // HOROVOD_LOG_TIMESTAMP).
+  static bool with_ts = [] {
+    const char* env = getenv("HOROVOD_LOG_TIMESTAMP");
+    return env && *env && *env != '0';
+  }();
   const char* rank = getenv("HOROVOD_RANK");
-  fprintf(stderr, "[hvd-core %s rank=%s] %s\n",
-          names[(int)level], rank ? rank : "?", msg.c_str());
+  if (with_ts) {
+    auto now = std::chrono::system_clock::now();
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch())
+                  .count();
+    time_t secs = (time_t)(us / 1000000);
+    struct tm tm_buf;
+    localtime_r(&secs, &tm_buf);
+    char ts[40];
+    strftime(ts, sizeof(ts), "%Y-%m-%d %H:%M:%S", &tm_buf);
+    fprintf(stderr, "[%s.%06lld hvd-core %s rank=%s] %s\n", ts,
+            (long long)(us % 1000000), names[(int)level],
+            rank ? rank : "?", msg.c_str());
+  } else {
+    fprintf(stderr, "[hvd-core %s rank=%s] %s\n",
+            names[(int)level], rank ? rank : "?", msg.c_str());
+  }
 }
 
 }  // namespace hvd

@@ -8,6 +8,14 @@
 // collectives live in XLA programs and only consume the ordering this
 // loop decides.
 
+#ifdef __linux__
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE
+#endif
+#include <pthread.h>
+#include <sched.h>
+#endif
+
 #include "controller.h"
 #include "perf.h"
 
@@ -40,7 +48,9 @@ struct Global {
   std::thread background;
 
   double cycle_ms = 1.0;
-  int64_t fusion_bytes = 64 * 1024 * 1024;
+  // 128 MB matches the reference's default fusion threshold
+  // (reference: horovod/common/operations.cc:488).
+  int64_t fusion_bytes = 128 * 1024 * 1024;
   int cache_cap = 1024;
   std::vector<char> fusion_buffer;
   // Removals are deferred to the end of the cycle: a "__ps_remove__"
@@ -431,6 +441,20 @@ void CreateProcessSetLocked(int ps_id, const std::vector<int>& ranks) {
 // -------------------------------------------------------- background loop ---
 
 void BackgroundLoop() {
+  // Pin the coordination thread when asked (reference:
+  // horovod/common/common.cc SetAffinity via HOROVOD_THREAD_AFFINITY).
+#ifdef __linux__
+  if (const char* env = getenv("HOROVOD_THREAD_AFFINITY")) {
+    if (*env) {
+      const char* lr = getenv("HOROVOD_LOCAL_RANK");
+      int cpu = atoi(env) + (lr ? atoi(lr) : 0);
+      cpu_set_t set;
+      CPU_ZERO(&set);
+      CPU_SET(cpu % CPU_SETSIZE, &set);
+      pthread_setaffinity_np(pthread_self(), sizeof(set), &set);
+    }
+  }
+#endif
   auto last_cycle = Clock::now();
   while (!g->shut_down.load()) {
     // Maintain the cycle cadence (reference: RunLoopOnce sleep,
@@ -743,12 +767,15 @@ int hvd_core_autotune_start(const char* log_path) {
   double fusion_mb = (double)g->fusion_bytes / (1024.0 * 1024.0);
   g->autotune.reset(new ParameterManager(
       fusion_mb, g->cycle_ms,
-      [](long long fusion_bytes, double cycle_ms) {
+      [](long long fusion_bytes, double cycle_ms, bool cache_enabled,
+         bool hierarchical) {
         if (!g) return;
         g->cycle_ms = cycle_ms;
         g->fusion_bytes = fusion_bytes;
-        if (g->controller)
+        if (g->controller) {
           g->controller->stage_fusion_threshold(fusion_bytes);
+          g->controller->stage_categoricals(cache_enabled, hierarchical);
+        }
       },
       log_path ? log_path : ""));
   return 0;

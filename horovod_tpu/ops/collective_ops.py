@@ -25,11 +25,16 @@ reference's op semantics:
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0")
 
 from horovod_tpu.parallel.mesh import DATA_AXIS
 
@@ -93,6 +98,21 @@ def allreduce(
 
     Differentiable: gradients of psum are psum, handled natively by JAX.
     """
+    # HOROVOD_HIERARCHICAL_ALLREDUCE (reference: operations.cc:514-551
+    # toggles NCCLHierarchicalAllreduce): with a two-level (dcn, ici)
+    # axis tuple, route reduce_scatter(ici)->psum(dcn)->all_gather(ici)
+    # so only 1/ici_size of the bytes ride the slow links. Env is read
+    # at trace time, like the reference reads it at init.
+    if (op in (Average, Sum) and process_set is None
+            and isinstance(axis, (tuple, list)) and len(axis) == 2
+            and _env_flag("HOROVOD_HIERARCHICAL_ALLREDUCE")):
+        from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+        dcn_axis, ici_axis = axis
+        x = _apply_prescale(x, prescale_factor)
+        out = hierarchical_allreduce(x, average=(op == Average),
+                                     ici_axis=ici_axis, dcn_axis=dcn_axis)
+        return _apply_postscale(out, postscale_factor)
     groups = _groups_for(process_set, _axis_size(axis))
     n = len(process_set.ranks) if groups is not None else _axis_size(axis)
     x = _apply_prescale(x, prescale_factor)
@@ -160,6 +180,17 @@ def allgather(x, *, axis=DATA_AXIS, process_set=None):
     horovod/common/ops/collective_operations.h:143-179 — the eager path in
     ``horovod_tpu.ops.eager`` reproduces that).
     """
+    # HOROVOD_HIERARCHICAL_ALLGATHER (reference analog:
+    # MPIHierarchicalAllgather, ops/mpi_operations.cc): two-level gather
+    # for a (dcn, ici) axis tuple.
+    if (process_set is None and isinstance(axis, (tuple, list))
+            and len(axis) == 2
+            and _env_flag("HOROVOD_HIERARCHICAL_ALLGATHER")):
+        from horovod_tpu.parallel.hierarchical import hierarchical_allgather
+
+        dcn_axis, ici_axis = axis
+        return hierarchical_allgather(x, ici_axis=ici_axis,
+                                      dcn_axis=dcn_axis)
     groups = _groups_for(process_set, _axis_size(axis))
     return lax.all_gather(x, axis, axis_index_groups=groups, tiled=True)
 

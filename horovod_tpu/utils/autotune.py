@@ -125,7 +125,7 @@ class ParameterManager:
                                      seed=1234)
         self._current = np.array([
             float(os.environ.get("HOROVOD_FUSION_THRESHOLD",
-                                 64 * 1024 * 1024)) / (1024 * 1024),
+                                 128 * 1024 * 1024)) / (1024 * 1024),
             float(os.environ.get("HOROVOD_CYCLE_TIME", 1.0))])
         self._steps = 0
         self._bytes = 0

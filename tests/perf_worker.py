@@ -45,7 +45,7 @@ def main():
     at = basics.core_session()._autotune
     assert at is not None
     fusion_mb, cycle_ms = at.current
-    assert 0 < fusion_mb <= 64 + 1e-6
+    assert 0 < fusion_mb <= 128 + 1e-6
     assert 0 < cycle_ms <= 100
 
     hvd.shutdown()

@@ -182,14 +182,17 @@ class CoreSession:
         self._lib.hvd_core_timeline_stop()
 
     def autotune_state(self):
-        """(fusion_mb, cycle_ms, done, samples) of the native autotuner,
-        or None when it is not running."""
+        """Native autotuner state incl. the categorical chain
+        (cache/hierarchical knobs), or None when it is not running."""
         if self._autotune_mode != "native":
             return None
-        buf = (ctypes.c_double * 4)()
-        self._lib.hvd_core_autotune_state(buf, 4)
+        buf = (ctypes.c_double * 7)()
+        self._lib.hvd_core_autotune_state(buf, 7)
         return {"fusion_mb": buf[0], "cycle_ms": buf[1],
-                "done": bool(buf[2]), "samples": int(buf[3])}
+                "done": bool(buf[2]), "samples": int(buf[3]),
+                "cache_enabled": bool(buf[4]),
+                "hierarchical": bool(buf[5]),
+                "categorical_samples": int(buf[6])}
 
     def shutdown(self):
         self._lib.hvd_core_shutdown()

@@ -687,14 +687,21 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
         ps.joined_ranks.clear();
         ps.last_join_rank = -1;
       }
-      // Adopt any staged fusion threshold before fusing, and ship the
-      // active value with the broadcast so all ranks stay in lockstep.
+      // Adopt any staged fusion threshold / categorical knobs before
+      // fusing, and ship the active values with the broadcast so all
+      // ranks flip in the same cycle (reference analog:
+      // Controller::SynchronizeParameters, controller.cc:39-53).
       int64_t staged = pending_fusion_.exchange(0);
       if (staged > 0) fusion_threshold_ = staged;
+      int staged_cats = pending_cats_.exchange(-1);
+      if (staged_cats >= 0)
+        ApplyCategoricals(ps, staged_cats & 1, staged_cats & 2, me);
       FuseResponses(&negotiated);
       std::string resp_blob;
       int64_t ft = fusion_threshold_;
       resp_blob.append(reinterpret_cast<const char*>(&ft), sizeof(ft));
+      uint8_t cats = (cache_enabled_ ? 1 : 0) | (hierarchical_ ? 2 : 0);
+      resp_blob.append(reinterpret_cast<const char*>(&cats), 1);
       SerializeResponseList(negotiated, &resp_blob);
       s = comm_.Bcast(&resp_blob, root, ps.members);
       if (!s.ok()) return s;
@@ -704,13 +711,15 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
       std::string resp_blob;
       s = comm_.Bcast(&resp_blob, root, ps.members);
       if (!s.ok()) return s;
-      if (resp_blob.size() < sizeof(int64_t))
+      if (resp_blob.size() < sizeof(int64_t) + 1)
         return Status::Error("short response blob");
       int64_t ft;
       memcpy(&ft, resp_blob.data(), sizeof(ft));
       fusion_threshold_ = ft;
-      negotiated = ParseResponseList(resp_blob.data() + sizeof(ft),
-                                     resp_blob.size() - sizeof(ft));
+      uint8_t cats = (uint8_t)resp_blob[sizeof(ft)];
+      ApplyCategoricals(ps, cats & 1, cats & 2, me);
+      negotiated = ParseResponseList(resp_blob.data() + sizeof(ft) + 1,
+                                     resp_blob.size() - sizeof(ft) - 1);
     }
     for (auto& r : negotiated) out->push_back(std::move(r));
   }

@@ -782,14 +782,18 @@ int hvd_core_autotune_start(const char* log_path) {
 }
 
 // out[0]=fusion_mb out[1]=cycle_ms out[2]=done out[3]=samples
+// out[4]=cache_enabled out[5]=hierarchical out[6]=categorical_samples
 void hvd_core_autotune_state(double* out, int n) {
   if (!g || !out) return;
   std::lock_guard<std::mutex> alk(g->autotune_mutex);
   if (!g->autotune) return;
-  double vals[4] = {g->autotune->fusion_mb(), g->autotune->cycle_ms(),
+  double vals[7] = {g->autotune->fusion_mb(), g->autotune->cycle_ms(),
                     g->autotune->done() ? 1.0 : 0.0,
-                    (double)g->autotune->samples()};
-  for (int i = 0; i < n && i < 4; ++i) out[i] = vals[i];
+                    (double)g->autotune->samples(),
+                    g->autotune->cache_enabled() ? 1.0 : 0.0,
+                    g->autotune->hierarchical() ? 1.0 : 0.0,
+                    (double)g->autotune->categorical_samples()};
+  for (int i = 0; i < n && i < 7; ++i) out[i] = vals[i];
 }
 
 // Native chrome-trace timeline of the background loop
@@ -811,6 +815,15 @@ void hvd_core_timeline_stop() {
     dead = std::move(g->timeline);
   }
   if (dead) dead->Stop();
+}
+
+// Live controller-side categorical state (what the staged broadcast
+// actually adopted, as opposed to what the autotuner proposed).
+int hvd_core_cache_enabled() {
+  return g && g->controller && g->controller->cache_enabled() ? 1 : 0;
+}
+int hvd_core_hierarchical() {
+  return g && g->controller && g->controller->hierarchical() ? 1 : 0;
 }
 
 double hvd_core_cycle_ms() { return g ? g->cycle_ms : 0.0; }

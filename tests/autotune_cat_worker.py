@@ -1,0 +1,65 @@
+"""np=2 worker: drive the native autotuner through its full categorical
+chain (GP phase -> cache flip trial -> hierarchical flip trial -> done).
+
+Reference discipline: parameter_manager.cc tunes the bool params in a
+chain after the joint BayesianParameter converges. The flips are adopted
+through the controller's staged-parameter broadcast: every rank's
+controller must flip in the same cycle, which both ranks verify below by
+watching ``hvd_core_cache_enabled`` (the live controller-side flag) and
+by every allreduce staying numerically correct across flips.
+
+Scores are recorded coordinator-side only (like the reference, where the
+parameter manager runs on the coordinator), so chain-progress asserts
+are rank-0-only and the loop runs a fixed count on every rank.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common import basics  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    session = basics.core_session()
+
+    # warmup(1) + GP(3) + categorical(2 knobs x baseline+trial = 4)
+    # samples at 5 steps each = 40 coordinator steps; fixed loop on all
+    # ranks (workers cannot observe chain progress to break early).
+    seen_cache_states = set()
+    for it in range(50):
+        out = hvd.allreduce(np.full(512, 1.5, np.float32),
+                            name="cat_tune", op=hvd.Average)
+        np.testing.assert_allclose(out, 1.5)
+        # Live controller-side flag: what the staged broadcast adopted.
+        seen_cache_states.add(bool(session._lib.hvd_core_cache_enabled()))
+
+    # Every rank's controller must have lived through the cache-off
+    # trial window — the flip was adopted via broadcast, not proposed.
+    assert seen_cache_states == {True, False}, seen_cache_states
+
+    if r == 0:
+        state = session.autotune_state()
+        assert state["done"], "chain never finished: %r" % state
+        assert state["samples"] >= 3, state
+        # 2 categorical knobs x (baseline + flipped trial).
+        assert state["categorical_samples"] == 4, state
+
+    # Collectives still correct after the chain settled.
+    out = hvd.allreduce(np.full(64, float(r + 1), np.float32),
+                        name="post_chain", op=hvd.Sum)
+    np.testing.assert_allclose(out, 3.0)
+    hvd.shutdown()
+    print("AUTOTUNE_CAT_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

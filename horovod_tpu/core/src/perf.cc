@@ -238,7 +238,7 @@ void ParameterManager::CloseSample(double now_s) {
         cats_[(size_t)cat_index_] ^= 1;  // flip back: baseline won
       cat_trial_ = false;
       cat_baseline_ = -1.0;
-      if (++cat_index_ >= (int)cats_.size()) done_.store(true);
+      if (++cat_index_ >= kTunableCats) done_.store(true);
     }
     Apply();
     if (log_) std::fflush(log_);

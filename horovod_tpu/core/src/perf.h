@@ -114,6 +114,12 @@ class ParameterManager {
   int samples_ = 0;
   int warmup_left_;
   // Categorical chain state: -1 = GP phase, else index into cats_.
+  // Only the cache knob is tuned: the native TCP data plane has no
+  // hierarchical algorithm (hierarchical collectives are the in-graph
+  // XLA path, selected by HOROVOD_HIERARCHICAL_* at trace time), so
+  // trialing it would measure pure noise. cats_[1] carries the
+  // env-initialized hierarchical value through the broadcast unchanged.
+  static constexpr int kTunableCats = 1;
   int cat_index_ = -1;
   int cat_samples_ = 0;
   double cat_baseline_ = -1.0;

@@ -10,6 +10,8 @@ tensors bridge through numpy to the shared eager/native path;
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 try:
@@ -24,7 +26,7 @@ from horovod_tpu.common import (  # noqa: F401
     add_process_set, global_process_set, remove_process_set,
 )
 from horovod_tpu.common.basics import (  # noqa: F401
-    cross_rank, cross_size, init, is_homogeneous, is_initialized,
+    cross_rank, cross_size, is_homogeneous, is_initialized,
     local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
     shutdown, size, start_timeline, stop_timeline, tpu_built,
 )
@@ -40,12 +42,61 @@ Max = C.Max
 Product = C.Product
 
 
+def init(process_sets=None):
+    """hvd.init for the TF binding: core init + TF collective runtime.
+
+    The TF-native collective runtime must be configured before the TF
+    eager context initializes ("Collective ops must be configured at
+    program startup"), so the bootstrap lives here rather than lazily at
+    the first collective. When TF has already run ops (context live) or
+    ``HOROVOD_TF_HOST_BRIDGE`` is set, collectives fall back to the
+    host-bridged path with a logged warning."""
+    basics.init(process_sets=process_sets)
+    if basics.size() <= 1:
+        return
+    if os.environ.get("HOROVOD_TF_HOST_BRIDGE", "") not in ("", "0"):
+        return
+    from horovod_tpu.tensorflow import ingraph
+
+    try:
+        ingraph.init_collective_runtime()
+    except Exception:
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "TF collective runtime bootstrap failed; falling back to "
+            "the host-bridged path", exc_info=True)
+
+
+def _use_ingraph(process_set) -> bool:
+    """Whether the TF-native collective runtime serves this call.
+
+    Process sets stay on the host-bridged path: TF collective groups
+    are global here."""
+    if basics.size() <= 1:
+        return False
+    if getattr(process_set, "process_set_id", 0) != 0:
+        return False
+    from horovod_tpu.tensorflow import ingraph
+
+    return ingraph.collective_runtime_ready()
+
+
 def allreduce(tensor, average=None, op=None, name=None,
               prescale_factor=1.0, postscale_factor=1.0,
               compression=None, process_set=global_process_set):
     """(reference: horovod/tensorflow/__init__.py:55-162)"""
     op = eager._effective_op(op, average)
     name = name or "HorovodAllreduce"
+
+    if op in (Average, Sum) and _use_ingraph(process_set):
+        from horovod_tpu.tensorflow import ingraph
+
+        return ingraph.allreduce(
+            tf.convert_to_tensor(tensor), name,
+            op_is_average=(op == Average),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
 
     def _run(x):
         return np.asarray(eager.synchronize(eager.allreduce_async(
@@ -77,6 +128,13 @@ def grouped_allreduce(tensors, average=None, op=None, name=None,
                       process_set=global_process_set):
     op = eager._effective_op(op, average)
     name = name or "HorovodGroupedAllreduce"
+    if op in (Average, Sum) and _use_ingraph(process_set):
+        from horovod_tpu.tensorflow import ingraph
+
+        return [ingraph.allreduce(tf.convert_to_tensor(t),
+                                  "%s.%d" % (name, i),
+                                  op_is_average=(op == Average))
+                for i, t in enumerate(tensors)]
     arrays = [t.numpy() if hasattr(t, "numpy") else np.asarray(t)
               for t in tensors]
     outs = eager.synchronize(eager.grouped_allreduce_async(
@@ -86,6 +144,10 @@ def grouped_allreduce(tensors, average=None, op=None, name=None,
 
 def allgather(tensor, name=None, process_set=global_process_set):
     name = name or "HorovodAllgather"
+    if _use_ingraph(process_set):
+        from horovod_tpu.tensorflow import ingraph
+
+        return ingraph.allgather(tf.convert_to_tensor(tensor), name)
     out = eager.synchronize(eager.allgather_async(
         np.asarray(tensor), name=name, process_set=process_set))
     return tf.convert_to_tensor(np.asarray(out))
@@ -94,6 +156,11 @@ def allgather(tensor, name=None, process_set=global_process_set):
 def broadcast(tensor, root_rank, name=None,
               process_set=global_process_set):
     name = name or "HorovodBroadcast"
+    if _use_ingraph(process_set):
+        from horovod_tpu.tensorflow import ingraph
+
+        return ingraph.broadcast(tf.convert_to_tensor(tensor), root_rank,
+                                 name)
     out = eager.synchronize(eager.broadcast_async(
         np.asarray(tensor), root_rank, name=name, process_set=process_set))
     return tf.convert_to_tensor(np.asarray(out))

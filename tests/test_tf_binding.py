@@ -164,3 +164,17 @@ def test_tf_multiproc():
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("TF_OK") == 2
+
+
+def test_tf_ingraph_collectives():
+    """In-graph TF collective runtime: DistributedOptimizer inside
+    tf.function with zero host bridges (VERDICT r1 item 8)."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "TF_CPP_MIN_LOG_LEVEL": "3"})
+    procs = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable,
+         os.path.join(_REPO, "tests", "tf_ingraph_worker.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert procs.returncode == 0, procs.stdout + procs.stderr
+    assert procs.stdout.count("TF_INGRAPH_OK") == 2

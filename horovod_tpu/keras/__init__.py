@@ -6,13 +6,13 @@
 
 from horovod_tpu.common.basics import (  # noqa: F401
     cross_rank, cross_size, is_initialized, local_rank, local_size,
-    rank, shutdown, size,
+    rank, size,
 )
 from horovod_tpu.tensorflow import (  # noqa: F401
     Adasum, Average, Sum,
     DistributedOptimizer,
     allgather, allgather_object, allreduce, broadcast, broadcast_object,
     broadcast_variables,
-    init,  # TF-aware init: bootstraps the in-graph collective runtime
+    init, shutdown,  # TF-aware: manage the in-graph collective runtime
 )
 from horovod_tpu.keras import callbacks  # noqa: F401

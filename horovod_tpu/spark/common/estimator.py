@@ -77,6 +77,35 @@ def read_shard(path: str, rank: int, size: int,
     return train, val
 
 
+def read_shard_rowgroups(path: str, rank: int, size: int):
+    """Petastorm-semantics shard: each rank reads only its own Parquet
+    *row groups* — IO proportional to the shard, not the dataset
+    (reference: petastorm's make_batch_reader(cur_shard, shard_count)
+    row-group sharding used by spark/data_loaders/pytorch_data_loaders.py).
+    Row groups are enumerated across files in sorted order and dealt
+    round-robin by rank."""
+    import pandas as pd
+    import pyarrow.parquet as pq
+
+    files = sorted(f for f in os.listdir(path) if f.endswith(".parquet"))
+    if not files:
+        raise FileNotFoundError("no .parquet files under %r" % path)
+    pieces = []
+    index = 0
+    for fn in files:
+        pf = pq.ParquetFile(os.path.join(path, fn))
+        for g in range(pf.num_row_groups):
+            if index % size == rank:
+                pieces.append(pf.read_row_group(g).to_pandas())
+            index += 1
+    if not pieces:
+        # Empty shard: column-correct zero-row frame without data IO.
+        schema = pq.ParquetFile(
+            os.path.join(path, files[0])).schema_arrow
+        return schema.empty_table().to_pandas()
+    return pd.concat(pieces, ignore_index=True)
+
+
 class HorovodEstimator(EstimatorParams):
     """Common fit orchestration
     (reference: spark/common/estimator.py HorovodEstimator)."""

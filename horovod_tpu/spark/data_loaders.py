@@ -51,3 +51,40 @@ class AsyncPandasShardDataLoader(AsyncDataLoaderMixin,
                                  PandasShardDataLoader):
     """Background-thread prefetching variant
     (reference: pytorch_data_loaders.py PytorchAsyncDataLoader)."""
+
+
+class ShufflingBufferDataLoader(BaseDataLoader):
+    """Windowed-shuffle wrapper over any batch iterable.
+
+    Petastorm readers shuffle with a bounded in-memory buffer rather
+    than a full permutation (reference: petastorm's
+    RandomShufflingBuffer used via pytorch_data_loaders.py
+    shuffling_queue_capacity): batches stream into a buffer of
+    ``capacity`` samples and each yield draws a random batch from it —
+    bounded memory over arbitrarily large shards.
+    """
+
+    def __init__(self, source, capacity: int = 1024,
+                 seed: Optional[int] = None):
+        self._source = source
+        self.capacity = max(int(capacity), 1)
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return len(self._source)
+
+    def _iterate(self) -> Iterator:
+        buf: List = []
+        for item in self._source:
+            buf.append(item)
+            if len(buf) >= self.capacity:
+                i = self._rng.randint(len(buf))
+                buf[i], buf[-1] = buf[-1], buf[i]
+                yield buf.pop()
+        while buf:
+            i = self._rng.randint(len(buf))
+            buf[i], buf[-1] = buf[-1], buf[i]
+            yield buf.pop()
+
+    def __iter__(self) -> Iterator:
+        return self._iterate()

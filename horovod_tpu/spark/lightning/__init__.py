@@ -1,0 +1,221 @@
+"""LightningEstimator: Spark-ML-style distributed training of
+PyTorch-Lightning-protocol modules.
+
+Parity with the reference's Lightning estimator
+(reference: horovod/spark/lightning/estimator.py TorchEstimator — pickle
+the LightningModule, train per-rank shards through a pl.Trainer wired to
+horovod, rank-0 checkpoint, return a Model transformer;
+horovod/spark/lightning/remote.py RemoteTrainer).
+
+pytorch_lightning is not a baked-in dependency here, so the remote side
+drives the *LightningModule protocol* directly with a minimal
+distributed trainer loop: ``configure_optimizers`` /
+``training_step(batch, batch_idx)`` / optional ``validation_step`` and
+``on_train_epoch_end`` hooks. A real ``pl.LightningModule`` satisfies
+the protocol as-is (it is a torch.nn.Module with exactly these methods);
+plain torch modules implementing the same methods work identically,
+which keeps the estimator testable without the pl package.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import List
+
+import numpy as np
+
+from horovod_tpu.spark.common.estimator import (
+    HorovodEstimator, HorovodModel, read_shard,
+)
+
+_PROTOCOL_METHODS = ("training_step", "configure_optimizers")
+
+
+def _check_module(module) -> None:
+    missing = [m for m in _PROTOCOL_METHODS
+               if not callable(getattr(module, m, None))]
+    if missing:
+        raise TypeError(
+            "model must implement the LightningModule protocol; missing "
+            "methods: %s" % ", ".join(missing))
+
+
+def _extract_loss(step_out):
+    """training_step may return a loss tensor or a dict with 'loss'
+    (reference: pl.LightningModule.training_step contract)."""
+    if isinstance(step_out, dict):
+        return step_out["loss"]
+    return step_out
+
+
+def _unpack_optimizers(opt_spec):
+    """Normalize every configure_optimizers return form of the pl
+    contract to (first_optimizer, [schedulers]): a bare optimizer, a
+    list/tuple of optimizers, the ([optimizers], [schedulers]) tuple,
+    the {'optimizer': ..., 'lr_scheduler': ...} dict, and
+    scheduler-config dicts ({'scheduler': s, 'interval': ...})."""
+
+    def _sched(entry):
+        return entry["scheduler"] if isinstance(entry, dict) else entry
+
+    if isinstance(opt_spec, dict):
+        scheds = []
+        if "lr_scheduler" in opt_spec:
+            scheds = [_sched(opt_spec["lr_scheduler"])]
+        return opt_spec["optimizer"], scheds
+    if isinstance(opt_spec, tuple) and len(opt_spec) == 2 and isinstance(
+            opt_spec[1], (list, tuple)):
+        opts, scheds = opt_spec
+        opt = opts[0] if isinstance(opts, (list, tuple)) else opts
+        return opt, [_sched(s) for s in scheds]
+    if isinstance(opt_spec, (list, tuple)):
+        return opt_spec[0], []
+    return opt_spec, []
+
+
+class LightningEstimator(HorovodEstimator):
+    """(reference: spark/lightning/estimator.py TorchEstimator)"""
+
+    def _train_fn(self, remote_store):
+        import torch
+
+        _check_module(self.model)
+        # cloudpickle, not torch.save: Lightning modules are routinely
+        # defined in local scopes/notebooks (reference remote.py ships
+        # the module with cloudpickle-compatible serialization too).
+        import cloudpickle
+
+        model_bytes = cloudpickle.dumps(self.model)
+        feature_cols = list(self.feature_cols or [])
+        label_cols = list(self.label_cols or [])
+        batch_size, epochs = self.batch_size, self.epochs
+        shuffle, verbose = self.shuffle, self.verbose
+        seed = self.random_seed
+        transformation_fn = self.transformation_fn
+        steps_per_epoch = self.train_steps_per_epoch
+
+        def train():
+            import torch
+
+            import horovod_tpu.torch as hvd
+            from horovod_tpu.spark.data_loaders import (
+                PandasShardDataLoader,
+            )
+
+            hvd.init()
+            rank, size = hvd.rank(), hvd.size()
+            train_pdf, val_pdf = read_shard(
+                remote_store.train_data_path, rank, size,
+                validation_col="__validation__")
+            if transformation_fn is not None:
+                train_pdf = transformation_fn(train_pdf)
+                if val_pdf is not None:
+                    # Validation must see the same feature space the
+                    # model trains on.
+                    val_pdf = transformation_fn(val_pdf)
+            import cloudpickle as _cp
+
+            module = _cp.loads(model_bytes)
+            opt, schedulers = _unpack_optimizers(
+                module.configure_optimizers())
+            if size > 1:
+                hvd.broadcast_parameters(module.state_dict(), root_rank=0)
+                hvd.broadcast_optimizer_state(opt, root_rank=0)
+                opt = hvd.DistributedOptimizer(
+                    opt, named_parameters=module.named_parameters())
+            loader = PandasShardDataLoader(
+                train_pdf, feature_cols, label_cols,
+                batch_size=batch_size, shuffle=shuffle, seed=seed)
+            history = {"loss": [], "val_loss": []}
+            module.train()
+            for epoch in range(epochs):
+                epoch_losses = []
+                for batch_idx, (bx, by) in enumerate(loader):
+                    if (steps_per_epoch is not None
+                            and batch_idx >= steps_per_epoch):
+                        break
+                    batch = (torch.tensor(bx, dtype=torch.float32),
+                             torch.tensor(by, dtype=torch.float32))
+                    opt.zero_grad()
+                    loss = _extract_loss(
+                        module.training_step(batch, batch_idx))
+                    loss.backward()
+                    opt.step()
+                    epoch_losses.append(float(loss.detach()))
+                for sched in (schedulers or []):
+                    sched.step()
+                history["loss"].append(
+                    float(np.mean(epoch_losses)) if epoch_losses
+                    else float("nan"))
+                if val_pdf is not None and hasattr(module,
+                                                   "validation_step"):
+                    module.eval()
+                    with torch.no_grad():
+                        vx = torch.tensor(np.stack(
+                            [val_pdf[c].to_numpy() for c in feature_cols],
+                            axis=1), dtype=torch.float32)
+                        vy = torch.tensor(np.stack(
+                            [val_pdf[c].to_numpy() for c in label_cols],
+                            axis=1), dtype=torch.float32)
+                        vloss = _extract_loss(
+                            module.validation_step((vx, vy), 0))
+                    history["val_loss"].append(float(vloss))
+                    module.train()
+                if hasattr(module, "on_train_epoch_end"):
+                    module.on_train_epoch_end()
+                if verbose and rank == 0:
+                    print("epoch %d loss %.5f" % (epoch,
+                                                  history["loss"][-1]))
+            state = None
+            if rank == 0:
+                # Serialize once; the checkpoint file gets the same
+                # bytes that ride back to the driver.
+                buf2 = io.BytesIO()
+                torch.save(module.state_dict(), buf2)
+                state = buf2.getvalue()
+                os.makedirs(os.path.dirname(
+                    remote_store.checkpoint_path), exist_ok=True)
+                with open(remote_store.checkpoint_path, "wb") as f:
+                    f.write(state)
+            return {"loss": history["loss"],
+                    "val_loss": history["val_loss"], "state": state}
+
+        return train
+
+    def _create_model(self, results: List, run_id, store):
+        import cloudpickle
+        import torch
+
+        rank0 = next(r for r in results if r["state"] is not None)
+        module = cloudpickle.loads(self._model_bytes())
+        module.load_state_dict(torch.load(io.BytesIO(rank0["state"]),
+                                          weights_only=False))
+        # History carries metrics only — the weights blob stays out of
+        # what callers treat as a metrics dict.
+        history = {"loss": rank0["loss"], "val_loss": rank0["val_loss"]}
+        return LightningModel(module, history, run_id, store,
+                              feature_cols=self.feature_cols)
+
+    def _model_bytes(self) -> bytes:
+        import cloudpickle
+
+        return cloudpickle.dumps(self.model)
+
+
+class LightningModel(HorovodModel):
+    """(reference: spark/lightning/estimator.py TorchModel)"""
+
+    def __init__(self, module, history, run_id, store, feature_cols=None):
+        super().__init__(history, run_id, store, feature_cols=feature_cols)
+        self.module = module
+
+    def predict(self, features):
+        import torch
+
+        self.module.eval()
+        with torch.no_grad():
+            x = torch.tensor(np.asarray(features), dtype=torch.float32)
+            if hasattr(self.module, "forward"):
+                return self.module(x).numpy()
+            raise TypeError("module has no forward()")

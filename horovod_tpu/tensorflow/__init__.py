@@ -28,7 +28,7 @@ from horovod_tpu.common import (  # noqa: F401
 from horovod_tpu.common.basics import (  # noqa: F401
     cross_rank, cross_size, is_homogeneous, is_initialized,
     local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
-    shutdown, size, start_timeline, stop_timeline, tpu_built,
+    size, start_timeline, stop_timeline, tpu_built,
 )
 from horovod_tpu.common import basics
 from horovod_tpu.ops import collective_ops as C
@@ -54,18 +54,22 @@ def init(process_sets=None):
     basics.init(process_sets=process_sets)
     if basics.size() <= 1:
         return
-    if os.environ.get("HOROVOD_TF_HOST_BRIDGE", "") not in ("", "0"):
-        return
+    # No try/except here: the HOROVOD_TF_HOST_BRIDGE opt-out and every
+    # local failure mode are folded into the runtime's unanimous
+    # pre-flight (a one-sided silent fallback would deadlock the job),
+    # and a failure after unanimous agreement must surface, not hide.
     from horovod_tpu.tensorflow import ingraph
 
-    try:
-        ingraph.init_collective_runtime()
-    except Exception:
-        import logging
+    ingraph.init_collective_runtime()
 
-        logging.getLogger("horovod_tpu").warning(
-            "TF collective runtime bootstrap failed; falling back to "
-            "the host-bridged path", exc_info=True)
+
+def shutdown():
+    """Tear down the in-graph collective state before the core so a
+    later init() re-bootstraps instead of reusing a dead cluster."""
+    from horovod_tpu.tensorflow import ingraph
+
+    ingraph.shutdown()
+    basics.shutdown()
 
 
 def _use_ingraph(process_set) -> bool:

@@ -90,16 +90,23 @@ def init_collective_runtime() -> bool:
         if size <= 1:
             return False
         rank = basics.rank()
-        from tensorflow.python.eager import context as tf_context
-
         from horovod_tpu.ops import eager
 
-        addr = "%s:%d" % (_advertise_host(), _free_port())
-        # Local pre-flight: collective ops can only be configured before
-        # the TF context initializes, and the address must fit the
-        # fixed-width exchange slot.
-        ok = (len(addr) <= 64
-              and tf_context.context()._context_handle is None)
+        # Local pre-flight: EVERY failure mode must reach the unanimity
+        # allreduce below — a rank that bails out early (exception, env
+        # opt-out) while its peers enter the allreduce is exactly the
+        # one-sided divergence this protocol exists to prevent.
+        addr = ""
+        try:
+            from tensorflow.python.eager import context as tf_context
+
+            addr = "%s:%d" % (_advertise_host(), _free_port())
+            ok = (len(addr) <= 64
+                  and tf_context.context()._context_handle is None
+                  and os.environ.get("HOROVOD_TF_HOST_BRIDGE",
+                                     "") in ("", "0"))
+        except Exception:
+            ok = False
         agreed = eager.synchronize(eager.allreduce_async(
             np.asarray([1.0 if ok else 0.0], np.float32),
             name="__tf_cluster_preflight__", op=3))  # Min
@@ -109,8 +116,8 @@ def init_collective_runtime() -> bool:
 
                 logging.getLogger("horovod_tpu").warning(
                     "TF in-graph pre-flight failed on this rank (context "
-                    "initialized early or bad address %r); all ranks use "
-                    "the host-bridged path", addr)
+                    "initialized early, env opt-out, or bad address %r); "
+                    "all ranks use the host-bridged path", addr)
             return False
         # Cluster-spec exchange over the coordination core (the
         # reference's comm-init-over-controller pattern,
@@ -189,15 +196,40 @@ def allreduce(x, name: str, op_is_average: bool,
 
 
 def allgather(x, name: str):
-    """Concatenate along dim 0 across ranks
-    (reference: HorovodAllgatherOp, tensorflow/mpi_ops.cc:648-734)."""
-    return tf.raw_ops.CollectiveGatherV2(
-        input=x,
-        group_size=tf.constant(_state["size"]),
-        group_key=tf.constant(_GROUP_KEY),
-        instance_key=tf.constant(next(_key_counter)),
-        ordering_token=[],
-        communication_hint="auto")
+    """Concatenate along dim 0 across ranks, ragged dim 0 allowed
+    (reference: HorovodAllgatherOp, tensorflow/mpi_ops.cc:648-734; the
+    reference computes per-rank displacements the same way,
+    ops/collective_operations.h:143-179).
+
+    CollectiveGatherV2 needs uniform shapes, so ragged inputs go through
+    two phases: gather every rank's dim-0 size (uniform (1,) tensors),
+    pad to the max, gather, then strip the padding rows per rank. Both
+    phases trace into the graph — no host round-trip.
+    """
+    sizes_key = tf.constant(next(_key_counter))
+    data_key = tf.constant(next(_key_counter))
+    gsize = tf.constant(_state["size"])
+    gkey = tf.constant(_GROUP_KEY)
+
+    n0 = tf.shape(x)[0]
+    sizes = tf.raw_ops.CollectiveGatherV2(
+        input=tf.reshape(n0, [1]), group_size=gsize, group_key=gkey,
+        instance_key=sizes_key, ordering_token=[],
+        communication_hint="auto")  # (size,) per-rank dim0
+    max_n = tf.reduce_max(sizes)
+    pad_rows = max_n - n0
+    paddings = tf.concat(
+        [[[0, pad_rows]],
+         tf.zeros([tf.rank(x) - 1, 2], tf.int32)], axis=0)
+    padded = tf.pad(x, paddings)
+    gathered = tf.raw_ops.CollectiveGatherV2(
+        input=padded, group_size=gsize, group_key=gkey,
+        instance_key=data_key, ordering_token=[],
+        communication_hint="auto")  # (size*max_n, ...)
+    # Keep each rank's first sizes[i] rows of its max_n-row block.
+    row = tf.range(_state["size"] * max_n)
+    keep = tf.math.floormod(row, max_n) < tf.repeat(sizes, max_n)
+    return tf.boolean_mask(gathered, keep)
 
 
 def broadcast(x, root_rank: int, name: str):

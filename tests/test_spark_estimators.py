@@ -158,3 +158,152 @@ def test_torch_estimator_fit_np2(tmp_path):
     fitted = est.fit(_toy_pdf(64))
     assert fitted.predict([[0.1, 0.9]]).shape == (1, 1)
     assert len(fitted.history) == 3
+
+
+class _ToyLightningModule:
+    """Minimal LightningModule-protocol module for the no-pl environment
+    (a real pl.LightningModule satisfies the same protocol)."""
+
+    def __new__(cls):
+        import torch
+
+        class Impl(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.net = torch.nn.Linear(2, 1)
+                self.epoch_end_calls = 0
+
+            def forward(self, x):
+                return self.net(x)
+
+            def training_step(self, batch, batch_idx):
+                import torch as t
+
+                x, y = batch
+                return t.nn.functional.mse_loss(self(x), y)
+
+            def validation_step(self, batch, batch_idx):
+                import torch as t
+
+                x, y = batch
+                return {"loss": t.nn.functional.mse_loss(self(x), y)}
+
+            def configure_optimizers(self):
+                import torch as t
+
+                return t.optim.SGD(self.parameters(), lr=0.1)
+
+            def on_train_epoch_end(self):
+                self.epoch_end_calls += 1
+
+        return Impl()
+
+
+def test_lightning_estimator_fit_predict(tmp_path):
+    pytest.importorskip("torch")
+    from horovod_tpu.spark.lightning import LightningEstimator
+
+    est = LightningEstimator(
+        model=_ToyLightningModule(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=15, verbose=0, validation=0.2,
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(256))
+    pred = fitted.predict([[0.25, 0.75]])
+    assert pred.shape == (1, 1)
+    assert len(fitted.history["loss"]) == 15
+    # loss decreased and validation hook ran
+    assert fitted.history["loss"][-1] < fitted.history["loss"][0]
+    assert len(fitted.history["val_loss"]) == 15
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "store"), "runs", fitted.run_id,
+                     "checkpoint.ckpt"))
+
+
+def test_lightning_estimator_rejects_non_protocol_model(tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.lightning import LightningEstimator
+
+    est = LightningEstimator(
+        model=torch.nn.Linear(2, 1),  # no training_step
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    with pytest.raises(TypeError, match="training_step"):
+        est.fit(_toy_pdf(32))
+
+
+@pytest.mark.tier2
+def test_lightning_estimator_fit_np2(tmp_path):
+    pytest.importorskip("torch")
+    from horovod_tpu.spark.lightning import LightningEstimator
+
+    est = LightningEstimator(
+        model=_ToyLightningModule(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=3, verbose=0,
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=2, env={"JAX_PLATFORMS": "cpu"}))
+    fitted = est.fit(_toy_pdf(64))
+    assert fitted.predict([[0.1, 0.9]]).shape == (1, 1)
+    assert len(fitted.history["loss"]) == 3
+
+
+def test_read_shard_rowgroups(tmp_path):
+    """Row-group sharding: ranks see disjoint, covering row sets with IO
+    proportional to the shard (petastorm semantics)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from horovod_tpu.spark.common.estimator import read_shard_rowgroups
+
+    pdf = _toy_pdf(100)
+    path = str(tmp_path / "data")
+    os.makedirs(path)
+    # 10 row groups of 10 rows across 2 files
+    for fi in range(2):
+        part = pdf.iloc[fi * 50:(fi + 1) * 50]
+        pq.write_table(pa.Table.from_pandas(part, preserve_index=False),
+                       os.path.join(path, "part-%d.parquet" % fi),
+                       row_group_size=10)
+    shards = [read_shard_rowgroups(path, r, 3) for r in range(3)]
+    assert sum(len(s) for s in shards) == 100
+    all_x1 = np.concatenate([s["x1"].to_numpy() for s in shards])
+    np.testing.assert_allclose(np.sort(all_x1),
+                               np.sort(pdf["x1"].to_numpy()))
+    # 10 groups dealt round-robin over 3 ranks: 4/3/3 groups
+    assert sorted(len(s) for s in shards) == [30, 30, 40]
+
+
+def test_shuffling_buffer_loader():
+    from horovod_tpu.spark.data_loaders import ShufflingBufferDataLoader
+
+    items = list(range(200))
+    loader = ShufflingBufferDataLoader(items, capacity=32, seed=7)
+    out = list(loader)
+    assert sorted(out) == items          # complete, no dups
+    assert out != items                  # actually shuffled
+    # Bounded window: an item cannot appear more than `capacity` before
+    # its source position.
+    for pos, v in enumerate(out):
+        assert pos >= v - 32, (pos, v)
+
+
+def test_unpack_optimizers_forms():
+    """Every configure_optimizers return form of the pl contract."""
+    from horovod_tpu.spark.lightning import _unpack_optimizers
+
+    opt, sched = object(), object()
+    assert _unpack_optimizers(opt) == (opt, [])
+    assert _unpack_optimizers([opt]) == (opt, [])
+    assert _unpack_optimizers(([opt], [sched])) == (opt, [sched])
+    assert _unpack_optimizers(
+        ([opt], [{"scheduler": sched, "interval": "epoch"}])) \
+        == (opt, [sched])
+    assert _unpack_optimizers(
+        {"optimizer": opt, "lr_scheduler": sched}) == (opt, [sched])
+    assert _unpack_optimizers(
+        {"optimizer": opt,
+         "lr_scheduler": {"scheduler": sched}}) == (opt, [sched])
+    assert _unpack_optimizers({"optimizer": opt}) == (opt, [])

@@ -33,6 +33,12 @@ def main():
                              name="ig_gather")
     np.testing.assert_allclose(gathered.numpy(),
                                [[0.0, 5.0], [1.0, 5.0]])
+    # Ragged dim 0 (the reference's allgather contract): rank r
+    # contributes r+1 rows.
+    ragged = hvd.allgather(
+        tf.fill([r + 1, 2], float(r)), name="ig_gather_ragged")
+    np.testing.assert_allclose(ragged.numpy(),
+                               [[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]])
     bc = hvd.broadcast(tf.constant([float(r) + 7.0]), root_rank=1,
                        name="ig_bcast")
     np.testing.assert_allclose(bc.numpy(), [8.0])

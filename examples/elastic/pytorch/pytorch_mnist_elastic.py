@@ -1,0 +1,99 @@
+"""Elastic MNIST in PyTorch — parity with the reference's
+examples/elastic/pytorch/pytorch_mnist_elastic.py: TorchState
+commit/restore loop surviving dynamic world-size changes.
+
+Run:  python -m horovod_tpu.runner --min-np 2 --max-np 4 \\
+          --host-discovery-script ./discover.sh \\
+          python examples/elastic/pytorch/pytorch_mnist_elastic.py
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+import horovod_tpu.elastic as elastic
+from horovod_tpu.elastic.state import TorchState
+
+
+class Net(torch.nn.Module):
+    """(reference: examples/elastic/pytorch/pytorch_mnist_elastic.py)"""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = x.view(-1, 784)
+        return F.log_softmax(self.fc2(F.relu(self.fc1(x))), dim=1)
+
+
+def synthetic_batch(batch_size, seed):
+    rng = np.random.RandomState(seed)
+    x = torch.from_numpy(rng.rand(batch_size, 784).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, size=batch_size))
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps-per-epoch", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(), momentum=0.5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    state = TorchState(model=model, optimizer=optimizer,
+                       epoch=0, batch=0)
+
+    def on_state_reset():
+        # Re-scale lr to the new world size (reference:
+        # pytorch_mnist_elastic.py on_state_reset).
+        for group in optimizer.param_groups:
+            group["lr"] = args.lr * hvd.size()
+
+    state.register_reset_callbacks([on_state_reset])
+
+    @elastic.run
+    def train(state):
+        # state.sync() already ran: params/opt broadcast from rank 0,
+        # epoch/batch agreed. Resume mid-epoch at state.batch
+        # (reference: pytorch_mnist_elastic.py train loop).
+        while state.epoch < args.epochs:
+            loss = None  # resume may land past the last batch
+            for batch_idx in range(state.batch, args.steps_per_epoch):
+                x, y = synthetic_batch(
+                    args.batch_size,
+                    seed=1000 * state.epoch + 10 * batch_idx + hvd.rank())
+                optimizer.zero_grad()
+                loss = F.nll_loss(model(x), y)
+                loss.backward()
+                optimizer.step()
+                state.batch = batch_idx + 1
+                if state.batch % 10 == 0:
+                    state.commit()
+            if hvd.rank() == 0 and loss is not None:
+                print("epoch %d done (size=%d) loss=%.4f"
+                      % (state.epoch, hvd.size(), float(loss)))
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    train(state)
+    if hvd.rank() == 0:
+        print("elastic torch training complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Elastic MNIST in TensorFlow 2 — parity with the reference's
+examples/elastic/tensorflow2/tensorflow2_mnist_elastic.py:
+TensorFlowKerasState commit/restore loop with dynamic world size.
+
+Run:  python -m horovod_tpu.runner --min-np 2 --max-np 4 \\
+          --host-discovery-script ./discover.sh \\
+          python examples/elastic/tensorflow2/tensorflow2_mnist_elastic.py
+"""
+
+import argparse
+import os
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+import horovod_tpu.elastic as elastic
+from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+
+def synthetic_batch(batch_size, seed):
+    rng = np.random.RandomState(seed)
+    return (tf.constant(rng.rand(batch_size, 784), tf.float32),
+            tf.constant(rng.randint(0, 10, size=batch_size)))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps-per-epoch", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.001)
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(784,)),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    optimizer = tf.keras.optimizers.SGD(args.lr * hvd.size())
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    state = TensorFlowKerasState(model=model, optimizer=optimizer,
+                                 epoch=0, batch=0)
+
+    def on_state_reset():
+        # Re-scale lr to the new world size (reference:
+        # tensorflow2_mnist_elastic.py on_state_reset).
+        optimizer.learning_rate.assign(args.lr * hvd.size())
+
+    state.register_reset_callbacks([on_state_reset])
+
+    def train_step(x, y):
+        with tf.GradientTape() as tape:
+            loss = loss_fn(y, model(x, training=True))
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        optimizer.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    @elastic.run
+    def train(state):
+        while state.epoch < args.epochs:
+            loss = None  # resume may land past the last batch
+            for batch_idx in range(state.batch, args.steps_per_epoch):
+                x, y = synthetic_batch(
+                    args.batch_size,
+                    seed=1000 * state.epoch + 10 * batch_idx + hvd.rank())
+                loss = train_step(x, y)
+                state.batch = batch_idx + 1
+                if state.batch % 10 == 0:
+                    state.commit()
+            if hvd.rank() == 0 and loss is not None:
+                print("epoch %d done (size=%d) loss=%.4f"
+                      % (state.epoch, hvd.size(), float(loss)))
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    train(state)
+    if hvd.rank() == 0:
+        print("elastic tf2 training complete")
+
+
+if __name__ == "__main__":
+    main()

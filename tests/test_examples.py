@@ -67,3 +67,38 @@ def test_adasum_example():
                         ["--steps", "30"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "final ||w - w*||" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_pytorch_imagenet_resnet50_example(tmp_path):
+    proc = _run_example(
+        "examples/pytorch/pytorch_imagenet_resnet50.py", 2,
+        ["--synthetic", "--epochs", "1", "--steps-per-epoch", "2",
+         "--batch-size", "2", "--image-size", "64",
+         "--checkpoint-format",
+         str(tmp_path / "checkpoint-{epoch}.pth.tar")],
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "epoch 0 mean_loss" in proc.stdout
+    assert (tmp_path / "checkpoint-0.pth.tar").exists()
+
+
+@pytest.mark.tier2
+def test_elastic_pytorch_example():
+    """Static np=2 run of the elastic torch example (the world-change
+    path is covered by tests/test_elastic.py; this proves the example's
+    commit loop end-to-end)."""
+    proc = _run_example(
+        "examples/elastic/pytorch/pytorch_mnist_elastic.py", 2,
+        ["--epochs", "2", "--steps-per-epoch", "4"], timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "elastic torch training complete" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_elastic_tensorflow2_example():
+    proc = _run_example(
+        "examples/elastic/tensorflow2/tensorflow2_mnist_elastic.py", 2,
+        ["--epochs", "2", "--steps-per-epoch", "4"], timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "elastic tf2 training complete" in proc.stdout

@@ -231,9 +231,11 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--model", default="resnet50")
-    p.add_argument("--steps-per-call", type=int, default=10,
+    p.add_argument("--steps-per-call", type=int, default=30,
                    help="Optimizer steps fused into one executable "
-                        "(amortizes dispatch latency).")
+                        "(amortizes dispatch latency; sweep on v5e: "
+                        "30 beats 10 by ~1%% at bs=128, and bs=128 "
+                        "beats bs=256 — 2726 vs 2563 img/s).")
     p.add_argument("--timeout", type=int,
                    default=int(os.environ.get("HVD_BENCH_TIMEOUT", "600")),
                    help="Hard wall-clock budget for the accelerator "

@@ -357,32 +357,55 @@ void Controller::FuseResponses(
     auto it = groups->find(r.tensor_names[0]);
     return it == groups->end() ? -1 : it->second;
   };
+  // First-fit into per-compatibility-key open bins: each allreduce
+  // joins the earliest-created compatible bin with room, matching the
+  // old quadratic scan's semantics at O(n x open-bins-per-key) — open
+  // bins per key is ~ceil(total_bytes / threshold), small even at
+  // thousand-tensor cycles.
   std::vector<Response> fused;
-  std::vector<bool> used(responses->size(), false);
+  struct Bin {
+    size_t index;   // position in `fused`
+    int64_t bytes;  // payload accumulated so far
+  };
+  std::unordered_map<std::string, std::vector<Bin>> open_bins;
   for (size_t i = 0; i < responses->size(); ++i) {
-    if (used[i]) continue;
     Response r = (*responses)[i];
-    used[i] = true;
-    if (r.op_type == OpType::ALLREDUCE) {
-      int64_t my_gid = gid_of(r);
-      int64_t bytes = r.tensor_sizes[0] * (int64_t)DataTypeSize(r.dtype);
-      for (size_t j = i + 1; j < responses->size(); ++j) {
-        if (used[j]) continue;
-        const Response& c = (*responses)[j];
-        if (c.op_type != OpType::ALLREDUCE || c.dtype != r.dtype ||
-            c.reduce_op != r.reduce_op || c.prescale != r.prescale ||
-            c.postscale != r.postscale)
-          continue;
-        if (disable_group_fusion_ && gid_of(c) != my_gid) continue;
-        int64_t cb = c.tensor_sizes[0] * (int64_t)DataTypeSize(c.dtype);
-        if (bytes + cb > fusion_threshold_) continue;
-        r.tensor_names.push_back(c.tensor_names[0]);
-        r.tensor_sizes.push_back(c.tensor_sizes[0]);
-        bytes += cb;
-        used[j] = true;
-      }
+    if (r.op_type != OpType::ALLREDUCE) {
+      fused.push_back(std::move(r));
+      continue;
     }
-    fused.push_back(std::move(r));
+    int64_t bytes = r.tensor_sizes[0] * (int64_t)DataTypeSize(r.dtype);
+    std::string key;
+    key.reserve(64);
+    key += std::to_string((int)r.dtype);
+    key += '|';
+    key += std::to_string((int)r.reduce_op);
+    key += '|';
+    // Exact bit patterns: to_string would truncate doubles and fuse
+    // across genuinely different scale factors.
+    int64_t pre_bits, post_bits;
+    memcpy(&pre_bits, &r.prescale, sizeof(pre_bits));
+    memcpy(&post_bits, &r.postscale, sizeof(post_bits));
+    key += std::to_string(pre_bits);
+    key += '|';
+    key += std::to_string(post_bits);
+    key += '|';
+    key += std::to_string(gid_of(r));
+    auto& bins = open_bins[key];
+    bool placed = false;
+    for (auto& b : bins) {
+      if (b.bytes + bytes > fusion_threshold_) continue;
+      Response& host = fused[b.index];
+      host.tensor_names.push_back(r.tensor_names[0]);
+      host.tensor_sizes.push_back(r.tensor_sizes[0]);
+      b.bytes += bytes;
+      placed = true;
+      break;
+    }
+    if (!placed) {
+      bins.push_back({fused.size(), bytes});
+      fused.push_back(std::move(r));
+    }
   }
   responses->swap(fused);
 }

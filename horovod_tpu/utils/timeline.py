@@ -25,6 +25,8 @@ class Timeline:
         self._t0 = time.perf_counter()
         self._mark_cycles = mark_cycles
         self._closed = False
+        self._buf = []
+        self._last_flush = time.perf_counter()
         from horovod_tpu.common import basics
 
         self._pid = basics.rank() if basics.is_initialized() else 0
@@ -34,12 +36,28 @@ class Timeline:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    # Flush cadence: the reference decouples producers from disk with a
+    # writer thread (timeline.cc TimelineWriter); at this layer's event
+    # rates a bounded write-buffer flushed on a period gets the same
+    # producer-side cost without a thread. json.dumps happens outside
+    # the lock; the file flushes at most every _FLUSH_EVERY events or
+    # _FLUSH_SECONDS, and on close.
+    _FLUSH_EVERY = 64
+    _FLUSH_SECONDS = 1.0
+
     def _write(self, event: dict):
+        line = json.dumps(event) + ",\n"
         with self._lock:
             if self._closed:
                 return
-            self._f.write(json.dumps(event) + ",\n")
-            self._f.flush()
+            self._buf.append(line)
+            now = time.perf_counter()
+            if (len(self._buf) >= self._FLUSH_EVERY
+                    or now - self._last_flush >= self._FLUSH_SECONDS):
+                self._f.write("".join(self._buf))
+                self._buf.clear()
+                self._f.flush()
+                self._last_flush = now
 
     def begin(self, name: str, category: str):
         self._write({"name": name, "cat": category, "ph": "B",
@@ -71,4 +89,7 @@ class Timeline:
         with self._lock:
             if not self._closed:
                 self._closed = True
+                if self._buf:
+                    self._f.write("".join(self._buf))
+                    self._buf.clear()
                 self._f.close()

@@ -28,6 +28,16 @@ TIER2_COVERAGE = {
         "tests/test_tf_binding.py::test_allreduce_gradient",
     "test_adasum_native_multiproc":
         "tests/test_adasum_hierarchical.py::test_adasum_native_multiproc",
+    "test_pytorch_imagenet_resnet50_example":
+        "tests/test_torch_binding.py::test_torch_multiproc",
+    "test_elastic_pytorch_example":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_elastic_tensorflow2_example":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_lightning_estimator_fit_np2":
+        "tests/test_spark_estimators.py::test_lightning_estimator_fit_predict",
+    "test_scaling_harness_runs_fresh":
+        "tests/test_scaling.py::test_scaling_json_has_all_world_sizes",
 }
 
 

@@ -26,7 +26,14 @@ class Timeline:
         self._mark_cycles = mark_cycles
         self._closed = False
         self._buf = []
-        self._last_flush = time.perf_counter()
+        self._stop_flusher = threading.Event()
+        # Background flusher (reference: timeline.cc TimelineWriter
+        # thread): drains the buffer on a period INDEPENDENT of producer
+        # activity, so when the job wedges mid-collective the stuck
+        # op's begin event still reaches disk.
+        self._flusher = threading.Thread(
+            target=self._flush_loop, daemon=True, name="hvd-timeline")
+        self._flusher.start()
         from horovod_tpu.common import basics
 
         self._pid = basics.rank() if basics.is_initialized() else 0
@@ -36,14 +43,24 @@ class Timeline:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
-    # Flush cadence: the reference decouples producers from disk with a
-    # writer thread (timeline.cc TimelineWriter); at this layer's event
-    # rates a bounded write-buffer flushed on a period gets the same
-    # producer-side cost without a thread. json.dumps happens outside
-    # the lock; the file flushes at most every _FLUSH_EVERY events or
-    # _FLUSH_SECONDS, and on close.
+    # Producers only append under the lock; disk IO happens on the
+    # flusher thread (every _FLUSH_SECONDS) or inline past _FLUSH_EVERY
+    # pending events (backpressure bound).
     _FLUSH_EVERY = 64
     _FLUSH_SECONDS = 1.0
+
+    def _flush_locked(self):
+        if self._buf:
+            self._f.write("".join(self._buf))
+            self._buf.clear()
+            self._f.flush()
+
+    def _flush_loop(self):
+        while not self._stop_flusher.wait(self._FLUSH_SECONDS):
+            with self._lock:
+                if self._closed:
+                    return
+                self._flush_locked()
 
     def _write(self, event: dict):
         line = json.dumps(event) + ",\n"
@@ -51,13 +68,8 @@ class Timeline:
             if self._closed:
                 return
             self._buf.append(line)
-            now = time.perf_counter()
-            if (len(self._buf) >= self._FLUSH_EVERY
-                    or now - self._last_flush >= self._FLUSH_SECONDS):
-                self._f.write("".join(self._buf))
-                self._buf.clear()
-                self._f.flush()
-                self._last_flush = now
+            if len(self._buf) >= self._FLUSH_EVERY:
+                self._flush_locked()
 
     def begin(self, name: str, category: str):
         self._write({"name": name, "cat": category, "ph": "B",
@@ -86,10 +98,9 @@ class Timeline:
         future.add_done_callback(_done)
 
     def close(self):
+        self._stop_flusher.set()
         with self._lock:
             if not self._closed:
                 self._closed = True
-                if self._buf:
-                    self._f.write("".join(self._buf))
-                    self._buf.clear()
+                self._flush_locked()
                 self._f.close()

@@ -37,7 +37,7 @@ TIER2_COVERAGE = {
     "test_lightning_estimator_fit_np2":
         "tests/test_spark_estimators.py::test_lightning_estimator_fit_predict",
     "test_scaling_harness_runs_fresh":
-        "tests/test_scaling.py::test_scaling_json_has_all_world_sizes",
+        "tests/test_scaling.py::test_scaling_harness_smoke",
 }
 
 

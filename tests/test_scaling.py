@@ -65,3 +65,20 @@ def test_scaling_harness_runs_fresh(tmp_path):
     with open(out_path) as f:
         payload = json.load(f)
     assert len(payload["records"]) >= 7
+
+
+def test_scaling_harness_smoke():
+    """Tier-1 stand-in for the full tier-2 harness rerun: executes one
+    real harness child (the 8-device psum bus-bandwidth microbench) so a
+    bench_scaling.py regression cannot hide behind the committed JSON."""
+    import bench_scaling
+
+    env = bench_scaling._cpu_env()
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench_scaling.py"),
+         "busbw-child"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    records = json.loads(out.stdout.strip().splitlines()[-1])
+    assert records[0]["metric"] == "allreduce_bus_bandwidth_ingraph"
+    assert records[0]["value"] > 0

@@ -43,6 +43,93 @@ class MetricAverageCallback(keras.callbacks.Callback):
                     value, op=hvd.Average, name="metric.%s" % k)))
 
 
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the LR by ``multiplier(epoch)`` inside
+    [start_epoch, end_epoch), with optional per-batch smoothing and
+    momentum correction (reference: _keras/callbacks.py:95-176
+    LearningRateScheduleCallbackImpl)."""
+
+    def __init__(self, initial_lr, multiplier, start_epoch=0,
+                 end_epoch=None, staircase=True,
+                 momentum_correction=True, steps_per_epoch=None):
+        super().__init__()
+        if initial_lr is None:
+            raise ValueError("initial_lr is required")
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase if callable(multiplier) else True
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.multiplier = (multiplier if callable(multiplier)
+                           else (lambda epoch: multiplier))
+        self.current_epoch = 0
+        self._restore_momentum = None
+
+    def _adjust(self, epoch):
+        opt = self.model.optimizer
+        old_lr = float(opt.learning_rate)
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        opt.learning_rate.assign(new_lr)
+        if self.momentum_correction and hasattr(opt, "momentum") and \
+                old_lr > 0:
+            # Momentum correction (reference cites Goyal et al. 2017):
+            # scale momentum so an LR change does not discontinuously
+            # change the effective update. Modern Keras bakes a float
+            # `momentum` into the compiled train step, where mutating it
+            # cannot take effect — only a tf.Variable momentum is
+            # correctable; otherwise warn once and skip.
+            mom = opt.momentum
+            if hasattr(mom, "assign"):
+                self._restore_momentum = float(mom)
+                mom.assign(self._restore_momentum * new_lr / old_lr)
+            elif not getattr(self, "_warned_momentum", False):
+                self._warned_momentum = True
+                import logging
+
+                logging.getLogger("horovod_tpu").warning(
+                    "momentum_correction requested but this optimizer's "
+                    "momentum is a compile-time constant (not a "
+                    "tf.Variable); skipping correction")
+
+    def _restore_momentum_if_needed(self):
+        if self._restore_momentum is not None:
+            self.model.optimizer.momentum.assign(self._restore_momentum)
+            self._restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        if not self.staircase and not self.steps_per_epoch:
+            # Autodetect like the reference (_keras/callbacks.py:118-130)
+            # or fail loudly — silently never adjusting is worse.
+            steps = (self.params or {}).get("steps")
+            if not steps:
+                raise ValueError(
+                    "staircase=False needs steps_per_epoch (could not "
+                    "autodetect from fit params)")
+            self.steps_per_epoch = steps
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.current_epoch < self.start_epoch or (
+                self.end_epoch is not None
+                and self.current_epoch >= self.end_epoch):
+            return
+        if self.staircase and batch == 0:
+            self._adjust(self.current_epoch)
+        elif not self.staircase:
+            self._adjust(self.current_epoch
+                         + float(batch) / self.steps_per_epoch)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = float(self.model.optimizer.learning_rate)
+
+
 class LearningRateWarmupCallback(keras.callbacks.Callback):
     """Scale LR linearly from initial to initial*size over warmup epochs
     (reference: _keras/callbacks.py:96-241)."""

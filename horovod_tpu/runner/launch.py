@@ -61,13 +61,50 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="Elastic: slots per discovered host when the "
                         "discovery script does not specify them.")
     p.add_argument("--reset-limit", type=int, dest="reset_limit")
-    # Core tuning knobs → env (reference: config_parser.py).
+    # Core tuning knobs → env (reference: config_parser.py
+    # set_env_from_args; flag names match launch.py:304-475).
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true",
+                   default=None, dest="hierarchical_allreduce")
+    p.add_argument("--no-hierarchical-allreduce", action="store_false",
+                   dest="hierarchical_allreduce")
+    p.add_argument("--hierarchical-allgather", action="store_true",
+                   default=None, dest="hierarchical_allgather")
+    p.add_argument("--no-hierarchical-allgather", action="store_false",
+                   dest="hierarchical_allgather")
     p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true",
+                   default=None, dest="timeline_mark_cycles")
     p.add_argument("--autotune", action="store_true")
+    p.add_argument("--no-autotune", action="store_false", dest="autotune")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
+    # Stall inspector (reference: launch.py:408-421).
+    p.add_argument("--no-stall-check", action="store_true", default=None,
+                   dest="no_stall_check")
+    p.add_argument("--stall-check", action="store_false",
+                   dest="no_stall_check")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   default=None)
+    p.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                   default=None)
+    # Library / logging (reference: launch.py:423-476).
+    p.add_argument("--thread-affinity", type=int, default=None,
+                   help="Pin each worker's coordination thread to CPU "
+                        "(base + local_rank).")
+    p.add_argument("--log-level", default=None,
+                   choices=["trace", "debug", "info", "warning", "error"])
+    p.add_argument("--log-with-timestamp", action="store_true",
+                   default=None, dest="log_with_timestamp")
+    p.add_argument("--log-without-timestamp", action="store_false",
+                   dest="log_with_timestamp")
     # Controller selection (reference: launch.py run_controller
     # gloo/mpi/jsrun dispatch).
     p.add_argument("--use-gloo", action="store_true", dest="use_gloo",
@@ -144,12 +181,47 @@ def _tuning_env(args) -> Dict[str, str]:
         env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
     if args.cache_capacity is not None:
         env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.hierarchical_allreduce is not None:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = (
+            "1" if args.hierarchical_allreduce else "0")
+    if args.hierarchical_allgather is not None:
+        env["HOROVOD_HIERARCHICAL_ALLGATHER"] = (
+            "1" if args.hierarchical_allgather else "0")
     if args.timeline_filename:
         env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
     if args.autotune:
         env["HOROVOD_AUTOTUNE"] = "1"
         if args.autotune_log_file:
             env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+        for attr, knob in (
+                ("autotune_warmup_samples",
+                 "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"),
+                ("autotune_steps_per_sample",
+                 "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"),
+                ("autotune_bayes_opt_max_samples",
+                 "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"),
+                ("autotune_gaussian_process_noise",
+                 "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE")):
+            value = getattr(args, attr)
+            if value is not None:
+                env[knob] = str(value)
+    if args.no_stall_check:
+        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_warning_time_seconds is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_warning_time_seconds)
+    if args.stall_check_shutdown_time_seconds is not None:
+        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_check_shutdown_time_seconds)
+    if args.thread_affinity is not None:
+        env["HOROVOD_THREAD_AFFINITY"] = str(args.thread_affinity)
+    if args.log_level:
+        env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if args.log_with_timestamp is not None:
+        env["HOROVOD_LOG_TIMESTAMP"] = (
+            "1" if args.log_with_timestamp else "0")
     return env
 
 

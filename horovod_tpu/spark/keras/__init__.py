@@ -36,6 +36,12 @@ class KerasEstimator(HorovodEstimator):
                       else tf.keras.optimizers.serialize(optimizer))
         loss = self.loss or "mse"
         metrics = list(self.metrics)
+        # Callbacks ship via cloudpickle (keras callback objects are
+        # routinely closures/locals; reference remote.py serializes them
+        # the same way) and are rebuilt inside each rank.
+        import cloudpickle
+
+        callbacks_blob = cloudpickle.dumps(list(self.callbacks))
         feature_cols = list(self.feature_cols or [])
         label_cols = list(self.label_cols or [])
         batch_size, epochs = self.batch_size, self.epochs
@@ -69,9 +75,9 @@ class KerasEstimator(HorovodEstimator):
             model.compile(optimizer=hvd.DistributedOptimizer(opt)
                           if size > 1 else opt,
                           loss=loss, metrics=metrics)
-            if size > 1:
-                hvd.broadcast_variables(
-                    model.trainable_variables, root_rank=0)
+            # Initial-state sync happens via the injected
+            # BroadcastGlobalVariablesCallback below (covers optimizer
+            # slots too) — no separate pre-fit broadcast.
             kwargs = {}
             if val_pdf is not None and len(val_pdf):
                 xv = np.stack([val_pdf[c].to_numpy()
@@ -79,9 +85,24 @@ class KerasEstimator(HorovodEstimator):
                 yv = np.stack([val_pdf[c].to_numpy()
                                for c in label_cols], axis=1)
                 kwargs["validation_data"] = (xv, yv)
+            # User callbacks + the distributed set (reference:
+            # spark/keras/remote.py: BroadcastGlobalVariables +
+            # MetricAverage wrap the user's list; rank-0-only
+            # checkpointing via BestModelCheckpoint semantics).
+            import cloudpickle as _cp
+
+            from horovod_tpu.keras import callbacks as hvd_callbacks
+
+            callbacks = _cp.loads(callbacks_blob)
+            if size > 1:
+                callbacks = (
+                    [hvd_callbacks.BroadcastGlobalVariablesCallback(0)]
+                    + callbacks
+                    + [hvd_callbacks.MetricAverageCallback()])
             history = model.fit(x, y, batch_size=batch_size,
                                 epochs=epochs, steps_per_epoch=steps,
-                                verbose=verbose, **kwargs)
+                                verbose=verbose, callbacks=callbacks,
+                                **kwargs)
             if rank == 0:
                 os.makedirs(os.path.dirname(
                     remote_store.checkpoint_path), exist_ok=True)

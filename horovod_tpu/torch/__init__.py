@@ -25,7 +25,7 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     barrier,
     broadcast, broadcast_, broadcast_async, broadcast_async_,
     grouped_allreduce, grouped_allreduce_async,
-    join, poll, reducescatter, synchronize,
+    join, poll, reducescatter, sparse_allreduce_async, synchronize,
 )
 from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401

@@ -181,6 +181,40 @@ def allgather_async(tensor, name=None,
     return _register(_TorchHandle(inner, tensor))
 
 
+def sparse_allreduce_async(tensor, name, op=Average,
+                           process_set=global_process_set):
+    """Allreduce a torch sparse COO tensor by allgathering indices and
+    values; returns a zero-arg callable producing the reduced sparse
+    tensor (reference: horovod/torch/mpi_ops.py:515-535
+    sparse_allreduce_async — same allgather-of-(indices,values) design,
+    with the indices transposed so concatenation runs along dim 0).
+    """
+    t = tensor.coalesce() if not tensor.is_coalesced() else tensor
+    indices_handle = allgather_async(
+        t._indices().transpose(0, 1).contiguous(),
+        name="%s.indices" % name, process_set=process_set)
+    values_handle = allgather_async(
+        t._values(), name="%s.values" % name, process_set=process_set)
+
+    def handle():
+        values = synchronize(values_handle)
+        indices = synchronize(indices_handle)
+        if op == Average:
+            n = (len(process_set.ranks)
+                 if getattr(process_set, "process_set_id", 0) != 0
+                 else basics.size())
+            values = values / n
+        if indices.numel() == 0 or values.numel() == 0:
+            return torch.sparse_coo_tensor(
+                torch.zeros((t._indices().shape[0], 0), dtype=torch.long),
+                torch.zeros((0,) + tuple(t._values().shape[1:]),
+                            dtype=t.dtype), t.size())
+        return torch.sparse_coo_tensor(
+            indices.transpose(0, 1), values, t.size())
+
+    return handle
+
+
 def allgather(tensor, name=None, process_set=global_process_set):
     return synchronize(allgather_async(tensor, name=name,
                                        process_set=process_set))

@@ -24,10 +24,11 @@ from horovod_tpu.torch.compression import Compression
 class _DistributedOptimizer(torch.optim.Optimizer):
     def __init__(self, params, named_parameters, compression, op,
                  gradient_predivide_factor, backward_passes_per_step,
-                 process_set):
+                 process_set, sparse_as_dense=False):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._op = op
+        self.sparse_as_dense = sparse_as_dense
         self._process_set = process_set
         self.backward_passes_per_step = backward_passes_per_step
         self._gradient_predivide_factor = gradient_predivide_factor
@@ -70,16 +71,36 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _allreduce_grad_async(self, p):
         name = self._parameter_names.get(p)
         grad = p.grad
+        if grad.is_sparse:
+            # Sparse gradients (e.g. sparse embedding layers):
+            # densify when asked, else allgather-based sparse allreduce
+            # (reference: optimizer.py:186-190, :215-217).
+            if self.sparse_as_dense:
+                grad = grad.to_dense()
+                p.grad = grad
+            else:
+                if self.backward_passes_per_step > 1:
+                    grad = grad / self.backward_passes_per_step
+                handle = mpi_ops.sparse_allreduce_async(
+                    grad, name=name, op=self._op,
+                    process_set=self._process_set)
+                return handle, (None, None, p)
         if self.backward_passes_per_step > 1:
             grad = grad / self.backward_passes_per_step
         if self._gradient_predivide_factor != 1.0:
+            # Split the averaging around the reduction; pre x post
+            # cancel so the final scale is unchanged (reference:
+            # optimizer.py:196-200 — prescale 1/f, postscale f). The
+            # sparse path above ignores the factor for the same
+            # reason: it is scale-neutral by construction.
             prescale = 1.0 / self._gradient_predivide_factor
+            postscale = self._gradient_predivide_factor
         else:
-            prescale = 1.0
+            prescale = postscale = 1.0
         tensor_compressed, ctx = self._compression.compress(grad)
         handle = mpi_ops.allreduce_async_(
             tensor_compressed, name=name, op=self._op,
-            prescale_factor=prescale,
+            prescale_factor=prescale, postscale_factor=postscale,
             process_set=self._process_set)
         return handle, (ctx, tensor_compressed, p)
 
@@ -93,8 +114,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 # the existing grad so ranks stay in lockstep.
                 self._handles[p] = self._allreduce_grad_async(p)
         for p, (handle, (ctx, compressed, _)) in list(self._handles.items()):
-            output = mpi_ops.synchronize(handle)
-            p.grad.copy_(self._compression.decompress(output, ctx))
+            if callable(handle):  # sparse: handle() builds the tensor
+                p.grad = handle()
+            else:
+                output = mpi_ops.synchronize(handle)
+                p.grad.copy_(self._compression.decompress(output, ctx))
             self._passes_done[p] = 0
         self._handles.clear()
         self._synchronized = True
@@ -129,11 +153,13 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          op=mpi_ops.Average,
                          gradient_predivide_factor=1.0,
                          backward_passes_per_step=1,
+                         sparse_as_dense=False,
                          process_set=global_process_set):
     """Wrap a torch optimizer so gradients are allreduced during backward
-    (reference: horovod/torch/optimizer.py:528-590)."""
+    (reference: horovod/torch/optimizer.py:528-590; sparse gradients
+    via allgather or densified with ``sparse_as_dense``)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression, op,
                gradient_predivide_factor, backward_passes_per_step,
-               process_set)
+               process_set, sparse_as_dense=sparse_as_dense)

@@ -117,3 +117,55 @@ def test_end_to_end_failure_propagates(tmp_path):
          sys.executable, str(script)],
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 3
+
+
+def test_full_knob_flag_surface():
+    """Reference config_parser parity: every tuning/stall/library flag
+    maps onto its HOROVOD_* env knob (reference: launch.py:304-476,
+    runner/common/util/config_parser.py set_env_from_args)."""
+    from horovod_tpu.runner.launch import _tuning_env, parse_args
+
+    args = parse_args([
+        "-np", "2",
+        "--fusion-threshold-mb", "32", "--cycle-time-ms", "2.5",
+        "--cache-capacity", "512",
+        "--hierarchical-allreduce", "--no-hierarchical-allgather",
+        "--timeline-filename", "/tmp/tl.json", "--timeline-mark-cycles",
+        "--autotune", "--autotune-log-file", "/tmp/at.csv",
+        "--autotune-warmup-samples", "2",
+        "--autotune-steps-per-sample", "5",
+        "--autotune-bayes-opt-max-samples", "8",
+        "--autotune-gaussian-process-noise", "0.4",
+        "--stall-check-warning-time-seconds", "30",
+        "--stall-check-shutdown-time-seconds", "90",
+        "--thread-affinity", "4",
+        "--log-level", "debug", "--log-with-timestamp",
+        "python", "train.py"])
+    env = _tuning_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_CACHE_CAPACITY"] == "512"
+    assert env["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HOROVOD_HIERARCHICAL_ALLGATHER"] == "0"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HOROVOD_TIMELINE_MARK_CYCLES"] == "1"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_AUTOTUNE_LOG"] == "/tmp/at.csv"
+    assert env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] == "2"
+    assert env["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] == "5"
+    assert env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "8"
+    assert env["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.4"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "30.0"
+    assert env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] == "90.0"
+    assert env["HOROVOD_THREAD_AFFINITY"] == "4"
+    assert env["HOROVOD_LOG_LEVEL"] == "debug"
+    assert env["HOROVOD_LOG_TIMESTAMP"] == "1"
+
+
+def test_stall_check_disable_flag():
+    from horovod_tpu.runner.launch import _tuning_env, parse_args
+
+    args = parse_args(["-np", "2", "--no-stall-check", "python", "t.py"])
+    assert _tuning_env(args)["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+    args = parse_args(["-np", "2", "python", "t.py"])
+    assert "HOROVOD_STALL_CHECK_DISABLE" not in _tuning_env(args)

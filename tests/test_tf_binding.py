@@ -178,3 +178,49 @@ def test_tf_ingraph_collectives():
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
     assert procs.returncode == 0, procs.stdout + procs.stderr
     assert procs.stdout.count("TF_INGRAPH_OK") == 2
+
+
+def test_learning_rate_schedule_callback():
+    """LearningRateScheduleCallback staircase + momentum correction
+    (reference: _keras/callbacks.py:95-176)."""
+    import tensorflow as tf
+
+    from horovod_tpu.keras.callbacks import LearningRateScheduleCallback
+
+    model = tf.keras.Sequential(
+        [tf.keras.Input(shape=(4,)), tf.keras.layers.Dense(1)])
+    opt = tf.keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)
+    model.compile(optimizer=opt, loss="mse")
+    cb = LearningRateScheduleCallback(
+        initial_lr=0.1, multiplier=lambda epoch: 0.1 ** (epoch // 2),
+        staircase=True)
+    x = np.random.RandomState(0).rand(8, 4).astype("float32")
+    y = np.zeros((8, 1), "float32")
+    hist = model.fit(x, y, epochs=4, batch_size=8, verbose=0,
+                     callbacks=[cb])
+    # Epochs 0,1 at 0.1; epochs 2,3 at 0.01 — logged per epoch.
+    np.testing.assert_allclose(hist.history["lr"],
+                               [0.1, 0.1, 0.01, 0.01], rtol=1e-5)
+    # Momentum restored after each batch.
+    assert abs(float(opt.momentum) - 0.9) < 1e-6
+
+
+def test_learning_rate_schedule_callback_window():
+    import tensorflow as tf
+
+    from horovod_tpu.keras.callbacks import LearningRateScheduleCallback
+
+    model = tf.keras.Sequential(
+        [tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    model.compile(optimizer=tf.keras.optimizers.SGD(learning_rate=1.0),
+                  loss="mse")
+    cb = LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=0.5, start_epoch=1, end_epoch=2,
+        momentum_correction=False)
+    x = np.zeros((4, 2), "float32")
+    y = np.zeros((4, 1), "float32")
+    hist = model.fit(x, y, epochs=3, batch_size=4, verbose=0,
+                     callbacks=[cb])
+    # Outside [1,2) the callback leaves the LR alone.
+    np.testing.assert_allclose(hist.history["lr"], [1.0, 0.5, 0.5],
+                               rtol=1e-5)

@@ -79,6 +79,56 @@ def main():
     np.testing.assert_allclose(out.detach().numpy(),
                                expect_mine.detach().numpy(), atol=1e-5)
 
+    # Sparse allreduce: embedding-style sparse grads survive both paths
+    # (reference: test_torch.py sparse variants; mpi_ops.py:515-535).
+    emb = torch.nn.Embedding(10, 4, sparse=True)
+    with torch.no_grad():
+        emb.weight.fill_(0.0)
+    opt = torch.optim.SGD(emb.parameters(), lr=1.0)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=emb.named_parameters())
+    # Each rank touches rows {r, 2}: row 2 is shared, rows 0/1 unique.
+    idx = torch.tensor([r, 2])
+    loss = emb(idx).sum()
+    loss.backward()
+    opt.step()
+    # d(sum)/d(row) = 1 for touched rows; averaged over 2 ranks:
+    # unique rows get 0.5, the shared row gets 1.0. SGD lr=1 subtracts.
+    w = emb.weight.detach()
+    np.testing.assert_allclose(w[2].numpy(), -1.0 * np.ones(4), atol=1e-6)
+    for k in range(n):
+        np.testing.assert_allclose(w[k].numpy(), -0.5 * np.ones(4),
+                                   atol=1e-6)
+    # sparse_as_dense path reduces identically.
+    emb2 = torch.nn.Embedding(10, 4, sparse=True)
+    with torch.no_grad():
+        emb2.weight.fill_(0.0)
+    opt2 = torch.optim.SGD(emb2.parameters(), lr=1.0)
+    opt2 = hvd.DistributedOptimizer(
+        opt2, named_parameters=emb2.named_parameters(),
+        sparse_as_dense=True)
+    emb2(torch.tensor([r, 2])).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(emb2.weight.detach().numpy(),
+                               w.numpy(), atol=1e-6)
+
+    # gradient_predivide_factor is scale-neutral: prescale 1/f and
+    # postscale f must cancel around the average (reference:
+    # optimizer.py:196-200).
+    lin = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        lin.weight.fill_(0.0)
+    opt3 = hvd.DistributedOptimizer(
+        torch.optim.SGD(lin.parameters(), lr=1.0),
+        named_parameters=lin.named_parameters(),
+        gradient_predivide_factor=4.0)
+    xin = torch.full((1, 3), float(r + 1))
+    lin(xin).sum().backward()
+    opt3.step()
+    # grad = x, averaged over ranks: (1+2)/2 = 1.5; lr=1 subtracts.
+    np.testing.assert_allclose(lin.weight.detach().numpy(),
+                               -1.5 * np.ones((1, 3)), atol=1e-6)
+
     hvd.shutdown()
     print("TORCH_OK rank=%d" % r)
     return 0

@@ -1,0 +1,51 @@
+"""np=2 worker: rank-coordinated orbax checkpointing.
+
+Rank 0 writes, everyone barriers, every rank restores the same
+committed step (reference commit discipline: common/elastic.py:60-113
+save/restore; rank-0-only persistence like keras/callbacks.py:151-190).
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.utils.checkpoint import Checkpointer  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    directory = os.environ["HVD_TEST_CKPT_DIR"]
+
+    ck = Checkpointer(directory, max_to_keep=2)
+    state = {"params": {"w": jnp.arange(6.0) + 1},
+             "epoch": np.int64(3)}
+    ck.save(10, state)
+    ck.save(11, {"params": {"w": (jnp.arange(6.0) + 1) * 10},
+                 "epoch": np.int64(4)})
+
+    # Every rank restores the same committed latest step.
+    out = ck.restore()
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]),
+                               (np.arange(6.0) + 1) * 10)
+    assert int(out["epoch"]) == 4
+    assert ck.latest_step() == 11
+    # Agreement across ranks via allreduce of the restored payload.
+    agreed = hvd.allreduce(np.asarray(out["params"]["w"], np.float32),
+                           name="ckpt_agree", op=hvd.Average)
+    np.testing.assert_allclose(agreed, (np.arange(6.0) + 1) * 10)
+
+    hvd.shutdown()
+    print("CKPT_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

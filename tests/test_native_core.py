@@ -70,3 +70,12 @@ def test_native_collectives(np_):
         np_, os.path.join(_REPO, "tests", "native_worker.py"))
     for r, (c, out) in enumerate(zip(codes, outputs)):
         assert c == 0, "rank %d failed:\n%s" % (r, out)
+
+
+def test_dtype_op_matrix():
+    """Exhaustive dtype x op collective matrix + shape-mismatch error
+    (reference discipline: test/parallel/test_torch.py matrices)."""
+    codes, outputs = _launch(2, os.path.join(_REPO, "tests",
+                                             "dtype_matrix_worker.py"))
+    assert codes == [0, 0], "\n".join(outputs)
+    assert sum("DTYPE_MATRIX_OK" in o for o in outputs) == 2

@@ -173,6 +173,17 @@ def broadcast(tensor, root_rank, name=None,
 def alltoall(tensor, splits=None, name=None,
              process_set=global_process_set):
     name = name or "HorovodAlltoall"
+    if splits is None and _use_ingraph(process_set):
+        # Uniform split: in-graph TF collective. Ragged (explicit
+        # splits) stays host-bridged, mirroring the in-graph XLA path's
+        # static-shape contract (ops/collective_ops.py alltoall).
+        from horovod_tpu.tensorflow import ingraph
+
+        t = tf.convert_to_tensor(tensor)
+        out = ingraph.alltoall(t, name)
+        n = basics.size()
+        rsplits = tf.fill([n], tf.shape(t)[0] // n)
+        return out, rsplits
     out, rsplits = eager.synchronize(eager.alltoall_async(
         np.asarray(tensor),
         None if splits is None else np.asarray(splits), name=name,

@@ -232,6 +232,21 @@ def allgather(x, name: str):
     return tf.boolean_mask(gathered, keep)
 
 
+def alltoall(x, name: str):
+    """Uniform all-to-all: scatter equal dim-0 slices to all ranks,
+    concatenate received slices along dim 0 (reference:
+    HorovodAlltoallOp, tensorflow/mpi_ops.cc:1049+; ragged splits stay
+    on the host-bridged path — TF's collective is uniform-only, like
+    the in-graph XLA path)."""
+    return tf.raw_ops.CollectiveAllToAllV2(
+        input=x,
+        group_size=tf.constant(_state["size"]),
+        group_key=tf.constant(_GROUP_KEY),
+        instance_key=tf.constant(next(_key_counter)),
+        ordering_token=[],
+        communication_hint="auto")
+
+
 def broadcast(x, root_rank: int, name: str):
     """Overwrite with root's value
     (reference: HorovodBroadcastOp, tensorflow/mpi_ops.cc:736-832)."""

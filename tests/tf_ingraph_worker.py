@@ -42,6 +42,13 @@ def main():
     bc = hvd.broadcast(tf.constant([float(r) + 7.0]), root_rank=1,
                        name="ig_bcast")
     np.testing.assert_allclose(bc.numpy(), [8.0])
+    # Uniform alltoall in-graph: row k of each rank lands on rank k.
+    a2a, rsplits = hvd.alltoall(
+        tf.constant([[float(r * 10)], [float(r * 10 + 1)]]),
+        name="ig_a2a")
+    np.testing.assert_allclose(a2a.numpy().ravel(),
+                               [float(r), float(10 + r)])
+    np.testing.assert_array_equal(rsplits.numpy(), [1, 1])
 
     from horovod_tpu.tensorflow import ingraph
 

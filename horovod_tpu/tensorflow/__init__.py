@@ -195,6 +195,11 @@ def alltoall(tensor, splits=None, name=None,
 def reducescatter(tensor, op=Sum, name=None,
                   process_set=global_process_set):
     name = name or "HorovodReducescatter"
+    if op in (Average, Sum) and _use_ingraph(process_set):
+        from horovod_tpu.tensorflow import ingraph
+
+        return ingraph.reducescatter(tf.convert_to_tensor(tensor), name,
+                                     op_is_average=(op == Average))
     out = eager.synchronize(eager.reducescatter_async(
         np.asarray(tensor), name=name, op=op, process_set=process_set))
     return tf.convert_to_tensor(np.asarray(out))

@@ -247,6 +247,26 @@ def alltoall(x, name: str):
         communication_hint="auto")
 
 
+def reducescatter(x, name: str, op_is_average: bool = False):
+    """Reduce across ranks and scatter equal dim-0 shards
+    (reference: the reducescatter surface of ops/eager.py; TF op:
+    CollectiveReduceScatterV2)."""
+    # CollectiveReduceScatterV2 only has an NCCL implementation in TF's
+    # registry ("auto" resolves to no CPU/gRPC kernel), so compose it:
+    # reduce then slice out this rank's dim-0 shard — both in-graph.
+    reduced = _collective_reduce(x, next(_key_counter))
+    n = _state["size"]
+    shard = tf.shape(reduced)[0] // n
+    out = tf.slice(
+        reduced,
+        tf.concat([[basics.rank() * shard],
+                   tf.zeros([tf.rank(reduced) - 1], tf.int32)], axis=0),
+        tf.concat([[shard], tf.shape(reduced)[1:]], axis=0))
+    if op_is_average:
+        out = out / tf.cast(_state["size"], out.dtype)
+    return out
+
+
 def broadcast(x, root_rank: int, name: str):
     """Overwrite with root's value
     (reference: HorovodBroadcastOp, tensorflow/mpi_ops.cc:736-832)."""

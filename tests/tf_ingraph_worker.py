@@ -42,6 +42,11 @@ def main():
     bc = hvd.broadcast(tf.constant([float(r) + 7.0]), root_rank=1,
                        name="ig_bcast")
     np.testing.assert_allclose(bc.numpy(), [8.0])
+    # Reducescatter in-graph: sum across ranks, shard dim 0.
+    rs = hvd.reducescatter(
+        tf.constant([[1.0 * (r + 1)], [2.0 * (r + 1)]]), op=hvd.Sum,
+        name="ig_rs")
+    np.testing.assert_allclose(rs.numpy().ravel(), [3.0 * (r + 1)])
     # Uniform alltoall in-graph: row k of each rank lands on rank k.
     a2a, rsplits = hvd.alltoall(
         tf.constant([[float(r * 10)], [float(r * 10 + 1)]]),

@@ -79,3 +79,13 @@ def test_dtype_op_matrix():
                                              "dtype_matrix_worker.py"))
     assert codes == [0, 0], "\n".join(outputs)
     assert sum("DTYPE_MATRIX_OK" in o for o in outputs) == 2
+
+
+def test_cache_eviction_under_tiny_capacity():
+    """12 live names vs capacity 4: constant LRU eviction +
+    renegotiation must stay exact and never wedge."""
+    codes, outputs = _launch(
+        2, os.path.join(_REPO, "tests", "cache_evict_worker.py"),
+        extra_env={"HOROVOD_CACHE_CAPACITY": "4"})
+    assert codes == [0, 0], "\n".join(outputs)
+    assert sum("CACHE_EVICT_OK" in o for o in outputs) == 2

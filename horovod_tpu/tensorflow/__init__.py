@@ -93,6 +93,26 @@ def allreduce(tensor, average=None, op=None, name=None,
     op = eager._effective_op(op, average)
     name = name or "HorovodAllreduce"
 
+    if isinstance(tensor, tf.IndexedSlices):
+        # Sparse gradients reduce by allgathering (values, indices);
+        # summation happens implicitly when the IndexedSlices are
+        # applied (reference: tensorflow/__init__.py:55-162 IndexedSlices
+        # branch — same allgather construction).
+        if op not in (Average, Sum):
+            raise NotImplementedError(
+                "IndexedSlices allreduce supports Sum/Average only")
+        values = allgather(tensor.values, name=name + ".values",
+                           process_set=process_set)
+        indices = allgather(tensor.indices, name=name + ".indices",
+                            process_set=process_set)
+        if op == Average:
+            n = (len(process_set.ranks)
+                 if getattr(process_set, "process_set_id", 0) != 0
+                 else basics.size())
+            values = values / tf.cast(n, values.dtype)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
     if op in (Average, Sum) and _use_ingraph(process_set):
         from horovod_tpu.tensorflow import ingraph
 
@@ -311,24 +331,44 @@ def DistributedOptimizer(optimizer, op=Average, name=None,
 
     base = optimizer.__class__
 
+    def _prep(g):
+        """sparse_as_dense densifies IndexedSlices before the reduce
+        (reference: tensorflow/__init__.py DistributedOptimizer
+        sparse_as_dense)."""
+        if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+            return tf.convert_to_tensor(g)
+        return g
+
     def _allreduce_list(grads):
         """Allreduce a gradient list, passing None entries through.
-        Falls back to per-tensor allreduce (graph-safe via
-        tf.numpy_function) when not executing eagerly."""
+        IndexedSlices take the sparse allgather path; dense tensors go
+        grouped (eager) or per-tensor (graph)."""
         if basics.size() <= 1:
             return list(grads)
-        not_none = [g for g in grads if g is not None]
-        if tf.executing_eagerly():
-            reduced = grouped_allreduce(not_none, op=op,
-                                        name="DistributedOptimizer",
-                                        process_set=process_set)
-        else:
-            reduced = [allreduce(g, op=op,
-                                 name="DistributedOptimizer.%d" % i,
-                                 process_set=process_set)
-                       for i, g in enumerate(not_none)]
-        it = iter(reduced)
-        return [None if g is None else next(it) for g in grads]
+        grads = [None if g is None else _prep(g) for g in grads]
+        out = list(grads)
+        dense_idx = [i for i, g in enumerate(grads)
+                     if g is not None
+                     and not isinstance(g, tf.IndexedSlices)]
+        for i, g in enumerate(grads):
+            if g is not None and isinstance(g, tf.IndexedSlices):
+                out[i] = allreduce(g, op=op,
+                                   name="DistributedOptimizer.%d" % i,
+                                   process_set=process_set)
+        dense = [grads[i] for i in dense_idx]
+        if dense:
+            if tf.executing_eagerly():
+                reduced = grouped_allreduce(
+                    dense, op=op, name="DistributedOptimizer",
+                    process_set=process_set)
+            else:
+                reduced = [allreduce(g, op=op,
+                                     name="DistributedOptimizer.%d" % i,
+                                     process_set=process_set)
+                           for i, g in zip(dense_idx, dense)]
+            for i, g in zip(dense_idx, reduced):
+                out[i] = g
+        return out
 
     agg_helper = None
     if backward_passes_per_step > 1:

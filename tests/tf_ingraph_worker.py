@@ -95,6 +95,23 @@ def main():
     assert any("Collective" in t for t in graph_ops), \
         "no collective op in the traced graph: %s" % sorted(graph_ops)
 
+    # Sparse (IndexedSlices) gradients: embedding rows reduce via the
+    # allgather path; rows touched by both ranks accumulate.
+    emb = tf.keras.layers.Embedding(8, 2, embeddings_initializer="zeros")
+    emb.build(None)
+    sopt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0), op=hvd.Average)
+    with tf.GradientTape() as tape:
+        # Rank r touches rows {r, 3}; row 3 is shared.
+        sloss = tf.reduce_sum(emb(tf.constant([r, 3])))
+    sgrads = tape.gradient(sloss, emb.trainable_variables)
+    assert isinstance(sgrads[0], tf.IndexedSlices), type(sgrads[0])
+    sopt.apply_gradients(zip(sgrads, emb.trainable_variables))
+    w_emb = emb.embeddings.numpy()
+    np.testing.assert_allclose(w_emb[3], -1.0 * np.ones(2), atol=1e-6)
+    for k in (0, 1):
+        np.testing.assert_allclose(w_emb[k], -0.5 * np.ones(2), atol=1e-6)
+
     # Ranks trained on different data; averaged gradients must keep
     # weights bit-identical across ranks.
     w = model.trainable_variables[0].numpy().ravel()

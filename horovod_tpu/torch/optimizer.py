@@ -148,6 +148,105 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Delta-Adasum: run the local optimizer step per parameter inside
+    the backward hook, allreduce the resulting parameter *delta* with
+    op=Adasum (orthogonality-weighted merge), and apply the combined
+    delta to the synchronized start point (reference:
+    horovod/torch/optimizer.py:335-503 _DistributedAdasumOptimizer —
+    same stash-groups/step-one-param/delta trick)."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                ("allreduce.noname.%s.%s" % (i, j), v)
+                for i, pg in enumerate(self.param_groups)
+                for j, v in enumerate(pg["params"])]
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self.backward_passes_per_step = backward_passes_per_step
+        self._passes_done = {}
+        self._handles = {}
+        self._requires_update = set()
+        # The agreed model state deltas apply to; updated by step().
+        self._starting_models = {
+            p: torch.zeros_like(p, requires_grad=False)
+            for _, p in named_parameters}
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._passes_done[p] = 0
+                    p.register_post_accumulate_grad_hook(
+                        self._make_hook(p))
+
+    def _make_hook(self, p):
+        def hook(param):
+            self._passes_done[p] += 1
+            if self._passes_done[p] == self.backward_passes_per_step:
+                self._handles[p] = self._allreduce_delta_async(p)
+
+        return hook
+
+    def _allreduce_delta_async(self, p):
+        name = self._parameter_names.get(p)
+        start = self._starting_models[p]
+        # Step ONLY p through the underlying optimizer, then turn the
+        # result into a delta from the agreed start point.
+        stashed = []
+        for group in self.param_groups:
+            stashed.append(group["params"])
+            group["params"] = ([p] if any(p is v
+                                          for v in group["params"])
+                               else [])
+        start.data.copy_(p)
+        super(self.__class__, self).step()
+        p.data.sub_(start)
+        compressed, ctx = self._compression.compress(p)
+        # .data: the in-place reduce writes through detached storage,
+        # not the autograd leaf (reference: optimizer.py:438-439).
+        handle = mpi_ops.allreduce_async_(
+            compressed.data, name=name, op=mpi_ops.Adasum)
+        for st, group in zip(stashed, self.param_groups):
+            group["params"] = st
+        return handle, ctx
+
+    def synchronize(self):  # parity: reference's is a no-op too
+        pass
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        raise AssertionError(
+            "Skipping synchronization is not supported when using "
+            "Adasum optimizer.")
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+        for p in self._requires_update - set(self._handles):
+            self._handles[p] = self._allreduce_delta_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            delta = self._compression.decompress(
+                mpi_ops.synchronize(handle), ctx)
+            start = self._starting_models[p]
+            start.data.add_(delta.data)
+            p.data.copy_(start)
+            self._passes_done[p] = 0
+        self._handles.clear()
+        return loss
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step(); this is prohibited with "
+                "the Adasum optimizer.")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          op=mpi_ops.Average,
@@ -157,7 +256,16 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          process_set=global_process_set):
     """Wrap a torch optimizer so gradients are allreduced during backward
     (reference: horovod/torch/optimizer.py:528-590; sparse gradients
-    via allgather or densified with ``sparse_as_dense``)."""
+    via allgather or densified with ``sparse_as_dense``; op=Adasum uses
+    the delta algorithm, reference :335-503)."""
+    if op == mpi_ops.Adasum:
+        if process_set is not global_process_set:
+            raise NotImplementedError(
+                "Adasum optimizer runs on the global process set")
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_DistributedAdasumOptimizer.__dict__))
+        return cls(optimizer.param_groups, named_parameters, compression,
+                   backward_passes_per_step)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression, op,

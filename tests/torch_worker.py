@@ -129,6 +129,30 @@ def main():
     np.testing.assert_allclose(lin.weight.detach().numpy(),
                                -1.5 * np.ones((1, 3)), atol=1e-6)
 
+    # Delta-Adasum optimizer (reference: optimizer.py:335-503): with
+    # identical data on both ranks the adasum merge of two identical
+    # deltas is that delta, so training matches single-process SGD.
+    torch.manual_seed(99)
+    ada = torch.nn.Linear(3, 1, bias=False)
+    ref = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        ref.weight.copy_(ada.weight)
+    opt_ada = hvd.DistributedOptimizer(
+        torch.optim.SGD(ada.parameters(), lr=0.1),
+        named_parameters=ada.named_parameters(), op=hvd.Adasum)
+    opt_ref = torch.optim.SGD(ref.parameters(), lr=0.1)
+    xa = torch.tensor([[1.0, 2.0, 3.0], [0.5, -1.0, 2.0]])
+    ya = torch.tensor([[1.0], [0.0]])
+    for _ in range(3):
+        opt_ada.zero_grad()
+        torch.nn.functional.mse_loss(ada(xa), ya).backward()
+        opt_ada.step()
+        opt_ref.zero_grad()
+        torch.nn.functional.mse_loss(ref(xa), ya).backward()
+        opt_ref.step()
+    np.testing.assert_allclose(ada.weight.detach().numpy(),
+                               ref.weight.detach().numpy(), atol=1e-5)
+
     hvd.shutdown()
     print("TORCH_OK rank=%d" % r)
     return 0

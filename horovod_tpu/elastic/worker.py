@@ -57,6 +57,13 @@ def reinit_for_version(min_version: int):
     worker's slot is not part of the new world."""
     from horovod_tpu.runner.http_server import read_kv
 
+    # The TF in-graph collective runtime (if this job booted it) points
+    # at the OLD world's gRPC cluster and cannot re-bootstrap in-process
+    # (TF configures collective ops once per process): clear its state
+    # so post-reset collectives take the host-bridged path instead of a
+    # dead cluster.
+    if "horovod_tpu.tensorflow.ingraph" in sys.modules:
+        sys.modules["horovod_tpu.tensorflow.ingraph"].shutdown()
     basics.shutdown()
     meta = _poll_meta(min_version)
     addr, port = _rendezvous()
@@ -83,6 +90,18 @@ def reinit_for_version(min_version: int):
         "HOROVOD_RENDEZVOUS_VERSION": str(meta["version"]),
     })
     basics.init()
+    # Fresh workers spawned into the new world run the TF binding's
+    # init (which enters the in-graph pre-flight allreduce); survivors
+    # must join that pre-flight too or the new workers block in it
+    # forever. A survivor's TF context is already live, so its vote is
+    # "no" and the whole new world lands on the host-bridged path
+    # consistently.
+    if "horovod_tpu.tensorflow" in sys.modules and basics.size() > 1:
+        try:
+            sys.modules["horovod_tpu.tensorflow.ingraph"] \
+                .init_collective_runtime()
+        except Exception:  # pragma: no cover - defensive
+            pass
     return meta["version"]
 
 

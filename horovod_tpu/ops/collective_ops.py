@@ -196,17 +196,23 @@ def allgather(x, *, axis=DATA_AXIS, process_set=None):
 
 
 def broadcast(x, root_rank: int = 0, *, axis=DATA_AXIS, process_set=None):
-    """Broadcast the value from ``root_rank`` (set-relative when a
-    process_set is given) to every rank on the axis.
+    """Broadcast the value from ``root_rank`` to every rank on the axis.
+
+    ``root_rank`` is the GLOBAL rank, process set or not, and must be a
+    member of the set — the reference's contract (its coordinator
+    errors with "broadcast root not in process set", matching the
+    native path here).
 
     Implemented as a masked psum — adding exact zeros from non-root ranks —
     which XLA lowers to a single all-reduce on ICI; exact for all dtypes.
     """
     groups = _groups_for(process_set, _axis_size(axis))
     if process_set is not None and groups is not None:
-        root_global = process_set.ranks[root_rank]
-    else:
-        root_global = root_rank
+        if root_rank not in process_set.ranks:
+            raise ValueError(
+                "broadcast root %d not in process set %r"
+                % (root_rank, list(process_set.ranks)))
+    root_global = root_rank
     idx = lax.axis_index(axis)
     orig_dtype = x.dtype
     xf = x

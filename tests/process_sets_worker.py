@@ -1,0 +1,75 @@
+"""np=4 worker: concurrent collectives on disjoint process sets.
+
+Reference pattern: test/parallel/test_process_sets_static.py — two
+disjoint sets run different collectives at the same time, values stay
+set-local, dynamic add/remove keeps working, and the global set is
+usable throughout.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 4
+
+    evens = hvd.add_process_set(hvd.ProcessSet([0, 2]))
+    odds = hvd.add_process_set(hvd.ProcessSet([1, 3]))
+    mine = evens if r % 2 == 0 else odds
+    peer_vals = ([0, 2] if r % 2 == 0 else [1, 3])
+
+    # Different ops on the two sets, concurrently, repeatedly.
+    for it in range(8):
+        out = hvd.allreduce(np.full(16, float(r + it), np.float32),
+                            name="ps.sum.%d" % it, op=hvd.Sum,
+                            process_set=mine)
+        np.testing.assert_allclose(
+            out, float(sum(v + it for v in peer_vals)))
+        g = hvd.allgather(np.full((1, 2), float(r), np.float32),
+                          name="ps.gather.%d" % it, process_set=mine)
+        np.testing.assert_allclose(g[:, 0], [float(v)
+                                             for v in peer_vals])
+
+    # Global collectives interleave with set-local ones.
+    out = hvd.allreduce(np.full(8, 1.0, np.float32), name="glob.sum",
+                        op=hvd.Sum)
+    np.testing.assert_allclose(out, float(n))
+
+    # Broadcast root is a GLOBAL rank and must be in the set
+    # (reference contract; the native core errors otherwise).
+    out = hvd.broadcast(np.full(4, float(r), np.float32),
+                        root_rank=peer_vals[1],
+                        name="ps.bcast", process_set=mine)
+    np.testing.assert_allclose(out, float(peer_vals[1]))
+
+    # Dynamic removal + re-add under a different membership.
+    hvd.remove_process_set(evens)
+    hvd.remove_process_set(odds)
+    trio = hvd.add_process_set(hvd.ProcessSet([0, 1, 2]))
+    if r in (0, 1, 2):
+        out = hvd.allreduce(np.full(4, float(r), np.float32),
+                            name="trio.sum", op=hvd.Sum,
+                            process_set=trio)
+        np.testing.assert_allclose(out, 3.0)
+    hvd.remove_process_set(trio)
+
+    out = hvd.allreduce(np.full(4, 2.0, np.float32), name="glob.final",
+                        op=hvd.Average)
+    np.testing.assert_allclose(out, 2.0)
+
+    hvd.shutdown()
+    print("PROCESS_SETS_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

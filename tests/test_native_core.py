@@ -89,3 +89,12 @@ def test_cache_eviction_under_tiny_capacity():
         extra_env={"HOROVOD_CACHE_CAPACITY": "4"})
     assert codes == [0, 0], "\n".join(outputs)
     assert sum("CACHE_EVICT_OK" in o for o in outputs) == 2
+
+
+def test_process_sets_np4():
+    """Concurrent disjoint process sets at np=4 (reference:
+    test_process_sets_static.py discipline)."""
+    codes, outputs = _launch(
+        4, os.path.join(_REPO, "tests", "process_sets_worker.py"))
+    assert codes == [0, 0, 0, 0], "\n".join(outputs)
+    assert sum("PROCESS_SETS_OK" in o for o in outputs) == 4

@@ -97,11 +97,13 @@ def reinit_for_version(min_version: int):
     # "no" and the whole new world lands on the host-bridged path
     # consistently.
     if "horovod_tpu.tensorflow" in sys.modules and basics.size() > 1:
-        try:
-            sys.modules["horovod_tpu.tensorflow.ingraph"] \
-                .init_collective_runtime()
-        except Exception:  # pragma: no cover - defensive
-            pass
+        # Import (not a sys.modules lookup: the submodule may not be
+        # loaded yet on a survivor that was size 1 before) and let
+        # failures raise — a swallowed pre-flight is exactly the
+        # one-sided divergence the protocol forbids.
+        from horovod_tpu.tensorflow import ingraph
+
+        ingraph.init_collective_runtime()
     return meta["version"]
 
 

@@ -97,19 +97,25 @@ def allreduce(tensor, average=None, op=None, name=None,
         # Sparse gradients reduce by allgathering (values, indices);
         # summation happens implicitly when the IndexedSlices are
         # applied (reference: tensorflow/__init__.py:55-162 IndexedSlices
-        # branch — same allgather construction).
+        # branch — same allgather construction). The host-bridged
+        # allgather cannot take symbolic tensors, so without the
+        # in-graph runtime the slices densify first (the reference's
+        # sparse_as_dense fallback).
         if op not in (Average, Sum):
             raise NotImplementedError(
                 "IndexedSlices allreduce supports Sum/Average only")
+        if not _use_ingraph(process_set):
+            return allreduce(
+                tf.convert_to_tensor(tensor), op=op, name=name,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set)
         values = allgather(tensor.values, name=name + ".values",
                            process_set=process_set)
         indices = allgather(tensor.indices, name=name + ".indices",
                             process_set=process_set)
         if op == Average:
-            n = (len(process_set.ranks)
-                 if getattr(process_set, "process_set_id", 0) != 0
-                 else basics.size())
-            values = values / tf.cast(n, values.dtype)
+            values = values / tf.cast(process_set.size(), values.dtype)
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
 

@@ -254,14 +254,20 @@ def reducescatter(x, name: str, op_is_average: bool = False):
     # CollectiveReduceScatterV2 only has an NCCL implementation in TF's
     # registry ("auto" resolves to no CPU/gRPC kernel), so compose it:
     # reduce then slice out this rank's dim-0 shard — both in-graph.
+    # Shard math matches the native core's uneven split (ranks below
+    # rows % n take one extra row), so the two paths agree on any size.
     reduced = _collective_reduce(x, next(_key_counter))
     n = _state["size"]
-    shard = tf.shape(reduced)[0] // n
+    r = basics.rank()
+    rows = tf.shape(reduced)[0]
+    base, extra = rows // n, rows % n
+    my_rows = base + tf.cast(r < extra, tf.int32)
+    offset = r * base + tf.minimum(r, extra)
     out = tf.slice(
         reduced,
-        tf.concat([[basics.rank() * shard],
+        tf.concat([[offset],
                    tf.zeros([tf.rank(reduced) - 1], tf.int32)], axis=0),
-        tf.concat([[shard], tf.shape(reduced)[1:]], axis=0))
+        tf.concat([[my_rows], tf.shape(reduced)[1:]], axis=0))
     if op_is_average:
         out = out / tf.cast(_state["size"], out.dtype)
     return out

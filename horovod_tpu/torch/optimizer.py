@@ -262,6 +262,15 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         if process_set is not global_process_set:
             raise NotImplementedError(
                 "Adasum optimizer runs on the global process set")
+        if gradient_predivide_factor != 1.0:
+            # Reference: gradient_predivide_factor is Average-only
+            # (optimizer.py:567-570 raises the same way).
+            raise ValueError(
+                "gradient_predivide_factor not supported with "
+                "op=Adasum")
+        if sparse_as_dense:
+            raise ValueError(
+                "sparse_as_dense not supported with op=Adasum")
         cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                    dict(_DistributedAdasumOptimizer.__dict__))
         return cls(optimizer.param_groups, named_parameters, compression,

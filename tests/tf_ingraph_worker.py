@@ -47,6 +47,13 @@ def main():
         tf.constant([[1.0 * (r + 1)], [2.0 * (r + 1)]]), op=hvd.Sum,
         name="ig_rs")
     np.testing.assert_allclose(rs.numpy().ravel(), [3.0 * (r + 1)])
+    # Uneven dim 0 (3 rows over 2 ranks): rank 0 takes rows 0-1,
+    # rank 1 takes row 2 — the native core's shard math.
+    rs3 = hvd.reducescatter(
+        tf.constant([[1.0], [2.0], [3.0]]) * (r + 1), op=hvd.Sum,
+        name="ig_rs_uneven")
+    expect = [3.0, 6.0] if r == 0 else [9.0]
+    np.testing.assert_allclose(rs3.numpy().ravel(), expect)
     # Uniform alltoall in-graph: row k of each rank lands on rank k.
     a2a, rsplits = hvd.alltoall(
         tf.constant([[float(r * 10)], [float(r * 10 + 1)]]),

@@ -38,6 +38,11 @@ TIER2_COVERAGE = {
         "tests/test_spark_estimators.py::test_lightning_estimator_fit_predict",
     "test_scaling_harness_runs_fresh":
         "tests/test_scaling.py::test_scaling_harness_smoke",
+    # np=2/3 process-set negotiation incl. dynamic add/remove runs in
+    # tier 1 via native_worker.py; np=4 concurrency is the heavyweight
+    # variant.
+    "test_process_sets_np4":
+        "tests/test_native_core.py::test_native_collectives",
 }
 
 

@@ -91,6 +91,7 @@ def test_cache_eviction_under_tiny_capacity():
     assert sum("CACHE_EVICT_OK" in o for o in outputs) == 2
 
 
+@pytest.mark.tier2
 def test_process_sets_np4():
     """Concurrent disjoint process sets at np=4 (reference:
     test_process_sets_static.py discipline)."""

@@ -46,13 +46,23 @@ TIER2_COVERAGE = {
 }
 
 
+_collect_cache = {}
+
+
 def _collect(args):
+    # Each collection subprocess pays a full jax+tf import (~15s);
+    # both tests reuse the same three arg-sets, so memoize.
+    key = tuple(args)
+    if key in _collect_cache:
+        return _collect_cache[key]
     out = subprocess.run(
         [sys.executable, "-m", "pytest", "--collect-only", "-q",
          "-p", "no:cacheprovider"] + args,
         cwd=_REPO, capture_output=True, text=True, timeout=120)
     assert out.returncode in (0, 5), out.stdout + out.stderr
-    return [ln for ln in out.stdout.splitlines() if "::" in ln]
+    result = [ln for ln in out.stdout.splitlines() if "::" in ln]
+    _collect_cache[key] = result
+    return result
 
 
 def test_tier_partition_is_complete_and_disjoint():

@@ -16,7 +16,7 @@ import numpy as np
 import tensorflow as tf
 
 import horovod_tpu.tensorflow as hvd
-import horovod_tpu.elastic as elastic
+from horovod_tpu.tensorflow import elastic
 from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
 
 

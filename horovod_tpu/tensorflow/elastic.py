@@ -13,8 +13,29 @@ import numpy as np
 import tensorflow as tf
 
 from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import HorovodInternalError
 from horovod_tpu.elastic.state import ObjectState
-from horovod_tpu.elastic import run  # noqa: F401  (re-export for hvd.elastic.run)
+from horovod_tpu.elastic import run as _base_run
+
+
+def run(func):
+    """TF-flavored elastic run: translates collective-runtime aborts
+    (a peer died and TF's gRPC cluster tore the op down) into
+    HorovodInternalError so the restore/rejoin loop handles them like
+    core failures (reference: tensorflow/elastic.py:51-60 translates
+    UnknownError from Horovod ops the same way)."""
+
+    def translated(state, *args, **kwargs):
+        try:
+            return func(state, *args, **kwargs)
+        except (tf.errors.UnavailableError, tf.errors.InternalError,
+                tf.errors.UnknownError) as e:
+            msg = str(e)
+            if "Collective" in msg or "collective" in msg:
+                raise HorovodInternalError(msg) from e
+            raise
+
+    return _base_run(translated)
 
 
 class TensorFlowState(ObjectState):

@@ -224,3 +224,56 @@ def test_learning_rate_schedule_callback_window():
     # Outside [1,2) the callback leaves the LR alone.
     np.testing.assert_allclose(hist.history["lr"], [1.0, 0.5, 0.5],
                                rtol=1e-5)
+
+
+def test_tf_elastic_run_translates_collective_aborts():
+    """Collective-runtime aborts become HorovodInternalError so the
+    elastic restore loop catches them (reference:
+    tensorflow/elastic.py:51-60)."""
+    import tensorflow as tf
+
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.tensorflow import elastic as tf_elastic
+
+    calls = {"n": 0}
+
+    class _State:
+        _known_version = 0
+
+        def sync(self):
+            pass
+
+        def restore(self):
+            pass
+
+        def on_reset(self):
+            pass
+
+    @tf_elastic.run
+    def train(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise tf.errors.UnavailableError(
+                None, None, "Collective ops is aborted by: Socket closed")
+        return "done"
+
+    # First call raises the translated error into the elastic loop,
+    # which triggers reinit; intercept reinit to avoid a real
+    # rendezvous and simply let the retry succeed.
+    import horovod_tpu.elastic.worker as worker_mod
+
+    orig = worker_mod.reinit_for_version
+    worker_mod.reinit_for_version = lambda v: v
+    try:
+        assert train(_State()) == "done"
+    finally:
+        worker_mod.reinit_for_version = orig
+    assert calls["n"] == 2
+
+    # Non-collective TF errors pass through untranslated.
+    @tf_elastic.run
+    def boom(state):
+        raise tf.errors.InternalError(None, None, "some other failure")
+
+    with pytest.raises(tf.errors.InternalError):
+        boom(_State())

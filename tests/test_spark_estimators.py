@@ -307,3 +307,33 @@ def test_unpack_optimizers_forms():
         {"optimizer": opt,
          "lr_scheduler": {"scheduler": sched}}) == (opt, [sched])
     assert _unpack_optimizers({"optimizer": opt}) == (opt, [])
+
+
+def test_keras_estimator_user_callbacks(tmp_path):
+    """User callbacks (incl. LR schedules) ship to the training ranks
+    and run inside fit (reference: spark/keras/remote.py callback
+    plumbing)."""
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.keras.callbacks import LearningRateScheduleCallback
+    from horovod_tpu.spark.keras import KerasEstimator
+
+    model = tf.keras.Sequential(
+        [tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model,
+        optimizer=tf.keras.optimizers.SGD(learning_rate=0.1),
+        loss="mse",
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=32, epochs=4, verbose=0,
+        callbacks=[LearningRateScheduleCallback(
+            initial_lr=0.1, multiplier=lambda e: 0.5 ** e)],
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(128))
+    # The schedule logged a decaying lr every epoch.
+    lrs = fitted.history["lr"]
+    assert len(lrs) == 4
+    assert lrs[0] > lrs[-1]
+    np.testing.assert_allclose(lrs, [0.1 * 0.5 ** e for e in range(4)],
+                               rtol=1e-5)

@@ -129,6 +129,21 @@ def main():
     np.testing.assert_allclose(lin.weight.detach().numpy(),
                                -1.5 * np.ones((1, 3)), atol=1e-6)
 
+    # fp16 gradient compression: reduce in half precision, decompress
+    # back (reference: torch/compression.py:20-74); small magnitudes
+    # keep ~1e-3 fidelity.
+    lin16 = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        lin16.weight.fill_(0.0)
+    optc = hvd.DistributedOptimizer(
+        torch.optim.SGD(lin16.parameters(), lr=1.0),
+        named_parameters=lin16.named_parameters(),
+        compression=hvd.Compression.fp16)
+    lin16(torch.full((1, 3), float(r + 1))).sum().backward()
+    optc.step()
+    np.testing.assert_allclose(lin16.weight.detach().numpy(),
+                               -1.5 * np.ones((1, 3)), atol=1e-3)
+
     # Delta-Adasum optimizer (reference: optimizer.py:335-503): with
     # identical data on both ranks the adasum merge of two identical
     # deltas is that delta, so training matches single-process SGD.

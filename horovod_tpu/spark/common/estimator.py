@@ -125,6 +125,9 @@ class HorovodEstimator(EstimatorParams):
     def fit(self, df) -> "HorovodModel":
         """Materialize ``df``, train across the backend's ranks, return
         the fitted model."""
+        from horovod_tpu.spark.common import util
+
+        util.check_validation(self.validation)
         self._validate_fit()
         store = self._store()
         run_id = self.run_id or ("run_" + uuid.uuid4().hex[:12])
@@ -132,6 +135,17 @@ class HorovodEstimator(EstimatorParams):
         materialize_dataframe(df, data_path, validation=self.validation)
         if hasattr(store, "make_run_dirs"):
             store.make_run_dirs(run_id)
+        # Dataset metadata rides with the run: row counts size shards,
+        # the schema gates against silent drift (reference:
+        # spark/common/util.py get_simple_meta_from_parquet +
+        # estimator metadata compatibility checks).
+        rows, metadata, avg_row_size = util.get_metadata_from_parquet(
+            data_path, label_columns=self.label_cols,
+            feature_columns=self.feature_cols)
+        self._dataset_rows = rows
+        self._dataset_avg_row_size = avg_row_size
+        if hasattr(store, "get_run_path"):
+            util.save_metadata(store.get_run_path(run_id), metadata)
         remote_store = store.to_remote(run_id)
         train_fn = self._train_fn(remote_store)
         backend = self._backend()

@@ -337,3 +337,65 @@ def test_keras_estimator_user_callbacks(tmp_path):
     assert lrs[0] > lrs[-1]
     np.testing.assert_allclose(lrs, [0.1 * 0.5 ** e for e in range(4)],
                                rtol=1e-5)
+
+
+def test_metadata_utils(tmp_path):
+    """Parquet metadata inference + schema-drift gate (reference:
+    spark/common/util.py get_simple_meta_from_parquet +
+    _check_metadata_compatibility)."""
+    from horovod_tpu.spark.common import util
+    from horovod_tpu.spark.common.estimator import materialize_dataframe
+
+    path = str(tmp_path / "data")
+    materialize_dataframe(_toy_pdf(64), path)
+    rows, meta, avg = util.get_metadata_from_parquet(
+        path, label_columns=["y"], feature_columns=["x1", "x2"])
+    assert rows == 64
+    assert set(meta) == {"x1", "x2", "y"}
+    assert meta["x1"]["dtype"] == "double"
+    assert avg > 0
+
+    with pytest.raises(ValueError, match="label column"):
+        util.get_metadata_from_parquet(path, label_columns=["nope"])
+
+    util.save_metadata(str(tmp_path / "run"), meta)
+    assert util.load_metadata(str(tmp_path / "run")) == meta
+    util.check_metadata_compatibility(meta, meta)
+    drifted = {k: dict(v) for k, v in meta.items()}
+    drifted["x1"]["dtype"] = "int64"
+    with pytest.raises(ValueError, match="changed dtype"):
+        util.check_metadata_compatibility(meta, drifted)
+    with pytest.raises(ValueError, match="schema changed"):
+        util.check_metadata_compatibility(meta, {"x1": meta["x1"]})
+
+
+def test_check_validation():
+    from horovod_tpu.spark.common import util
+
+    util.check_validation(None)
+    util.check_validation(0.25)
+    util.check_validation("is_val")
+    with pytest.raises(ValueError):
+        util.check_validation(1.5)
+    with pytest.raises(ValueError):
+        util.check_validation("")
+    with pytest.raises(ValueError):
+        util.check_validation([0.2])
+
+
+def test_estimator_persists_metadata(tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.common import util
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=1, verbose=0,
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(32))
+    assert est._dataset_rows == 32
+    meta = util.load_metadata(
+        os.path.join(str(tmp_path / "store"), "runs", fitted.run_id))
+    assert meta is not None and "y" in meta

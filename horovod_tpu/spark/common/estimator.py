@@ -31,18 +31,26 @@ def _is_spark_df(df) -> bool:
     return mod.startswith("pyspark.")
 
 
+VALIDATION_COL = "__validation__"
+
+
 def materialize_dataframe(df, path: str, validation=None) -> None:
-    """Write ``df`` (pandas or Spark) as a Parquet dataset at ``path``;
-    with ``validation`` a float fraction, rows are tagged with a
-    __validation__ 0/1 column first (reference: spark/common/util.py
-    prepare_data/check_validation)."""
+    """Write ``df`` (pandas or Spark) as a Parquet dataset at ``path``.
+
+    ``validation`` tags rows with a ``__validation__`` 0/1 column
+    (reference: spark/common/util.py prepare_data/check_validation):
+    a float fraction samples rows, a string names an existing 0/1
+    column whose values become the tag."""
     if _is_spark_df(df):  # pragma: no cover - needs pyspark
         from pyspark.sql import functions as F
 
         if isinstance(validation, float):
             df = df.withColumn(
-                "__validation__",
+                VALIDATION_COL,
                 (F.rand(seed=0) < validation).cast("int"))
+        elif isinstance(validation, str):
+            df = df.withColumn(
+                VALIDATION_COL, df[validation].cast("int"))
         df.write.mode("overwrite").parquet("file://" + path)
         return
     import numpy as np
@@ -51,8 +59,14 @@ def materialize_dataframe(df, path: str, validation=None) -> None:
     pdf = pd.DataFrame(df).copy()
     if isinstance(validation, float):
         rng = np.random.RandomState(0)
-        pdf["__validation__"] = (
+        pdf[VALIDATION_COL] = (
             rng.rand(len(pdf)) < validation).astype("int64")
+    elif isinstance(validation, str):
+        if validation not in pdf.columns:
+            raise ValueError(
+                "validation column %r not in DataFrame (have %s)"
+                % (validation, sorted(pdf.columns)))
+        pdf[VALIDATION_COL] = pdf[validation].astype("int64")
     os.makedirs(path, exist_ok=True)
     pdf.to_parquet(os.path.join(path, "part-00000.parquet"))
 
@@ -135,17 +149,23 @@ class HorovodEstimator(EstimatorParams):
         materialize_dataframe(df, data_path, validation=self.validation)
         if hasattr(store, "make_run_dirs"):
             store.make_run_dirs(run_id)
-        # Dataset metadata rides with the run: row counts size shards,
-        # the schema gates against silent drift (reference:
+        # Dataset metadata rides with the run (reference:
         # spark/common/util.py get_simple_meta_from_parquet +
-        # estimator metadata compatibility checks).
+        # estimator metadata compatibility checks): stats are exposed
+        # on the estimator, and refitting into an existing run with a
+        # drifted schema fails loudly instead of silently mixing data.
         rows, metadata, avg_row_size = util.get_metadata_from_parquet(
             data_path, label_columns=self.label_cols,
             feature_columns=self.feature_cols)
+        metadata.pop(VALIDATION_COL, None)  # internal tag, not schema
         self._dataset_rows = rows
         self._dataset_avg_row_size = avg_row_size
         if hasattr(store, "get_run_path"):
-            util.save_metadata(store.get_run_path(run_id), metadata)
+            run_path = store.get_run_path(run_id)
+            prior = util.load_metadata(run_path)
+            if prior is not None:
+                util.check_metadata_compatibility(prior, metadata)
+            util.save_metadata(run_path, metadata)
         remote_store = store.to_remote(run_id)
         train_fn = self._train_fn(remote_store)
         backend = self._backend()

@@ -70,9 +70,8 @@ class EstimatorParams:
             raise ValueError("epochs must be > 0")
         if self.batch_size <= 0:
             raise ValueError("batch_size must be > 0")
-        if isinstance(self.validation, float) and not (
-                0.0 < self.validation < 1.0):
-            raise ValueError("validation fraction must be in (0, 1)")
+        # validation spec validity is owned by
+        # spark.common.util.check_validation (fit runs it first).
 
     # Reference-style getters (reference exposes getModel()-style
     # accessors via pyspark Params; keep the snake_case surface).

@@ -59,9 +59,12 @@ def test_estimator_params_validation():
         EstimatorParams(no_such_param=1)
     with pytest.raises(ValueError):
         EstimatorParams(model=object(), epochs=0)._validate_fit()
+    # validation-spec validity is owned by util.check_validation,
+    # which fit() runs before _validate_fit.
+    from horovod_tpu.spark.common import util
+
     with pytest.raises(ValueError):
-        EstimatorParams(model=object(),
-                        validation=1.5)._validate_fit()
+        util.check_validation(1.5)
 
 
 def test_materialize_and_shard(tmp_path):
@@ -399,3 +402,46 @@ def test_estimator_persists_metadata(tmp_path):
     meta = util.load_metadata(
         os.path.join(str(tmp_path / "store"), "runs", fitted.run_id))
     assert meta is not None and "y" in meta
+
+
+def test_named_validation_column(tmp_path):
+    """validation='col' tags rows from an existing 0/1 column and the
+    train fn excludes them (reference: check_validation str form)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    pdf = _toy_pdf(64)
+    pdf["is_val"] = (np.arange(64) % 4 == 0).astype("int64")
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=1, verbose=0, validation="is_val",
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(pdf)
+    assert fitted.predict([[0.1, 0.2]]).shape == (1, 1)
+    # Training shard excluded the 16 tagged rows.
+    from horovod_tpu.spark.common.estimator import read_shard
+
+    train, val = read_shard(
+        est._store().get_train_data_path() if False else
+        os.path.join(str(tmp_path / "store"), "intermediate_train_data"),
+        0, 1, validation_col="__validation__")
+    assert len(val) == 16 and len(train) == 48
+
+
+def test_refit_with_drifted_schema_fails(tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    store = FilesystemStore(str(tmp_path / "store"))
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=1, verbose=0, run_id="fixed_run",
+        store=store, backend=LocalBackend(num_proc=1))
+    est.fit(_toy_pdf(32))
+    drifted = _toy_pdf(32)
+    drifted["extra"] = 1.0
+    with pytest.raises(ValueError, match="schema changed"):
+        est.fit(drifted)

@@ -95,10 +95,15 @@ class KerasEstimator(HorovodEstimator):
 
             callbacks = _cp.loads(callbacks_blob)
             if size > 1:
+                # MetricAverageCallback must run BEFORE user callbacks so
+                # metric-driven user callbacks (EarlyStopping,
+                # ReduceLROnPlateau) see globally-averaged metrics and stay
+                # in lockstep across ranks (reference:
+                # spark/keras/remote.py:142-154).
                 callbacks = (
-                    [hvd_callbacks.BroadcastGlobalVariablesCallback(0)]
-                    + callbacks
-                    + [hvd_callbacks.MetricAverageCallback()])
+                    [hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+                     hvd_callbacks.MetricAverageCallback()]
+                    + callbacks)
             history = model.fit(x, y, batch_size=batch_size,
                                 epochs=epochs, steps_per_epoch=steps,
                                 verbose=verbose, callbacks=callbacks,

@@ -206,9 +206,12 @@ def alltoall(tensor, splits=None, name=None,
         from horovod_tpu.tensorflow import ingraph
 
         t = tf.convert_to_tensor(tensor)
-        out = ingraph.alltoall(t, name)
         n = basics.size()
-        rsplits = tf.fill([n], tf.shape(t)[0] // n)
+        # ingraph.alltoall pre-flights cross-rank dim-0 agreement and
+        # divisibility (failing loudly on every rank), so uniform
+        # division of the received row count is exact here.
+        out = ingraph.alltoall(t, name)
+        rsplits = tf.fill([n], tf.shape(out)[0] // n)
         return out, rsplits
     out, rsplits = eager.synchronize(eager.alltoall_async(
         np.asarray(tensor),

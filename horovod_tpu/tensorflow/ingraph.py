@@ -45,6 +45,47 @@ _KEY_BASE = 0x40000000
 _lock = threading.Lock()
 _state = {"ready": False, "strategy": None, "size": 0}
 _key_counter = itertools.count(_KEY_BASE)  # next() is GIL-atomic
+_eager_key_cache: dict = {}
+
+
+def _instance_keys(kind: str, name: Optional[str], n: int, sig=None):
+    """Allocate (or, eagerly, reuse) ``n`` collective instance keys.
+
+    TF retains per-instance collective state, so a long eager loop that
+    allocated fresh keys every call would grow runtime state without
+    bound. Repeated *eager* calls at the same logical call site
+    therefore reuse their keys. Two constraints shape the cache key:
+
+    - TF pins the shape/dtype of each instance key (a reuse with a
+      different signature aborts the whole collective runtime), so the
+      tensor signature ``sig`` is part of the key.
+    - Every rank must resolve the same logical collective to the same
+      keys, so reuse is only offered to ops whose horovod contract makes
+      the *local* signature identical on every rank (allreduce,
+      broadcast, reducescatter). Ops whose local shapes may legally vary
+      per rank (ragged allgather, alltoall) pass ``sig=None`` and always
+      take fresh keys: with a cache they could disagree on hit/miss,
+      desync the shared counter, and end up on mismatched keys (a hang,
+      not an error); fresh allocation keeps every rank's counter in
+      lockstep because allocation *count* per logical op is constant.
+
+    Inside a ``tf.function`` trace fresh keys are correct and free: they
+    are baked into the graph once and reused on every graph execution.
+    """
+    if sig is None or name is None or tf.inside_function():
+        return tuple(next(_key_counter) for _ in range(n))
+    cache_key = (kind, name, sig)
+    with _lock:
+        keys = _eager_key_cache.get(cache_key)
+        if keys is None:
+            keys = tuple(next(_key_counter) for _ in range(n))
+            _eager_key_cache[cache_key] = keys
+    return keys
+
+
+def _sig(x) -> tuple:
+    x = tf.convert_to_tensor(x)
+    return (x.dtype.name, tuple(x.shape.as_list()))
 
 
 def _advertise_host() -> str:
@@ -167,8 +208,7 @@ def allreduce(x, name: str, op_is_average: bool,
     horovod/tensorflow/mpi_ops.py:131-151). ``name`` is kept for
     horovod-API parity / debugging; collective matching uses allocation
     order."""
-    fwd_key = next(_key_counter)
-    grad_key = next(_key_counter)
+    fwd_key, grad_key = _instance_keys("allreduce", name, 2, sig=_sig(x))
 
     @tf.custom_gradient
     def _fwd(v):
@@ -206,8 +246,14 @@ def allgather(x, name: str):
     pad to the max, gather, then strip the padding rows per rank. Both
     phases trace into the graph — no host round-trip.
     """
-    sizes_key = tf.constant(next(_key_counter))
-    data_key = tf.constant(next(_key_counter))
+    # The sizes phase always gathers a [1] int32 regardless of the data
+    # shape, so its key is rank-invariant and cacheable; only the ragged
+    # data-phase key must stay fresh (sig=None, see _instance_keys).
+    (_sk,) = _instance_keys("allgather.sizes", name, 1,
+                            sig=("int32", (1,)))
+    (_dk,) = _instance_keys("allgather", name, 1)
+    sizes_key = tf.constant(_sk)
+    data_key = tf.constant(_dk)
     gsize = tf.constant(_state["size"])
     gkey = tf.constant(_GROUP_KEY)
 
@@ -238,13 +284,57 @@ def alltoall(x, name: str):
     HorovodAlltoallOp, tensorflow/mpi_ops.cc:1049+; ragged splits stay
     on the host-bridged path — TF's collective is uniform-only, like
     the in-graph XLA path)."""
-    return tf.raw_ops.CollectiveAllToAllV2(
-        input=x,
-        group_size=tf.constant(_state["size"]),
+    # Local dim 0 may legally differ per rank in horovod's splits=None
+    # contract, so the data-phase key is uncacheable (sig=None, see
+    # _instance_keys) — and that same raggedness is exactly what the
+    # uniform-only TF collective cannot express, so it is rejected by a
+    # cross-rank pre-flight below rather than left to hang. The
+    # pre-flight key itself gathers a [1] int32 regardless of data
+    # shape: rank-invariant, cacheable.
+    (pre_key,) = _instance_keys("alltoall.sizes", name, 1,
+                                sig=("int32", (1,)))
+    (key,) = _instance_keys("alltoall", name, 1)
+    n = _state["size"]
+    shape = tf.shape(x)
+    k = shape[0] // n
+    # Pre-flight: gather every rank's dim-0 size (always-uniform [1]
+    # tensors), then validate. Running the gather FIRST means every
+    # rank — including ones whose local input is fine — raises
+    # together on violation, BEFORE the main exchange launches: a loud
+    # error instead of a shape-mismatch abort/hang inside the
+    # collective runtime (or one rank raising while peers block).
+    sizes = tf.raw_ops.CollectiveGatherV2(
+        input=tf.reshape(shape[0], [1]), group_size=tf.constant(n),
         group_key=tf.constant(_GROUP_KEY),
-        instance_key=tf.constant(next(_key_counter)),
+        instance_key=tf.constant(pre_key), ordering_token=[],
+        communication_hint="auto")
+    checks = [
+        tf.debugging.assert_equal(
+            sizes, tf.fill([n], shape[0]),
+            message="horovod alltoall (in-graph): first-dimension size "
+                    "must match on every rank; use explicit `splits` "
+                    "(host path) for ragged alltoall"),
+        tf.debugging.assert_equal(
+            tf.math.floormod(shape[0], n), 0,
+            message="horovod alltoall (in-graph): first dimension must "
+                    "be divisible by the process-set size; use explicit "
+                    "`splits` for ragged alltoall"),
+    ]
+    # CollectiveAllToAllV2 exchanges exactly one dim-0 slice per rank
+    # (dim 0 must equal group_size), so fold the k rows destined for
+    # each peer into one [k, ...] block, exchange, and unfold: the
+    # output is the received blocks concatenated in rank order — the
+    # horovod alltoall contract.
+    with tf.control_dependencies(checks):
+        blocks = tf.reshape(x, tf.concat([[n, k], shape[1:]], axis=0))
+    out = tf.raw_ops.CollectiveAllToAllV2(
+        input=blocks,
+        group_size=tf.constant(n),
+        group_key=tf.constant(_GROUP_KEY),
+        instance_key=tf.constant(key),
         ordering_token=[],
         communication_hint="auto")
+    return tf.reshape(out, tf.concat([[n * k], shape[1:]], axis=0))
 
 
 def reducescatter(x, name: str, op_is_average: bool = False):
@@ -256,7 +346,8 @@ def reducescatter(x, name: str, op_is_average: bool = False):
     # reduce then slice out this rank's dim-0 shard — both in-graph.
     # Shard math matches the native core's uneven split (ranks below
     # rows % n take one extra row), so the two paths agree on any size.
-    reduced = _collective_reduce(x, next(_key_counter))
+    (rkey,) = _instance_keys("reducescatter", name, 1, sig=_sig(x))
+    reduced = _collective_reduce(x, rkey)
     n = _state["size"]
     r = basics.rank()
     rows = tf.shape(reduced)[0]
@@ -276,7 +367,8 @@ def reducescatter(x, name: str, op_is_average: bool = False):
 def broadcast(x, root_rank: int, name: str):
     """Overwrite with root's value
     (reference: HorovodBroadcastOp, tensorflow/mpi_ops.cc:736-832)."""
-    key = tf.constant(next(_key_counter))
+    (_bk,) = _instance_keys("broadcast", name, 1, sig=_sig(x))
+    key = tf.constant(_bk)
     gsize = tf.constant(_state["size"])
     gkey = tf.constant(_GROUP_KEY)
     if basics.rank() == root_rank:
@@ -291,3 +383,4 @@ def broadcast(x, root_rank: int, name: str):
 def shutdown():  # pragma: no cover - process teardown
     with _lock:
         _state.update(ready=False, strategy=None, size=0)
+        _eager_key_cache.clear()

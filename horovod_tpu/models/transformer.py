@@ -39,6 +39,15 @@ def _axis_bound(axis) -> bool:
         return False
 
 
+def _under_manual_dp() -> bool:
+    """True when tracing inside a shard_map whose manual axes include a
+    data-parallel axis (the partial-manual flagship composition)."""
+    from horovod_tpu.parallel.hierarchical import DCN_AXIS, ICI_AXIS
+    from horovod_tpu.parallel.mesh import DATA_AXIS
+
+    return any(_axis_bound(a) for a in (DATA_AXIS, DCN_AXIS, ICI_AXIS))
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -157,7 +166,18 @@ class Transformer(nn.Module):
         pos = self.param(
             "pos", param_with_axes(init, (None, None)),
             (cfg.max_seq_len, cfg.d_model), jnp.float32)
-        x = embed.astype(cfg.dtype)[tokens]
+        if _under_manual_dp():
+            # Inside partial-manual shard_map the vocab-sharded gather
+            # trips XLA's PartitionGather CHECK (it cannot partition a
+            # sliced-operand gather under manual subgroups); the one-hot
+            # contraction partitions cleanly and rides the MXU. Outside
+            # that composition the plain gather is cheaper (no
+            # [b, s, vocab] one-hot activation), so keep it.
+            onehot = jax.nn.one_hot(tokens, cfg.vocab_size,
+                                    dtype=cfg.dtype)
+            x = jnp.einsum("bsv,vm->bsm", onehot, embed.astype(cfg.dtype))
+        else:
+            x = embed.astype(cfg.dtype)[tokens]
         s_local = tokens.shape[1]
         if cfg.seq_axis is not None and _axis_bound(cfg.seq_axis):
             # Sequence-sharded (shard_map): this shard holds positions

@@ -51,6 +51,21 @@ _OP_NAMES = {Average: "Average", Sum: "Sum", Adasum: "Adasum",
              Min: "Min", Max: "Max", Product: "Product"}
 
 
+def _is_global_set(process_set) -> bool:
+    return (process_set is None
+            or getattr(process_set, "process_set_id", 0) == 0)
+
+
+def _route_hierarchical(op, process_set, axis, env_var) -> bool:
+    """Single predicate for the two-level (dcn, ici) routing so the
+    single-tensor, grouped, and allgather paths can never desync
+    (reference: the one HOROVOD_HIERARCHICAL_* toggle read at init,
+    operations.cc:514-551)."""
+    return (op in (Average, Sum) and _is_global_set(process_set)
+            and isinstance(axis, (tuple, list)) and len(axis) == 2
+            and _env_flag(env_var))
+
+
 def _groups_for(process_set, axis_size: int):
     """Translate a ProcessSet into lax ``axis_index_groups``.
 
@@ -103,9 +118,8 @@ def allreduce(
     # axis tuple, route reduce_scatter(ici)->psum(dcn)->all_gather(ici)
     # so only 1/ici_size of the bytes ride the slow links. Env is read
     # at trace time, like the reference reads it at init.
-    if (op in (Average, Sum) and process_set is None
-            and isinstance(axis, (tuple, list)) and len(axis) == 2
-            and _env_flag("HOROVOD_HIERARCHICAL_ALLREDUCE")):
+    if _route_hierarchical(op, process_set, axis,
+                           "HOROVOD_HIERARCHICAL_ALLREDUCE"):
         from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
 
         dcn_axis, ici_axis = axis
@@ -154,6 +168,22 @@ def grouped_allreduce(
     transfers into one collective.
     """
     xs = list(xs)
+    # Two-level grouped path (reference: NCCLHierarchicalAllreduce fused
+    # through the 128 MB fusion buffer, nccl_operations.cc:233-440 +
+    # operations.cc:488): same env toggle and axis contract as the
+    # single-tensor route above.
+    if _route_hierarchical(op, process_set, axis,
+                           "HOROVOD_HIERARCHICAL_ALLREDUCE"):
+        from horovod_tpu.parallel.hierarchical import (
+            grouped_hierarchical_allreduce,
+        )
+
+        dcn_axis, ici_axis = axis
+        xs = [_apply_prescale(x, prescale_factor) for x in xs]
+        outs = grouped_hierarchical_allreduce(
+            xs, average=(op == Average),
+            ici_axis=ici_axis, dcn_axis=dcn_axis)
+        return [_apply_postscale(o, postscale_factor) for o in outs]
     groups = _groups_for(process_set, _axis_size(axis))
     n = len(process_set.ranks) if groups is not None else _axis_size(axis)
     xs = [_apply_prescale(x, prescale_factor) for x in xs]
@@ -183,9 +213,8 @@ def allgather(x, *, axis=DATA_AXIS, process_set=None):
     # HOROVOD_HIERARCHICAL_ALLGATHER (reference analog:
     # MPIHierarchicalAllgather, ops/mpi_operations.cc): two-level gather
     # for a (dcn, ici) axis tuple.
-    if (process_set is None and isinstance(axis, (tuple, list))
-            and len(axis) == 2
-            and _env_flag("HOROVOD_HIERARCHICAL_ALLGATHER")):
+    if _route_hierarchical(Sum, process_set, axis,
+                           "HOROVOD_HIERARCHICAL_ALLGATHER"):
         from horovod_tpu.parallel.hierarchical import hierarchical_allgather
 
         dcn_axis, ici_axis = axis

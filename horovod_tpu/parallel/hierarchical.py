@@ -51,6 +51,44 @@ def hierarchical_allreduce(x, *, average: bool = True, ici_axis=ICI_AXIS,
     return out
 
 
+def grouped_hierarchical_allreduce(xs, *, average: bool = True,
+                                   ici_axis=ICI_AXIS, dcn_axis=DCN_AXIS):
+    """Two-level allreduce of a tensor group through one fused buffer.
+
+    The per-tensor path requires dim 0 divisible by the ici size —
+    gradient pytrees rarely oblige (biases, odd leading dims). Instead,
+    reproduce the reference's fusion-buffer move
+    (reference: horovod/common/fusion_buffer_manager.h:40 + the
+    memcpy-in/collective/memcpy-out sequence in
+    ops/nccl_operations.cc:233-440): flatten every tensor into one 1-D
+    buffer per dtype, pad to a multiple of the ici size, run the
+    reduce_scatter(ici) → psum(dcn) → all_gather(ici) ladder once per
+    buffer, and slice the results back out. XLA keeps the pack/unpack
+    as on-chip reshapes, so the fused form costs one collective ladder
+    per dtype instead of one per tensor.
+    """
+    xs = list(xs)
+    ici = lax.axis_size(ici_axis)
+    out = [None] * len(xs)
+    by_dtype: Dict = {}
+    for i, x in enumerate(xs):
+        by_dtype.setdefault(jnp.asarray(x).dtype, []).append(i)
+    for dt, idxs in by_dtype.items():
+        flat = jnp.concatenate(
+            [jnp.ravel(jnp.asarray(xs[i])) for i in idxs])
+        pad = (-flat.size) % ici
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        reduced = hierarchical_allreduce(
+            flat, average=average, ici_axis=ici_axis, dcn_axis=dcn_axis)
+        offset = 0
+        for i in idxs:
+            n = xs[i].size
+            out[i] = reduced[offset:offset + n].reshape(xs[i].shape)
+            offset += n
+    return out
+
+
 def hierarchical_allgather(x, *, ici_axis=ICI_AXIS, dcn_axis=DCN_AXIS):
     """Two-level allgather (reference analog: MPIHierarchicalAllgather,
     horovod/common/ops/mpi_operations.cc): gather across ici, then across

@@ -81,6 +81,62 @@ def test_hierarchical_allreduce():
         np.testing.assert_allclose(out[r], expect, rtol=1e-5, atol=1e-6)
 
 
+def test_grouped_hierarchical_allreduce_fused_buffer():
+    """Mixed-dtype, ici-indivisible leaves go through the fused flat
+    buffer (pad to ici multiple, one ladder per dtype) and come back
+    equal to the global mean — the fusion-buffer parity case
+    (reference: fusion_buffer_manager.h:40)."""
+    mesh = make_mesh(hier.make_hierarchical_axes(ici_size=4, dcn_size=2))
+    rng = np.random.RandomState(7)
+    # Leaf sizes 3*2=6, 5, 1 — none divisible by ici=4.
+    leaves = [rng.randn(8, 3, 2).astype(np.float32),
+              rng.randn(8, 5).astype(np.float32),
+              rng.randn(8, 1).astype(np.float16)]
+
+    def fn(a, b, c):
+        outs = hier.grouped_hierarchical_allreduce(
+            [a[0], b[0], c[0]], average=True)
+        return tuple(o[None] for o in outs)
+
+    spec = P(("data_dcn", "data_ici"))
+    sm = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=(spec, spec, spec))
+    outs = jax.jit(sm)(*leaves)
+    for leaf, out in zip(leaves, outs):
+        out = np.asarray(out)
+        assert out.dtype == leaf.dtype
+        expect = leaf.astype(np.float64).mean(0)
+        tol = 1e-5 if leaf.dtype == np.float32 else 2e-3
+        for r in range(8):
+            np.testing.assert_allclose(out[r], expect, rtol=tol, atol=tol)
+
+
+def test_grouped_allreduce_env_routes_hierarchical(monkeypatch):
+    """C.grouped_allreduce honors HOROVOD_HIERARCHICAL_ALLREDUCE for a
+    2-level axis tuple (reference: operations.cc:514-551 toggle)."""
+    from horovod_tpu.ops import collective_ops as C
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    mesh = make_mesh(hier.make_hierarchical_axes(ici_size=2, dcn_size=2),
+                     devices=jax.devices()[:4])
+    x = np.random.RandomState(11).randn(4, 5).astype(np.float32)
+
+    def fn(s):
+        (out,) = C.grouped_allreduce(
+            [s[0]], op=C.Average, axis=("data_dcn", "data_ici"))
+        return out[None]
+
+    spec = P(("data_dcn", "data_ici"))
+    sm = shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)
+    from horovod_tpu.jax import introspect
+
+    counts = introspect.collective_counts(jax.jit(sm), x)
+    assert counts.get("reduce_scatter", 0) >= 1, counts
+    out = np.asarray(jax.jit(sm)(x))
+    for r in range(4):
+        np.testing.assert_allclose(out[r], x.mean(0), rtol=1e-5, atol=1e-6)
+
+
 def test_hierarchical_allgather():
     mesh = make_mesh(hier.make_hierarchical_axes(ici_size=2, dcn_size=4))
     x = np.arange(8, dtype=np.float32).reshape(8, 1)

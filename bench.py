@@ -33,32 +33,54 @@ import time
 
 BASELINE_IMG_PER_SEC_PER_ACCEL = 1656.82 / 16.0
 
+# Analytic forward-pass FLOPs per image at 224x224 (multiply-add = 2
+# FLOPs; the standard published counts). Training step = 3x forward
+# (forward + ~2x backward). Scaled by (image_size/224)^2 for other
+# resolutions (conv FLOPs scale with spatial area).
+RESNET_FWD_FLOPS_224 = {
+    "resnet18": 1.82e9, "resnet34": 3.67e9, "resnet50": 4.09e9,
+    "resnet101": 7.85e9, "resnet152": 11.58e9,
+}
+
+# Peak dense bf16 FLOP/s by TPU generation (matched against
+# jax.Device.device_kind, lowercase substring). Published spec sheets:
+# v4 275 TF, v5e 197 TF, v5p 459 TF, v6e (Trillium) 918 TF.
+CHIP_PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v5", 197e12), ("v4", 275e12), ("v6", 918e12), ("trillium", 918e12),
+)
+
+
+def _chip_peak_flops(device_kind: str):
+    dk = device_kind.lower()
+    for key, peak in CHIP_PEAK_BF16:
+        if key in dk:
+            return peak
+    return None
+
+
+def _mfu(achieved_flops_per_sec, device_kind: str):
+    """Model FLOPs utilization: analytic model FLOP/s over the chip's
+    published bf16 peak. None when the chip generation is unknown (e.g.
+    the CPU fallback)."""
+    peak = _chip_peak_flops(device_kind)
+    if not peak or not achieved_flops_per_sec:
+        return None
+    return round(achieved_flops_per_sec / peak, 4)
+
 
 # --------------------------------------------------------------------------
 # Child: the real benchmark. Only ever run with a parent supervising it.
 # --------------------------------------------------------------------------
 
-def run_child(args) -> int:
+def _bench_resnet(args, platform, device_kind):
     import jax
-
-    if args.backend == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-
-    # Claim the accelerator FIRST, before any framework machinery —
-    # if the backend is unavailable this raises (or hangs, and the
-    # parent's timeout handles it) without leaving hvd state behind.
-    devices = jax.devices()
-    platform = devices[0].platform
-
     import jax.numpy as jnp
     import optax
     from functools import partial
 
-    import horovod_tpu as hvd
     import horovod_tpu.jax as hvd_jax
     from horovod_tpu import models
-
-    hvd.init()
 
     if platform == "cpu":
         # Keep a CPU fallback run finishable: tiny model + batch +
@@ -70,8 +92,9 @@ def run_child(args) -> int:
         args.iters = min(args.iters, 3)
         args.steps_per_call = 1
 
-    model_cls = {"resnet50": models.ResNet50, "resnet101": models.ResNet101,
-                 "resnet18": models.ResNet18}[args.model]
+    model_cls = {"resnet18": models.ResNet18, "resnet34": models.ResNet34,
+                 "resnet50": models.ResNet50, "resnet101": models.ResNet101,
+                 "resnet152": models.ResNet152}[args.model]
     model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
 
     rng = jax.random.PRNGKey(0)
@@ -118,11 +141,13 @@ def run_child(args) -> int:
     else:
         train_step = partial(jax.jit, donate_argnums=(0, 1, 2))(_step)
 
+    loss = None
     for _ in range(args.warmup):
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, images, labels)
-    float(loss)  # host transfer: forces execution even where
-    # block_until_ready is a no-op (remote-relay platforms)
+    if loss is not None:
+        float(loss)  # host transfer: forces execution even where
+        # block_until_ready is a no-op (remote-relay platforms)
 
     t0 = time.perf_counter()
     for _ in range(args.iters):
@@ -133,14 +158,134 @@ def run_child(args) -> int:
 
     img_per_sec = (args.batch_size * args.iters
                    * max(args.steps_per_call, 1) / dt)
-    print(json.dumps({
+    train_flops_per_img = (3.0 * RESNET_FWD_FLOPS_224[args.model]
+                           * (args.image_size / 224.0) ** 2)
+    return {
         "metric": "%s_images_per_sec_per_chip" % args.model,
         "value": round(img_per_sec, 2),
-        "unit": "images/sec/chip (%s, bs=%d, bf16)" % (platform,
+        "unit": "images/sec/chip (%s, bs=%d, bf16)" % (device_kind,
                                                        args.batch_size),
         "vs_baseline": round(
             img_per_sec / BASELINE_IMG_PER_SEC_PER_ACCEL, 3),
-    }))
+        "mfu": _mfu(img_per_sec * train_flops_per_img, device_kind),
+        "flops_model": "3 x %.2fe9 fwd-FLOPs/img (analytic, %dpx)" % (
+            RESNET_FWD_FLOPS_224[args.model] / 1e9, args.image_size),
+    }
+
+
+def _bench_transformer(args, platform, device_kind):
+    """Flagship decoder-only transformer causal-LM step, tokens/sec.
+
+    MFU uses the standard analytic count: 6 * n_params FLOPs per token
+    for the parameter matmuls (fwd + bwd) plus the 12 * L * S * d_model
+    attention term.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from functools import partial
+
+    import __graft_entry__ as graft
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu.models import Transformer
+
+    tiny = platform == "cpu"
+    cfg = graft._flagship_config(tiny=tiny)
+    batch, seq = (2, 32) if tiny else (args.tf_batch, args.tf_seq)
+    iters, warmup, steps_per_call = (
+        (2, 1, 1) if tiny else (args.iters, args.warmup,
+                                args.steps_per_call))
+
+    model = Transformer(cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens)
+    n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
+
+    tx = hvd_jax.DistributedOptimizer(optax.adamw(1e-3))
+    opt_state = tx.init(params)
+
+    def loss_fn(params, tokens):
+        logits = model.apply(params, tokens)
+        targets = jnp.roll(tokens, -1, axis=1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    def _step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jnp.float32(loss)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        def body(_, carry):
+            p, s, _ = carry
+            return _step(p, s, tokens)
+        return jax.lax.fori_loop(
+            0, steps_per_call, body,
+            (params, opt_state, jnp.float32(0)))
+
+    loss = None
+    for _ in range(warmup):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    if loss is not None:
+        float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters * steps_per_call / dt
+    flops_per_token = (6.0 * n_params
+                       + 12.0 * cfg.n_layers * seq * cfg.d_model)
+    return {
+        "metric": "transformer_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip (%s, %.1fM params, bs=%d, seq=%d, bf16)"
+                % (device_kind, n_params / 1e6, batch, seq),
+        "vs_baseline": None,  # the reference publishes no LM baseline
+        "mfu": _mfu(tokens_per_sec * flops_per_token, device_kind),
+        "flops_model": "(6 x %.1fM + 12*L*S*d) FLOPs/token (analytic)"
+                       % (n_params / 1e6),
+    }
+
+
+def run_child(args) -> int:
+    import jax
+
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    # Claim the accelerator FIRST, before any framework machinery —
+    # if the backend is unavailable this raises (or hangs, and the
+    # parent's timeout handles it) without leaving hvd state behind.
+    devices = jax.devices()
+    platform = devices[0].platform
+    device_kind = devices[0].device_kind
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+
+    entries = []
+    for workload in args.workloads.split(","):
+        workload = workload.strip()
+        if not workload:
+            continue
+        if workload == "transformer":
+            entries.append(_bench_transformer(args, platform, device_kind))
+        else:
+            wl_args = argparse.Namespace(**vars(args))
+            wl_args.model = workload
+            entries.append(_bench_resnet(wl_args, platform, device_kind))
+
+    headline = dict(entries[0])
+    if len(entries) > 1:
+        headline["entries"] = entries
+    print(json.dumps(headline))
     return 0
 
 
@@ -230,7 +375,17 @@ def main():
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--iters", type=int, default=20)
-    p.add_argument("--model", default="resnet50")
+    p.add_argument("--model", default="resnet50",
+                   help="(legacy alias) single resnet workload; prefer "
+                        "--workloads")
+    p.add_argument("--workloads", default="resnet50,transformer",
+                   help="Comma list of benchmark workloads, run in order; "
+                        "first is the headline metric. resnet* or "
+                        "transformer.")
+    p.add_argument("--tf-batch", type=int, default=16,
+                   help="Transformer workload batch size.")
+    p.add_argument("--tf-seq", type=int, default=512,
+                   help="Transformer workload sequence length.")
     p.add_argument("--steps-per-call", type=int, default=30,
                    help="Optimizer steps fused into one executable "
                         "(amortizes dispatch latency; sweep on v5e: "
@@ -241,15 +396,24 @@ def main():
                    help="Hard wall-clock budget for the accelerator "
                         "child process.")
     args = p.parse_args()
+    # iters=0 would divide by zero; negative warmup is meaningless.
+    args.iters = max(args.iters, 1)
+    args.warmup = max(args.warmup, 0)
 
     if args.child:
         return run_child(args)
 
+    workloads = args.workloads
+    if args.model != "resnet50" and "resnet50" in workloads:
+        workloads = workloads.replace("resnet50", args.model)
     passthrough = ["--batch-size", str(args.batch_size),
                    "--image-size", str(args.image_size),
                    "--warmup", str(args.warmup),
                    "--iters", str(args.iters),
                    "--model", args.model,
+                   "--workloads", workloads,
+                   "--tf-batch", str(args.tf_batch),
+                   "--tf-seq", str(args.tf_seq),
                    "--steps-per-call", str(args.steps_per_call)]
 
     error = None

@@ -39,13 +39,31 @@ def _axis_bound(axis) -> bool:
         return False
 
 
-def _under_manual_dp() -> bool:
-    """True when tracing inside a shard_map whose manual axes include a
-    data-parallel axis (the partial-manual flagship composition)."""
-    from horovod_tpu.parallel.hierarchical import DCN_AXIS, ICI_AXIS
-    from horovod_tpu.parallel.mesh import DATA_AXIS
+def _use_onehot_embed(cfg) -> bool:
+    """Whether the vocab-sharded embedding lookup must avoid gather.
 
-    return any(_axis_bound(a) for a in (DATA_AXIS, DCN_AXIS, ICI_AXIS))
+    XLA's PartitionGather CHECK-crashes partitioning a sliced-operand
+    gather under manual subgroups, i.e. whenever we trace inside a
+    shard_map that leaves the embed's ``model`` axis auto. So: one-hot
+    iff some axis is manual-bound but ``model`` is not (if ``model``
+    itself is manual, params arrive as local shards and no SPMD
+    partitioning of the gather happens). ``cfg.vocab_onehot_lookup``
+    forces either path (e.g. False for a pure-DP mesh with an
+    unsharded embed, where the gather is safe and cheaper).
+    """
+    if cfg.vocab_onehot_lookup is not None:
+        return cfg.vocab_onehot_lookup
+    try:
+        from jax._src import core as _core
+
+        bound = set(_core.get_axis_env().axis_names())
+    except Exception:  # private-API drift: fall back to known DP axes
+        from horovod_tpu.parallel.hierarchical import DCN_AXIS, ICI_AXIS
+        from horovod_tpu.parallel.mesh import DATA_AXIS
+
+        bound = {a for a in (DATA_AXIS, DCN_AXIS, ICI_AXIS)
+                 if _axis_bound(a)}
+    return bool(bound) and "model" not in bound
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +84,9 @@ class TransformerConfig:
     num_experts: int = 0
     expert_axis: Optional[str] = None
     remat: bool = False
+    # None = auto (one-hot lookup only under manual subgroups, see
+    # _use_onehot_embed); True/False forces the lookup style.
+    vocab_onehot_lookup: Optional[bool] = None
 
 
 def _dense_causal_attention(q, k, v, dtype):
@@ -166,12 +187,11 @@ class Transformer(nn.Module):
         pos = self.param(
             "pos", param_with_axes(init, (None, None)),
             (cfg.max_seq_len, cfg.d_model), jnp.float32)
-        if _under_manual_dp():
-            # Inside partial-manual shard_map the vocab-sharded gather
-            # trips XLA's PartitionGather CHECK (it cannot partition a
-            # sliced-operand gather under manual subgroups); the one-hot
-            # contraction partitions cleanly and rides the MXU. Outside
-            # that composition the plain gather is cheaper (no
+        if _use_onehot_embed(cfg):
+            # The one-hot contraction partitions cleanly under manual
+            # subgroups (where the gather CHECK-crashes XLA's
+            # partitioner, see _use_onehot_embed) and rides the MXU.
+            # Outside that composition the plain gather is cheaper (no
             # [b, s, vocab] one-hot activation), so keep it.
             onehot = jax.nn.one_hot(tokens, cfg.vocab_size,
                                     dtype=cfg.dtype)

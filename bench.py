@@ -73,6 +73,26 @@ def _mfu(achieved_flops_per_sec, device_kind: str):
 # Child: the real benchmark. Only ever run with a parent supervising it.
 # --------------------------------------------------------------------------
 
+def _timed_loop(step_fn, carry, warmup, iters):
+    """Shared timing harness: run ``step_fn(carry) -> tuple`` (last
+    element = loss) ``warmup`` then ``iters`` times; return (carry,
+    seconds) for the timed portion. The float(loss) host transfer
+    forces execution even where block_until_ready is a no-op
+    (remote-relay platforms)."""
+    loss = None
+    for _ in range(warmup):
+        out = step_fn(carry)
+        carry, loss = out[:-1], out[-1]
+    if loss is not None:
+        float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_fn(carry)
+        carry, loss = out[:-1], out[-1]
+    float(loss)
+    return carry, time.perf_counter() - t0
+
+
 def _bench_resnet(args, platform, device_kind):
     import jax
     import jax.numpy as jnp
@@ -141,20 +161,9 @@ def _bench_resnet(args, platform, device_kind):
     else:
         train_step = partial(jax.jit, donate_argnums=(0, 1, 2))(_step)
 
-    loss = None
-    for _ in range(args.warmup):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
-    if loss is not None:
-        float(loss)  # host transfer: forces execution even where
-        # block_until_ready is a no-op (remote-relay platforms)
-
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
-    float(loss)
-    dt = time.perf_counter() - t0
+    _, dt = _timed_loop(
+        lambda c: train_step(*c, images, labels),
+        (params, batch_stats, opt_state), args.warmup, args.iters)
 
     img_per_sec = (args.batch_size * args.iters
                    * max(args.steps_per_call, 1) / dt)
@@ -217,35 +226,31 @@ def _bench_transformer(args, platform, device_kind):
         params = optax.apply_updates(params, updates)
         return params, opt_state, jnp.float32(loss)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, tokens):
-        def body(_, carry):
-            p, s, _ = carry
-            return _step(p, s, tokens)
-        return jax.lax.fori_loop(
-            0, steps_per_call, body,
-            (params, opt_state, jnp.float32(0)))
+    if steps_per_call > 1:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, tokens):
+            def body(_, carry):
+                p, s, _ = carry
+                return _step(p, s, tokens)
+            return jax.lax.fori_loop(
+                0, steps_per_call, body,
+                (params, opt_state, jnp.float32(0)))
+    else:
+        train_step = partial(jax.jit, donate_argnums=(0, 1))(_step)
 
-    loss = None
-    for _ in range(warmup):
-        params, opt_state, loss = train_step(params, opt_state, tokens)
-    if loss is not None:
-        float(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss = train_step(params, opt_state, tokens)
-    float(loss)
-    dt = time.perf_counter() - t0
+    _, dt = _timed_loop(
+        lambda c: train_step(*c, tokens),
+        (params, opt_state), warmup, iters)
 
     tokens_per_sec = batch * seq * iters * steps_per_call / dt
     flops_per_token = (6.0 * n_params
                        + 12.0 * cfg.n_layers * seq * cfg.d_model)
+    dtype_name = jnp.dtype(cfg.dtype).name
     return {
         "metric": "transformer_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
-        "unit": "tokens/sec/chip (%s, %.1fM params, bs=%d, seq=%d, bf16)"
-                % (device_kind, n_params / 1e6, batch, seq),
+        "unit": "tokens/sec/chip (%s, %.1fM params, bs=%d, seq=%d, %s)"
+                % (device_kind, n_params / 1e6, batch, seq, dtype_name),
         "vs_baseline": None,  # the reference publishes no LM baseline
         "mfu": _mfu(tokens_per_sec * flops_per_token, device_kind),
         "flops_model": "(6 x %.1fM + 12*L*S*d) FLOPs/token (analytic)"
@@ -270,8 +275,12 @@ def run_child(args) -> int:
 
     hvd.init()
 
+    # Parent always resolves --workloads; the fallback covers a direct
+    # --child invocation (debugging).
+    workloads_str = args.workloads or (
+        "resnet50,transformer" if args.model == "resnet50" else args.model)
     entries = []
-    for workload in args.workloads.split(","):
+    for workload in workloads_str.split(","):
         workload = workload.strip()
         if not workload:
             continue
@@ -282,6 +291,13 @@ def run_child(args) -> int:
             wl_args.model = workload
             entries.append(_bench_resnet(wl_args, platform, device_kind))
 
+    if not entries:
+        print(json.dumps({
+            "metric": "none", "value": 0.0, "unit": "",
+            "vs_baseline": 0.0,
+            "error": "no workloads requested: %r" % workloads_str,
+        }))
+        return 0
     headline = dict(entries[0])
     if len(entries) > 1:
         headline["entries"] = entries
@@ -378,10 +394,12 @@ def main():
     p.add_argument("--model", default="resnet50",
                    help="(legacy alias) single resnet workload; prefer "
                         "--workloads")
-    p.add_argument("--workloads", default="resnet50,transformer",
+    p.add_argument("--workloads", default=None,
                    help="Comma list of benchmark workloads, run in order; "
-                        "first is the headline metric. resnet* or "
-                        "transformer.")
+                        "first is the headline metric. "
+                        "resnet18/34/50/101/152 or transformer. Default: "
+                        "'resnet50,transformer', or just --model when "
+                        "that legacy flag names a different resnet.")
     p.add_argument("--tf-batch", type=int, default=16,
                    help="Transformer workload batch size.")
     p.add_argument("--tf-seq", type=int, default=512,
@@ -403,9 +421,22 @@ def main():
     if args.child:
         return run_child(args)
 
-    workloads = args.workloads
-    if args.model != "resnet50" and "resnet50" in workloads:
-        workloads = workloads.replace("resnet50", args.model)
+    # Resolve the workload list: an explicit --workloads wins verbatim;
+    # otherwise the legacy --model alias keeps its one-workload meaning
+    # (no silent transformer run inside the same --timeout budget).
+    if args.workloads is not None:
+        workloads = args.workloads
+    elif args.model != "resnet50":
+        workloads = args.model
+    else:
+        workloads = "resnet50,transformer"
+    if not [w for w in workloads.split(",") if w.strip()]:
+        print(json.dumps({
+            "metric": "none", "value": 0.0, "unit": "",
+            "vs_baseline": 0.0,
+            "error": "no workloads requested: %r" % workloads,
+        }))
+        return 0
     passthrough = ["--batch-size", str(args.batch_size),
                    "--image-size", str(args.image_size),
                    "--warmup", str(args.warmup),

@@ -189,8 +189,12 @@ void ParameterManager::Record(long long bytes, double now_s) {
 }
 
 void ParameterManager::Apply() {
-  apply_((long long)(current_[0] * 1024 * 1024), current_[1],
-         cats_[0] != 0, cats_[1] != 0);
+  // The search box's 0 MB endpoint means "unfused"; downstream staging
+  // treats <=0 as "no update", so express it as a 1-byte threshold
+  // (every tensor closes its own bin — unfused semantics).
+  long long fusion_bytes = (long long)(current_[0] * 1024 * 1024);
+  if (fusion_bytes <= 0) fusion_bytes = 1;
+  apply_(fusion_bytes, current_[1], cats_[0] != 0, cats_[1] != 0);
 }
 
 void ParameterManager::CloseSample(double now_s) {

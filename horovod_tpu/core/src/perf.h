@@ -96,8 +96,10 @@ class ParameterManager {
   bool hierarchical() const { return cats_[1] != 0; }
   int categorical_samples() const { return cat_samples_; }
 
-  static constexpr double kFusionMbLo = 1.0, kFusionMbHi = 64.0;
-  static constexpr double kCycleMsLo = 1.0, kCycleMsHi = 25.0;
+  // Reference search box (parameter_manager.cc:28-66): fusion 0-64 MB
+  // (0 = unfused), cycle 1-100 ms.
+  static constexpr double kFusionMbLo = 0.0, kFusionMbHi = 64.0;
+  static constexpr double kCycleMsLo = 1.0, kCycleMsHi = 100.0;
 
  private:
   void CloseSample(double now_s);

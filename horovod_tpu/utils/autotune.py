@@ -19,9 +19,11 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-# Search space matching the reference (parameter_manager.cc:28-66).
-FUSION_MB_BOUNDS = (1.0, 64.0)
-CYCLE_MS_BOUNDS = (1.0, 25.0)
+# Search space matching the reference exactly: fusion 0-64 MB (0 = no
+# fusion, every tensor ships alone) x cycle 1-100 ms
+# (reference: parameter_manager.cc:28-66).
+FUSION_MB_BOUNDS = (0.0, 64.0)
+CYCLE_MS_BOUNDS = (1.0, 100.0)
 WARMUP_SAMPLES = 3
 STEPS_PER_SAMPLE = 10
 MAX_SAMPLES = 20
@@ -173,7 +175,12 @@ class ParameterManager:
 
     def _apply(self):
         fusion_mb, cycle_ms = self._current
-        self._set_params(float(cycle_ms), int(fusion_mb * 1024 * 1024))
+        # The box's 0 MB endpoint means "unfused"; the apply/staging
+        # paths treat <=0 as "no update", so express it as a 1-byte
+        # threshold — every tensor then closes its own bin, which IS
+        # unfused semantics.
+        fusion_bytes = max(int(fusion_mb * 1024 * 1024), 1)
+        self._set_params(float(cycle_ms), fusion_bytes)
 
     @property
     def current(self):

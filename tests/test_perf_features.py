@@ -78,7 +78,7 @@ def test_parameter_manager_state_machine(tmp_path):
     assert applied, "set_params was never called"
     for cycle_ms, fusion_bytes in applied:
         assert 0.5 <= cycle_ms <= 100.0
-        assert 1 << 20 <= fusion_bytes <= 65 << 20
+        assert 0 <= fusion_bytes <= 65 << 20
     log = open(str(tmp_path / "autotune.csv")).read().splitlines()
     assert len(log) >= at.MAX_SAMPLES  # header + samples
 

@@ -143,16 +143,28 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
         self.verbose = verbose
         self.current_epoch = 0
 
+    def on_train_begin(self, logs=None):
+        # Infer steps/epoch from keras' own params when not given
+        # (reference: _keras/callbacks.py reads self.params['steps']) —
+        # without this the warmup would silently be a no-op.
+        if not self.steps_per_epoch:
+            self.steps_per_epoch = (self.params or {}).get("steps")
+
     def on_epoch_begin(self, epoch, logs=None):
         self.current_epoch = epoch
+        if epoch == self.warmup_epochs:
+            # Land exactly on the size-scaled LR when warmup completes;
+            # later epochs are left alone for user LR schedules.
+            self.model.optimizer.learning_rate.assign(
+                self.initial_lr * hvd.size())
 
     def on_train_batch_begin(self, batch, logs=None):
         if self.current_epoch >= self.warmup_epochs:
             return
         if not self.steps_per_epoch:
             return
-        progress = (self.current_epoch * self.steps_per_epoch + batch) / \
-            float(self.warmup_epochs * self.steps_per_epoch)
+        progress = (self.current_epoch * self.steps_per_epoch + batch + 1) \
+            / float(self.warmup_epochs * self.steps_per_epoch)
         scale = 1.0 + progress * (hvd.size() - 1.0)
         self.model.optimizer.learning_rate.assign(self.initial_lr * scale)
 

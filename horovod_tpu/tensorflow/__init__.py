@@ -119,6 +119,17 @@ def allreduce(tensor, average=None, op=None, name=None,
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
 
+    if compression is not None and compression is not Compression.none:
+        # Reduce on the compressed wire dtype, restore afterwards
+        # (reference: horovod/tensorflow/compression.py usage in
+        # allreduce).
+        wire, ctx = compression.compress(tf.convert_to_tensor(tensor))
+        out = allreduce(wire, op=op, name=name,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
+        return compression.decompress(out, ctx)
+
     if op in (Average, Sum) and _use_ingraph(process_set):
         from horovod_tpu.tensorflow import ingraph
 
@@ -296,6 +307,52 @@ class Compression:
             return tf.cast(t, ctx) if ctx is not None else t
 
 
+def _allreduce_grad_list(grads, op, process_set, sparse_as_dense=False,
+                         name_prefix="DistributedOptimizer",
+                         compression=None):
+    """Allreduce a gradient list, passing None entries through.
+    IndexedSlices take the sparse allgather path (or densify when
+    ``sparse_as_dense``); dense tensors go grouped (eager) or
+    per-tensor (graph), compressed on the wire when ``compression`` is
+    given. Shared by DistributedOptimizer and DistributedGradientTape
+    so both route sparse gradients identically
+    (reference: tensorflow/__init__.py:55-162 + :627-855)."""
+    if basics.size() <= 1:
+        return list(grads)
+    comp = compression or Compression.none
+
+    def _prep(g):
+        if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+            return tf.convert_to_tensor(g)
+        return g
+
+    grads = [None if g is None else _prep(g) for g in grads]
+    out = list(grads)
+    dense_idx = [i for i, g in enumerate(grads)
+                 if g is not None and not isinstance(g, tf.IndexedSlices)]
+    for i, g in enumerate(grads):
+        if g is not None and isinstance(g, tf.IndexedSlices):
+            out[i] = allreduce(g, op=op, name="%s.%d" % (name_prefix, i),
+                               process_set=process_set)
+    dense = [grads[i] for i in dense_idx]
+    if dense:
+        wires, ctxs = zip(*[comp.compress(tf.convert_to_tensor(g))
+                            for g in dense])
+        if tf.executing_eagerly():
+            reduced = grouped_allreduce(
+                list(wires), op=op, name=name_prefix,
+                process_set=process_set)
+        else:
+            reduced = [allreduce(g, op=op,
+                                 name="%s.%d" % (name_prefix, i),
+                                 process_set=process_set)
+                       for i, g in zip(dense_idx, wires)]
+        reduced = [comp.decompress(g, c) for g, c in zip(reduced, ctxs)]
+        for i, g in zip(dense_idx, reduced):
+            out[i] = g
+    return out
+
+
 class DistributedGradientTape(tf.GradientTape):
     """Tape whose ``gradient()`` allreduces the results
     (reference: horovod/tensorflow/__init__.py:758-855)."""
@@ -309,75 +366,41 @@ class DistributedGradientTape(tf.GradientTape):
             super().__init__(persistent=persistent,
                              watch_accessed_variables=watch_accessed_variables)
         self._hvd_op = op
+        self._hvd_compression = compression
         self._hvd_process_set = process_set
 
     def gradient(self, target, sources, output_gradients=None, **kwargs):
         grads = super().gradient(target, sources, output_gradients,
                                  **kwargs)
-        if basics.size() <= 1:
-            return grads
-        flat = [g for g in grads if g is not None]
-        reduced = grouped_allreduce(flat, op=self._hvd_op,
-                                    name="DistributedGradientTape",
-                                    process_set=self._hvd_process_set)
-        it = iter(reduced)
-        return [None if g is None else next(it) for g in grads]
+        return _allreduce_grad_list(
+            grads, self._hvd_op, self._hvd_process_set,
+            name_prefix="DistributedGradientTape",
+            compression=self._hvd_compression)
 
 
 def DistributedOptimizer(optimizer, op=Average, name=None,
                          process_set=global_process_set,
                          backward_passes_per_step=1,
                          sparse_as_dense=False,
+                         compression=None,
                          average_aggregated_gradients=True):
     """Wrap a Keras optimizer so apply_gradients allreduces first
     (reference: horovod/tensorflow/__init__.py:627-757; keras wrapper
     horovod/keras/__init__.py). With ``backward_passes_per_step > 1``,
     gradients aggregate locally and are communicated + applied only every
-    Nth step (reference: horovod/tensorflow/gradient_aggregation.py)."""
+    Nth step (reference: horovod/tensorflow/gradient_aggregation.py).
+    ``compression`` (e.g. ``hvd.Compression.fp16``) reduces gradients on
+    a narrower wire dtype."""
     from horovod_tpu.tensorflow.gradient_aggregation import (
         LocalGradientAggregationHelper,
     )
 
     base = optimizer.__class__
 
-    def _prep(g):
-        """sparse_as_dense densifies IndexedSlices before the reduce
-        (reference: tensorflow/__init__.py DistributedOptimizer
-        sparse_as_dense)."""
-        if sparse_as_dense and isinstance(g, tf.IndexedSlices):
-            return tf.convert_to_tensor(g)
-        return g
-
     def _allreduce_list(grads):
-        """Allreduce a gradient list, passing None entries through.
-        IndexedSlices take the sparse allgather path; dense tensors go
-        grouped (eager) or per-tensor (graph)."""
-        if basics.size() <= 1:
-            return list(grads)
-        grads = [None if g is None else _prep(g) for g in grads]
-        out = list(grads)
-        dense_idx = [i for i, g in enumerate(grads)
-                     if g is not None
-                     and not isinstance(g, tf.IndexedSlices)]
-        for i, g in enumerate(grads):
-            if g is not None and isinstance(g, tf.IndexedSlices):
-                out[i] = allreduce(g, op=op,
-                                   name="DistributedOptimizer.%d" % i,
-                                   process_set=process_set)
-        dense = [grads[i] for i in dense_idx]
-        if dense:
-            if tf.executing_eagerly():
-                reduced = grouped_allreduce(
-                    dense, op=op, name="DistributedOptimizer",
-                    process_set=process_set)
-            else:
-                reduced = [allreduce(g, op=op,
-                                     name="DistributedOptimizer.%d" % i,
-                                     process_set=process_set)
-                           for i, g in zip(dense_idx, dense)]
-            for i, g in zip(dense_idx, reduced):
-                out[i] = g
-        return out
+        return _allreduce_grad_list(grads, op, process_set,
+                                    sparse_as_dense=sparse_as_dense,
+                                    compression=compression)
 
     agg_helper = None
     if backward_passes_per_step > 1:

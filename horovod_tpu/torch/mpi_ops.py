@@ -77,6 +77,8 @@ def synchronize(handle: int) -> torch.Tensor:
     if h is None:
         raise ValueError("Unknown handle %r" % handle)
     result = eager.synchronize(h.inner)
+    if isinstance(h.template, (list, tuple)):  # grouped handle
+        return [_to_torch(a, t) for a, t in zip(result, h.template)]
     if isinstance(result, tuple):  # alltoall
         out = _to_torch(result[0], h.template)
         splits = torch.from_numpy(np.asarray(result[1]).astype(np.int64))

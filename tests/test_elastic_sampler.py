@@ -97,6 +97,53 @@ def test_torch_wrapper_is_torch_sampler():
     assert len(batches) == 2
 
 
+def test_dataloader_mid_epoch_resume_covers_remainder(monkeypatch):
+    """Drive a REAL torch DataLoader through interruption + world-size
+    change: a mid-epoch reset must resume with exactly the unprocessed
+    samples, re-sharded over the new world, none repeated (reference:
+    torch/elastic/sampler.py:24-140 record_batch / reset contract)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.torch.elastic import ElasticSampler as TorchES
+
+    dataset = torch.arange(12).float().unsqueeze(1)
+    s = TorchES(list(range(12)), shuffle=False)
+    loader = torch.utils.data.DataLoader(dataset, batch_size=2, sampler=s)
+
+    seen = []
+    for bi, batch in enumerate(loader):
+        seen += [int(v) for v in batch.ravel()]
+        s.record_batch(bi, 2)
+        if bi == 2:  # interrupted after 3 of 6 batches
+            break
+    assert len(seen) == 6
+
+    # World grows to 2; this process becomes rank 0 of 2.
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    monkeypatch.setattr(basics, "rank", lambda: 0)
+    s.reset()
+    resumed = []
+    for bi, batch in enumerate(loader):
+        resumed += [int(v) for v in batch.ravel()]
+        s.record_batch(bi, 2)
+    # Rank 0's share of the 6 remaining samples: no repeats of the
+    # processed set, and with rank 1's complementary shard (the other
+    # half of the remainder) the epoch is exactly covered.
+    assert not set(resumed) & set(seen)
+    assert len(resumed) == 3
+    remainder = set(range(12)) - set(seen)
+    assert set(resumed) <= remainder
+
+    # The complementary rank sees the rest: simulate rank 1 on a fresh
+    # sampler sharing the committed state.
+    s2 = TorchES(list(range(12)), shuffle=False)
+    s2.load_state_dict(s.state_dict() | {
+        "processed_indices": sorted(seen)})
+    monkeypatch.setattr(basics, "rank", lambda: 1)
+    s2.reset()
+    other = [int(dataset[i]) for i in iter(s2)]
+    assert set(other) == remainder - set(resumed)
+
+
 def test_object_state_tracks_sampler():
     from horovod_tpu.elastic.state import ObjectState
 

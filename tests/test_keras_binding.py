@@ -1,0 +1,23 @@
+"""Keras binding: np=2 callback-stack contract through the launcher.
+
+Size-1 callback unit coverage lives in test_tf_binding.py; this drives
+the full fit() lockstep scenario (reference:
+test/parallel/test_tensorflow2_keras.py).
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_keras_multiproc():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(_REPO, "tests", "keras_worker.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("KERAS_OK") == 2

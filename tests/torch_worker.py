@@ -168,9 +168,268 @@ def main():
     np.testing.assert_allclose(ada.weight.detach().numpy(),
                                ref.weight.detach().numpy(), atol=1e-5)
 
+    dtype_op_matrix(r, n)
+    grouped_mixed_dtypes(r, n)
+    collective_surfaces(r, n)
+    async_handles(r, n)
+    process_sets_through_binding(r, n)
+    optimizer_state_broadcast(r, n)
+    join_through_binding(r, n)
+    error_propagation(r, n)
+    sync_bn_backward(r, n)
+
     hvd.shutdown()
     print("TORCH_OK rank=%d" % r)
     return 0
+
+
+def async_handles(r, n):
+    """Handle-based async API: poll + out-of-order synchronize +
+    grouped async + in-place variants + reducescatter
+    (reference: torch/mpi_ops_v2.cc PollHandle/WaitAndClear
+    :566-575, mpi_ops.py:865-901)."""
+    h1 = hvd.allreduce_async(torch.full((4,), float(r + 1)),
+                             name="ah.1", op=hvd.Sum)
+    h2 = hvd.allreduce_async(torch.full((2,), 2.0 * (r + 1)),
+                             name="ah.2", op=hvd.Average)
+    hg = hvd.grouped_allreduce_async(
+        [torch.full((3,), float(r)), torch.full((1,), 10.0 * r)],
+        name="ah.g", op=hvd.Sum)
+    # Out-of-order synchronize is legal; poll never blocks.
+    hvd.poll(h2)
+    out2 = hvd.synchronize(h2)
+    outs = hvd.synchronize(hg)
+    out1 = hvd.synchronize(h1)
+    total = float(sum(range(1, n + 1)))
+    np.testing.assert_allclose(out1.numpy(), np.full(4, total))
+    np.testing.assert_allclose(out2.numpy(), np.full(2, 2.0 * total / n))
+    np.testing.assert_allclose(outs[0].numpy(),
+                               np.full(3, float(sum(range(n)))))
+    np.testing.assert_allclose(outs[1].numpy(),
+                               np.full(1, 10.0 * sum(range(n))))
+    # In-place async mutates the SAME storage.
+    x = torch.full((3,), float(r + 1))
+    h = hvd.allreduce_async_(x, name="ah.ip", op=hvd.Sum)
+    out = hvd.synchronize(h)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), np.full(3, total))
+    # Reducescatter: rank r owns shard r of the summed tensor.
+    full = torch.arange(2 * n, dtype=torch.float32) * (r + 1)
+    shard = hvd.reducescatter(full, op=hvd.Sum, name="ah.rs")
+    expect = (np.arange(2 * n) * total)[r * 2:(r + 1) * 2]
+    np.testing.assert_allclose(shard.numpy(), expect)
+
+
+def optimizer_state_broadcast(r, n):
+    """broadcast_optimizer_state must align stateful (momentum) and
+    param-group hyperparameters across ranks (reference:
+    torch/functions.py:29-266)."""
+    torch.manual_seed(1000 + r)  # DIFFERENT init per rank on purpose
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.05 * (r + 1),
+                          momentum=0.9)
+    # Build momentum state locally (diverged across ranks).
+    model(torch.randn(4, 3)).sum().backward()
+    opt.step()
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    assert opt.param_groups[0]["lr"] == 0.05  # rank 0's lr everywhere
+    state_blobs = hvd.allgather_object(
+        [v["momentum_buffer"].numpy().tolist()
+         for v in opt.state.values()])
+    assert state_blobs[0] == state_blobs[-1]
+    params_blobs = hvd.allgather_object(
+        [p.detach().numpy().tolist() for p in model.parameters()])
+    assert params_blobs[0] == params_blobs[-1]
+
+
+def dtype_op_matrix(r, n):
+    """dtype x op allreduce matrix through the torch API
+    (reference: test/parallel/test_torch.py:154+ test_horovod_allreduce
+    and its dtype variants)."""
+    base = np.arange(1, 7, dtype=np.float64).reshape(2, 3)
+    float_dtypes = [torch.float32, torch.float64, torch.bfloat16,
+                    torch.float16]
+    int_dtypes = [torch.int32, torch.int64]
+    scale = [float(k + 1) for k in range(n)]
+    for dt in float_dtypes + int_dtypes:
+        x = torch.tensor(base * (r + 1)).to(dt)
+        cases = {
+            hvd.Sum: base * sum(scale),
+            hvd.Min: base * min(scale),
+            hvd.Max: base * max(scale),
+            hvd.Product: base ** n * np.prod(scale),
+        }
+        if dt in float_dtypes:
+            cases[hvd.Average] = base * (sum(scale) / n)
+        for op, expect in cases.items():
+            out = hvd.allreduce(x, name="mx.%s.%s" % (dt, op), op=op)
+            assert out.dtype == dt, (dt, out.dtype)
+            tol = 2e-2 if dt in (torch.bfloat16, torch.float16) else 1e-6
+            np.testing.assert_allclose(
+                out.to(torch.float64).numpy(), expect, rtol=tol, atol=tol)
+
+
+def grouped_mixed_dtypes(r, n):
+    """One explicit group mixing dtypes must reduce every member
+    correctly (reference: grouped allreduce variants,
+    torch/mpi_ops.py:300-513)."""
+    xs = [torch.full((3,), float(r + 1), dtype=torch.float32),
+          torch.full((2, 2), r + 1, dtype=torch.int64),
+          torch.full((5,), float(r + 1), dtype=torch.bfloat16),
+          torch.full((1,), float(r + 1), dtype=torch.float64)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="gmix")
+    total = float(sum(range(1, n + 1)))
+    for x, out in zip(xs, outs):
+        assert out.dtype == x.dtype
+        np.testing.assert_allclose(
+            out.to(torch.float64).numpy(),
+            np.full(x.shape, total), rtol=1e-2)
+
+
+def collective_surfaces(r, n):
+    """Ragged allgather, non-zero-root broadcast, explicit-splits
+    alltoall through the torch API (reference: test_torch.py
+    allgather/broadcast/alltoall variants)."""
+    # Ragged dim 0: rank k contributes k+1 rows of value k.
+    g = hvd.allgather(torch.full((r + 1, 2), float(r)), name="rag")
+    expect = np.concatenate(
+        [np.full((k + 1, 2), float(k)) for k in range(n)])
+    np.testing.assert_allclose(g.numpy(), expect)
+    # int64 allgather keeps dtype.
+    gi = hvd.allgather(torch.arange(2, dtype=torch.int64) + r, name="ragi")
+    assert gi.dtype == torch.int64 and gi.shape[0] == 2 * n
+
+    # Broadcast from the LAST rank, float + int + 0-d scalar.
+    for name, t in (("bf", torch.full((3,), float(r))),
+                    ("bi", torch.tensor([r, r], dtype=torch.int32)),
+                    ("bs", torch.tensor(float(r)))):
+        out = hvd.broadcast(t, n - 1, name="bcast." + name)
+        np.testing.assert_allclose(
+            out.to(torch.float64).numpy(),
+            np.full(t.shape, float(n - 1)))
+
+    # Explicit uneven splits (np=2): rank0 sends 1 row to itself and 2
+    # to rank1; rank1 sends 2 rows to rank0 and 1 to itself.
+    if n == 2:
+        data = torch.arange(3, dtype=torch.float32) + 10.0 * r
+        splits = torch.tensor([1, 2] if r == 0 else [2, 1])
+        out, rsplits = hvd.alltoall(data, splits=splits, name="a2av")
+        if r == 0:
+            np.testing.assert_allclose(out.numpy(), [0.0, 10.0, 11.0])
+            np.testing.assert_allclose(np.asarray(rsplits), [1, 2])
+        else:
+            np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 12.0])
+            np.testing.assert_allclose(np.asarray(rsplits), [2, 1])
+
+
+def process_sets_through_binding(r, n):
+    """Collectives restricted to a process set via the torch surface
+    (reference: test_torch.py process-set variants; registration is
+    collective, so every rank registers every set)."""
+    sets = [hvd.add_process_set(hvd.ProcessSet([k])) for k in range(n)]
+    try:
+        mine = sets[r]
+        assert mine.included() and mine.rank() == 0 and mine.size() == 1
+        out = hvd.allreduce(torch.full((4,), float(r + 1)),
+                            name="ps.solo", op=hvd.Sum, process_set=mine)
+        # Size-1 set: the reduction is the rank's own tensor.
+        np.testing.assert_allclose(out.numpy(), np.full(4, float(r + 1)))
+        g = hvd.allgather(torch.full((2, 1), float(r)), name="ps.g",
+                          process_set=mine)
+        assert g.shape == (2, 1)
+        b = hvd.broadcast(torch.full((2,), float(r)), r, name="ps.b",
+                          process_set=mine)
+        np.testing.assert_allclose(b.numpy(), [float(r)] * 2)
+    finally:
+        for s in sets:
+            hvd.remove_process_set(s)
+
+
+def join_through_binding(r, n):
+    """Uneven-data Join through the torch API (reference:
+    torch/mpi_ops.py:888, controller.cc:262-317): the joined rank
+    contributes zeros, join() returns the last rank to join."""
+    if r == 0:
+        out = hvd.allreduce(torch.ones(3), name="join.ar", op=hvd.Sum)
+        # Rank 1 already joined -> contributes zeros.
+        np.testing.assert_allclose(out.numpy(), np.ones(3))
+    last = hvd.join()
+    assert last == 1, last
+
+
+def error_propagation(r, n):
+    """Cross-rank mismatches must raise through the framework API on
+    EVERY rank, and the session must stay usable afterwards
+    (reference: test_torch.py error cases -> coordinator ERROR
+    response)."""
+    with _expect_internal_error("shape"):
+        hvd.allreduce(torch.ones(2 + r), name="err.shape", op=hvd.Sum)
+    with _expect_internal_error("dtype"):
+        t = torch.ones(4, dtype=torch.float32 if r == 0
+                       else torch.float64)
+        hvd.allreduce(t, name="err.dtype", op=hvd.Sum)
+    # Duplicate name: second submission errors, the first completes.
+    h1 = hvd.allreduce_async(torch.ones(4), name="err.dup", op=hvd.Sum)
+    with _expect_internal_error("duplicate"):
+        h2 = hvd.allreduce_async(torch.ones(4), name="err.dup",
+                                 op=hvd.Sum)
+        hvd.synchronize(h2)
+    np.testing.assert_allclose(hvd.synchronize(h1).numpy(),
+                               np.full(4, float(n)))
+    # Session still healthy.
+    out = hvd.allreduce(torch.ones(2), name="err.after", op=hvd.Sum)
+    np.testing.assert_allclose(out.numpy(), np.full(2, float(n)))
+
+
+class _expect_internal_error:
+    def __init__(self, what):
+        self.what = what
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        assert exc_type is not None and issubclass(
+            exc_type, hvd.HorovodInternalError), (
+            "expected HorovodInternalError for %s mismatch, got %r"
+            % (self.what, exc_type))
+        return True  # swallow
+
+
+def sync_bn_backward(r, n):
+    """SyncBatchNorm BACKWARD at np=2 must match single-process BN on
+    the concatenated batch (reference: torch/sync_batch_norm.py:110-163
+    backward allreduces sum_dy / sum_dy_xmu)."""
+    xs = [torch.randn(4, 3, 5,
+                      generator=torch.Generator().manual_seed(70 + k))
+          for k in range(n)]
+    gs = [torch.randn(4, 3, 5,
+                      generator=torch.Generator().manual_seed(170 + k))
+          for k in range(n)]
+
+    sbn = hvd.SyncBatchNorm(3)
+    sbn.train()
+    x_mine = xs[r].clone().requires_grad_(True)
+    out = sbn(x_mine)
+    out.backward(gs[r])
+
+    bn = torch.nn.BatchNorm1d(3)
+    bn.train()
+    x_all = torch.cat(xs).requires_grad_(True)
+    bn(x_all).backward(torch.cat(gs))
+    expect_x_grad = x_all.grad[r * 4:(r + 1) * 4]
+    np.testing.assert_allclose(x_mine.grad.numpy(),
+                               expect_x_grad.numpy(), atol=1e-5)
+    # Weight/bias grads stay LOCAL-batch sums (the optimizer averages
+    # them later, as in the reference); summing across ranks must equal
+    # BN's grads on the concatenated batch.
+    wsum = hvd.allreduce(sbn.weight.grad, name="sbn.wg", op=hvd.Sum)
+    bsum = hvd.allreduce(sbn.bias.grad, name="sbn.bg", op=hvd.Sum)
+    np.testing.assert_allclose(wsum.numpy(), bn.weight.grad.numpy(),
+                               atol=1e-4)
+    np.testing.assert_allclose(bsum.numpy(), bn.bias.grad.numpy(),
+                               atol=1e-5)
 
 
 if __name__ == "__main__":

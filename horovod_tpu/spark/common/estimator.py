@@ -34,6 +34,12 @@ def _is_spark_df(df) -> bool:
 VALIDATION_COL = "__validation__"
 
 
+def _join(base: str, name: str) -> str:
+    """Path join that preserves URL-style store paths (hdfs://...)."""
+    return base.rstrip("/") + "/" + name if "://" in base \
+        else os.path.join(base, name)
+
+
 def materialize_dataframe(df, path: str, validation=None) -> None:
     """Write ``df`` (pandas or Spark) as a Parquet dataset at ``path``.
 
@@ -187,7 +193,64 @@ class HorovodEstimator(EstimatorParams):
 class HorovodModel:
     """Fitted model wrapper (reference: spark/common/estimator.py
     HorovodModel): predicts locally; with pyspark, ``transform`` adds an
-    output column per label."""
+    output column per label. ``save``/``load`` give the Spark-ML
+    MLWritable/MLReadable round trip (reference:
+    spark/common/serialization.py HorovodParamsWriter/Reader): model
+    payload + metadata + run linkage persisted under the store's run
+    directory."""
+
+    _MODEL_META = "model_meta.json"
+    _MODEL_BLOB = "model.bin"
+
+    def save(self, store: Optional[Store] = None,
+             run_id: Optional[str] = None) -> str:
+        """Persist this fitted model under ``store``'s run directory;
+        returns the run path. Defaults to the model's own store/run."""
+        import json
+
+        store = store or self.store
+        run_id = run_id or self.run_id
+        run_path = store.get_run_path(run_id)
+        meta = {
+            "class": "%s.%s" % (type(self).__module__,
+                                type(self).__qualname__),
+            "run_id": run_id,
+            "feature_cols": self.feature_cols,
+            "history": self.history,
+        }
+        store.write_text(_join(run_path, self._MODEL_META),
+                         json.dumps(meta, default=float))
+        store.write_bytes(_join(run_path, self._MODEL_BLOB),
+                          self._payload_bytes())
+        return run_path
+
+    @classmethod
+    def load(cls, store: Store, run_id: str) -> "HorovodModel":
+        """Reconstruct a fitted model saved with :meth:`save`. Can be
+        called on ``HorovodModel`` (the metadata names the concrete
+        class) or directly on the subclass."""
+        import importlib
+        import json
+
+        run_path = store.get_run_path(run_id)
+        meta = json.loads(store.read(
+            _join(run_path, cls._MODEL_META)).decode())
+        mod, _, qual = meta["class"].rpartition(".")
+        klass = getattr(importlib.import_module(mod), qual)
+        if cls is not HorovodModel and not issubclass(klass, cls):
+            raise TypeError("run %r holds a %s, not a %s"
+                            % (run_id, klass.__name__, cls.__name__))
+        blob = store.read(_join(run_path, cls._MODEL_BLOB))
+        return klass._from_payload(blob, meta, store)
+
+    # --- subclass hooks ---
+    def _payload_bytes(self) -> bytes:
+        raise NotImplementedError()
+
+    @classmethod
+    def _from_payload(cls, blob: bytes, meta: dict,
+                      store: Store) -> "HorovodModel":
+        raise NotImplementedError()
 
     def __init__(self, history, run_id: str, store: Store,
                  feature_cols: Optional[List[str]] = None):

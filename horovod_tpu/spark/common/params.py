@@ -20,10 +20,12 @@ class EstimatorParams:
     _param_names = [
         "num_proc", "model", "backend", "store", "loss", "loss_weights",
         "metrics", "optimizer", "feature_cols", "label_cols",
-        "sample_weight_col", "batch_size", "epochs", "verbose", "shuffle",
-        "callbacks", "random_seed", "train_steps_per_epoch",
+        "sample_weight_col", "batch_size", "val_batch_size", "epochs",
+        "verbose", "shuffle", "callbacks", "checkpoint_callback",
+        "random_seed", "train_steps_per_epoch",
         "validation_steps_per_epoch", "validation", "custom_objects",
-        "run_id", "transformation_fn",
+        "run_id", "resume_from_checkpoint", "terminate_on_nan",
+        "gradient_compression", "transformation_fn",
     ]
 
     def __init__(self, **kwargs):
@@ -39,11 +41,26 @@ class EstimatorParams:
         self.label_cols: Optional[List[str]] = None
         self.sample_weight_col: Optional[str] = None
         self.batch_size: int = 32
+        # Validation batch size; None = same as batch_size (reference:
+        # params.py val_batch_size).
+        self.val_batch_size: Optional[int] = None
         self.epochs: int = 1
         self.verbose: int = 1
         self.shuffle: bool = True
         self.callbacks: List[Any] = []
+        # Rank-0-only checkpoint hook: a keras callback (Keras
+        # estimator) or fn(model, epoch) (Torch estimator) — reference:
+        # params.py checkpoint_callback.
+        self.checkpoint_callback: Any = None
         self.random_seed: Optional[int] = None
+        # Load the run's existing checkpoint before training — the
+        # reference's resume-from-checkpoint fit behavior.
+        self.resume_from_checkpoint: bool = False
+        # Abort on NaN loss (reference: TerminateOnNaN plumbing).
+        self.terminate_on_nan: bool = False
+        # hvd Compression class reducing gradients on a narrower wire
+        # dtype (reference: params.py gradient_compression).
+        self.gradient_compression: Any = None
         self.train_steps_per_epoch: Optional[int] = None
         self.validation_steps_per_epoch: Optional[int] = None
         # float in (0,1): split fraction; str: name of a 0/1 column.

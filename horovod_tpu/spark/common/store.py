@@ -17,52 +17,87 @@ from typing import List, Optional
 
 
 class Store:
-    """(reference: spark/common/store.py:36-160)"""
+    """(reference: spark/common/store.py:36-160)
+
+    Path layout is shared by every backend (see ``_init_prefix_paths`` /
+    ``_join``); subclasses provide the IO primitives and directory
+    listing.
+    """
 
     def __init__(self):
         self._train_data_to_key = {}
         self._val_data_to_key = {}
 
-    # --- dataset paths ---
-    def is_parquet_dataset(self, path: str) -> bool:
+    # --- layout (shared) ---
+    def _init_prefix_paths(self, prefix_path: str,
+                           train_path: Optional[str],
+                           val_path: Optional[str],
+                           test_path: Optional[str],
+                           runs_path: Optional[str],
+                           save_runs: bool) -> None:
+        self.prefix_path = prefix_path
+        self._train_path = train_path or self._join(
+            prefix_path, "intermediate_train_data")
+        self._val_path = val_path or self._join(
+            prefix_path, "intermediate_val_data")
+        self._test_path = test_path or self._join(
+            prefix_path, "intermediate_test_data")
+        self._runs_path = runs_path or self._join(prefix_path, "runs")
+        self._save_runs = save_runs
+
+    def _join(self, base: str, name: str) -> str:
+        """Join path components in this backend's convention."""
         raise NotImplementedError()
+
+    @staticmethod
+    def _with_idx(path: str, idx) -> str:
+        return path if idx is None else "%s.%s" % (path, idx)
 
     def get_train_data_path(self, idx=None) -> str:
-        raise NotImplementedError()
+        return self._with_idx(self._train_path, idx)
 
     def get_val_data_path(self, idx=None) -> str:
-        raise NotImplementedError()
+        return self._with_idx(self._val_path, idx)
 
     def get_test_data_path(self, idx=None) -> str:
-        raise NotImplementedError()
+        return self._with_idx(self._test_path, idx)
 
-    # --- run artifacts ---
     def saving_runs(self) -> bool:
-        raise NotImplementedError()
+        return self._save_runs
 
     def get_runs_path(self) -> str:
-        raise NotImplementedError()
+        return self._runs_path
 
     def get_run_path(self, run_id: str) -> str:
-        raise NotImplementedError()
+        return self._join(self._runs_path, run_id)
 
     def get_checkpoint_path(self, run_id: str) -> str:
-        raise NotImplementedError()
+        return self._join(self.get_run_path(run_id),
+                          self.get_checkpoint_filename())
 
     def get_checkpoints(self, run_id: str,
                         suffix: str = ".ckpt") -> List[str]:
-        raise NotImplementedError()
+        return sorted(p for p in self._list_dir(self.get_run_path(run_id))
+                      if p.endswith(suffix))
 
     def get_logs_path(self, run_id: str) -> str:
-        raise NotImplementedError()
+        return self._join(self.get_run_path(run_id),
+                          self.get_logs_subdir())
 
     def get_checkpoint_filename(self) -> str:
-        raise NotImplementedError()
+        return "checkpoint.ckpt"
 
     def get_logs_subdir(self) -> str:
+        return "logs"
+
+    # --- io (backend-specific) ---
+    def is_parquet_dataset(self, path: str) -> bool:
         raise NotImplementedError()
 
-    # --- io ---
+    def _list_dir(self, path: str) -> List[str]:
+        """Full paths of directory entries; [] for a missing dir."""
+        raise NotImplementedError()
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError()
 
@@ -70,6 +105,9 @@ class Store:
         raise NotImplementedError()
 
     def write_text(self, path: str, text: str) -> None:
+        raise NotImplementedError()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
         raise NotImplementedError()
 
     def to_remote(self, run_id: str, dataset_idx=None):
@@ -112,19 +150,10 @@ class FilesystemStore(Store):
                  runs_path: Optional[str] = None,
                  save_runs: bool = True):
         super().__init__()
-        self.prefix_path = self._normalize(prefix_path)
-        self._train_path = (self._normalize(train_path)
-                            or os.path.join(self.prefix_path,
-                                            "intermediate_train_data"))
-        self._val_path = (self._normalize(val_path)
-                          or os.path.join(self.prefix_path,
-                                          "intermediate_val_data"))
-        self._test_path = (self._normalize(test_path)
-                           or os.path.join(self.prefix_path,
-                                           "intermediate_test_data"))
-        self._runs_path = (self._normalize(runs_path)
-                           or os.path.join(self.prefix_path, "runs"))
-        self._save_runs = save_runs
+        self._init_prefix_paths(
+            self._normalize(prefix_path), self._normalize(train_path),
+            self._normalize(val_path), self._normalize(test_path),
+            self._normalize(runs_path), save_runs)
 
     @staticmethod
     def _normalize(path: Optional[str]) -> Optional[str]:
@@ -134,9 +163,8 @@ class FilesystemStore(Store):
             path = path[len("file://"):]
         return path
 
-    @staticmethod
-    def _with_idx(path: str, idx) -> str:
-        return path if idx is None else "%s.%s" % (path, idx)
+    def _join(self, base: str, name: str) -> str:
+        return os.path.join(base, name)
 
     def is_parquet_dataset(self, path: str) -> bool:
         path = self._normalize(path)
@@ -144,46 +172,10 @@ class FilesystemStore(Store):
             return False
         return any(f.endswith(".parquet") for f in os.listdir(path))
 
-    def get_train_data_path(self, idx=None) -> str:
-        return self._with_idx(self._train_path, idx)
-
-    def get_val_data_path(self, idx=None) -> str:
-        return self._with_idx(self._val_path, idx)
-
-    def get_test_data_path(self, idx=None) -> str:
-        return self._with_idx(self._test_path, idx)
-
-    def saving_runs(self) -> bool:
-        return self._save_runs
-
-    def get_runs_path(self) -> str:
-        return self._runs_path
-
-    def get_run_path(self, run_id: str) -> str:
-        return os.path.join(self._runs_path, run_id)
-
-    def get_checkpoint_path(self, run_id: str) -> str:
-        return os.path.join(self.get_run_path(run_id),
-                            self.get_checkpoint_filename())
-
-    def get_checkpoints(self, run_id: str,
-                        suffix: str = ".ckpt") -> List[str]:
-        run_path = self.get_run_path(run_id)
-        if not os.path.isdir(run_path):
+    def _list_dir(self, path: str) -> List[str]:
+        if not os.path.isdir(path):
             return []
-        return sorted(
-            os.path.join(run_path, f) for f in os.listdir(run_path)
-            if f.endswith(suffix))
-
-    def get_logs_path(self, run_id: str) -> str:
-        return os.path.join(self.get_run_path(run_id),
-                            self.get_logs_subdir())
-
-    def get_checkpoint_filename(self) -> str:
-        return "checkpoint.ckpt"
-
-    def get_logs_subdir(self) -> str:
-        return "logs"
+        return [os.path.join(path, f) for f in os.listdir(path)]
 
     def exists(self, path: str) -> bool:
         return os.path.exists(self._normalize(path))
@@ -197,6 +189,12 @@ class FilesystemStore(Store):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:
             f.write(text)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        path = self._normalize(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
 
     def copy_dir(self, src: str, dst: str) -> None:
         shutil.copytree(self._normalize(src), self._normalize(dst),
@@ -212,8 +210,19 @@ class LocalStore(FilesystemStore):
 
 
 class HDFSStore(Store):
-    """HDFS-backed store (reference: store.py:351-486). Requires a
-    pyarrow HDFS connection; constructing without one raises."""
+    """HDFS-backed store over ``pyarrow.fs``
+    (reference: store.py:351-486 HDFSStore).
+
+    Constructed from ``hdfs://[host[:port]]/prefix``, every path this
+    store hands out KEEPS the full ``hdfs://authority/...`` URI, so
+    pandas/pyarrow dataset readers and writers route it to the Hadoop
+    filesystem layer rather than local disk; the store's own IO strips
+    the scheme and talks to its ``pyarrow.fs.HadoopFileSystem``
+    (libhdfs + the usual ``HADOOP_HOME``/CLASSPATH environment).
+
+    For tests — or any other ``pyarrow.fs.FileSystem`` — pass
+    ``filesystem=`` with a plain path prefix; paths then stay plain.
+    """
 
     PREFIX = "hdfs://"
 
@@ -221,9 +230,90 @@ class HDFSStore(Store):
     def matches(cls, path: str) -> bool:
         return bool(path) and path.startswith(cls.PREFIX)
 
-    def __init__(self, prefix_path: str, *args, **kwargs):
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 test_path: Optional[str] = None,
+                 runs_path: Optional[str] = None,
+                 save_runs: bool = True,
+                 filesystem=None):
         super().__init__()
-        raise NotImplementedError(
-            "HDFSStore requires an HDFS client (pyarrow.hdfs); mount the "
-            "cluster path and use FilesystemStore, or extend HDFSStore "
-            "with your connector")
+        self._uri = ""
+        self._fs = filesystem
+        if self._fs is None:  # pragma: no cover - needs a live cluster
+            from pyarrow import fs as pafs
+
+            host, port, path = self._parse_url(prefix_path)
+            authority = host + (":%d" % port if port else "")
+            self._uri = self.PREFIX + authority
+            self._fs = pafs.HadoopFileSystem(host=host, port=port)
+            prefix_path = self._uri + path
+        self._init_prefix_paths(prefix_path.rstrip("/"), train_path,
+                                val_path, test_path, runs_path,
+                                save_runs)
+
+    @classmethod
+    def _parse_url(cls, url: str):
+        rest = url[len(cls.PREFIX):] if url.startswith(cls.PREFIX) else url
+        if "/" in rest:
+            authority, path = rest.split("/", 1)
+        else:
+            authority, path = rest, ""
+        host, _, port = authority.partition(":")
+        return (host or "default", int(port) if port else 0, "/" + path)
+
+    def _join(self, base: str, name: str) -> str:
+        return base.rstrip("/") + "/" + name
+
+    def _strip(self, path: str) -> str:
+        """URI -> filesystem path for pyarrow.fs calls."""
+        if self._uri and path.startswith(self._uri):
+            return path[len(self._uri):]
+        return path
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        from pyarrow import fs as pafs
+
+        path = self._strip(path)
+        info = self._fs.get_file_info(path)
+        if info.type != pafs.FileType.Directory:
+            return False
+        sel = pafs.FileSelector(path, recursive=False)
+        return any(i.path.endswith(".parquet")
+                   for i in self._fs.get_file_info(sel))
+
+    def _list_dir(self, path: str) -> List[str]:
+        from pyarrow import fs as pafs
+
+        fs_path = self._strip(path)
+        if self._fs.get_file_info(fs_path).type != pafs.FileType.Directory:
+            return []
+        sel = pafs.FileSelector(fs_path, recursive=False)
+        return [self._uri + i.path if self._uri else i.path
+                for i in self._fs.get_file_info(sel)]
+
+    def exists(self, path: str) -> bool:
+        from pyarrow import fs as pafs
+
+        return (self._fs.get_file_info(self._strip(path)).type
+                != pafs.FileType.NotFound)
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open_input_stream(self._strip(path)) as f:
+            return f.read()
+
+    def write_text(self, path: str, text: str) -> None:
+        self.write_bytes(path, text.encode())
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        path = self._strip(path)
+        parent = path.rsplit("/", 1)[0]
+        self._fs.create_dir(parent, recursive=True)
+        with self._fs.open_output_stream(path) as f:
+            f.write(data)
+
+    def make_run_dirs(self, run_id: str) -> None:
+        self._fs.create_dir(self._strip(self.get_run_path(run_id)),
+                            recursive=True)
+        self._fs.create_dir(self._strip(self.get_logs_path(run_id)),
+                            recursive=True)

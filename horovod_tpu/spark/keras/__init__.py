@@ -42,13 +42,22 @@ class KerasEstimator(HorovodEstimator):
         import cloudpickle
 
         callbacks_blob = cloudpickle.dumps(list(self.callbacks))
+        ckpt_cb_blob = cloudpickle.dumps(self.checkpoint_callback)
         feature_cols = list(self.feature_cols or [])
         label_cols = list(self.label_cols or [])
         batch_size, epochs = self.batch_size, self.epochs
+        val_batch_size = self.val_batch_size or self.batch_size
         steps = self.train_steps_per_epoch
+        val_steps = self.validation_steps_per_epoch
         verbose = self.verbose
         custom_objects = dict(self.custom_objects)
         transformation_fn = self.transformation_fn
+        resume = self.resume_from_checkpoint
+        terminate_on_nan = self.terminate_on_nan
+        # The compressor class rides the cloudpickled closure — names
+        # are not stable across bindings (torch's fp16 class is called
+        # FP16Compressor).
+        gradient_compression = self.gradient_compression
 
         def train():
             import tensorflow as tf
@@ -72,9 +81,25 @@ class KerasEstimator(HorovodEstimator):
             opt = (tf.keras.optimizers.deserialize(opt_config)
                    if isinstance(opt_config, dict)
                    else tf.keras.optimizers.get(opt_config))
-            model.compile(optimizer=hvd.DistributedOptimizer(opt)
-                          if size > 1 else opt,
-                          loss=loss, metrics=metrics)
+            model.compile(
+                optimizer=hvd.DistributedOptimizer(
+                    opt, compression=gradient_compression)
+                if size > 1 else opt,
+                loss=loss, metrics=metrics)
+            if resume and os.path.exists(remote_store.checkpoint_path):
+                # Resume fit from the run's previous checkpoint
+                # (reference: estimator resume behavior) — AFTER
+                # compile so optimizer slots exist. Keras insists on a
+                # .weights.h5 suffix, so stage through a temp name.
+                import shutil
+                import tempfile
+
+                tmp = tempfile.mktemp(suffix=".weights.h5")
+                shutil.copyfile(remote_store.checkpoint_path, tmp)
+                try:
+                    model.load_weights(tmp)
+                finally:
+                    os.unlink(tmp)
             # Initial-state sync happens via the injected
             # BroadcastGlobalVariablesCallback below (covers optimizer
             # slots too) — no separate pre-fit broadcast.
@@ -85,6 +110,9 @@ class KerasEstimator(HorovodEstimator):
                 yv = np.stack([val_pdf[c].to_numpy()
                                for c in label_cols], axis=1)
                 kwargs["validation_data"] = (xv, yv)
+                kwargs["validation_batch_size"] = val_batch_size
+                if val_steps:
+                    kwargs["validation_steps"] = val_steps
             # User callbacks + the distributed set (reference:
             # spark/keras/remote.py: BroadcastGlobalVariables +
             # MetricAverage wrap the user's list; rank-0-only
@@ -94,6 +122,14 @@ class KerasEstimator(HorovodEstimator):
             from horovod_tpu.keras import callbacks as hvd_callbacks
 
             callbacks = _cp.loads(callbacks_blob)
+            if terminate_on_nan:
+                callbacks = [tf.keras.callbacks.TerminateOnNaN()] \
+                    + callbacks
+            ckpt_cb = _cp.loads(ckpt_cb_blob)
+            if ckpt_cb is not None and rank == 0:
+                # Rank-0-only user checkpoint hook (reference:
+                # params.py checkpoint_callback).
+                callbacks = callbacks + [ckpt_cb]
             if size > 1:
                 # MetricAverageCallback must run BEFORE user callbacks so
                 # metric-driven user callbacks (EarlyStopping,
@@ -111,8 +147,13 @@ class KerasEstimator(HorovodEstimator):
             if rank == 0:
                 os.makedirs(os.path.dirname(
                     remote_store.checkpoint_path), exist_ok=True)
-                model.save_weights(
-                    remote_store.checkpoint_path + ".weights.h5")
+                # Write through a keras-suffixed temp name, land on the
+                # store's canonical checkpoint filename so
+                # Store.get_checkpoints() lists it like every other
+                # framework's.
+                tmp = remote_store.checkpoint_path + ".tmp.weights.h5"
+                model.save_weights(tmp)
+                os.replace(tmp, remote_store.checkpoint_path)
             return {"history": {k: [float(v) for v in vs]
                                 for k, vs in history.history.items()},
                     "weights": model.get_weights() if rank == 0 else None}
@@ -127,15 +168,44 @@ class KerasEstimator(HorovodEstimator):
             self.model.to_json(), custom_objects=self.custom_objects)
         model.set_weights(rank0["weights"])
         return KerasModel(model, rank0["history"], run_id, store,
-                          feature_cols=self.feature_cols)
+                          feature_cols=self.feature_cols,
+                          custom_objects=self.custom_objects)
 
 
 class KerasModel(HorovodModel):
     """(reference: spark/keras/estimator.py KerasModel)"""
 
-    def __init__(self, model, history, run_id, store, feature_cols=None):
+    def __init__(self, model, history, run_id, store, feature_cols=None,
+                 custom_objects=None):
         super().__init__(history, run_id, store, feature_cols=feature_cols)
         self.model = model
+        self.custom_objects = dict(custom_objects or {})
 
     def predict(self, features):
         return self.model.predict(np.asarray(features), verbose=0)
+
+    def _payload_bytes(self) -> bytes:
+        import cloudpickle
+
+        # custom_objects ride the payload (cloudpickle handles classes
+        # by value) so load() can rebuild custom layers without the
+        # caller re-supplying them.
+        return cloudpickle.dumps({
+            "model_json": self.model.to_json(),
+            "weights": self.model.get_weights(),
+            "custom_objects": self.custom_objects,
+        })
+
+    @classmethod
+    def _from_payload(cls, blob, meta, store):
+        import cloudpickle
+        import tensorflow as tf
+
+        payload = cloudpickle.loads(blob)
+        custom_objects = payload.get("custom_objects") or {}
+        model = tf.keras.models.model_from_json(
+            payload["model_json"], custom_objects=custom_objects)
+        model.set_weights(payload["weights"])
+        return cls(model, meta["history"], meta["run_id"], store,
+                   feature_cols=meta["feature_cols"],
+                   custom_objects=custom_objects)

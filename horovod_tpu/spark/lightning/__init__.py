@@ -94,6 +94,10 @@ class LightningEstimator(HorovodEstimator):
         seed = self.random_seed
         transformation_fn = self.transformation_fn
         steps_per_epoch = self.train_steps_per_epoch
+        resume = self.resume_from_checkpoint
+        terminate_on_nan = self.terminate_on_nan
+        checkpoint_callback = self.checkpoint_callback
+        gradient_compression = self.gradient_compression
 
         def train():
             import torch
@@ -117,13 +121,20 @@ class LightningEstimator(HorovodEstimator):
             import cloudpickle as _cp
 
             module = _cp.loads(model_bytes)
+            if resume and os.path.exists(remote_store.checkpoint_path):
+                # Resume fit from the run's previous checkpoint
+                # (reference: estimator resume behavior).
+                module.load_state_dict(torch.load(
+                    remote_store.checkpoint_path, weights_only=False))
             opt, schedulers = _unpack_optimizers(
                 module.configure_optimizers())
             if size > 1:
                 hvd.broadcast_parameters(module.state_dict(), root_rank=0)
                 hvd.broadcast_optimizer_state(opt, root_rank=0)
                 opt = hvd.DistributedOptimizer(
-                    opt, named_parameters=module.named_parameters())
+                    opt, named_parameters=module.named_parameters(),
+                    compression=(gradient_compression
+                                 or hvd.Compression.none))
             loader = PandasShardDataLoader(
                 train_pdf, feature_cols, label_cols,
                 batch_size=batch_size, shuffle=shuffle, seed=seed)
@@ -164,6 +175,13 @@ class LightningEstimator(HorovodEstimator):
                     module.train()
                 if hasattr(module, "on_train_epoch_end"):
                     module.on_train_epoch_end()
+                if terminate_on_nan and not np.isfinite(
+                        history["loss"][-1]):
+                    raise RuntimeError(
+                        "loss is NaN/inf at epoch %d (terminate_on_nan)"
+                        % epoch)
+                if checkpoint_callback is not None and rank == 0:
+                    checkpoint_callback(module, epoch)
                 if verbose and rank == 0:
                     print("epoch %d loss %.5f" % (epoch,
                                                   history["loss"][-1]))
@@ -219,3 +237,16 @@ class LightningModel(HorovodModel):
             if hasattr(self.module, "forward"):
                 return self.module(x).numpy()
             raise TypeError("module has no forward()")
+
+    def _payload_bytes(self) -> bytes:
+        import cloudpickle
+
+        return cloudpickle.dumps(self.module)
+
+    @classmethod
+    def _from_payload(cls, blob, meta, store):
+        import cloudpickle
+
+        module = cloudpickle.loads(blob)
+        return cls(module, meta["history"], meta["run_id"], store,
+                   feature_cols=meta["feature_cols"])

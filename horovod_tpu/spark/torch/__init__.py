@@ -36,6 +36,13 @@ class TorchEstimator(HorovodEstimator):
         batch_size, epochs = self.batch_size, self.epochs
         verbose = self.verbose
         transformation_fn = self.transformation_fn
+        resume = self.resume_from_checkpoint
+        terminate_on_nan = self.terminate_on_nan
+        checkpoint_callback = self.checkpoint_callback
+        # The compressor class rides the cloudpickled closure — names
+        # are not stable across bindings (torch's fp16 class is
+        # FP16Compressor, not "fp16").
+        gradient_compression = self.gradient_compression
 
         def train():
             import torch
@@ -57,6 +64,11 @@ class TorchEstimator(HorovodEstimator):
                 axis=1), dtype=torch.float32)
             model = torch.load(io.BytesIO(model_bytes),
                                weights_only=False)
+            if resume and os.path.exists(remote_store.checkpoint_path):
+                # Resume fit from the run's previous checkpoint
+                # (reference: estimator resume behavior).
+                model.load_state_dict(torch.load(
+                    remote_store.checkpoint_path, weights_only=False))
             criterion = loss_fn or torch.nn.MSELoss()
             opt = (opt_factory(model.parameters()) if opt_factory
                    else torch.optim.SGD(model.parameters(), lr=0.01))
@@ -64,7 +76,9 @@ class TorchEstimator(HorovodEstimator):
                 hvd.broadcast_parameters(model.state_dict(), root_rank=0)
                 hvd.broadcast_optimizer_state(opt, root_rank=0)
                 opt = hvd.DistributedOptimizer(
-                    opt, named_parameters=model.named_parameters())
+                    opt, named_parameters=model.named_parameters(),
+                    compression=(gradient_compression
+                                 or hvd.Compression.none))
             losses = []
             for _epoch in range(epochs):
                 perm = torch.randperm(len(x))
@@ -76,6 +90,12 @@ class TorchEstimator(HorovodEstimator):
                     loss.backward()
                     opt.step()
                 losses.append(float(loss.detach()))
+                if terminate_on_nan and not np.isfinite(losses[-1]):
+                    raise RuntimeError(
+                        "loss is NaN/inf at epoch %d (terminate_on_nan)"
+                        % _epoch)
+                if checkpoint_callback is not None and rank == 0:
+                    checkpoint_callback(model, _epoch)
                 if verbose and rank == 0:
                     print("epoch %d loss %.5f" % (_epoch, losses[-1]))
             state = None
@@ -125,3 +145,18 @@ class TorchModel(HorovodModel):
             return self.model(
                 torch.tensor(np.asarray(features),
                              dtype=torch.float32)).numpy()
+
+    def _payload_bytes(self) -> bytes:
+        import torch
+
+        buf = io.BytesIO()
+        torch.save(self.model, buf)
+        return buf.getvalue()
+
+    @classmethod
+    def _from_payload(cls, blob, meta, store):
+        import torch
+
+        model = torch.load(io.BytesIO(blob), weights_only=False)
+        return cls(model, meta["history"], meta["run_id"], store,
+                   feature_cols=meta["feature_cols"])

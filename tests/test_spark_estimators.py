@@ -47,9 +47,40 @@ def test_store_paths_and_io(tmp_path):
     assert remote.checkpoint_filename == "checkpoint.ckpt"
 
 
-def test_store_create_hdfs_refused():
-    with pytest.raises(NotImplementedError):
+def test_store_create_hdfs_without_cluster_raises():
+    # No libhdfs / namenode in this image: constructing the real client
+    # must fail loudly (any connector error), not silently degrade.
+    with pytest.raises(Exception):
         Store.create("hdfs://namenode/path")
+
+
+def test_hdfs_store_over_injected_filesystem(tmp_path):
+    """HDFSStore's pyarrow.fs IO, exercised over LocalFileSystem (the
+    injectable-backend contract; on a cluster the same code runs over
+    HadoopFileSystem)."""
+    from pyarrow import fs as pafs
+
+    from horovod_tpu.spark.common.store import HDFSStore
+
+    root = str(tmp_path / "hdfs_root")
+    os.makedirs(root)
+    store = HDFSStore(root, filesystem=pafs.LocalFileSystem())
+    assert store.get_train_data_path().endswith("intermediate_train_data")
+    assert store.get_run_path("r1").endswith("runs/r1")
+    store.make_run_dirs("r1")
+    assert store.exists(store.get_logs_path("r1"))
+    p = store.get_run_path("r1") + "/blob.bin"
+    store.write_bytes(p, b"\x00\x01hvd")
+    assert store.read(p) == b"\x00\x01hvd"
+    store.write_text(store.get_run_path("r1") + "/note.txt", "hi")
+    assert store.read(store.get_run_path("r1") + "/note.txt") == b"hi"
+    assert store.get_checkpoints("r1") == []
+    store.write_bytes(store.get_run_path("r1") + "/model.ckpt", b"x")
+    assert len(store.get_checkpoints("r1")) == 1
+    assert not store.is_parquet_dataset(store.get_train_data_path())
+    assert HDFSStore._parse_url("hdfs://nn:9000/a/b") == ("nn", 9000,
+                                                          "/a/b")
+    assert HDFSStore.matches("hdfs://x") and not HDFSStore.matches("/x")
 
 
 def test_estimator_params_validation():
@@ -251,6 +282,219 @@ def test_lightning_estimator_fit_np2(tmp_path):
     fitted = est.fit(_toy_pdf(64))
     assert fitted.predict([[0.1, 0.9]]).shape == (1, 1)
     assert len(fitted.history["loss"]) == 3
+
+
+def test_keras_model_save_load_roundtrip(tmp_path):
+    """save -> load -> transform equals the original outputs (the
+    MLWritable contract, reference: spark/common/serialization.py)."""
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.spark.common.estimator import HorovodModel
+    from horovod_tpu.spark.keras import KerasEstimator, KerasModel
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    store = FilesystemStore(str(tmp_path / "store"))
+    est = KerasEstimator(
+        model=model, optimizer="sgd", loss="mse",
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=2, verbose=0, store=store,
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(64))
+    x = [[0.5, 0.5], [1.0, -1.0]]
+    before = fitted.predict(x)
+
+    fitted.save()
+    # Load through the base class (metadata names the concrete class)
+    # and through the subclass.
+    for loader in (HorovodModel, KerasModel):
+        loaded = loader.load(store, fitted.run_id)
+        assert isinstance(loaded, KerasModel)
+        assert loaded.feature_cols == ["x1", "x2"]
+        assert loaded.history.keys() == fitted.history.keys()
+        np.testing.assert_allclose(loaded.predict(x), before, atol=1e-6)
+    # Loading as the wrong subclass is an error, not a miscast.
+    from horovod_tpu.spark.torch import TorchModel
+
+    with pytest.raises(TypeError):
+        TorchModel.load(store, fitted.run_id)
+
+
+def test_keras_custom_objects_roundtrip_and_checkpoint_listing(tmp_path):
+    """Custom layers survive save/load (the payload carries
+    custom_objects), the rank-0 checkpoint lands under the store's
+    canonical name so get_checkpoints() lists it, and refit with
+    resume_from_checkpoint starts from the saved weights."""
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.spark.common.estimator import HorovodModel
+    from horovod_tpu.spark.keras import KerasEstimator
+
+    class Doubler(tf.keras.layers.Layer):
+        def call(self, x):
+            return 2.0 * x
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)), Doubler(),
+        tf.keras.layers.Dense(1)])
+    store = FilesystemStore(str(tmp_path / "store"))
+    est = KerasEstimator(
+        model=model, optimizer="sgd", loss="mse",
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=2, verbose=0, store=store,
+        run_id="co_run", custom_objects={"Doubler": Doubler},
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(64))
+    x = [[0.5, 0.5]]
+    before = fitted.predict(x)
+
+    # Checkpoint is listed under the canonical name.
+    assert store.get_checkpoints("co_run") == [
+        store.get_checkpoint_path("co_run")]
+
+    fitted.save()
+    loaded = HorovodModel.load(store, "co_run")
+    np.testing.assert_allclose(loaded.predict(x), before, atol=1e-6)
+
+    # Resume: training STARTS from the checkpointed weights (captured
+    # by an on_train_begin probe — note keras' load_weights also
+    # restores optimizer variables, so an lr=0 trick can't be used).
+    probe_path = str(tmp_path / "start_bias.npy")
+    trained_bias = fitted.model.get_weights()[-1]
+
+    class StartProbe(tf.keras.callbacks.Callback):
+        def on_train_begin(self, logs=None):
+            np.save(probe_path, self.model.get_weights()[-1])
+
+    est2 = KerasEstimator(
+        model=model, optimizer="sgd",
+        loss="mse", feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=1, verbose=0, store=store,
+        run_id="co_run", custom_objects={"Doubler": Doubler},
+        resume_from_checkpoint=True, callbacks=[StartProbe()],
+        backend=LocalBackend(num_proc=1))
+    est2.fit(_toy_pdf(64))
+    np.testing.assert_allclose(np.load(probe_path), trained_bias,
+                               atol=1e-6)
+
+
+def test_torch_model_save_load_roundtrip(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark.common.estimator import HorovodModel
+    from horovod_tpu.spark.torch import TorchEstimator, TorchModel
+
+    store = FilesystemStore(str(tmp_path / "store"))
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=2, verbose=0, store=store,
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(64))
+    x = [[0.25, 0.75]]
+    before = fitted.predict(x)
+    fitted.save()
+    loaded = HorovodModel.load(store, fitted.run_id)
+    assert isinstance(loaded, TorchModel)
+    np.testing.assert_allclose(loaded.predict(x), before, atol=1e-6)
+
+
+def test_lightning_model_save_load_roundtrip(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark.common.estimator import HorovodModel
+    from horovod_tpu.spark.lightning import (
+        LightningEstimator, LightningModel,
+    )
+
+    module = _ToyLightningModule()
+    store = FilesystemStore(str(tmp_path / "store"))
+    est = LightningEstimator(
+        model=module, feature_cols=["x1", "x2"], label_cols=["y"],
+        batch_size=16, epochs=2, verbose=0, store=store,
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(_toy_pdf(64))
+    x = [[0.1, 0.9]]
+    before = fitted.predict(x)
+    fitted.save()
+    loaded = HorovodModel.load(store, fitted.run_id)
+    assert isinstance(loaded, LightningModel)
+    np.testing.assert_allclose(loaded.predict(x), before, atol=1e-6)
+
+
+def test_torch_fit_resume_from_checkpoint(tmp_path):
+    """Refit into the same run with resume_from_checkpoint: training
+    continues from the saved weights instead of the fresh init."""
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    store = FilesystemStore(str(tmp_path / "store"))
+    pdf = _toy_pdf(128)
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"], batch_size=16,
+        epochs=4, verbose=0, store=store, run_id="resume_run",
+        backend=LocalBackend(num_proc=1))
+    first = est.fit(pdf)
+    x = [[0.3, 0.4], [0.9, 0.1]]
+    trained = first.predict(x)
+
+    # lr=0 refit: the returned weights are exactly what training
+    # STARTED from, so predictions reveal the starting point.
+    frozen = lambda params: torch.optim.SGD(params, lr=0.0)  # noqa: E731
+
+    est_resume = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        optimizer=frozen,
+        feature_cols=["x1", "x2"], label_cols=["y"], batch_size=16,
+        epochs=1, verbose=0, store=store, run_id="resume_run",
+        backend=LocalBackend(num_proc=1), resume_from_checkpoint=True)
+    resumed = est_resume.fit(pdf)
+    np.testing.assert_allclose(resumed.predict(x), trained, atol=1e-6)
+
+    # Negative control: without the flag, the fresh random init (not
+    # the checkpoint) is the starting point.
+    est_fresh = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        optimizer=frozen,
+        feature_cols=["x1", "x2"], label_cols=["y"], batch_size=16,
+        epochs=1, verbose=0, store=store, run_id="resume_run2",
+        backend=LocalBackend(num_proc=1))
+    fresh = est_fresh.fit(pdf)
+    assert not np.allclose(fresh.predict(x), trained, atol=1e-6)
+
+
+def test_torch_estimator_new_params(tmp_path):
+    """terminate_on_nan raises on a diverging loss; checkpoint_callback
+    fires per epoch on rank 0."""
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    seen = []
+    store = FilesystemStore(str(tmp_path / "store"))
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"], batch_size=16,
+        epochs=3, verbose=0, store=store,
+        backend=LocalBackend(num_proc=1),
+        checkpoint_callback=lambda model, epoch: seen.append(epoch))
+    est.fit(_toy_pdf(64))
+    assert seen == [0, 1, 2]
+
+    def diverge(params):
+        return torch.optim.SGD(params, lr=1e9)
+
+    est_nan = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        optimizer=diverge,
+        feature_cols=["x1", "x2"], label_cols=["y"], batch_size=16,
+        epochs=5, verbose=0,
+        store=FilesystemStore(str(tmp_path / "store2")),
+        backend=LocalBackend(num_proc=1), terminate_on_nan=True)
+    with pytest.raises(Exception, match="NaN|nan|inf"):
+        est_nan.fit(_toy_pdf(64))
 
 
 def test_read_shard_rowgroups(tmp_path):

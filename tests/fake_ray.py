@@ -53,6 +53,19 @@ def _actor_server(conn, cls_blob, init_args_blob):
             conn.send_bytes(cloudpickle.dumps((call_id, False, repr(e))))
 
 
+class RayError(Exception):
+    """(fake of ray.exceptions.RayError)"""
+
+
+class RayActorError(RayError):
+    """Actor process died (fake of ray.exceptions.RayActorError)."""
+
+
+class RayTaskError(RayError):
+    """Task raised an application exception (fake of
+    ray.exceptions.RayTaskError)."""
+
+
 class ObjectRef:
     _ids = itertools.count()
 
@@ -96,11 +109,20 @@ class ActorHandle:
 
     def _resolve(self, call_id):
         while call_id not in self._resolved:
-            cid, ok, value = cloudpickle.loads(self._conn.recv_bytes())
+            try:
+                cid, ok, value = cloudpickle.loads(
+                    self._conn.recv_bytes())
+            except (EOFError, ConnectionError, OSError) as e:
+                # The actor process died (node loss / os._exit): ray
+                # surfaces this as RayActorError, distinct from an
+                # exception RAISED by the task (RayTaskError below).
+                raise RayActorError(
+                    "actor died before returning call %d: %r"
+                    % (call_id, e)) from e
             self._resolved[cid] = (ok, value)
         ok, value = self._resolved.pop(call_id)
         if not ok:
-            raise RuntimeError("actor task failed: %s" % value)
+            raise RayTaskError("actor task failed: %s" % value)
         return value
 
     def _kill(self):
@@ -194,6 +216,12 @@ def install():
     ray_mod.kill = kill
     ray_mod.init = init
     ray_mod.is_initialized = is_initialized
+    exc_mod = types.ModuleType("ray.exceptions")
+    exc_mod.RayError = RayError
+    exc_mod.RayActorError = RayActorError
+    exc_mod.RayTaskError = RayTaskError
+    ray_mod.exceptions = exc_mod
+    sys.modules["ray.exceptions"] = exc_mod
     util_mod = types.ModuleType("ray.util")
     util_mod.placement_group = placement_group
     util_mod.remove_placement_group = remove_placement_group
@@ -215,5 +243,5 @@ def install():
 
 def uninstall():
     for name in ("ray", "ray.util", "ray.util.scheduling_strategies",
-                 "ray.util.placement_group"):
+                 "ray.util.placement_group", "ray.exceptions"):
         sys.modules.pop(name, None)

@@ -2,11 +2,20 @@
 
 import pytest
 
+import fake_ray
+
 from horovod_tpu.ray.strategy import (
     ColocatedStrategy, PackStrategy, bundles_for, resources_per_bundle,
 )
 from horovod_tpu.ray.elastic import ElasticRayExecutor, StaticHostDiscovery
 from horovod_tpu.runner.discovery import HostManager
+
+
+@pytest.fixture
+def ray_fake():
+    fake_ray.install()
+    yield
+    fake_ray.uninstall()
 
 
 def test_resources_per_bundle():
@@ -47,6 +56,90 @@ def test_static_discovery_feeds_host_manager():
     mgr.blacklist_slot("hostA:2")
     assert "hostA:2" not in mgr.available_slot_keys()
     assert mgr.refresh() is False  # unchanged
+
+
+class _RecordingDiscovery:
+    """Host map as a schedule over discovery calls (the fake-cluster
+    analog of the reference's discovery-script schedules,
+    test/integration/elastic_common.py:42-66)."""
+
+    def __init__(self, schedule):
+        self.schedule = list(schedule)
+        self.calls = 0
+
+    def find_available_hosts_and_slots(self):
+        hosts = self.schedule[min(self.calls, len(self.schedule) - 1)]
+        self.calls += 1
+        return dict(hosts)
+
+    def find_available_hosts(self):
+        from horovod_tpu.runner.hosts import HostInfo
+
+        return [HostInfo(h, s) for h, s in sorted(
+            self.find_available_hosts_and_slots().items())]
+
+
+def _die_if_world_of_one():
+    """Actor-side fn: simulate node loss at world size 1, succeed at 2."""
+    import os
+
+    if os.environ.get("HOROVOD_SIZE") == "1":
+        os._exit(1)  # hard actor death, like a lost node
+    return (int(os.environ["HOROVOD_RANK"]),
+            int(os.environ["HOROVOD_SIZE"]))
+
+
+def _always_die():
+    import os
+
+    os._exit(1)
+
+
+def test_ray_elastic_grows_after_actor_loss(ray_fake):
+    """Reference behavior (ray/elastic.py): an actor death tears the
+    world down, discovery reports the (now larger) cluster, and the
+    retry runs at the new size."""
+    disc = _RecordingDiscovery([{"localhost": 1}, {"localhost": 2}])
+    ex = ElasticRayExecutor(min_np=1, max_np=4, discovery=disc,
+                            env_vars={"JAX_PLATFORMS": "cpu",
+                                      "PALLAS_AXON_POOL_IPS": ""})
+    results = ex.run(_die_if_world_of_one)
+    assert sorted(results) == [(0, 2), (1, 2)]
+    assert disc.calls == 2  # one failed world + one grown world
+
+
+def test_ray_elastic_reset_limit_bounds_retries(ray_fake):
+    """Permanent failure: the executor retries exactly reset_limit
+    times, re-discovering each attempt, then surfaces the actor error
+    (reference: reset_limit semantics, registration.py:28-160)."""
+    import ray
+
+    disc = _RecordingDiscovery([{"localhost": 1}])
+    ex = ElasticRayExecutor(min_np=1, discovery=disc, reset_limit=2,
+                            env_vars={"JAX_PLATFORMS": "cpu",
+                                      "PALLAS_AXON_POOL_IPS": ""})
+    with pytest.raises(ray.exceptions.RayActorError):
+        ex.run(_always_die)
+    assert disc.calls == 3  # initial attempt + 2 permitted resets
+
+
+def test_ray_elastic_app_error_fails_fast(ray_fake):
+    """An exception RAISED by the training fn is an application bug:
+    no world reset, it propagates on the first attempt (reference:
+    ray/elastic.py separates task errors from actor loss)."""
+    import ray
+
+    disc = _RecordingDiscovery([{"localhost": 2}])
+    ex = ElasticRayExecutor(min_np=1, discovery=disc,
+                            env_vars={"JAX_PLATFORMS": "cpu",
+                                      "PALLAS_AXON_POOL_IPS": ""})
+
+    def boom():
+        raise ValueError("bad hyperparameter")
+
+    with pytest.raises(ray.exceptions.RayTaskError):
+        ex.run(boom)
+    assert disc.calls == 1
 
 
 def test_elastic_executor_validates_min_np(monkeypatch):

@@ -34,12 +34,6 @@ def _is_spark_df(df) -> bool:
 VALIDATION_COL = "__validation__"
 
 
-def _join(base: str, name: str) -> str:
-    """Path join that preserves URL-style store paths (hdfs://...)."""
-    return base.rstrip("/") + "/" + name if "://" in base \
-        else os.path.join(base, name)
-
-
 def materialize_dataframe(df, path: str, validation=None) -> None:
     """Write ``df`` (pandas or Spark) as a Parquet dataset at ``path``.
 
@@ -218,9 +212,9 @@ class HorovodModel:
             "feature_cols": self.feature_cols,
             "history": self.history,
         }
-        store.write_text(_join(run_path, self._MODEL_META),
+        store.write_text(store._join(run_path, self._MODEL_META),
                          json.dumps(meta, default=float))
-        store.write_bytes(_join(run_path, self._MODEL_BLOB),
+        store.write_bytes(store._join(run_path, self._MODEL_BLOB),
                           self._payload_bytes())
         return run_path
 
@@ -234,13 +228,13 @@ class HorovodModel:
 
         run_path = store.get_run_path(run_id)
         meta = json.loads(store.read(
-            _join(run_path, cls._MODEL_META)).decode())
+            store._join(run_path, cls._MODEL_META)).decode())
         mod, _, qual = meta["class"].rpartition(".")
         klass = getattr(importlib.import_module(mod), qual)
         if cls is not HorovodModel and not issubclass(klass, cls):
             raise TypeError("run %r holds a %s, not a %s"
                             % (run_id, klass.__name__, cls.__name__))
-        blob = store.read(_join(run_path, cls._MODEL_BLOB))
+        blob = store.read(store._join(run_path, cls._MODEL_BLOB))
         return klass._from_payload(blob, meta, store)
 
     # --- subclass hooks ---

@@ -110,9 +110,18 @@ class Store:
     def write_bytes(self, path: str, data: bytes) -> None:
         raise NotImplementedError()
 
+    def _remote_spec(self):
+        """Picklable recipe to rebuild an equivalent store inside a
+        training process, or None when local file IO suffices (plain
+        filesystem stores). Non-local backends override."""
+        return None
+
     def to_remote(self, run_id: str, dataset_idx=None):
         """Picklable view for training processes
-        (reference: store.py:130-160)."""
+        (reference: store.py:130-160). Besides the path attributes, the
+        view exposes ``exists/read/write_bytes`` so train fns do
+        checkpoint IO through the STORE's backend — plain open()/
+        os.path would silently write local junk for hdfs:// paths."""
         attrs = {
             "train_data_path": self.get_train_data_path(dataset_idx),
             "val_data_path": self.get_val_data_path(dataset_idx),
@@ -125,18 +134,60 @@ class Store:
             "checkpoint_filename": self.get_checkpoint_filename(),
             "logs_subdir": self.get_logs_subdir(),
         }
-
-        class RemoteStore:
-            def __init__(self):
-                self.__dict__.update(attrs)
-
-        return RemoteStore()
+        return RemoteStore(attrs, self._remote_spec())
 
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
         if HDFSStore.matches(prefix_path):
             return HDFSStore(prefix_path, *args, **kwargs)
         return FilesystemStore(prefix_path, *args, **kwargs)
+
+
+class RemoteStore:
+    """Picklable worker-side store view (reference: the remote-store
+    objects shipped by spark/common/store.py Store.to_remote)."""
+
+    def __init__(self, attrs, spec):
+        self.__dict__.update(attrs)
+        self._spec = spec
+        self._store = None
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_store"] = None  # backend clients don't pickle
+        return state
+
+    def _backend(self):
+        if self._store is None and self._spec is not None:
+            cls_name, kwargs = self._spec
+            self._store = {
+                "FilesystemStore": FilesystemStore,
+                "LocalStore": LocalStore,
+                "HDFSStore": HDFSStore,
+            }[cls_name](**kwargs)
+        return self._store
+
+    def exists(self, path: str) -> bool:
+        store = self._backend()
+        if store is not None:
+            return store.exists(path)
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        store = self._backend()
+        if store is not None:
+            return store.read(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        store = self._backend()
+        if store is not None:
+            store.write_bytes(path, data)
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
 
 
 class FilesystemStore(Store):
@@ -240,6 +291,9 @@ class HDFSStore(Store):
         super().__init__()
         self._uri = ""
         self._fs = filesystem
+        # Rebuildable inside workers only when the client comes from a
+        # URL (an injected filesystem object is not picklable/derivable).
+        self._ctor_url = None if filesystem is not None else prefix_path
         if self._fs is None:  # pragma: no cover - needs a live cluster
             from pyarrow import fs as pafs
 
@@ -251,6 +305,15 @@ class HDFSStore(Store):
         self._init_prefix_paths(prefix_path.rstrip("/"), train_path,
                                 val_path, test_path, runs_path,
                                 save_runs)
+
+    def _remote_spec(self):
+        if self._ctor_url is None:
+            raise ValueError(
+                "HDFSStore built from an injected filesystem object "
+                "cannot be shipped to training processes (the client "
+                "is not picklable); construct it from an hdfs:// URL")
+        return ("HDFSStore", {"prefix_path": self._ctor_url,
+                              "save_runs": self._save_runs})
 
     @classmethod
     def _parse_url(cls, url: str):

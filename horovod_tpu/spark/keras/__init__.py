@@ -86,17 +86,21 @@ class KerasEstimator(HorovodEstimator):
                     opt, compression=gradient_compression)
                 if size > 1 else opt,
                 loss=loss, metrics=metrics)
-            if resume and os.path.exists(remote_store.checkpoint_path):
+            if resume and remote_store.exists(
+                    remote_store.checkpoint_path):
                 # Resume fit from the run's previous checkpoint
                 # (reference: estimator resume behavior) — AFTER
-                # compile so optimizer slots exist. Keras insists on a
-                # .weights.h5 suffix, so stage through a temp name.
-                import shutil
+                # compile so optimizer slots exist. Checkpoint bytes
+                # come through the STORE backend (hdfs-safe); keras
+                # insists on a .weights.h5 suffix, so stage through a
+                # local temp file (mkstemp: no mktemp name race).
                 import tempfile
 
-                tmp = tempfile.mktemp(suffix=".weights.h5")
-                shutil.copyfile(remote_store.checkpoint_path, tmp)
+                fd, tmp = tempfile.mkstemp(suffix=".weights.h5")
                 try:
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(remote_store.read(
+                            remote_store.checkpoint_path))
                     model.load_weights(tmp)
                 finally:
                     os.unlink(tmp)
@@ -145,15 +149,21 @@ class KerasEstimator(HorovodEstimator):
                                 verbose=verbose, callbacks=callbacks,
                                 **kwargs)
             if rank == 0:
-                os.makedirs(os.path.dirname(
-                    remote_store.checkpoint_path), exist_ok=True)
-                # Write through a keras-suffixed temp name, land on the
-                # store's canonical checkpoint filename so
-                # Store.get_checkpoints() lists it like every other
-                # framework's.
-                tmp = remote_store.checkpoint_path + ".tmp.weights.h5"
-                model.save_weights(tmp)
-                os.replace(tmp, remote_store.checkpoint_path)
+                # Stage through a keras-suffixed local temp file, then
+                # ship the bytes through the STORE backend to its
+                # canonical checkpoint name — listable by
+                # Store.get_checkpoints() and hdfs-safe.
+                import tempfile
+
+                fd, tmp = tempfile.mkstemp(suffix=".weights.h5")
+                os.close(fd)
+                try:
+                    model.save_weights(tmp)
+                    with open(tmp, "rb") as f:
+                        remote_store.write_bytes(
+                            remote_store.checkpoint_path, f.read())
+                finally:
+                    os.unlink(tmp)
             return {"history": {k: [float(v) for v in vs]
                                 for k, vs in history.history.items()},
                     "weights": model.get_weights() if rank == 0 else None}

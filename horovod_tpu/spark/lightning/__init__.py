@@ -121,11 +121,14 @@ class LightningEstimator(HorovodEstimator):
             import cloudpickle as _cp
 
             module = _cp.loads(model_bytes)
-            if resume and os.path.exists(remote_store.checkpoint_path):
-                # Resume fit from the run's previous checkpoint
-                # (reference: estimator resume behavior).
+            if resume and remote_store.exists(
+                    remote_store.checkpoint_path):
+                # Resume fit from the run's previous checkpoint,
+                # reading through the store backend (hdfs-safe).
                 module.load_state_dict(torch.load(
-                    remote_store.checkpoint_path, weights_only=False))
+                    io.BytesIO(remote_store.read(
+                        remote_store.checkpoint_path)),
+                    weights_only=False))
             opt, schedulers = _unpack_optimizers(
                 module.configure_optimizers())
             if size > 1:
@@ -187,15 +190,14 @@ class LightningEstimator(HorovodEstimator):
                                                   history["loss"][-1]))
             state = None
             if rank == 0:
-                # Serialize once; the checkpoint file gets the same
-                # bytes that ride back to the driver.
+                # Serialize once; the same bytes go to the store's
+                # checkpoint (through its backend — hdfs-safe) and
+                # back to the driver.
                 buf2 = io.BytesIO()
                 torch.save(module.state_dict(), buf2)
                 state = buf2.getvalue()
-                os.makedirs(os.path.dirname(
-                    remote_store.checkpoint_path), exist_ok=True)
-                with open(remote_store.checkpoint_path, "wb") as f:
-                    f.write(state)
+                remote_store.write_bytes(remote_store.checkpoint_path,
+                                         state)
             return {"loss": history["loss"],
                     "val_loss": history["val_loss"], "state": state}
 

@@ -64,11 +64,14 @@ class TorchEstimator(HorovodEstimator):
                 axis=1), dtype=torch.float32)
             model = torch.load(io.BytesIO(model_bytes),
                                weights_only=False)
-            if resume and os.path.exists(remote_store.checkpoint_path):
-                # Resume fit from the run's previous checkpoint
-                # (reference: estimator resume behavior).
+            if resume and remote_store.exists(
+                    remote_store.checkpoint_path):
+                # Resume fit from the run's previous checkpoint,
+                # reading through the store backend (hdfs-safe).
                 model.load_state_dict(torch.load(
-                    remote_store.checkpoint_path, weights_only=False))
+                    io.BytesIO(remote_store.read(
+                        remote_store.checkpoint_path)),
+                    weights_only=False))
             criterion = loss_fn or torch.nn.MSELoss()
             opt = (opt_factory(model.parameters()) if opt_factory
                    else torch.optim.SGD(model.parameters(), lr=0.01))
@@ -100,13 +103,14 @@ class TorchEstimator(HorovodEstimator):
                     print("epoch %d loss %.5f" % (_epoch, losses[-1]))
             state = None
             if rank == 0:
-                os.makedirs(os.path.dirname(
-                    remote_store.checkpoint_path), exist_ok=True)
-                torch.save(model.state_dict(),
-                           remote_store.checkpoint_path)
+                # Serialize once; the same bytes go to the store's
+                # checkpoint (through its backend — hdfs-safe) and
+                # back to the driver.
                 buf2 = io.BytesIO()
                 torch.save(model.state_dict(), buf2)
                 state = buf2.getvalue()
+                remote_store.write_bytes(remote_store.checkpoint_path,
+                                         state)
             return {"loss": losses, "state": state}
 
         return train

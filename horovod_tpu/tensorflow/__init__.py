@@ -75,11 +75,11 @@ def shutdown():
 def _use_ingraph(process_set) -> bool:
     """Whether the TF-native collective runtime serves this call.
 
-    Process sets stay on the host-bridged path: TF collective groups
-    are global here."""
+    Process sets get their own TF collective group key (derived from
+    the collectively-agreed set id, see ingraph._group_for), so they
+    ride the native runtime too — down to degenerate single-member
+    groups, which TF executes as identities."""
     if basics.size() <= 1:
-        return False
-    if getattr(process_set, "process_set_id", 0) != 0:
         return False
     from horovod_tpu.tensorflow import ingraph
 
@@ -137,7 +137,8 @@ def allreduce(tensor, average=None, op=None, name=None,
             tf.convert_to_tensor(tensor), name,
             op_is_average=(op == Average),
             prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor)
+            postscale_factor=postscale_factor,
+            process_set=process_set)
 
     def _run(x):
         return np.asarray(eager.synchronize(eager.allreduce_async(
@@ -174,7 +175,8 @@ def grouped_allreduce(tensors, average=None, op=None, name=None,
 
         return [ingraph.allreduce(tf.convert_to_tensor(t),
                                   "%s.%d" % (name, i),
-                                  op_is_average=(op == Average))
+                                  op_is_average=(op == Average),
+                                  process_set=process_set)
                 for i, t in enumerate(tensors)]
     arrays = [t.numpy() if hasattr(t, "numpy") else np.asarray(t)
               for t in tensors]
@@ -188,7 +190,8 @@ def allgather(tensor, name=None, process_set=global_process_set):
     if _use_ingraph(process_set):
         from horovod_tpu.tensorflow import ingraph
 
-        return ingraph.allgather(tf.convert_to_tensor(tensor), name)
+        return ingraph.allgather(tf.convert_to_tensor(tensor), name,
+                                 process_set=process_set)
     out = eager.synchronize(eager.allgather_async(
         np.asarray(tensor), name=name, process_set=process_set))
     return tf.convert_to_tensor(np.asarray(out))
@@ -201,7 +204,7 @@ def broadcast(tensor, root_rank, name=None,
         from horovod_tpu.tensorflow import ingraph
 
         return ingraph.broadcast(tf.convert_to_tensor(tensor), root_rank,
-                                 name)
+                                 name, process_set=process_set)
     out = eager.synchronize(eager.broadcast_async(
         np.asarray(tensor), root_rank, name=name, process_set=process_set))
     return tf.convert_to_tensor(np.asarray(out))
@@ -217,11 +220,13 @@ def alltoall(tensor, splits=None, name=None,
         from horovod_tpu.tensorflow import ingraph
 
         t = tf.convert_to_tensor(tensor)
-        n = basics.size()
+        n = (len(process_set.ranks)
+             if getattr(process_set, "process_set_id", 0) else
+             basics.size())
         # ingraph.alltoall pre-flights cross-rank dim-0 agreement and
         # divisibility (failing loudly on every rank), so uniform
         # division of the received row count is exact here.
-        out = ingraph.alltoall(t, name)
+        out = ingraph.alltoall(t, name, process_set=process_set)
         rsplits = tf.fill([n], tf.shape(out)[0] // n)
         return out, rsplits
     out, rsplits = eager.synchronize(eager.alltoall_async(
@@ -239,7 +244,8 @@ def reducescatter(tensor, op=Sum, name=None,
         from horovod_tpu.tensorflow import ingraph
 
         return ingraph.reducescatter(tf.convert_to_tensor(tensor), name,
-                                     op_is_average=(op == Average))
+                                     op_is_average=(op == Average),
+                                     process_set=process_set)
     out = eager.synchronize(eager.reducescatter_async(
         np.asarray(tensor), name=name, op=op, process_set=process_set))
     return tf.convert_to_tensor(np.asarray(out))

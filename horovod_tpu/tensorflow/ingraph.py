@@ -41,14 +41,50 @@ from horovod_tpu.common import basics
 # MultiWorkerMirroredStrategy's small sequential keys should a user run
 # their own strategy beside this runtime.
 _GROUP_KEY = 0x68764400
+_PAIR_KEY_BASE = 0x68800000
 _KEY_BASE = 0x40000000
-_lock = threading.Lock()
+# Instance keys are scoped PER GROUP by TF's collective runtime
+# (verified: two pair groups reusing one instance key don't collide),
+# but different process sets trace different numbers of collectives, so
+# each group gets its own counter + a disjoint block of the key space
+# to keep allocation order rank-consistent within the set.
+_KEY_BLOCK = 1 << 20
+_lock = threading.RLock()
 _state = {"ready": False, "strategy": None, "size": 0}
-_key_counter = itertools.count(_KEY_BASE)  # next() is GIL-atomic
+_key_counters: dict = {}
 _eager_key_cache: dict = {}
 
 
-def _instance_keys(kind: str, name: Optional[str], n: int, sig=None):
+def _group_for(process_set):
+    """(group_key, group_size, group_rank, member_global_ranks).
+
+    Each process set gets its own TF collective group key, derived from
+    its (collectively agreed) id — the per-set communicator bootstrap,
+    reference analog: per-set controllers/NCCL comms
+    (process_set.h:26-168, nccl_operations.cc:65-107). The group itself
+    forms lazily on the members' first collective; non-members never
+    call, exactly like the reference's per-set comms.
+    """
+    if process_set is None or getattr(process_set, "process_set_id", 0) == 0:
+        n = _state["size"]
+        return _GROUP_KEY, n, basics.rank(), list(range(n))
+    ranks = sorted(process_set.ranks)
+    return (_GROUP_KEY + process_set.process_set_id, len(ranks),
+            ranks.index(basics.rank()), ranks)
+
+
+def _fresh_key(group_key: int) -> int:
+    with _lock:
+        counter = _key_counters.get(group_key)
+        if counter is None:
+            block = (group_key - _GROUP_KEY) % 512
+            counter = itertools.count(_KEY_BASE + block * _KEY_BLOCK)
+            _key_counters[group_key] = counter
+        return next(counter)
+
+
+def _instance_keys(kind: str, name: Optional[str], n: int, sig=None,
+                   group_key: int = _GROUP_KEY):
     """Allocate (or, eagerly, reuse) ``n`` collective instance keys.
 
     TF retains per-instance collective state, so a long eager loop that
@@ -73,12 +109,12 @@ def _instance_keys(kind: str, name: Optional[str], n: int, sig=None):
     are baked into the graph once and reused on every graph execution.
     """
     if sig is None or name is None or tf.inside_function():
-        return tuple(next(_key_counter) for _ in range(n))
-    cache_key = (kind, name, sig)
-    with _lock:
+        return tuple(_fresh_key(group_key) for _ in range(n))
+    cache_key = (group_key, kind, name, sig)
+    with _lock:  # RLock: _fresh_key re-enters it
         keys = _eager_key_cache.get(cache_key)
         if keys is None:
-            keys = tuple(next(_key_counter) for _ in range(n))
+            keys = tuple(_fresh_key(group_key) for _ in range(n))
             _eager_key_cache[cache_key] = keys
     return keys
 
@@ -190,11 +226,15 @@ def init_collective_runtime() -> bool:
         return True
 
 
-def _collective_reduce(x, instance_key: int):
+def _collective_reduce(x, instance_key: int,
+                       group_key: int = _GROUP_KEY,
+                       group_size: Optional[int] = None):
     return tf.raw_ops.CollectiveReduceV2(
         input=x,
-        group_size=tf.constant(_state["size"]),
-        group_key=tf.constant(_GROUP_KEY),
+        group_size=tf.constant(group_size
+                               if group_size is not None
+                               else _state["size"]),
+        group_key=tf.constant(group_key),
         instance_key=tf.constant(instance_key),
         ordering_token=[],
         merge_op="Add", final_op="Id",
@@ -202,30 +242,33 @@ def _collective_reduce(x, instance_key: int):
 
 
 def allreduce(x, name: str, op_is_average: bool,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set=None):
     """Differentiable in-graph allreduce (gradient: allreduce of the
     upstream gradient with its own instance key — reference:
     horovod/tensorflow/mpi_ops.py:131-151). ``name`` is kept for
     horovod-API parity / debugging; collective matching uses allocation
     order."""
-    fwd_key, grad_key = _instance_keys("allreduce", name, 2, sig=_sig(x))
+    gkey, gsize, _, _ = _group_for(process_set)
+    fwd_key, grad_key = _instance_keys("allreduce", name, 2, sig=_sig(x),
+                                       group_key=gkey)
 
     @tf.custom_gradient
     def _fwd(v):
         if prescale_factor != 1.0:
             v = v * tf.cast(prescale_factor, v.dtype)
-        out = _collective_reduce(v, fwd_key)
+        out = _collective_reduce(v, fwd_key, gkey, gsize)
         if op_is_average:
-            out = out / tf.cast(_state["size"], out.dtype)
+            out = out / tf.cast(gsize, out.dtype)
         if postscale_factor != 1.0:
             out = out * tf.cast(postscale_factor, out.dtype)
 
         def grad(dy):
             if prescale_factor != 1.0:
                 dy = dy * tf.cast(prescale_factor, dy.dtype)
-            g = _collective_reduce(dy, grad_key)
+            g = _collective_reduce(dy, grad_key, gkey, gsize)
             if op_is_average:
-                g = g / tf.cast(_state["size"], g.dtype)
+                g = g / tf.cast(gsize, g.dtype)
             if postscale_factor != 1.0:
                 g = g * tf.cast(postscale_factor, g.dtype)
             return g
@@ -235,7 +278,7 @@ def allreduce(x, name: str, op_is_average: bool,
     return _fwd(x)
 
 
-def allgather(x, name: str):
+def allgather(x, name: str, process_set=None):
     """Concatenate along dim 0 across ranks, ragged dim 0 allowed
     (reference: HorovodAllgatherOp, tensorflow/mpi_ops.cc:648-734; the
     reference computes per-rank displacements the same way,
@@ -246,16 +289,17 @@ def allgather(x, name: str):
     pad to the max, gather, then strip the padding rows per rank. Both
     phases trace into the graph — no host round-trip.
     """
+    gk, n, _, _ = _group_for(process_set)
     # The sizes phase always gathers a [1] int32 regardless of the data
     # shape, so its key is rank-invariant and cacheable; only the ragged
     # data-phase key must stay fresh (sig=None, see _instance_keys).
     (_sk,) = _instance_keys("allgather.sizes", name, 1,
-                            sig=("int32", (1,)))
-    (_dk,) = _instance_keys("allgather", name, 1)
+                            sig=("int32", (1,)), group_key=gk)
+    (_dk,) = _instance_keys("allgather", name, 1, group_key=gk)
     sizes_key = tf.constant(_sk)
     data_key = tf.constant(_dk)
-    gsize = tf.constant(_state["size"])
-    gkey = tf.constant(_GROUP_KEY)
+    gsize = tf.constant(n)
+    gkey = tf.constant(gk)
 
     n0 = tf.shape(x)[0]
     sizes = tf.raw_ops.CollectiveGatherV2(
@@ -273,12 +317,12 @@ def allgather(x, name: str):
         instance_key=data_key, ordering_token=[],
         communication_hint="auto")  # (size*max_n, ...)
     # Keep each rank's first sizes[i] rows of its max_n-row block.
-    row = tf.range(_state["size"] * max_n)
+    row = tf.range(n * max_n)
     keep = tf.math.floormod(row, max_n) < tf.repeat(sizes, max_n)
     return tf.boolean_mask(gathered, keep)
 
 
-def alltoall(x, name: str):
+def alltoall(x, name: str, process_set=None):
     """Uniform all-to-all: scatter equal dim-0 slices to all ranks,
     concatenate received slices along dim 0 (reference:
     HorovodAlltoallOp, tensorflow/mpi_ops.cc:1049+; ragged splits stay
@@ -291,10 +335,10 @@ def alltoall(x, name: str):
     # cross-rank pre-flight below rather than left to hang. The
     # pre-flight key itself gathers a [1] int32 regardless of data
     # shape: rank-invariant, cacheable.
+    gk, n, _, _ = _group_for(process_set)
     (pre_key,) = _instance_keys("alltoall.sizes", name, 1,
-                                sig=("int32", (1,)))
-    (key,) = _instance_keys("alltoall", name, 1)
-    n = _state["size"]
+                                sig=("int32", (1,)), group_key=gk)
+    (key,) = _instance_keys("alltoall", name, 1, group_key=gk)
     shape = tf.shape(x)
     k = shape[0] // n
     # Pre-flight: gather every rank's dim-0 size (always-uniform [1]
@@ -305,7 +349,7 @@ def alltoall(x, name: str):
     # collective runtime (or one rank raising while peers block).
     sizes = tf.raw_ops.CollectiveGatherV2(
         input=tf.reshape(shape[0], [1]), group_size=tf.constant(n),
-        group_key=tf.constant(_GROUP_KEY),
+        group_key=tf.constant(gk),
         instance_key=tf.constant(pre_key), ordering_token=[],
         communication_hint="auto")
     checks = [
@@ -330,47 +374,130 @@ def alltoall(x, name: str):
     out = tf.raw_ops.CollectiveAllToAllV2(
         input=blocks,
         group_size=tf.constant(n),
-        group_key=tf.constant(_GROUP_KEY),
+        group_key=tf.constant(gk),
         instance_key=tf.constant(key),
         ordering_token=[],
         communication_hint="auto")
     return tf.reshape(out, tf.concat([[n * k], shape[1:]], axis=0))
 
 
-def reducescatter(x, name: str, op_is_average: bool = False):
+# Per-call stats of the last eager reducescatter, for tests asserting
+# the traffic shape: {"algorithm": str, "elements_sent": int}.
+rs_stats = {"algorithm": None, "elements_sent": 0}
+
+
+def _pair_group_key(group_key: int, round_idx: int, lo_grank: int) -> int:
+    """Deterministic TF group key for one recursive-halving pair.
+
+    Group keys identify persistent member sets, so the same (set,
+    round, pair) reuses its key across calls; namespaced away from the
+    full-group keys. Layout (int32 budget above _PAIR_KEY_BASE
+    ~0.4e9): 64 set blocks x 64 rounds x 65536 lo_granks — supports
+    group sizes up to 65536 without two distinct pairs sharing a key
+    (lo_grank < n/2; rounds = log2 n <= 16 there)."""
+    return (_PAIR_KEY_BASE
+            + ((group_key - _GROUP_KEY) % 64) * (64 * 65536)
+            + round_idx * 65536 + lo_grank)
+
+
+def reducescatter(x, name: str, op_is_average: bool = False,
+                  process_set=None):
     """Reduce across ranks and scatter equal dim-0 shards
-    (reference: the reducescatter surface of ops/eager.py; TF op:
-    CollectiveReduceScatterV2)."""
-    # CollectiveReduceScatterV2 only has an NCCL implementation in TF's
-    # registry ("auto" resolves to no CPU/gRPC kernel), so compose it:
-    # reduce then slice out this rank's dim-0 shard — both in-graph.
-    # Shard math matches the native core's uneven split (ranks below
-    # rows % n take one extra row), so the two paths agree on any size.
-    (rkey,) = _instance_keys("reducescatter", name, 1, sig=_sig(x))
-    reduced = _collective_reduce(x, rkey)
-    n = _state["size"]
-    r = basics.rank()
-    rows = tf.shape(reduced)[0]
-    base, extra = rows // n, rows % n
-    my_rows = base + tf.cast(r < extra, tf.int32)
-    offset = r * base + tf.minimum(r, extra)
-    out = tf.slice(
-        reduced,
-        tf.concat([[offset],
-                   tf.zeros([tf.rank(reduced) - 1], tf.int32)], axis=0),
-        tf.concat([[my_rows], tf.shape(reduced)[1:]], axis=0))
+    (reference: ncclReduceScatter's role in nccl_operations.cc:233-440).
+
+    TF's CollectiveReduceScatterV2 has only an NCCL kernel, so the real
+    algorithm is built from pair primitives: RECURSIVE HALVING — in
+    round t each rank swaps half of its remaining buffer with a partner
+    via a 2-member CollectiveAllToAllV2 group and adds, halving the
+    live data every round. Total traffic per rank is
+    rows*(n-1)/n — the textbook reduce-scatter volume — vs the
+    reduce-then-slice fallback's full allreduce of the whole tensor.
+    Requires: group size a power of two, static dim 0 divisible by it;
+    anything else falls back to reduce+slice (kept for shape parity
+    with the native core's uneven split).
+    """
+    gkey, n, grank, ranks = _group_for(process_set)
+    rows = x.shape[0] if x.shape.rank is not None else None
+    halving_ok = (rows is not None and n > 1 and (n & (n - 1)) == 0
+                  and rows % n == 0)
+    if not halving_ok:
+        (rkey,) = _instance_keys("reducescatter", name, 1, sig=_sig(x),
+                                 group_key=gkey)
+        reduced = _collective_reduce(x, rkey, gkey, n)
+        r = grank
+        trows = tf.shape(reduced)[0]
+        base, extra = trows // n, trows % n
+        my_rows = base + tf.cast(r < extra, tf.int32)
+        offset = r * base + tf.minimum(r, extra)
+        out = tf.slice(
+            reduced,
+            tf.concat([[offset],
+                       tf.zeros([tf.rank(reduced) - 1], tf.int32)],
+                      axis=0),
+            tf.concat([[my_rows], tf.shape(reduced)[1:]], axis=0))
+        if not tf.inside_function():
+            rs_stats.update(algorithm="reduce_slice",
+                            elements_sent=int(x.shape.num_elements()
+                                              or 0))
+        if op_is_average:
+            out = out / tf.cast(n, out.dtype)
+        return out
+
+    rounds = n.bit_length() - 1
+    keys = _instance_keys("reducescatter.halving", name, rounds,
+                          sig=_sig(x), group_key=gkey)
+    buf = x
+    lo, span = 0, n  # group-rank range owning the live buffer segment
+    sent = 0
+    for t in range(rounds):
+        half = span // 2
+        top = grank >= lo + half
+        cur_rows = rows >> t
+        low_block, high_block = buf[:cur_rows // 2], buf[cur_rows // 2:]
+        keep = high_block if top else low_block
+        give = low_block if top else high_block
+        partner = grank - half if top else grank + half
+        g_lo, g_hi = sorted((ranks[grank], ranks[partner]))
+        pair_key = _pair_group_key(gkey, t, min(grank, partner))
+        my_idx = 0 if ranks[grank] == g_lo else 1
+        # Block j of the alltoall goes to pair member j (members are
+        # ordered by ascending global rank — verified behavior).
+        blocks = [None, None]
+        blocks[my_idx] = keep
+        blocks[1 - my_idx] = give
+        out = tf.raw_ops.CollectiveAllToAllV2(
+            input=tf.stack(blocks),
+            group_size=tf.constant(2),
+            group_key=tf.constant(pair_key),
+            instance_key=tf.constant(keys[t]),
+            ordering_token=[],
+            communication_hint="auto")
+        # Received: my own keep block + the partner's contribution to
+        # the same segment — reduce locally.
+        buf = out[0] + out[1]
+        sent += int(give.shape.num_elements() or 0)
+        lo, span = (lo + half, half) if top else (lo, half)
+    if not tf.inside_function():
+        rs_stats.update(algorithm="recursive_halving",
+                        elements_sent=sent)
     if op_is_average:
-        out = out / tf.cast(_state["size"], out.dtype)
-    return out
+        buf = buf / tf.cast(n, buf.dtype)
+    return buf
 
 
-def broadcast(x, root_rank: int, name: str):
+def broadcast(x, root_rank: int, name: str, process_set=None):
     """Overwrite with root's value
-    (reference: HorovodBroadcastOp, tensorflow/mpi_ops.cc:736-832)."""
-    (_bk,) = _instance_keys("broadcast", name, 1, sig=_sig(x))
+    (reference: HorovodBroadcastOp, tensorflow/mpi_ops.cc:736-832).
+    ``root_rank`` is the GLOBAL rank and must belong to the set."""
+    gk, n, _, ranks = _group_for(process_set)
+    if root_rank not in ranks:
+        raise ValueError("broadcast root %d not in process set %r"
+                         % (root_rank, ranks))
+    (_bk,) = _instance_keys("broadcast", name, 1, sig=_sig(x),
+                            group_key=gk)
     key = tf.constant(_bk)
-    gsize = tf.constant(_state["size"])
-    gkey = tf.constant(_GROUP_KEY)
+    gsize = tf.constant(n)
+    gkey = tf.constant(gk)
     if basics.rank() == root_rank:
         return tf.raw_ops.CollectiveBcastSendV2(
             input=x, group_size=gsize, group_key=gkey, instance_key=key,

@@ -26,6 +26,8 @@ TIER2_COVERAGE = {
         "tests/test_mxnet_binding.py::test_allreduce_inplace_and_prescale",
     "test_tf_multiproc":
         "tests/test_tf_binding.py::test_allreduce_gradient",
+    "test_tf_ingraph_process_sets_np4":
+        "tests/test_tf_binding.py::test_tf_ingraph_collectives",
     "test_adasum_native_multiproc":
         "tests/test_adasum_hierarchical.py::test_adasum_native_multiproc",
     "test_pytorch_imagenet_resnet50_example":

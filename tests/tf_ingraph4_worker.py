@@ -1,0 +1,92 @@
+"""np=4 worker: TF in-graph PROCESS-SET collectives + 2-round halving.
+
+The np=2 in-graph worker can only form single-member sets; this one
+forms two disjoint 2-member sets (evens/odds) whose collectives run
+concurrently on their own TF group keys — the per-set communicator
+parity case (reference: per-set controllers, process_set.h:26-168) —
+and a 4-rank recursive-halving reduce-scatter (2 exchange rounds,
+traffic rows*(3/4)).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, size = hvd.rank(), hvd.size()
+    assert size == 4
+    from horovod_tpu.tensorflow import ingraph
+
+    assert ingraph.collective_runtime_ready()
+
+    evens = hvd.add_process_set(hvd.ProcessSet([0, 2]))
+    odds = hvd.add_process_set(hvd.ProcessSet([1, 3]))
+    mine = evens if r % 2 == 0 else odds
+    peers = [0, 2] if r % 2 == 0 else [1, 3]
+
+    # Concurrent per-set allreduce on per-set TF group keys; repeated
+    # to exercise the eager per-set key caches.
+    for it in range(3):
+        out = hvd.allreduce(tf.fill([4], float(r + it)), op=hvd.Sum,
+                            name="ig4.ar", process_set=mine)
+        np.testing.assert_allclose(
+            out.numpy(), [float(sum(p + it for p in peers))] * 4)
+    # Per-set ragged allgather: set-rank order, set-local concat.
+    g = hvd.allgather(tf.fill([mine.rank() + 1, 1], float(r)),
+                      name="ig4.g", process_set=mine)
+    expect = np.concatenate(
+        [np.full((i + 1, 1), float(p))
+         for i, p in enumerate(peers)])
+    np.testing.assert_allclose(g.numpy(), expect)
+    # Per-set broadcast from the HIGHER global rank.
+    b = hvd.broadcast(tf.fill([2], float(r)), peers[1], name="ig4.b",
+                      process_set=mine)
+    np.testing.assert_allclose(b.numpy(), [float(peers[1])] * 2)
+    # Per-set uniform alltoall.
+    a2a, rsplits = hvd.alltoall(
+        tf.constant([[10.0 * r], [10.0 * r + 1.0]]), name="ig4.a2a",
+        process_set=mine)
+    np.testing.assert_allclose(
+        a2a.numpy().ravel(),
+        [10.0 * peers[0] + mine.rank(), 10.0 * peers[1] + mine.rank()])
+    np.testing.assert_array_equal(rsplits.numpy(), [1, 1])
+
+    # Global 4-rank recursive-halving reduce-scatter: 2 rounds,
+    # traffic = rows*cols * 3/4 elements.
+    big = tf.reshape(tf.range(32.0, dtype=tf.float32) * (r + 1), [8, 4])
+    shard = hvd.reducescatter(big, op=hvd.Sum, name="ig4.rs")
+    assert ingraph.rs_stats["algorithm"] == "recursive_halving", \
+        ingraph.rs_stats
+    assert ingraph.rs_stats["elements_sent"] == 32 * 3 // 4, \
+        ingraph.rs_stats
+    total = 1.0 + 2.0 + 3.0 + 4.0
+    expect_rows = np.arange(32.0).reshape(8, 4) * total
+    np.testing.assert_allclose(shard.numpy(),
+                               expect_rows[r * 2:(r + 1) * 2])
+
+    # Per-set reduce-scatter (2-member set, 1 round).
+    rs2 = hvd.reducescatter(
+        tf.reshape(tf.range(4.0) * (r + 1), [2, 2]), op=hvd.Sum,
+        name="ig4.ps.rs", process_set=mine)
+    psum = sum(p + 1 for p in peers)
+    expect2 = (np.arange(4.0).reshape(2, 2) * psum)[mine.rank():
+                                                    mine.rank() + 1]
+    np.testing.assert_allclose(rs2.numpy(), expect2)
+
+    hvd.remove_process_set(evens)
+    hvd.remove_process_set(odds)
+    hvd.shutdown()
+    print("TF_INGRAPH4_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,9 +13,13 @@ cd "$(dirname "$0")/.."
 
 TIER="${1:-all}"
 
+# Tier-1 wall budget: measured 575s nominal on this host after moving
+# the compiled dryrun + partition-collection checks to tier 2
+# (r3; was 689s). 900s leaves ~35% headroom for slow/loaded CI
+# machines — the r2 margin (636s vs 720s) proved too thin.
 run_tier1() {
     echo "=== tier 1 (default suite) ==="
-    timeout "${HVD_CI_TIER1_BUDGET:-720}" \
+    timeout "${HVD_CI_TIER1_BUDGET:-900}" \
         python -m pytest tests/ -q -p no:cacheprovider
 }
 

@@ -185,9 +185,13 @@ class RemoteStore:
         if store is not None:
             store.write_bytes(path, data)
             return
+        # Atomic local write: a worker killed mid-write must not
+        # destroy the previous good checkpoint (resume loads this).
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "wb") as f:
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb") as f:
             f.write(data)
+        os.replace(tmp, path)
 
 
 class FilesystemStore(Store):
@@ -244,8 +248,11 @@ class FilesystemStore(Store):
     def write_bytes(self, path: str, data: bytes) -> None:
         path = self._normalize(path)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "wb") as f:
+        # Atomic: never leave a truncated artifact under the final name.
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb") as f:
             f.write(data)
+        os.replace(tmp, path)
 
     def copy_dir(self, src: str, dst: str) -> None:
         shutil.copytree(self._normalize(src), self._normalize(dst),
@@ -308,10 +315,11 @@ class HDFSStore(Store):
 
     def _remote_spec(self):
         if self._ctor_url is None:
-            raise ValueError(
-                "HDFSStore built from an injected filesystem object "
-                "cannot be shipped to training processes (the client "
-                "is not picklable); construct it from an hdfs:// URL")
+            # Injected-filesystem stores (tests, LocalFileSystem) hand
+            # out plain paths, so workers' local-IO fallback is
+            # correct; only URL-built stores need (and can have) a
+            # rebuildable backend in the workers.
+            return None
         return ("HDFSStore", {"prefix_path": self._ctor_url,
                               "save_runs": self._save_runs})
 

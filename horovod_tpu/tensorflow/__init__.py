@@ -220,9 +220,9 @@ def alltoall(tensor, splits=None, name=None,
         from horovod_tpu.tensorflow import ingraph
 
         t = tf.convert_to_tensor(tensor)
-        n = (len(process_set.ranks)
-             if getattr(process_set, "process_set_id", 0) else
-             basics.size())
+        # Group size from the same discriminator the collective itself
+        # uses (also validates that the set is registered).
+        _, n, _, _ = ingraph._group_for(process_set)
         # ingraph.alltoall pre-flights cross-rank dim-0 agreement and
         # divisibility (failing loudly on every rank), so uniform
         # division of the received row count is exact here.

@@ -65,11 +65,16 @@ def _group_for(process_set):
     forms lazily on the members' first collective; non-members never
     call, exactly like the reference's per-set comms.
     """
-    if process_set is None or getattr(process_set, "process_set_id", 0) == 0:
+    ps_id = getattr(process_set, "process_set_id", 0)
+    if process_set is None or ps_id == 0:
         n = _state["size"]
         return _GROUP_KEY, n, basics.rank(), list(range(n))
+    if ps_id is None:
+        raise RuntimeError(
+            "process set %r is not registered (removed, or never "
+            "passed to add_process_set)" % (process_set,))
     ranks = sorted(process_set.ranks)
-    return (_GROUP_KEY + process_set.process_set_id, len(ranks),
+    return (_GROUP_KEY + ps_id, len(ranks),
             ranks.index(basics.rank()), ranks)
 
 
@@ -386,18 +391,16 @@ def alltoall(x, name: str, process_set=None):
 rs_stats = {"algorithm": None, "elements_sent": 0}
 
 
-def _pair_group_key(group_key: int, round_idx: int, lo_grank: int) -> int:
-    """Deterministic TF group key for one recursive-halving pair.
+def _pair_group_key(g_lo: int, g_hi: int) -> int:
+    """Deterministic TF group key for a 2-member pair of GLOBAL ranks.
 
-    Group keys identify persistent member sets, so the same (set,
-    round, pair) reuses its key across calls; namespaced away from the
-    full-group keys. Layout (int32 budget above _PAIR_KEY_BASE
-    ~0.4e9): 64 set blocks x 64 rounds x 65536 lo_granks — supports
-    group sizes up to 65536 without two distinct pairs sharing a key
-    (lo_grank < n/2; rounds = log2 n <= 16 there)."""
-    return (_PAIR_KEY_BASE
-            + ((group_key - _GROUP_KEY) % 64) * (64 * 65536)
-            + round_idx * 65536 + lo_grank)
+    A TF collective group is identified purely by its member set, so
+    the key depends on the two global ranks alone — any process set or
+    round pairing the same two ranks REUSES their group (instance keys
+    distinguish the collectives). Keying on set-local values would let
+    two different member pairs collide. Int32 budget above
+    _PAIR_KEY_BASE (~0.4e9) supports world sizes to ~20000 ranks."""
+    return _PAIR_KEY_BASE + g_lo * _state["size"] + g_hi
 
 
 def reducescatter(x, name: str, op_is_average: bool = False,
@@ -458,7 +461,7 @@ def reducescatter(x, name: str, op_is_average: bool = False,
         give = low_block if top else high_block
         partner = grank - half if top else grank + half
         g_lo, g_hi = sorted((ranks[grank], ranks[partner]))
-        pair_key = _pair_group_key(gkey, t, min(grank, partner))
+        pair_key = _pair_group_key(g_lo, g_hi)
         my_idx = 0 if ranks[grank] == g_lo else 1
         # Block j of the alltoall goes to pair member j (members are
         # ordered by ascending global rank — verified behavior).

@@ -1,0 +1,69 @@
+"""np=2 worker: ~2k named tensors through negotiation, bounded time.
+
+Quantifies the control-plane scaling claims (O(log n) LRU response
+cache + fusion bin-packing): a submission wave of 2000 uniquely named
+tensors must negotiate, fuse, and complete within a generous per-tensor
+budget, and a SECOND wave over the same names (response-cache steady
+state, reference: response_cache.cc fast path) must not be slower than
+the cold wave by more than the allowed factor.
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.ops import eager  # noqa: E402
+
+N_TENSORS = 2000
+# Generous ceiling: 2k tensors in well under a minute even on a loaded
+# CI host; a regression to quadratic cache/fusion behavior blows way
+# past it.
+WAVE_BUDGET_S = 60.0
+WARM_FACTOR = 1.5  # steady-state wave must stay near the cold wave
+
+
+def run_wave(r, tag):
+    handles = [
+        eager.allreduce_async(
+            np.full(16, float(r + i), np.float32),
+            name="scale.%s.%d" % (tag, i), op=1)
+        for i in range(N_TENSORS)
+    ]
+    t0 = time.perf_counter()
+    for i, h in enumerate(handles):
+        out = eager.synchronize(h)
+        assert float(np.asarray(out)[0]) == float(2 * i + 1), (i, out)
+    return time.perf_counter() - t0
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    assert hvd.size() == 2
+
+    cold = run_wave(r, "a")
+    assert cold < WAVE_BUDGET_S, (
+        "cold wave of %d tensors took %.1fs (budget %.0fs)"
+        % (N_TENSORS, cold, WAVE_BUDGET_S))
+    # Same names again: every request should ride the response cache's
+    # bitvector fast path.
+    warm = run_wave(r, "a")
+    assert warm < max(cold * WARM_FACTOR, 5.0), (
+        "steady-state wave %.1fs vs cold %.1fs — cache fast path "
+        "is not holding" % (warm, cold))
+
+    hvd.shutdown()
+    print("NEGOTIATION_SCALE_OK rank=%d cold=%.2fs warm=%.2fs"
+          % (r, cold, warm))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,24 @@ import shutil
 from typing import List, Optional
 
 
+def _atomic_write(path: str, data: bytes) -> None:
+    """Write-then-rename so a killed writer never leaves a truncated
+    artifact under the final name; the orphaned temp is unlinked on a
+    failed write (full disk etc.)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp.%d" % os.getpid()
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 class Store:
     """(reference: spark/common/store.py:36-160)
 
@@ -185,13 +203,7 @@ class RemoteStore:
         if store is not None:
             store.write_bytes(path, data)
             return
-        # Atomic local write: a worker killed mid-write must not
-        # destroy the previous good checkpoint (resume loads this).
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp.%d" % os.getpid()
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        _atomic_write(path, data)
 
 
 class FilesystemStore(Store):
@@ -246,13 +258,7 @@ class FilesystemStore(Store):
             f.write(text)
 
     def write_bytes(self, path: str, data: bytes) -> None:
-        path = self._normalize(path)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        # Atomic: never leave a truncated artifact under the final name.
-        tmp = path + ".tmp.%d" % os.getpid()
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        _atomic_write(self._normalize(path), data)
 
     def copy_dir(self, src: str, dst: str) -> None:
         shutil.copytree(self._normalize(src), self._normalize(dst),
@@ -315,11 +321,20 @@ class HDFSStore(Store):
 
     def _remote_spec(self):
         if self._ctor_url is None:
-            # Injected-filesystem stores (tests, LocalFileSystem) hand
-            # out plain paths, so workers' local-IO fallback is
-            # correct; only URL-built stores need (and can have) a
-            # rebuildable backend in the workers.
-            return None
+            from pyarrow import fs as pafs
+
+            if isinstance(self._fs, (pafs.LocalFileSystem,
+                                     getattr(pafs, "SubTreeFileSystem",
+                                             ()))):
+                # Local injected filesystems hand out plain paths, so
+                # the workers' local-IO fallback is correct.
+                return None
+            raise ValueError(
+                "a %s injected via filesystem= cannot be shipped to "
+                "training processes (the client is not picklable and "
+                "worker-local IO would write to the wrong place); "
+                "construct the store from an hdfs:// URL instead"
+                % type(self._fs).__name__)
         return ("HDFSStore", {"prefix_path": self._ctor_url,
                               "save_runs": self._save_runs})
 

@@ -30,13 +30,15 @@ WARM_FACTOR = 1.5  # steady-state wave must stay near the cold wave
 
 
 def run_wave(r, tag):
+    # The timer covers SUBMISSION too — a quadratic enqueue path must
+    # blow the budget, not hide outside it.
+    t0 = time.perf_counter()
     handles = [
         eager.allreduce_async(
             np.full(16, float(r + i), np.float32),
             name="scale.%s.%d" % (tag, i), op=1)
         for i in range(N_TENSORS)
     ]
-    t0 = time.perf_counter()
     for i, h in enumerate(handles):
         out = eager.synchronize(h)
         assert float(np.asarray(out)[0]) == float(2 * i + 1), (i, out)

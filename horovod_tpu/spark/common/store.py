@@ -323,11 +323,12 @@ class HDFSStore(Store):
         if self._ctor_url is None:
             from pyarrow import fs as pafs
 
-            if isinstance(self._fs, (pafs.LocalFileSystem,
-                                     getattr(pafs, "SubTreeFileSystem",
-                                             ()))):
-                # Local injected filesystems hand out plain paths, so
-                # the workers' local-IO fallback is correct.
+            if type(self._fs) is pafs.LocalFileSystem:
+                # A bare LocalFileSystem maps paths 1:1, so the
+                # workers' local-IO fallback is correct. Anything that
+                # remaps paths (SubTreeFileSystem) or talks to a
+                # remote backend must be rejected — the fallback
+                # would write to the wrong place.
                 return None
             raise ValueError(
                 "a %s injected via filesystem= cannot be shipped to "

@@ -35,7 +35,11 @@ class KerasEstimator(HorovodEstimator):
         opt_config = (optimizer if isinstance(optimizer, str)
                       else tf.keras.optimizers.serialize(optimizer))
         loss = self.loss or "mse"
+        loss_weights = self.loss_weights
         metrics = list(self.metrics)
+        shuffle = self.shuffle
+        random_seed = self.random_seed
+        sample_weight_col = self.sample_weight_col
         # Callbacks ship via cloudpickle (keras callback objects are
         # routinely closures/locals; reference remote.py serializes them
         # the same way) and are rebuilt inside each rank.
@@ -66,6 +70,11 @@ class KerasEstimator(HorovodEstimator):
 
             hvd.init()
             rank, size = hvd.rank(), hvd.size()
+            if random_seed is not None:
+                # Reproducible init/shuffle; offset by rank so dropout
+                # masks etc. differ per rank (reference: remote.py
+                # seeding discipline).
+                tf.keras.utils.set_random_seed(random_seed + rank)
             train_pdf, val_pdf = read_shard(
                 remote_store.train_data_path, rank, size,
                 validation_col="__validation__")
@@ -85,7 +94,7 @@ class KerasEstimator(HorovodEstimator):
                 optimizer=hvd.DistributedOptimizer(
                     opt, compression=gradient_compression)
                 if size > 1 else opt,
-                loss=loss, metrics=metrics)
+                loss=loss, loss_weights=loss_weights, metrics=metrics)
             if resume and remote_store.exists(
                     remote_store.checkpoint_path):
                 # Resume fit from the run's previous checkpoint
@@ -107,7 +116,10 @@ class KerasEstimator(HorovodEstimator):
             # Initial-state sync happens via the injected
             # BroadcastGlobalVariablesCallback below (covers optimizer
             # slots too) — no separate pre-fit broadcast.
-            kwargs = {}
+            kwargs = {"shuffle": shuffle}
+            if sample_weight_col is not None:
+                kwargs["sample_weight"] = \
+                    train_pdf[sample_weight_col].to_numpy()
             if val_pdf is not None and len(val_pdf):
                 xv = np.stack([val_pdf[c].to_numpy()
                                for c in feature_cols], axis=1)

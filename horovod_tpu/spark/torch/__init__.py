@@ -36,6 +36,9 @@ class TorchEstimator(HorovodEstimator):
         batch_size, epochs = self.batch_size, self.epochs
         verbose = self.verbose
         transformation_fn = self.transformation_fn
+        shuffle = self.shuffle
+        random_seed = self.random_seed
+        sample_weight_col = self.sample_weight_col
         resume = self.resume_from_checkpoint
         terminate_on_nan = self.terminate_on_nan
         checkpoint_callback = self.checkpoint_callback
@@ -51,6 +54,10 @@ class TorchEstimator(HorovodEstimator):
 
             hvd.init()
             rank, size = hvd.rank(), hvd.size()
+            if random_seed is not None:
+                # Reproducible init/shuffle, rank-offset so per-rank
+                # randomness (dropout, shuffles) differs.
+                torch.manual_seed(random_seed + rank)
             train_pdf, _val = read_shard(
                 remote_store.train_data_path, rank, size,
                 validation_col="__validation__")
@@ -82,14 +89,39 @@ class TorchEstimator(HorovodEstimator):
                     opt, named_parameters=model.named_parameters(),
                     compression=(gradient_compression
                                  or hvd.Compression.none))
+            weights_col = (torch.tensor(
+                train_pdf[sample_weight_col].to_numpy(),
+                dtype=torch.float32)
+                if sample_weight_col is not None else None)
             losses = []
             for _epoch in range(epochs):
-                perm = torch.randperm(len(x))
+                perm = (torch.randperm(len(x)) if shuffle
+                        else torch.arange(len(x)))
                 for start in range(0, len(x), batch_size):
                     idx = perm[start:start + batch_size]
                     opt.zero_grad()
                     out = model(x[idx])
-                    loss = criterion(out, y[idx])
+                    if weights_col is not None:
+                        # Per-sample weights need an UNREDUCED loss
+                        # (reference: sample_weight_col contract).
+                        per_sample = criterion(out, y[idx])
+                        if per_sample.dim() == 0:
+                            raise ValueError(
+                                "sample_weight_col requires a loss "
+                                "with reduction='none' (got a scalar "
+                                "from %r)" % type(criterion).__name__)
+                        per_sample = per_sample.reshape(
+                            len(idx), -1).mean(dim=1)
+                        w = weights_col[idx]
+                        wsum = w.sum()
+                        if float(wsum) == 0.0:
+                            # Every sample in this batch is
+                            # zero-weighted: nothing to learn, and
+                            # 0/0 would NaN the model.
+                            continue
+                        loss = (per_sample * w).sum() / wsum
+                    else:
+                        loss = criterion(out, y[idx])
                     loss.backward()
                     opt.step()
                 losses.append(float(loss.detach()))

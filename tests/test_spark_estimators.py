@@ -497,6 +497,104 @@ def test_torch_estimator_new_params(tmp_path):
         est_nan.fit(_toy_pdf(64))
 
 
+def test_torch_sample_weights_and_seed(tmp_path):
+    """sample_weight_col: zero-weighted rows must not influence the
+    fit; random_seed makes fits reproducible."""
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    rng = np.random.RandomState(3)
+    x1 = rng.rand(128)
+    x2 = rng.rand(128)
+    y = 2.0 * x1 - x2
+    # Half the rows are poisoned but carry weight 0.
+    w = np.ones(128)
+    y_poisoned = y.copy()
+    y_poisoned[::2] = 100.0
+    w[::2] = 0.0
+    pdf = pd.DataFrame({"x1": x1, "x2": x2, "y": y_poisoned, "w": w})
+
+    def fit(seed, store_dir):
+        torch.manual_seed(seed)  # driver-side model init; the
+        # random_seed param covers worker-side shuffles/dropout
+        est = TorchEstimator(
+            model=torch.nn.Linear(2, 1),
+            loss=torch.nn.MSELoss(reduction="none"),
+            feature_cols=["x1", "x2"], label_cols=["y"],
+            sample_weight_col="w", batch_size=16, epochs=40,
+            verbose=0, random_seed=seed,
+            store=FilesystemStore(str(tmp_path / store_dir)),
+            backend=LocalBackend(num_proc=1))
+        return est.fit(pdf)
+
+    m1 = fit(7, "s1")
+    m2 = fit(7, "s2")
+    probe = [[0.5, 0.5]]
+    # Reproducible: same seed, same result.
+    np.testing.assert_allclose(m1.predict(probe), m2.predict(probe),
+                               atol=1e-6)
+    # Poisoned rows ignored: prediction tracks the CLEAN function.
+    clean = 2.0 * 0.5 - 0.5
+    assert abs(float(m1.predict(probe)[0, 0]) - clean) < 0.5, \
+        m1.predict(probe)
+    # A CONTIGUOUS all-zero-weight block spanning whole batches
+    # (shuffle=False) must be skipped, not divide 0/0 into NaN.
+    pdf_block = pdf.copy()
+    pdf_block["w"] = ([0.0] * 32) + [1.0] * (len(pdf) - 32)
+    torch.manual_seed(7)
+    est_blk = TorchEstimator(
+        model=torch.nn.Linear(2, 1),
+        loss=torch.nn.MSELoss(reduction="none"),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        sample_weight_col="w", batch_size=16, epochs=3, verbose=0,
+        shuffle=False,
+        store=FilesystemStore(str(tmp_path / "s_blk")),
+        backend=LocalBackend(num_proc=1))
+    m_blk = est_blk.fit(pdf_block)
+    assert np.isfinite(m_blk.predict(probe)).all()
+
+    # A scalar-reduction loss with sample weights fails loudly.
+    est_bad = TorchEstimator(
+        model=torch.nn.Linear(2, 1), loss=torch.nn.MSELoss(),
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        sample_weight_col="w", batch_size=16, epochs=1, verbose=0,
+        store=FilesystemStore(str(tmp_path / "s3")),
+        backend=LocalBackend(num_proc=1))
+    with pytest.raises(Exception, match="reduction"):
+        est_bad.fit(pdf)
+
+
+def test_keras_sample_weights(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.spark.keras import KerasEstimator
+
+    rng = np.random.RandomState(4)
+    x1 = rng.rand(128)
+    x2 = rng.rand(128)
+    y = x1 + x2
+    w = np.ones(128)
+    y_poisoned = y.copy()
+    y_poisoned[::2] = -50.0
+    w[::2] = 0.0
+    pdf = pd.DataFrame({"x1": x1, "x2": x2, "y": y_poisoned, "w": w})
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model, optimizer=tf.keras.optimizers.Adam(0.02),
+        loss="mse",
+        feature_cols=["x1", "x2"], label_cols=["y"],
+        sample_weight_col="w", batch_size=16, epochs=60, verbose=0,
+        shuffle=False, random_seed=11,
+        store=FilesystemStore(str(tmp_path / "store")),
+        backend=LocalBackend(num_proc=1))
+    fitted = est.fit(pdf)
+    pred = float(fitted.predict([[0.5, 0.5]])[0, 0])
+    assert abs(pred - 1.0) < 0.5, pred  # clean function, not -50
+
+
 def test_read_shard_rowgroups(tmp_path):
     """Row-group sharding: ranks see disjoint, covering row sets with IO
     proportional to the shard (petastorm semantics)."""

@@ -15,14 +15,33 @@ import subprocess
 from typing import Optional
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
-_BUILD_DIR = os.path.join(os.path.dirname(__file__), "build")
-_LIB = os.path.join(_BUILD_DIR, "libhvdcore.so")
+
+
+def _sanitize_mode() -> str:
+    """``HVD_CORE_SANITIZE=thread`` builds/loads a TSAN-instrumented
+    core — race detection for the background-thread/controller
+    concurrency. Beyond the reference, which ships no sanitizer
+    integration (SURVEY.md §5.2). Workers must ``LD_PRELOAD`` libtsan
+    so the runtime initializes before the uninstrumented python binary
+    loads the library."""
+    return os.environ.get("HVD_CORE_SANITIZE", "").strip()
+
+
+def _build_dir() -> str:
+    mode = _sanitize_mode()
+    suffix = "-" + mode if mode else ""
+    return os.path.join(os.path.dirname(__file__), "build" + suffix)
+
+
+def _lib_path() -> str:
+    return os.path.join(_build_dir(), "libhvdcore.so")
 
 
 def _needs_build() -> bool:
-    if not os.path.exists(_LIB):
+    lib = _lib_path()
+    if not os.path.exists(lib):
         return True
-    lib_mtime = os.path.getmtime(_LIB)
+    lib_mtime = os.path.getmtime(lib)
     for fn in os.listdir(_SRC_DIR):
         if fn.endswith((".cc", ".h", "Makefile")):
             if os.path.getmtime(os.path.join(_SRC_DIR, fn)) > lib_mtime:
@@ -34,23 +53,26 @@ def library_path(build_if_missing: bool = True) -> Optional[str]:
     """Path to libhvdcore.so, building it if needed. Returns None when the
     library is absent and ``build_if_missing`` is False."""
     if not _needs_build():
-        return _LIB
+        return _lib_path()
     if not build_if_missing:
         return None
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    lock_path = os.path.join(_BUILD_DIR, ".build.lock")
+    build_dir = _build_dir()
+    os.makedirs(build_dir, exist_ok=True)
+    lock_path = os.path.join(build_dir, ".build.lock")
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:
             if _needs_build():
-                subprocess.run(
-                    ["make", "-C", _SRC_DIR, "-j2",
-                     "BUILDDIR=" + _BUILD_DIR],
-                    check=True, capture_output=True, text=True)
+                cmd = ["make", "-C", _SRC_DIR, "-j2",
+                       "BUILDDIR=" + build_dir]
+                if _sanitize_mode():
+                    cmd.append("SANITIZE=" + _sanitize_mode())
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
         except subprocess.CalledProcessError as e:
             raise RuntimeError(
                 "Failed to build horovod_tpu native core:\n" + e.stderr
             ) from e
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
-    return _LIB
+    return _lib_path()

@@ -36,6 +36,8 @@ TIER2_COVERAGE = {
         "tests/test_native_core.py::test_cache_eviction_under_tiny_capacity",
     "test_tier_partition_is_complete_and_disjoint":
         "tests/test_ci.py::test_tier2_has_tier1_coverage",
+    "test_native_core_under_tsan":
+        "tests/test_native_core.py::test_native_collectives",
     "test_graft_entry_dryrun":
         "tests/test_graft_entry.py::"
         "test_flagship_shard_map_step_contains_framework_psum",

@@ -115,6 +115,45 @@ def test_negotiation_scale_2k_tensors():
 
 
 @pytest.mark.tier2
+def test_native_core_under_tsan():
+    """np=2 collective matrix on a ThreadSanitizer-instrumented core:
+    the background-thread/controller concurrency must produce ZERO race
+    reports. The reference ships no sanitizer integration (SURVEY.md
+    §5.2 — thread-safety by design only); this verifies it mechanically.
+    """
+    import glob
+
+    libtsan = None
+    for pat in ("/usr/lib/x86_64-linux-gnu/libtsan.so.*",
+                "/usr/lib/gcc/x86_64-linux-gnu/*/libtsan.so"):
+        hits = sorted(glob.glob(pat))
+        if hits:
+            libtsan = hits[-1]
+            break
+    if libtsan is None:
+        pytest.skip("libtsan not available")
+    report_prefix = os.path.join(
+        _REPO, "horovod_tpu", "core", "build-thread", "tsan_report")
+    for old in glob.glob(report_prefix + "*"):
+        os.unlink(old)
+    codes, outputs = _launch(
+        2, os.path.join(_REPO, "tests", "native_worker.py"),
+        extra_env={
+            "HVD_CORE_SANITIZE": "thread",
+            "LD_PRELOAD": libtsan,
+            # exitcode=66 turns any race report into a rank failure;
+            # thread-leak checking off (python's own threads).
+            "TSAN_OPTIONS": "report_thread_leaks=0 exitcode=66 "
+                            "log_path=%s" % report_prefix,
+        }, timeout=300)
+    reports = glob.glob(report_prefix + "*")
+    blobs = "".join(open(p).read() for p in reports)
+    assert codes == [0, 0] and not reports, (
+        "TSAN reports:\n%s\nworker output:\n%s"
+        % (blobs[:4000], "\n".join(outputs)[-2000:]))
+
+
+@pytest.mark.tier2
 def test_process_sets_np4():
     """Concurrent disjoint process sets at np=4 (reference:
     test_process_sets_static.py discipline)."""

@@ -276,6 +276,11 @@ def reducescatter_async(tensor, *, name: Optional[str] = None,
                         op: int = Sum,
                         process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
+    if op not in (Sum, Average):
+        # Same contract on every backend, including the size-1
+        # identity path (reference: reducescatter supports Sum/Average).
+        raise ValueError(
+            "reducescatter supports Sum/Average, got op=%r" % (op,))
     name = name or _auto_name("reducescatter")
     fut = _backend().reducescatter_async([tensor], [name], op, process_set)
     out = Future()

@@ -26,6 +26,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  gradient_predivide_factor, backward_passes_per_step,
                  process_set, sparse_as_dense=False):
         super(self.__class__, self).__init__(params)
+        # Contract validation lives in the DistributedOptimizer
+        # factory, shared with the Adasum class.
         self._compression = compression
         self._op = op
         self.sparse_as_dense = sparse_as_dense
@@ -258,6 +260,22 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     (reference: horovod/torch/optimizer.py:528-590; sparse gradients
     via allgather or densified with ``sparse_as_dense``; op=Adasum uses
     the delta algorithm, reference :335-503)."""
+    # Validate here so BOTH optimizer classes (average and Adasum)
+    # share the contract.
+    if backward_passes_per_step < 1:
+        raise ValueError(
+            "backward_passes_per_step must be >= 1, got %r"
+            % (backward_passes_per_step,))
+    if named_parameters is not None:
+        named_parameters = list(named_parameters)
+        names = [k for k, _ in named_parameters]
+        if len(set(names)) != len(names):
+            # Duplicate names would collide in the core's tensor table
+            # (reference: optimizer.py duplicate-name check).
+            dupes = sorted({k for k in names if names.count(k) > 1})
+            raise ValueError(
+                "named_parameters contains duplicate names: %r"
+                % (dupes,))
     if op == mpi_ops.Adasum:
         if process_set is not global_process_set:
             raise NotImplementedError(

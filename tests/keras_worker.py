@@ -113,6 +113,41 @@ def main():
                       name="k.ar")
     np.testing.assert_allclose(s.numpy(), [3.0])
 
+    # 6. Validation metrics are averaged too: per-rank validation
+    # shards with rank-dependent labels must surface one agreed
+    # val_loss on every rank (MetricAverageCallback covers val_*).
+    tf.keras.utils.set_random_seed(99)
+    m2 = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    m2.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01)), loss="mse")
+    rec2 = _Recorder()
+    xv = np.full((8, 2), 1.0, np.float32)
+    yv = np.full((8, 1), float(r), np.float32)  # rank-dependent!
+    m2.fit(x[:, :2], y, validation_data=(xv, yv), batch_size=8, epochs=1,
+           verbose=0,
+           callbacks=[hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+                      hvd_callbacks.MetricAverageCallback(), rec2])
+    vals = hvd.allgather(tf.constant(
+        [[rec2.epoch_logs[0]["val_loss"]]]), name="k.val").numpy()
+    np.testing.assert_allclose(vals[0], vals[1], rtol=1e-6)
+
+    # 7. LearningRateScheduleCallback staircase stays in lockstep at
+    # np=2 (reference: _keras/callbacks.py:95-176): epoch >= 1 halves.
+    m3 = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    m3.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.1)), loss="mse")
+    rec3 = _Recorder()
+    sched = hvd_callbacks.LearningRateScheduleCallback(
+        initial_lr=0.1, multiplier=0.5, start_epoch=1)
+    m3.fit(x[:, :2], y, batch_size=8, epochs=2, verbose=0,
+           callbacks=[hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+                      hvd_callbacks.MetricAverageCallback(),
+                      sched, rec3])
+    np.testing.assert_allclose(rec3.lrs[0], 0.1, rtol=1e-5)
+    np.testing.assert_allclose(rec3.lrs[1], 0.05, rtol=1e-5)
+
     hvd.shutdown()
     print("KERAS_OK rank=%d" % r)
     return 0

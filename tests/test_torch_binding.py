@@ -139,6 +139,76 @@ def test_sync_batch_norm_function_math():
     assert torch.allclose(b.grad, bn.bias.grad, atol=1e-5)
 
 
+def test_compression_roundtrips():
+    """fp16/bf16 compressors preserve dtype contracts and tolerable
+    precision (reference: torch/compression.py:20-74)."""
+    torch.manual_seed(5)  # unseeded randn can exceed fp16 atol at |x|>=4
+    for comp, wire_dtype, tol in (
+            (hvd.Compression.fp16, torch.float16, 1e-3),
+            (hvd.Compression.bf16, torch.bfloat16, 2e-2),
+            (hvd.Compression.none, torch.float32, 0.0)):
+        x = torch.randn(64)
+        wire, ctx = comp.compress(x)
+        assert wire.dtype == wire_dtype
+        back = comp.decompress(wire, ctx)
+        assert back.dtype == torch.float32
+        assert torch.allclose(back, x, atol=tol or 1e-7)
+    # Non-float tensors pass through uncompressed.
+    xi = torch.arange(8, dtype=torch.int64)
+    wire, ctx = hvd.Compression.fp16.compress(xi)
+    assert wire.dtype == torch.int64
+    assert torch.equal(hvd.Compression.fp16.decompress(wire, ctx), xi)
+
+
+def test_reducescatter_size1_and_ops():
+    full = torch.arange(6, dtype=torch.float32)
+    out = hvd.reducescatter(full, op=hvd.Sum, name="rs1")
+    assert torch.allclose(out, full)  # size 1: whole tensor, own shard
+    avg = hvd.reducescatter(full, op=hvd.Average, name="rs1a")
+    assert torch.allclose(avg, full)
+    with pytest.raises(Exception):
+        hvd.reducescatter(full, op=hvd.Min, name="rs1m")
+
+
+def test_alltoall_splits_validation():
+    t = torch.arange(4, dtype=torch.float32)
+    # splits must sum to dim 0.
+    with pytest.raises(Exception):
+        hvd.alltoall(t, splits=torch.tensor([1]), name="a2a.bad")
+    out, rsplits = hvd.alltoall(t, splits=torch.tensor([4]),
+                                name="a2a.ok")
+    assert torch.equal(out, t)
+    assert list(np.asarray(rsplits)) == [4]
+
+
+def test_optimizer_rejects_bad_options():
+    model = torch.nn.Linear(2, 1)
+    with pytest.raises(ValueError):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=0)
+    # Duplicate parameter names are rejected (reference:
+    # optimizer.py named_parameters validation).
+    dup = [("w", model.weight), ("w", model.bias)]
+    with pytest.raises(ValueError):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=dup)
+    # The Adasum flavor shares the same factory-level contract.
+    with pytest.raises(ValueError):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=dup, op=hvd.Adasum)
+
+
+def test_grouped_allreduce_empty_and_single():
+    assert hvd.grouped_allreduce([], name="empty") == []
+    (out,) = hvd.grouped_allreduce([torch.ones(3)], op=hvd.Sum,
+                                   name="single")
+    assert torch.allclose(out, torch.ones(3))
+
+
 def test_torch_multiproc():
     """np=2 torch DistributedOptimizer through the launcher: both ranks
     converge to identical parameters equal to a mean-gradient step."""

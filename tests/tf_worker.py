@@ -84,12 +84,60 @@ def main():
     sparse_gradients_tf(r, n)
     reducescatter_alltoall_tf(r, n)
     traced_collectives_tf(r, n)
+    minmax_and_scales_tf(r, n)
+    compression_and_objects_tf(r, n)
     error_propagation_tf(r, n)
     join_tf(r, n)
 
     hvd.shutdown()
     print("TF_OK rank=%d" % r)
     return 0
+
+
+def minmax_and_scales_tf(r, n):
+    """Min/Max ops (host path in both modes — the in-graph router only
+    serves Sum/Average) and pre/postscale through the TF surface
+    (reference: test_tensorflow.py op variants)."""
+    x = tf.constant([float(r + 1), -float(r + 1)])
+    mn = hvd.allreduce(x, op=hvd.Min, name="tf.min")
+    mx = hvd.allreduce(x, op=hvd.Max, name="tf.max")
+    np.testing.assert_allclose(mn.numpy(), [1.0, -float(n)])
+    np.testing.assert_allclose(mx.numpy(), [float(n), -1.0])
+    out = hvd.allreduce(tf.fill([3], float(r + 1)), op=hvd.Sum,
+                        name="tf.pre", prescale_factor=0.5)
+    np.testing.assert_allclose(out.numpy(),
+                               [0.5 * sum(range(1, n + 1))] * 3)
+    out = hvd.allreduce(tf.fill([3], float(r + 1)), op=hvd.Average,
+                        name="tf.post", postscale_factor=4.0)
+    np.testing.assert_allclose(
+        out.numpy(), [4.0 * sum(range(1, n + 1)) / n] * 3)
+
+
+def compression_and_objects_tf(r, n):
+    """fp16 wire compression through allreduce and the tape; nested
+    object broadcast round-trips (reference:
+    tensorflow/compression.py + functions.py broadcast_object)."""
+    out = hvd.allreduce(tf.fill([4], float(r + 1)), op=hvd.Average,
+                        name="tf.comp", compression=hvd.Compression.fp16)
+    assert out.dtype == tf.float32  # decompressed back
+    np.testing.assert_allclose(out.numpy(),
+                               [sum(range(1, n + 1)) / n] * 4,
+                               atol=1e-3)
+    w = tf.Variable([2.0, 2.0])
+    with hvd.DistributedGradientTape(
+            op=hvd.Average, compression=hvd.Compression.fp16) as tape:
+        loss = tf.reduce_sum(w * float(r + 1))
+    (g,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(g.numpy(),
+                               [sum(range(1, n + 1)) / n] * 2,
+                               atol=1e-3)
+    obj = hvd.broadcast_object(
+        {"nested": {"rank": r, "arr": np.arange(3) + r},
+         "items": [r, (r, float(r))]}, root_rank=1)
+    assert obj["nested"]["rank"] == 1 and obj["items"][0] == 1
+    np.testing.assert_array_equal(obj["nested"]["arr"], np.arange(3) + 1)
+    gathered = hvd.allgather_object({"r": r})
+    assert [g["r"] for g in gathered] == list(range(n))
 
 
 def sparse_gradients_tf(r, n):

@@ -174,6 +174,10 @@ def main():
     async_handles(r, n)
     process_sets_through_binding(r, n)
     optimizer_state_broadcast(r, n)
+    scale_factor_matrix(r, n)
+    alltoall_edge_cases(r, n)
+    backward_passes_accumulation(r, n)
+    bf16_compression_and_uneven_reducescatter(r, n)
     join_through_binding(r, n)
     error_propagation(r, n)
     sync_bn_backward(r, n)
@@ -181,6 +185,115 @@ def main():
     hvd.shutdown()
     print("TORCH_OK rank=%d" % r)
     return 0
+
+
+def scale_factor_matrix(r, n):
+    """prescale/postscale across dtypes through the binding
+    (reference: Request pre/postscale fields, common/message.h:50;
+    test_torch.py prescale/postscale variants). Scaling happens in the
+    reduction pipeline, so integer tensors keep integer semantics only
+    when the factors keep values integral."""
+    for dt, tol in ((torch.float32, 1e-6), (torch.float64, 1e-12),
+                    (torch.bfloat16, 2e-2)):
+        x = torch.full((5,), float(r + 1), dtype=dt)
+        out = hvd.allreduce(x, name="sf.%s" % dt, op=hvd.Sum,
+                            prescale_factor=0.5)
+        expect = 0.5 * sum(range(1, n + 1))
+        np.testing.assert_allclose(out.to(torch.float64).numpy(),
+                                   np.full(5, expect), rtol=tol,
+                                   atol=tol)
+        out = hvd.allreduce(x, name="sf.post.%s" % dt, op=hvd.Sum,
+                            postscale_factor=2.0)
+        np.testing.assert_allclose(out.to(torch.float64).numpy(),
+                                   np.full(5, 2.0 * sum(range(1, n + 1))),
+                                   rtol=tol, atol=tol)
+    # Combined pre+post on Average: (pre * mean) * post.
+    out = hvd.allreduce(torch.full((3,), float(r + 1)),
+                        name="sf.both", op=hvd.Average,
+                        prescale_factor=4.0, postscale_factor=0.25)
+    mean = sum(range(1, n + 1)) / n
+    np.testing.assert_allclose(out.numpy(), np.full(3, mean), rtol=1e-6)
+
+
+def alltoall_edge_cases(r, n):
+    """Zero-length splits and 2-D payloads through the binding
+    (reference: alltoallv semantics — a rank may send nothing to some
+    peer; test_torch.py alltoall variants)."""
+    if n != 2:
+        return
+    # Rank 0 sends everything to rank 1, nothing to itself; rank 1
+    # sends one row to each.
+    data = torch.arange(2, dtype=torch.float32).reshape(2, 1) + 10.0 * r
+    splits = torch.tensor([0, 2] if r == 0 else [1, 1])
+    out, rsplits = hvd.alltoall(data, splits=splits, name="a2a.zero")
+    if r == 0:
+        np.testing.assert_allclose(out.numpy().ravel(), [10.0])
+        np.testing.assert_array_equal(np.asarray(rsplits), [0, 1])
+    else:
+        np.testing.assert_allclose(out.numpy().ravel(),
+                                   [0.0, 1.0, 11.0])
+        np.testing.assert_array_equal(np.asarray(rsplits), [2, 1])
+    # 2-D payload with trailing feature dim keeps row structure.
+    mat = torch.arange(8, dtype=torch.float32).reshape(4, 2) \
+        + 100.0 * r
+    out2, _ = hvd.alltoall(mat, name="a2a.2d")
+    assert out2.shape == (4, 2)
+    expect = np.concatenate([
+        (np.arange(8).reshape(4, 2) + 100.0 * k)[r * 2:(r + 1) * 2]
+        for k in range(n)])
+    np.testing.assert_allclose(out2.numpy(), expect)
+
+
+def backward_passes_accumulation(r, n):
+    """backward_passes_per_step=2 through the torch optimizer: the
+    first backward accumulates locally (no communication, no update);
+    the second averages the accumulation across ranks and steps
+    (reference: torch/optimizer.py:72-74 local aggregation)."""
+    lin = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        lin.weight.fill_(0.0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(lin.parameters(), lr=1.0),
+        named_parameters=lin.named_parameters(),
+        backward_passes_per_step=2)
+    # Torch usage pattern: k backwards accumulate into p.grad (no
+    # zero_grad between), the hook fires the allreduce on the k-th
+    # pass, then ONE step applies the result.
+    lin(torch.full((1, 3), float(r + 1))).sum().backward()
+    lin(torch.full((1, 3), float(r + 1))).sum().backward()
+    opt.step()
+    # Local sum 2(r+1), divided by passes -> (r+1), averaged over
+    # ranks; lr=1 subtracts.
+    mean = sum(range(1, n + 1)) / n
+    np.testing.assert_allclose(lin.weight.detach().numpy(),
+                               -mean * np.ones((1, 3)), atol=1e-6)
+    opt.zero_grad()
+
+
+def bf16_compression_and_uneven_reducescatter(r, n):
+    """bf16 wire compression (the TPU-native narrow dtype) and the
+    uneven-rows reducescatter shard math through the binding."""
+    lin = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        lin.weight.fill_(0.0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(lin.parameters(), lr=1.0),
+        named_parameters=lin.named_parameters(),
+        compression=hvd.Compression.bf16)
+    lin(torch.full((1, 3), float(r + 1))).sum().backward()
+    opt.step()
+    mean = sum(range(1, n + 1)) / n
+    np.testing.assert_allclose(lin.weight.detach().numpy(),
+                               -mean * np.ones((1, 3)), atol=2e-2)
+    # Uneven reducescatter: 2n+1 rows over n ranks — rank 0 gets the
+    # extra row (native core's shard math).
+    full = torch.arange(2 * n + 1, dtype=torch.float32) * (r + 1)
+    shard = hvd.reducescatter(full, op=hvd.Average, name="rs.uneven")
+    total = sum(range(1, n + 1)) / n
+    rows = 3 if r == 0 else 2
+    offset = r * 2 + min(r, 1)
+    expect = (np.arange(2 * n + 1) * total)[offset:offset + rows]
+    np.testing.assert_allclose(shard.numpy(), expect, rtol=1e-6)
 
 
 def async_handles(r, n):

@@ -100,6 +100,10 @@ from horovod_tpu.ops import (  # noqa: F401
     reducescatter_ingraph,
     synchronize,
 )
+from horovod_tpu.common.objects import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+)
 from horovod_tpu.parallel import (  # noqa: F401
     DATA_AXIS,
     EXPERT_AXIS,

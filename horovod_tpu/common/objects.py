@@ -1,0 +1,70 @@
+"""Framework-neutral picklable-object collectives.
+
+Parity with the reference's ``horovod/torch/functions.py:190-266``
+(``broadcast_object`` / ``allgather_object``): pickle to a uint8 wire
+tensor, exchange sizes, then payloads — numpy + the eager data plane
+only, so every binding (and the root package) can expose them without
+dragging framework imports along.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.process_sets import global_process_set
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set=global_process_set) -> Any:
+    """Broadcast an arbitrary picklable object
+    (reference: horovod/torch/functions.py:190-232): pickle to bytes,
+    broadcast the length, then the payload."""
+    from horovod_tpu.ops import eager
+
+    basics._check_initialized()
+    if basics.size() == 1:
+        return obj
+    name = name or "broadcast_object"
+    if basics.rank() == root_rank:
+        payload = pickle.dumps(obj)
+        buf = np.frombuffer(payload, dtype=np.uint8).copy()
+        sz = np.array([buf.size], dtype=np.int64)
+    else:
+        buf = None
+        sz = np.zeros(1, dtype=np.int64)
+    sz = eager.broadcast(sz, root_rank, name=name + ".sz",
+                         process_set=process_set)
+    if buf is None:
+        buf = np.zeros(int(sz[0]), dtype=np.uint8)
+    buf = eager.broadcast(buf, root_rank, name=name + ".data",
+                          process_set=process_set)
+    return pickle.loads(np.asarray(buf).tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set=global_process_set) -> List[Any]:
+    """Gather one picklable object per rank; returns the list ordered by
+    rank (reference: horovod/torch/functions.py:235-266)."""
+    from horovod_tpu.ops import eager
+
+    basics._check_initialized()
+    if basics.size() == 1:
+        return [obj]
+    name = name or "allgather_object"
+    payload = pickle.dumps(obj)
+    buf = np.frombuffer(payload, dtype=np.uint8).copy()
+    sizes = eager.allgather(np.array([buf.size], dtype=np.int64),
+                            name=name + ".sz", process_set=process_set)
+    data = eager.allgather(buf, name=name + ".data",
+                           process_set=process_set)
+    data = np.asarray(data)
+    out, off = [], 0
+    for s in np.asarray(sizes).ravel().tolist():
+        out.append(pickle.loads(data[off:off + s].tobytes()))
+        off += s
+    return out

@@ -8,10 +8,6 @@ Parity with the reference's ``horovod/torch/functions.py:29-266``:
 
 from __future__ import annotations
 
-import io
-import pickle
-from typing import Any, List
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -50,47 +46,9 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0,
     return broadcast_parameters(opt_state, root_rank, process_set=process_set)
 
 
-def broadcast_object(obj: Any, root_rank: int = 0, name: str = None,
-                     process_set=global_process_set) -> Any:
-    """Broadcast an arbitrary picklable object
-    (reference: horovod/torch/functions.py:190-232): pickle to bytes,
-    broadcast the length, then the payload."""
-    basics._check_initialized()
-    if basics.size() == 1:
-        return obj
-    name = name or "broadcast_object"
-    if basics.rank() == root_rank:
-        payload = pickle.dumps(obj)
-        buf = np.frombuffer(payload, dtype=np.uint8).copy()
-        sz = np.array([buf.size], dtype=np.int64)
-    else:
-        buf = None
-        sz = np.zeros(1, dtype=np.int64)
-    sz = eager.broadcast(sz, root_rank, name=name + ".sz",
-                         process_set=process_set)
-    if buf is None:
-        buf = np.zeros(int(sz[0]), dtype=np.uint8)
-    buf = eager.broadcast(buf, root_rank, name=name + ".data",
-                          process_set=process_set)
-    return pickle.loads(np.asarray(buf).tobytes())
-
-
-def allgather_object(obj: Any, name: str = None,
-                     process_set=global_process_set) -> List[Any]:
-    """Gather one picklable object per rank; returns the list ordered by
-    rank (reference: horovod/torch/functions.py:235-266)."""
-    basics._check_initialized()
-    if basics.size() == 1:
-        return [obj]
-    name = name or "allgather_object"
-    payload = pickle.dumps(obj)
-    buf = np.frombuffer(payload, dtype=np.uint8).copy()
-    sizes = eager.allgather(np.array([buf.size], dtype=np.int64),
-                            name=name + ".sz", process_set=process_set)
-    data = eager.allgather(buf, name=name + ".data", process_set=process_set)
-    data = np.asarray(data)
-    out, off = [], 0
-    for s in np.asarray(sizes).ravel().tolist():
-        out.append(pickle.loads(data[off:off + s].tobytes()))
-        off += s
-    return out
+# Framework-neutral implementations live in common.objects; re-exported
+# here for the established jax-binding surface.
+from horovod_tpu.common.objects import (  # noqa: F401,E402
+    allgather_object,
+    broadcast_object,
+)

@@ -166,7 +166,7 @@ def test_reducescatter_size1_and_ops():
     assert torch.allclose(out, full)  # size 1: whole tensor, own shard
     avg = hvd.reducescatter(full, op=hvd.Average, name="rs1a")
     assert torch.allclose(avg, full)
-    with pytest.raises(Exception):
+    with pytest.raises(ValueError, match="Sum/Average"):
         hvd.reducescatter(full, op=hvd.Min, name="rs1m")
 
 

@@ -391,6 +391,29 @@ def alltoall(x, name: str, process_set=None):
 rs_stats = {"algorithm": None, "elements_sent": 0}
 
 
+def halving_schedule(n: int, grank: int):
+    """Exchange plan for recursive-halving reduce-scatter, pure math.
+
+    Returns ``(rounds, final_lo)`` where ``rounds`` is a list of
+    ``(partner_grank, keep_top, seg_lo, seg_span)`` — per round, the
+    pair partner, whether this rank keeps the upper half of its live
+    segment, and the group-rank segment [seg_lo, seg_lo+seg_span) the
+    live buffer covers BEFORE the exchange. After the last round the
+    buffer covers exactly ``final_lo == grank`` — each rank owns its
+    own shard (tested for large n in test_tf_binding.py, beyond the
+    world sizes the suite can spawn)."""
+    assert n >= 2 and (n & (n - 1)) == 0
+    rounds = []
+    lo, span = 0, n
+    while span > 1:
+        half = span // 2
+        top = grank >= lo + half
+        partner = grank - half if top else grank + half
+        rounds.append((partner, top, lo, span))
+        lo, span = (lo + half, half) if top else (lo, half)
+    return rounds, lo
+
+
 def _pair_group_key(g_lo: int, g_hi: int) -> int:
     """Deterministic TF group key for a 2-member pair of GLOBAL ranks.
 
@@ -446,20 +469,17 @@ def reducescatter(x, name: str, op_is_average: bool = False,
             out = out / tf.cast(n, out.dtype)
         return out
 
-    rounds = n.bit_length() - 1
-    keys = _instance_keys("reducescatter.halving", name, rounds,
+    schedule, final_lo = halving_schedule(n, grank)
+    assert final_lo == grank
+    keys = _instance_keys("reducescatter.halving", name, len(schedule),
                           sig=_sig(x), group_key=gkey)
     buf = x
-    lo, span = 0, n  # group-rank range owning the live buffer segment
     sent = 0
-    for t in range(rounds):
-        half = span // 2
-        top = grank >= lo + half
+    for t, (partner, top, _, _) in enumerate(schedule):
         cur_rows = rows >> t
         low_block, high_block = buf[:cur_rows // 2], buf[cur_rows // 2:]
         keep = high_block if top else low_block
         give = low_block if top else high_block
-        partner = grank - half if top else grank + half
         g_lo, g_hi = sorted((ranks[grank], ranks[partner]))
         pair_key = _pair_group_key(g_lo, g_hi)
         my_idx = 0 if ranks[grank] == g_lo else 1
@@ -479,7 +499,6 @@ def reducescatter(x, name: str, op_is_average: bool = False,
         # the same segment — reduce locally.
         buf = out[0] + out[1]
         sent += int(give.shape.num_elements() or 0)
-        lo, span = (lo + half, half) if top else (lo, half)
     if not tf.inside_function():
         rs_stats.update(algorithm="recursive_halving",
                         elements_sent=sent)

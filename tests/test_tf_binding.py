@@ -195,6 +195,40 @@ def test_tf_ingraph_collectives():
     assert procs.stdout.count("TF_INGRAPH_OK") == 2
 
 
+def test_halving_schedule_properties():
+    """Pure-math proof of the recursive-halving plan at world sizes the
+    suite cannot spawn (n up to 64): every rank ends owning exactly its
+    own shard, pairings are mutual and agree on the exchanged segment,
+    and per-rank traffic is rows*(n-1)/n."""
+    from horovod_tpu.tensorflow.ingraph import halving_schedule
+
+    for n in (2, 4, 8, 16, 32, 64):
+        plans = [halving_schedule(n, g) for g in range(n)]
+        for g, (rounds, final_lo) in enumerate(plans):
+            # Terminates at the rank's own shard.
+            assert final_lo == g, (n, g, final_lo)
+            assert len(rounds) == n.bit_length() - 1
+            # Simulated traffic: live rows halve each round; a unit-row
+            # buffer of n rows sends n/2 + n/4 + ... + 1 = n-1 rows.
+            sent = sum((n >> t) // 2 for t in range(len(rounds)))
+            assert sent == n - 1
+        for g, (rounds, _) in enumerate(plans):
+            for t, (partner, top, lo, span) in enumerate(rounds):
+                p_rounds, _ = plans[partner]
+                p_partner, p_top, p_lo, p_span = p_rounds[t]
+                # Mutual pairing, opposite halves, same live segment.
+                assert p_partner == g, (n, g, t)
+                assert p_top != top
+                assert (p_lo, p_span) == (lo, span)
+        # Segment containment: each round's kept half contains the
+        # rank's final shard.
+        for g, (rounds, _) in enumerate(plans):
+            for partner, top, lo, span in rounds:
+                half = span // 2
+                kept_lo = lo + half if top else lo
+                assert kept_lo <= g < kept_lo + half
+
+
 @pytest.mark.tier2
 def test_tf_ingraph_process_sets_np4():
     """np=4: process-set collectives on per-set TF group keys + 2-round

@@ -254,6 +254,28 @@ def broadcast(x, root_rank: int = 0, *, axis=DATA_AXIS, process_set=None):
     return out.astype(orig_dtype)
 
 
+def _uniform_groups_for(process_set, axis_size: int):
+    """``axis_index_groups`` where EVERY group has the set's size.
+
+    XLA's all_to_all needs uniform group sizes (each group exchanges
+    one slice per member), so the complement ranks are chunked into
+    same-sized groups — their exchanges are discarded by callers, they
+    just have to be well-formed. Requires ``len(set)`` to divide the
+    axis size (equal sub-grids, the MoE/submesh layout)."""
+    if _is_global_set(process_set):
+        return None
+    ranks = list(process_set.ranks)
+    k = len(ranks)
+    rest = [r for r in range(axis_size) if r not in ranks]
+    if len(rest) % k:
+        raise ValueError(
+            "in-graph alltoall on a process set needs the set size "
+            "(%d) to divide the axis size (%d); use the eager path "
+            "for irregular sets" % (k, axis_size))
+    groups = [ranks] + [rest[i:i + k] for i in range(0, len(rest), k)]
+    return groups
+
+
 def alltoall(x, *, axis=DATA_AXIS, split_axis: int = 0, concat_axis: int = 0,
              process_set=None):
     """Uniform all-to-all: scatter equal slices of dim ``split_axis`` to all
@@ -261,17 +283,20 @@ def alltoall(x, *, axis=DATA_AXIS, split_axis: int = 0, concat_axis: int = 0,
 
     The in-graph path requires uniform splits (static shapes under XLA);
     ragged ``splits`` are supported by the eager path (reference allows
-    ragged via alltoallv, horovod/common/ops/mpi_operations.cc MPI_Alltoallv).
+    ragged via alltoallv, horovod/common/ops/mpi_operations.cc
+    MPI_Alltoallv). With a ``process_set``, the exchange stays inside
+    the set (lowered to ``axis_index_groups``).
     """
-    del process_set  # lax.all_to_all has no group support; eager path covers it
-    n = _axis_size(axis)
+    groups = _uniform_groups_for(process_set, _axis_size(axis))
+    n = len(process_set.ranks) if groups is not None else _axis_size(axis)
     if x.shape[split_axis] % n:
         raise ValueError(
-            "alltoall split dim %d (size %d) not divisible by axis size %d"
+            "alltoall split dim %d (size %d) not divisible by group size %d"
             % (split_axis, x.shape[split_axis], n)
         )
-    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis,
-                          tiled=True)
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis,
+                          axis_index_groups=groups, tiled=True)
 
 
 def reducescatter(x, op: int = Sum, *, axis=DATA_AXIS, scatter_dim: int = 0,

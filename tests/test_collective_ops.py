@@ -74,6 +74,36 @@ def test_allreduce_process_set(mesh8):
         np.testing.assert_allclose(out[r], expect)
 
 
+def test_alltoall_process_set(mesh8):
+    """In-graph alltoall restricted to a set: exchange stays inside the
+    group (lowered to axis_index_groups; complement ranks run their own
+    well-formed exchange that callers ignore)."""
+    ps = hvd.ProcessSet([0, 2, 4, 6])
+    ps.process_set_id = 98  # mark as non-global without registering
+    # Each rank holds 4 rows valued 10*rank + row.
+    x = (10.0 * np.arange(8)[:, None]
+         + np.arange(4)[None, :]).astype(np.float32).reshape(8, 4, 1)
+
+    out = _per_rank(
+        mesh8,
+        lambda s: C.alltoall(s[0], process_set=ps)[None], x,
+        check_vma=False)
+    out = np.asarray(out)
+    members = [0, 2, 4, 6]
+    for gi, r in enumerate(members):
+        # Row j of member gi = member j's slice gi (set-rank order).
+        expect = np.array([10.0 * members[j] + gi for j in range(4)])
+        np.testing.assert_allclose(out[r].ravel(), expect)
+
+    # A set whose size does not divide the axis raises loudly.
+    bad = hvd.ProcessSet([0, 1, 2])
+    bad.process_set_id = 97
+    with pytest.raises(ValueError, match="divide"):
+        _per_rank(mesh8,
+                  lambda s: C.alltoall(s[0], process_set=bad)[None], x,
+                  check_vma=False)
+
+
 def test_grouped_allreduce(mesh8):
     xs = [np.ones((8, 2), np.float32), 2.0 * np.ones((8, 3), np.float32)]
 

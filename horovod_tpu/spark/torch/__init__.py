@@ -36,6 +36,7 @@ class TorchEstimator(HorovodEstimator):
         batch_size, epochs = self.batch_size, self.epochs
         verbose = self.verbose
         transformation_fn = self.transformation_fn
+        steps_per_epoch = self.train_steps_per_epoch
         shuffle = self.shuffle
         random_seed = self.random_seed
         sample_weight_col = self.sample_weight_col
@@ -94,10 +95,48 @@ class TorchEstimator(HorovodEstimator):
                 dtype=torch.float32)
                 if sample_weight_col is not None else None)
             losses = []
+            # Lockstep invariant: every rank must run the SAME number
+            # of optimizer steps per epoch — row shards can differ by
+            # one row (read_shard deals rows round-robin), and under
+            # the hook-based DistributedOptimizer a rank running an
+            # extra batch fires allreduces no peer joins (a hang). All
+            # ranks agree on min(batches) and drop the remainder,
+            # like the reference's steps_per_epoch contract
+            # (reference: spark/torch/remote.py steps_per_epoch from
+            # global row counts).
+            n_batches = (len(x) + batch_size - 1) // batch_size
+            if steps_per_epoch is not None:
+                n_batches = min(n_batches, steps_per_epoch)
+            if size > 1:
+                local_batches = n_batches
+                n_batches = int(hvd.allreduce(
+                    torch.tensor(local_batches, dtype=torch.int64),
+                    op=hvd.Min, name="spark.torch.n_batches"))
+                max_batches = int(hvd.allreduce(
+                    torch.tensor(local_batches, dtype=torch.int64),
+                    op=hvd.Max, name="spark.torch.max_batches"))
+                if max_batches > n_batches and not shuffle and rank == 0:
+                    # Without shuffling the SAME tail rows fall past
+                    # the agreed step count every epoch. Detected via
+                    # the Max reduction so surplus on ANY rank warns.
+                    print("warning: uneven shards (max %d vs global "
+                          "min %d batches) and shuffle=False: tail "
+                          "rows beyond the global minimum are never "
+                          "trained" % (max_batches, n_batches))
+            if n_batches == 0:
+                raise ValueError(
+                    "no trainable batches: at least one rank's shard "
+                    "is empty (global min over %d rank(s)); provide "
+                    "more rows than workers or check "
+                    "transformation_fn" % size)
+            # An all-skipped epoch (every batch zero-weighted) reports
+            # 0.0 rather than leaving `loss` unbound.
+            loss = torch.zeros(())
             for _epoch in range(epochs):
                 perm = (torch.randperm(len(x)) if shuffle
                         else torch.arange(len(x)))
-                for start in range(0, len(x), batch_size):
+                for bi in range(n_batches):
+                    start = bi * batch_size
                     idx = perm[start:start + batch_size]
                     opt.zero_grad()
                     out = model(x[idx])
@@ -114,21 +153,55 @@ class TorchEstimator(HorovodEstimator):
                             len(idx), -1).mean(dim=1)
                         w = weights_col[idx]
                         wsum = w.sum()
+                        # A zero-weight-sum batch must still run
+                        # backward()+step() when distributed: under
+                        # DistributedOptimizer every rank's collective
+                        # sequence has to stay identical, so skipping
+                        # the step on one rank while peers run it would
+                        # hang training. A zero-gradient loss keeps the
+                        # step (and its allreduces); note stateful
+                        # optimizers (momentum, Adam) still apply their
+                        # buffers on such a step — the price of staying
+                        # in lockstep. Single-worker runs have no such
+                        # constraint and keep the skip (and its exact
+                        # parameter trajectory). Nonzero sums (incl.
+                        # negative) divide normally.
                         if float(wsum) == 0.0:
-                            # Every sample in this batch is
-                            # zero-weighted: nothing to learn, and
-                            # 0/0 would NaN the model.
-                            continue
-                        loss = (per_sample * w).sum() / wsum
+                            if size == 1:
+                                continue
+                            # Zero-gradient loss built from the model
+                            # OUTPUT, not the criterion: zero-weighted
+                            # samples are exactly the ones users mark
+                            # invalid, and backprop of 0 through an
+                            # infinite criterion derivative (log(0),
+                            # saturated fp32) would be 0*inf = NaN,
+                            # allreduced into every rank's weights.
+                            # Non-finite outputs are masked for the
+                            # same reason (inf * 0.0 = NaN).
+                            loss = torch.where(
+                                torch.isfinite(out), out,
+                                torch.zeros_like(out)).sum() * 0.0
+                        else:
+                            loss = (per_sample * w).sum() / wsum
                     else:
                         loss = criterion(out, y[idx])
                     loss.backward()
                     opt.step()
                 losses.append(float(loss.detach()))
-                if terminate_on_nan and not np.isfinite(losses[-1]):
-                    raise RuntimeError(
-                        "loss is NaN/inf at epoch %d (terminate_on_nan)"
-                        % _epoch)
+                if terminate_on_nan:
+                    # The verdict must be GLOBAL: a per-rank raise
+                    # would exit one rank while peers continue into
+                    # collectives with no partner (a hang, not a
+                    # clean failure).
+                    bad = not np.isfinite(losses[-1])
+                    if size > 1:
+                        bad = bool(float(hvd.allreduce(
+                            torch.tensor(float(bad)), op=hvd.Max,
+                            name="spark.torch.nan_check")))
+                    if bad:
+                        raise RuntimeError(
+                            "loss is NaN/inf at epoch %d on at least "
+                            "one rank (terminate_on_nan)" % _epoch)
                 if checkpoint_callback is not None and rank == 0:
                     checkpoint_callback(model, _epoch)
                 if verbose and rank == 0:

@@ -49,6 +49,14 @@ _KEY_BASE = 0x40000000
 # each group gets its own counter + a disjoint block of the key space
 # to keep allocation order rank-consistent within the set.
 _KEY_BLOCK = 1 << 20
+_INT32_MAX = 2**31 - 1
+# Largest world size whose worst pair key (g_lo=n-2, g_hi=n-1) still
+# fits int32: PAIR_BASE + (n-2)*n + (n-1) <= INT32_MAX  =>  n <= 19856
+# (n=19856 gives offset 394,240,879 <= budget 394,264,575).
+_PAIR_MAX_WORLD = 19856
+# Process-set ids at or past this value would push _GROUP_KEY + ps_id
+# into the pair-key range and collide with pairwise groups.
+_MAX_PROCESS_SET_ID = _PAIR_KEY_BASE - _GROUP_KEY  # 0x9BC00 = 637952
 _lock = threading.RLock()
 _state = {"ready": False, "strategy": None, "size": 0}
 _key_counters: dict = {}
@@ -73,6 +81,11 @@ def _group_for(process_set):
         raise RuntimeError(
             "process set %r is not registered (removed, or never "
             "passed to add_process_set)" % (process_set,))
+    if ps_id >= _MAX_PROCESS_SET_ID:
+        raise RuntimeError(
+            "process set id %d exceeds the TF group-key budget (max "
+            "%d): its group key would collide with the pairwise "
+            "collective key range" % (ps_id, _MAX_PROCESS_SET_ID - 1))
     ranks = sorted(process_set.ranks)
     return (_GROUP_KEY + ps_id, len(ranks),
             ranks.index(basics.rank()), ranks)
@@ -112,9 +125,18 @@ def _instance_keys(kind: str, name: Optional[str], n: int, sig=None,
 
     Inside a ``tf.function`` trace fresh keys are correct and free: they
     are baked into the graph once and reused on every graph execution.
+
+    ``name=None`` maps to a stable per-kind default name so such calls
+    still hit the cache. The public wrappers in ``tensorflow/__init__``
+    already default their names before reaching here, so this is a
+    safety net for direct ``ingraph`` callers only: the signature is
+    part of the cache key and is rank-invariant for the cacheable ops,
+    so all ranks agree on hit/miss.
     """
-    if sig is None or name is None or tf.inside_function():
+    if sig is None or tf.inside_function():
         return tuple(_fresh_key(group_key) for _ in range(n))
+    if name is None:
+        name = "_hvd_default." + kind
     cache_key = (group_key, kind, name, sig)
     with _lock:  # RLock: _fresh_key re-enters it
         keys = _eager_key_cache.get(cache_key)
@@ -422,8 +444,16 @@ def _pair_group_key(g_lo: int, g_hi: int) -> int:
     round pairing the same two ranks REUSES their group (instance keys
     distinguish the collectives). Keying on set-local values would let
     two different member pairs collide. Int32 budget above
-    _PAIR_KEY_BASE (~0.4e9) supports world sizes to ~20000 ranks."""
-    return _PAIR_KEY_BASE + g_lo * _state["size"] + g_hi
+    _PAIR_KEY_BASE (~0.39e9) supports world sizes to 19856 ranks;
+    beyond that the key would overflow TF's int32 group-key space, so
+    we fail loudly instead of wrapping into another key range."""
+    key = _PAIR_KEY_BASE + g_lo * _state["size"] + g_hi
+    if key > _INT32_MAX:
+        raise RuntimeError(
+            "pair group key for global ranks (%d, %d) overflows int32 "
+            "at world size %d; pairwise collectives support at most "
+            "%d ranks" % (g_lo, g_hi, _state["size"], _PAIR_MAX_WORLD))
+    return key
 
 
 def reducescatter(x, name: str, op_is_average: bool = False,
@@ -444,8 +474,13 @@ def reducescatter(x, name: str, op_is_average: bool = False,
     """
     gkey, n, grank, ranks = _group_for(process_set)
     rows = x.shape[0] if x.shape.rank is not None else None
+    # The pair-key budget is checked against the GLOBAL world size (a
+    # value every rank agrees on) so that all ranks pick the same
+    # algorithm: a per-pair overflow raise would kill only the ranks
+    # whose pair key overflows and hang the rest in their collectives.
     halving_ok = (rows is not None and n > 1 and (n & (n - 1)) == 0
-                  and rows % n == 0)
+                  and rows % n == 0
+                  and _state["size"] <= _PAIR_MAX_WORLD)
     if not halving_ok:
         (rkey,) = _instance_keys("reducescatter", name, 1, sig=_sig(x),
                                  group_key=gkey)

@@ -169,18 +169,20 @@ class TorchEstimator(HorovodEstimator):
                         if float(wsum) == 0.0:
                             if size == 1:
                                 continue
-                            # Zero-gradient loss built from the model
-                            # OUTPUT, not the criterion: zero-weighted
-                            # samples are exactly the ones users mark
-                            # invalid, and backprop of 0 through an
-                            # infinite criterion derivative (log(0),
-                            # saturated fp32) would be 0*inf = NaN,
-                            # allreduced into every rank's weights.
-                            # Non-finite outputs are masked for the
-                            # same reason (inf * 0.0 = NaN).
-                            loss = torch.where(
-                                torch.isfinite(out), out,
-                                torch.zeros_like(out)).sum() * 0.0
+                            # Zero-gradient loss from a SECOND forward
+                            # on zeroed inputs: every saved activation
+                            # is then finite, so backward of the 0.0-
+                            # scaled loss yields exactly-zero grads.
+                            # Using the real batch (whose samples are
+                            # user-marked invalid and may saturate to
+                            # inf) anywhere in the graph risks
+                            # 0*inf = NaN in matmul backward, which the
+                            # hooks would allreduce into every rank's
+                            # weights. Same module graph => same
+                            # collective pattern; BN running stats see
+                            # one extra zero batch on these steps.
+                            loss = model(
+                                torch.zeros_like(x[idx])).sum() * 0.0
                         else:
                             loss = (per_sample * w).sum() / wsum
                     else:

@@ -23,9 +23,14 @@ run_tier1() {
         python -m pytest tests/ -q -p no:cacheprovider
 }
 
+# Tier-2 wall budget: the r3 value (720s) was breached on a cold XLA
+# cache (rc=124, judged round 3). Re-measured r4 on this host after
+# `rm -rf /tmp/hvd_tpu_jax_cache` (np=4/np=8 workers compile fresh XLA
+# programs): 530.78s cold. Budget raised to 900s (~41% headroom);
+# consecutive cold proof runs are recorded below once measured.
 run_tier2() {
     echo "=== tier 2 (heavyweight integration) ==="
-    timeout "${HVD_CI_TIER2_BUDGET:-720}" \
+    timeout "${HVD_CI_TIER2_BUDGET:-900}" \
         python -m pytest tests/ -q -p no:cacheprovider \
         --override-ini 'addopts=' -m tier2
 }

@@ -75,6 +75,9 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "core/src/controller.cc StallInspector enforcement"),
     Knob("HOROVOD_ELASTIC", HONORED,
          "runner/elastic_run.py + elastic/worker.py"),
+    Knob("HOROVOD_ELASTIC_TIMEOUT", HONORED,
+         "runner/elastic_run.py re-scaling rendezvous budget "
+         "(reference elastic/driver.py:81, default 600s)"),
     Knob("HOROVOD_DISABLE_GROUP_FUSION", HONORED,
          "core/src/controller.cc FuseResponses"),
     Knob("HOROVOD_DYNAMIC_PROCESS_SETS", HONORED,

@@ -504,6 +504,10 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
     } else {
       if (state == ResponseCache::State::INVALID)
         ps.cache.EraseByName(req.tensor_name);
+      // Timeline: this rank's request enters negotiation (cached hits
+      // bypass it — same as the reference's cache fast path).
+      if (timeline_hooks_.negotiate_start)
+        timeline_hooks_.negotiate_start(req.tensor_name, req.op_type);
       uncached.push_back(req);
     }
   }
@@ -655,6 +659,9 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
             ps.group_members[req.group_id].insert(req.tensor_name);
             ps.group_of[req.tensor_name] = req.group_id;
           }
+          if (timeline_hooks_.negotiate_rank_ready)
+            timeline_hooks_.negotiate_rank_ready(
+                req.tensor_name, req.request_rank, req.op_type);
           if (IncrementTensorCount(ps, req)) {
             auto git = ps.group_of.find(req.tensor_name);
             if (git == ps.group_of.end()) {
@@ -748,6 +755,13 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
       ApplyCategoricals(ps, cats & 1, cats & 2, me);
       negotiated = ParseResponseList(resp_blob.data() + sizeof(ft) + 1,
                                      resp_blob.size() - sizeof(ft) - 1);
+    }
+    // Timeline: negotiation over for every tensor in this cycle's
+    // responses (on the coordinator AND on workers, whose list arrives
+    // via the broadcast).
+    if (timeline_hooks_.negotiate_end) {
+      for (auto& r : negotiated)
+        for (auto& nm : r.tensor_names) timeline_hooks_.negotiate_end(nm);
     }
     for (auto& r : negotiated) out->push_back(std::move(r));
   }

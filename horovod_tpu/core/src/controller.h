@@ -17,6 +17,7 @@
 
 #include <chrono>
 #include <deque>
+#include <functional>
 #include <list>
 #include <set>
 
@@ -168,9 +169,30 @@ struct ProcessSetState {
 
 // ------------------------------------------------------------- controller ---
 
+// Timeline callbacks for the negotiation phase (reference:
+// timeline.cc:496-558 NegotiateStart/NegotiateRankReady/NegotiateEnd).
+// Installed by the runtime owner (operations.cc); every hook must be
+// cheap when the timeline is off.
+struct TimelineHooks {
+  // This rank's request entered slow-path negotiation.
+  std::function<void(const std::string& tensor, OpType op)> negotiate_start;
+  // Coordinator only: ``rank``'s request for ``tensor`` arrived. May
+  // precede this rank's own negotiate_start (a peer can submit first);
+  // the receiver opens the span on first contact, whichever hook that
+  // is (reference: NegotiateStart "first call takes precedence").
+  std::function<void(const std::string& tensor, int rank, OpType op)>
+      negotiate_rank_ready;
+  // The tensor was emitted in this cycle's response list.
+  std::function<void(const std::string& tensor)> negotiate_end;
+};
+
 class Controller {
  public:
   Controller(TcpComm& comm, int64_t fusion_bytes);
+
+  void set_timeline_hooks(TimelineHooks hooks) {
+    timeline_hooks_ = std::move(hooks);
+  }
 
   // One negotiation round for one process set. Returns the ordered list
   // of responses every member must execute this cycle; the first
@@ -210,6 +232,7 @@ class Controller {
                          bool hierarchical, int my_rank);
 
   TcpComm& comm_;
+  TimelineHooks timeline_hooks_;
   int64_t fusion_threshold_;
   std::atomic<int64_t> pending_fusion_{0};
   // bit2 = staged marker, bit0 = cache_enabled, bit1 = hierarchical.

@@ -76,6 +76,9 @@ struct Global {
   std::unique_ptr<ParameterManager> autotune;
   std::mutex timeline_mutex;
   std::unique_ptr<TimelineWriter> timeline;
+  // Tensors currently inside a NEGOTIATE_* span (guarded by
+  // timeline_mutex; mirrors the reference's per-tensor TimelineState).
+  std::set<std::string> tl_negotiating;
   Clock::time_point t_origin = Clock::now();
 
   std::mutex init_mutex;
@@ -106,6 +109,81 @@ DoneCallback MakeDone(long long tag) {
                const int64_t* splits, int n_splits) {
     FireCallback(tag, s, out, out_bytes, splits, n_splits);
   };
+}
+
+// --------------------------------------------------- timeline phases -------
+// Per-tensor phase emission (reference: timeline.cc:496-558): each
+// tensor gets NEGOTIATE_<OP> (begin at slow-path entry, rank-ready
+// instants on the coordinator, end at response receipt), then a
+// top-level <OP> span whose children are QUEUE (waiting behind earlier
+// responses in the cycle), MEMCPY_IN/OUT_FUSION_BUFFER around the
+// fused pack/unpack, and the wire op (TCP_*). All helpers no-op
+// cheaply when the timeline is off.
+
+long long TlNowUs() {
+  return (long long)std::chrono::duration_cast<std::chrono::microseconds>(
+             Clock::now() - g->t_origin)
+      .count();
+}
+
+void TlNegotiateStart(const std::string& name, OpType op) {
+  std::lock_guard<std::mutex> lk(g->timeline_mutex);
+  if (!g->timeline) return;
+  // Repeated entry (cache invalidation requeue) keeps the first span,
+  // like the reference's NEGOTIATING-state guard.
+  if (!g->tl_negotiating.insert(name).second) return;
+  g->timeline->Begin(name, std::string("NEGOTIATE_") + OpTypeName(op),
+                     TlNowUs());
+}
+
+void TlNegotiateRankReady(const std::string& name, int rank, OpType op) {
+  std::lock_guard<std::mutex> lk(g->timeline_mutex);
+  if (!g->timeline) return;
+  // A peer's request can reach the coordinator before this rank pops
+  // its own; first contact opens the span (reference: NegotiateStart
+  // "first call takes precedence" + NegotiateRankReady).
+  if (g->tl_negotiating.insert(name).second)
+    g->timeline->Begin(name, std::string("NEGOTIATE_") + OpTypeName(op),
+                       TlNowUs());
+  g->timeline->Instant(name, std::to_string(rank), TlNowUs());
+}
+
+void TlNegotiateEnd(const std::string& name) {
+  std::lock_guard<std::mutex> lk(g->timeline_mutex);
+  if (!g->timeline) return;
+  if (g->tl_negotiating.erase(name) == 0) return;
+  g->timeline->End(name, TlNowUs());
+}
+
+// Begin/end a span on every tensor of a response.
+void TlAllBegin(const Response& resp, const std::string& category) {
+  std::lock_guard<std::mutex> lk(g->timeline_mutex);
+  if (!g->timeline) return;
+  long long now = TlNowUs();
+  for (auto& nm : resp.tensor_names) g->timeline->Begin(nm, category, now);
+}
+
+void TlAllEnd(const Response& resp) {
+  std::lock_guard<std::mutex> lk(g->timeline_mutex);
+  if (!g->timeline) return;
+  long long now = TlNowUs();
+  for (auto& nm : resp.tensor_names) g->timeline->End(nm, now);
+}
+
+// The wire-op activity name (reference analog: MPI_ALLREDUCE /
+// NCCL_ALLREDUCE names in common.h:73-105; the transport here is the
+// native TCP data plane).
+const char* TlWireName(const Response& resp) {
+  switch (resp.op_type) {
+    case OpType::ALLREDUCE:
+      return resp.reduce_op == ReduceOp::ADASUM ? "TCP_ADASUM_ALLREDUCE"
+                                                : "TCP_ALLREDUCE";
+    case OpType::ALLGATHER: return "TCP_ALLGATHER";
+    case OpType::BROADCAST: return "TCP_BCAST";
+    case OpType::ALLTOALL: return "TCP_ALLTOALLV";
+    case OpType::REDUCESCATTER: return "TCP_REDUCESCATTER";
+    default: return "TCP_OP";
+  }
 }
 
 // ----------------------------------------------------------- executor ------
@@ -144,6 +222,7 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
   if (resp.reduce_op == ReduceOp::ADASUM) {
     // Adasum coefficients are per-tensor: run the merge tree tensor by
     // tensor (reference: adasum.h FusedAllreduce per-layer dots).
+    TlAllBegin(resp, TlWireName(resp));
     for (auto& p : parts) {
       std::vector<char> scratch;
       void* data;
@@ -160,13 +239,16 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
       if (resp.postscale != 1.0)
         ScaleBuffer(data, p.count, resp.dtype, resp.postscale);
     }
+    TlAllEnd(resp);
   } else if (parts.size() == 1 && parts[0].present) {
     // Single tensor: reduce in place, no fusion copy.
     Part& p = parts[0];
     if (resp.prescale != 1.0)
       ScaleBuffer(p.entry.data, p.count, resp.dtype, resp.prescale);
+    TlAllBegin(resp, TlWireName(resp));
     st = RingAllreduce(g->comm, p.entry.data, p.count, resp.dtype,
                        resp.reduce_op, ps.members);
+    TlAllEnd(resp);
     if (st.ok()) {
       double s = avg_scale * resp.postscale;
       if (s != 1.0) ScaleBuffer(p.entry.data, p.count, resp.dtype, s);
@@ -178,6 +260,7 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
       g->fusion_buffer.resize((size_t)(total * (int64_t)esize));
     char* buf = g->fusion_buffer.data();
     int64_t off = 0;
+    TlAllBegin(resp, "MEMCPY_IN_FUSION_BUFFER");
     for (auto& p : parts) {
       if (p.present) {
         memcpy(buf + off * esize, p.entry.data, (size_t)(p.count * esize));
@@ -186,20 +269,25 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
       }
       off += p.count;
     }
+    TlAllEnd(resp);
     if (resp.prescale != 1.0)
       ScaleBuffer(buf, total, resp.dtype, resp.prescale);
+    TlAllBegin(resp, TlWireName(resp));
     st = RingAllreduce(g->comm, buf, total, resp.dtype, resp.reduce_op,
                        ps.members);
+    TlAllEnd(resp);
     if (st.ok()) {
       double s = avg_scale * resp.postscale;
       if (s != 1.0) ScaleBuffer(buf, total, resp.dtype, s);
       off = 0;
+      TlAllBegin(resp, "MEMCPY_OUT_FUSION_BUFFER");
       for (auto& p : parts) {
         if (p.present)
           memcpy(p.entry.data, buf + off * esize,
                  (size_t)(p.count * esize));
         off += p.count;
       }
+      TlAllEnd(resp);
     }
   }
   for (auto& p : parts) {
@@ -225,7 +313,9 @@ Status ExecuteAllgather(ProcessSetState& ps, const Response& resp) {
   }
   std::vector<char> out((size_t)total_bytes);
   const void* send = present ? e.data : nullptr;
+  TlAllBegin(resp, TlWireName(resp));
   Status st = RingAllgatherv(g->comm, send, out.data(), bytes, ps.members);
+  TlAllEnd(resp);
   if (present && e.callback) {
     // splits: per-member element counts (python derives dim 0).
     e.callback(st, out.data(), total_bytes, resp.tensor_sizes.data(),
@@ -251,7 +341,9 @@ Status ExecuteBroadcast(ProcessSetState& ps, const Response& resp) {
     scratch.resize((size_t)bytes);
     data = scratch.data();
   }
+  TlAllBegin(resp, TlWireName(resp));
   Status st = BroadcastData(g->comm, data, bytes, root_idx, ps.members);
+  TlAllEnd(resp);
   if (present && e.callback)
     e.callback(st, data, bytes, nullptr, 0);
   return st;
@@ -274,9 +366,11 @@ Status ExecuteAlltoall(ProcessSetState& ps, const Response& resp) {
   }
   std::vector<char> out((size_t)total_recv);
   const void* send = present ? e.data : nullptr;
+  TlAllBegin(resp, TlWireName(resp));
   Status st =
       AlltoallvData(g->comm, send, send_bytes, out.data(), recv_bytes,
                     ps.members);
+  TlAllEnd(resp);
   if (present && e.callback) {
     std::vector<int64_t> recv_counts(n);
     for (size_t j = 0; j < n; ++j)
@@ -305,8 +399,10 @@ Status ExecuteReducescatter(ProcessSetState& ps, const Response& resp) {
     scratch.assign((size_t)(count * (int64_t)esize), 0);
     data = scratch.data();
   }
+  TlAllBegin(resp, TlWireName(resp));
   Status st = RingAllreduce(g->comm, data, count, resp.dtype, resp.reduce_op,
                             ps.members);
+  TlAllEnd(resp);
   if (st.ok() && resp.reduce_op == ReduceOp::AVERAGE)
     ScaleBuffer(data, count, resp.dtype, 1.0 / n);
   if (present && e.callback) {
@@ -524,6 +620,13 @@ void BackgroundLoop() {
           other->queue.AbortAll(s);
         break;
       }
+      // Top-level per-tensor spans open as soon as the response list is
+      // known; QUEUE covers the wait behind earlier responses in the
+      // same cycle (reference: Timeline::Start + QUEUE activity).
+      for (auto& r : responses) {
+        TlAllBegin(r, OpTypeName(r.op_type));
+        TlAllBegin(r, "QUEUE");
+      }
       long long cycle_bytes = 0;
       for (size_t i = 0; i < responses.size(); ++i) {
         bool from_cache = i < n_cached;
@@ -540,7 +643,9 @@ void BackgroundLoop() {
           cycle_bytes += bytes;
         }
         auto op_start = Clock::now();
+        TlAllEnd(responses[i]);  // QUEUE over: execution starts
         Status es = PerformOperation(*ps, responses[i], from_cache);
+        TlAllEnd(responses[i]);  // top-level span
         {
           std::lock_guard<std::mutex> tlk(g->timeline_mutex);
           if (g->timeline) {
@@ -626,6 +731,13 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
     return -2;
   }
   g->controller = std::make_unique<Controller>(g->comm, g->fusion_bytes);
+  {
+    TimelineHooks hooks;
+    hooks.negotiate_start = TlNegotiateStart;
+    hooks.negotiate_rank_ready = TlNegotiateRankReady;
+    hooks.negotiate_end = TlNegotiateEnd;
+    g->controller->set_timeline_hooks(std::move(hooks));
+  }
   {
     std::lock_guard<std::mutex> lk(g->ps_mutex);
     std::vector<int> world(size);
@@ -813,6 +925,9 @@ void hvd_core_timeline_stop() {
   {
     std::lock_guard<std::mutex> lk(g->timeline_mutex);
     dead = std::move(g->timeline);
+    // A later start must not inherit phase state from this session
+    // (stale entries would suppress fresh NEGOTIATE begins).
+    g->tl_negotiating.clear();
   }
   if (dead) dead->Stop();
 }

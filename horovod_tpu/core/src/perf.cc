@@ -269,7 +269,53 @@ void TimelineWriter::Event(const std::string& name,
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (stop_) return;
-    q_.push_back({name, category, ts_us, dur_us});
+    q_.push_back({'X', name, category, ts_us, dur_us, 0});
+  }
+  cv_.notify_one();
+}
+
+int TimelineWriter::TidLocked(const std::string& tensor) {
+  auto it = tids_.find(tensor);
+  if (it != tids_.end()) return it->second;
+  int tid = next_tid_++;
+  tids_.emplace(tensor, tid);
+  // Announce the row's name, like the reference's per-tensor lanes
+  // (timeline.cc WriteEvent first-seen tensor => thread_name metadata).
+  q_.push_back({'M', tensor, "", 0, 0, tid});
+  return tid;
+}
+
+void TimelineWriter::Begin(const std::string& tensor,
+                           const std::string& category, long long ts_us) {
+  if (!f_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_) return;
+    int tid = TidLocked(tensor);
+    q_.push_back({'B', category, "", ts_us, 0, tid});
+  }
+  cv_.notify_one();
+}
+
+void TimelineWriter::End(const std::string& tensor, long long ts_us) {
+  if (!f_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_) return;
+    int tid = TidLocked(tensor);
+    q_.push_back({'E', "", "", ts_us, 0, tid});
+  }
+  cv_.notify_one();
+}
+
+void TimelineWriter::Instant(const std::string& tensor,
+                             const std::string& name, long long ts_us) {
+  if (!f_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_) return;
+    int tid = TidLocked(tensor);
+    q_.push_back({'i', name, "", ts_us, 0, tid});
   }
   cv_.notify_one();
 }
@@ -323,12 +369,45 @@ void TimelineWriter::Loop() {
       q_.pop_front();
       lk.unlock();
       if (f_) {
-        std::fprintf(
-            f_,
-            "%s{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"X\", "
-            "\"ts\": %lld, \"dur\": %lld, \"pid\": %d, \"tid\": 0}",
-            first_ ? "" : ",\n", JsonEscape(r.name).c_str(),
-            JsonEscape(r.cat).c_str(), r.ts, r.dur, rank_);
+        const char* sep = first_ ? "" : ",\n";
+        switch (r.ph) {
+          case 'X':
+            std::fprintf(
+                f_,
+                "%s{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"X\", "
+                "\"ts\": %lld, \"dur\": %lld, \"pid\": %d, \"tid\": %d}",
+                sep, JsonEscape(r.name).c_str(), JsonEscape(r.cat).c_str(),
+                r.ts, r.dur, rank_, r.tid);
+            break;
+          case 'M':
+            // thread_name metadata: names the tensor's lane.
+            std::fprintf(
+                f_,
+                "%s{\"name\": \"thread_name\", \"ph\": \"M\", "
+                "\"pid\": %d, \"tid\": %d, \"args\": {\"name\": \"%s\"}}",
+                sep, rank_, r.tid, JsonEscape(r.name).c_str());
+            break;
+          case 'B':
+            std::fprintf(
+                f_,
+                "%s{\"name\": \"%s\", \"ph\": \"B\", \"ts\": %lld, "
+                "\"pid\": %d, \"tid\": %d}",
+                sep, JsonEscape(r.name).c_str(), r.ts, rank_, r.tid);
+            break;
+          case 'E':
+            std::fprintf(f_,
+                         "%s{\"ph\": \"E\", \"ts\": %lld, \"pid\": %d, "
+                         "\"tid\": %d}",
+                         sep, r.ts, rank_, r.tid);
+            break;
+          case 'i':
+            std::fprintf(
+                f_,
+                "%s{\"name\": \"%s\", \"ph\": \"i\", \"s\": \"t\", "
+                "\"ts\": %lld, \"pid\": %d, \"tid\": %d}",
+                sep, JsonEscape(r.name).c_str(), r.ts, rank_, r.tid);
+            break;
+        }
         first_ = false;
       }
       lk.lock();

@@ -20,6 +20,7 @@
 #include <random>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace hvd {
@@ -132,30 +133,50 @@ class ParameterManager {
 };
 
 // --- Timeline writer ------------------------------------------------------
-// Complete-event ("ph":"X") chrome trace records drained by a writer
-// thread (reference: timeline.cc TimelineWriter + lock-free queue; a
-// mutex + condvar deque suffices at control-plane event rates).
+// Chrome trace records drained by a writer thread (reference:
+// timeline.cc TimelineWriter + lock-free queue; a mutex + condvar deque
+// suffices at control-plane event rates). Mirrors the reference's
+// per-tensor layout (timeline.cc:496-558): every tensor gets its own
+// trace "thread" (tid) named by a metadata event, duration events nest
+// B/E spans under that tid (NEGOTIATE_* -> top-level op -> QUEUE /
+// MEMCPY_IN_FUSION_BUFFER / TCP_* sub-activities), and rank-ready
+// marks are instants.
 class TimelineWriter {
  public:
   TimelineWriter(const std::string& path, int rank);
   ~TimelineWriter();
 
-  // ts/dur in microseconds since Start; thread-safe.
+  // Complete event ("ph":"X") on the shared loop row (tid 0).
+  // ts/dur in microseconds since Start; all methods thread-safe.
   void Event(const std::string& name, const std::string& category,
              long long ts_us, long long dur_us);
+  // Begin/End a span on ``tensor``'s own trace thread; spans nest.
+  void Begin(const std::string& tensor, const std::string& category,
+             long long ts_us);
+  void End(const std::string& tensor, long long ts_us);
+  // Instant mark on the tensor's thread (e.g. a rank's readiness).
+  void Instant(const std::string& tensor, const std::string& name,
+               long long ts_us);
   void Stop();
 
  private:
   struct Rec {
+    char ph;  // 'X', 'B', 'E', 'i', 'M'
     std::string name, cat;
     long long ts, dur;
+    int tid;
   };
+  // Assign (and on first use announce via thread_name metadata) the
+  // tensor's tid. Caller holds mu_.
+  int TidLocked(const std::string& tensor);
   void Loop();
   int rank_;
   std::FILE* f_;
   std::mutex mu_;
   std::condition_variable cv_;
   std::deque<Rec> q_;
+  std::unordered_map<std::string, int> tids_;
+  int next_tid_ = 1;  // 0 = the background-loop row
   bool stop_ = false;
   bool first_ = true;
   std::thread thread_;

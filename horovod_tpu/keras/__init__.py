@@ -1,18 +1,252 @@
-"""Keras binding (reference: horovod/keras/__init__.py:1-456).
+"""Keras-native binding (reference: horovod/keras/__init__.py:36-201 +
+horovod/_keras/__init__.py:28-207).
 
-``import horovod_tpu.keras as hvd`` gives the Keras-flavored surface:
-``DistributedOptimizer`` for model.compile, broadcast/metric callbacks.
+``import horovod_tpu.keras as hvd`` gives the Keras-flavored surface.
+Where the TF binding wraps ``apply_gradients`` (the tf.keras training
+loop's entry point), this layer targets the Keras 3 optimizer contract
+directly:
+
+- ``DistributedOptimizer`` builds a dynamic subclass of the wrapped
+  optimizer's own class (same class NAME, so serialized models
+  round-trip, reference: _keras/__init__.py:154-161) overriding
+  ``apply()`` — the single funnel both ``apply_gradients`` and custom
+  Keras 3 loops go through — plus the legacy Keras-2 hooks
+  ``get_gradients``/``_aggregate_gradients`` for code written against
+  the reference's keras API.
+- ``allreduce/allgather/broadcast`` here take VALUES (arrays, scalars)
+  and return numpy — the reference's backend-eval semantics
+  (_keras/__init__.py:164-189) — unlike the tensor-in/tensor-out TF
+  binding.
+- ``load_model`` deserializes a model saved with a wrapped optimizer
+  and re-wraps it (reference: keras/__init__.py:167-201).
 """
+
+from __future__ import annotations
+
+import numpy as np
 
 from horovod_tpu.common.basics import (  # noqa: F401
     cross_rank, cross_size, is_initialized, local_rank, local_size,
     rank, size,
 )
+from horovod_tpu.common.process_sets import global_process_set
 from horovod_tpu.tensorflow import (  # noqa: F401
-    Adasum, Average, Sum,
-    DistributedOptimizer,
-    allgather, allgather_object, allreduce, broadcast, broadcast_object,
+    Adasum, Average, Sum, Compression,
     broadcast_variables,
+    allgather_object, broadcast_object,
     init, shutdown,  # TF-aware: manage the in-graph collective runtime
 )
+from horovod_tpu.tensorflow import (
+    allreduce as _tf_allreduce,
+    allgather as _tf_allgather,
+    broadcast as _tf_broadcast,
+)
+from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: F401
+    SyncBatchNormalization,
+)
 from horovod_tpu.keras import callbacks  # noqa: F401
+
+
+def _distributed_optimizer_class(base, name=None, op=Average,
+                                 compression=None, sparse_as_dense=False,
+                                 backward_passes_per_step=1,
+                                 average_aggregated_gradients=True,
+                                 process_set=global_process_set):
+    """Dynamic Keras optimizer subclass whose gradient application
+    allreduces first (reference: _keras/__init__.py:33-161).
+
+    Returned as a CLASS so ``load_model`` can hand it to the Keras
+    deserializer as a custom object; ``DistributedOptimizer`` calls
+    ``.from_config`` on it directly.
+    """
+    import tensorflow as tf
+
+    from horovod_tpu.tensorflow import _allreduce_grad_list
+    from horovod_tpu.tensorflow.gradient_aggregation import (
+        LocalGradientAggregationHelper,
+    )
+
+    prefix = name or "KerasDistributedOptimizer"
+
+    def _reduce(grads):
+        return _allreduce_grad_list(
+            grads, op, process_set, sparse_as_dense=sparse_as_dense,
+            name_prefix=prefix, compression=compression)
+
+    def _agg_helper(self):
+        # Per-INSTANCE aggregation state, created lazily: the class is
+        # shared by every instance the deserializer builds.
+        helper = getattr(self, "_hvd_agg_helper", None)
+        if helper is None and backward_passes_per_step > 1:
+            helper = LocalGradientAggregationHelper(
+                backward_passes_per_step, _reduce,
+                sparse_as_dense=sparse_as_dense,
+                average_aggregated_gradients=average_aggregated_gradients)
+            object.__setattr__(self, "_hvd_agg_helper", helper)
+        return helper
+
+    def apply(self, grads, trainable_variables=None):
+        """Keras 3 funnel: both ``apply_gradients`` and direct calls
+        land here, so one override distributes every training path."""
+        grads = list(grads)
+        helper = _agg_helper(self)
+        if helper is None:
+            return base.apply(self, _reduce(grads), trainable_variables)
+        reduced = helper.compute_aggregated_gradients(grads)
+        # Build slot variables outside the tf.cond branch — variable
+        # creation inside cond is illegal under tf.function.
+        if trainable_variables is not None and not self.built:
+            self.build(trainable_variables)
+        return helper.apply_gradients(
+            lambda: base.apply(self, reduced, trainable_variables))
+
+    def get_gradients(self, loss, params):
+        """Legacy Keras-2 contract (reference:
+        _keras/__init__.py:97-108): symbolic gradients of ``loss`` wrt
+        ``params``, allreduced. Keras 3 dropped the symbolic-loss API,
+        so this shim covers graph-mode callers; eager code should use
+        ``horovod_tpu.tensorflow.DistributedGradientTape``."""
+        if hasattr(base, "get_gradients"):
+            grads = base.get_gradients(self, loss, params)
+        elif not tf.executing_eagerly():
+            grads = tf.gradients(loss, params)
+        else:
+            raise RuntimeError(
+                "get_gradients(loss, params) is a legacy symbolic API; "
+                "under eager Keras 3 compute gradients with "
+                "horovod_tpu.tensorflow.DistributedGradientTape instead")
+        return _reduce(grads)
+
+    def _aggregate_gradients(self, grads_and_vars):
+        """Legacy Keras 2.4+ aggregation hook (reference:
+        _keras/__init__.py:109-117)."""
+        gv = list(grads_and_vars)
+        reduced = _reduce([g for g, _ in gv])
+        return list(zip(reduced, [v for _, v in gv]))
+
+    # Same NAME as the wrapped class so saved models (which record the
+    # optimizer's class name) resolve back through load_model.
+    return type(base.__name__, (base,), {
+        "apply": apply,
+        "get_gradients": get_gradients,
+        "_aggregate_gradients": _aggregate_gradients,
+        "_hvd_wrapped_base": base,
+    })
+
+
+def DistributedOptimizer(optimizer, name=None, op=Average,
+                         compression=None, sparse_as_dense=False,
+                         backward_passes_per_step=1,
+                         average_aggregated_gradients=True,
+                         process_set=global_process_set):
+    """Wrap a Keras optimizer for data-parallel training
+    (reference: keras/__init__.py:36-111).
+
+    The wrapper allreduces gradients across ranks before every
+    ``apply``; with ``backward_passes_per_step > 1`` gradients
+    accumulate locally and communicate every Nth step.
+    """
+    if getattr(optimizer, "_hvd_wrapped_base", None) is not None:
+        raise ValueError(
+            "optimizer is already a DistributedOptimizer; double "
+            "wrapping would allreduce every gradient twice")
+    cls = _distributed_optimizer_class(
+        optimizer.__class__, name=name, op=op, compression=compression,
+        sparse_as_dense=sparse_as_dense,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients,
+        process_set=process_set)
+    return cls.from_config(optimizer.get_config())
+
+
+def _to_numpy(t):
+    return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+
+
+def allreduce(value, name=None, average=True, prescale_factor=1.0,
+              postscale_factor=1.0, op=None,
+              process_set=global_process_set):
+    """Value-in, numpy-out allreduce — the reference's backend-eval
+    semantics (_keras/__init__.py:176-182)."""
+    import tensorflow as tf
+
+    if op is None:
+        op = Average if average else Sum
+    t = tf.convert_to_tensor(value)
+    out = _tf_allreduce(t, op=op, name=name,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
+    return _to_numpy(out)
+
+
+def allgather(value, name=None, process_set=global_process_set):
+    """Value-in, numpy-out allgather (_keras/__init__.py:183-186)."""
+    import tensorflow as tf
+
+    return _to_numpy(_tf_allgather(tf.convert_to_tensor(value),
+                                   name=name, process_set=process_set))
+
+
+def broadcast(value, root_rank, name=None,
+              process_set=global_process_set):
+    """Value-in, numpy-out broadcast (_keras/__init__.py:187-189)."""
+    import tensorflow as tf
+
+    return _to_numpy(_tf_broadcast(tf.convert_to_tensor(value),
+                                   root_rank, name=name,
+                                   process_set=process_set))
+
+
+def broadcast_global_variables(root_rank=0, model=None):
+    """Broadcast model + optimizer state from ``root_rank``
+    (reference: keras/__init__.py:112-121).
+
+    Keras 3 has no global-variable registry (the TF1 notion the
+    reference's version walks), so the model is passed explicitly; in
+    ``model.fit`` use ``callbacks.BroadcastGlobalVariablesCallback``,
+    which does this on the first batch.
+    """
+    if model is None:
+        raise ValueError(
+            "Keras 3 has no global variable collection: pass the model "
+            "(broadcast_global_variables(0, model=m)) or use "
+            "callbacks.BroadcastGlobalVariablesCallback in fit()")
+    variables = list(model.variables)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        variables += list(opt.variables)
+    broadcast_variables(variables, root_rank=root_rank)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None, **distributed_kwargs):
+    """Load a model saved with a wrapped optimizer, re-wrapping it
+    (reference: keras/__init__.py:167-201).
+
+    The saved config records the ORIGINAL optimizer class name (the
+    wrapper reuses it), so every standard Keras optimizer name — plus
+    any classes in ``custom_optimizers`` — is mapped to a freshly built
+    distributed subclass before deserialization.
+    """
+    import keras
+
+    def _subclasses(cls):
+        out = []
+        for sub in cls.__subclasses__():
+            out.append(sub)
+            out.extend(_subclasses(sub))
+        return out
+
+    candidates = {c.__name__: c
+                  for c in _subclasses(keras.optimizers.Optimizer)
+                  if getattr(c, "_hvd_wrapped_base", None) is None}
+    for c in (custom_optimizers or []):
+        candidates[c.__name__] = c
+    objects = {
+        name_: _distributed_optimizer_class(
+            c, compression=compression, **distributed_kwargs)
+        for name_, c in candidates.items()
+    }
+    objects.update(custom_objects or {})
+    return keras.models.load_model(filepath, custom_objects=objects)

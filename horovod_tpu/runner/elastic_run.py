@@ -19,6 +19,7 @@ Protocol with workers (horovod_tpu.elastic.worker):
 from __future__ import annotations
 
 import json
+import os
 import socket
 import sys
 import time
@@ -44,6 +45,13 @@ class ElasticDriver:
         self.max_np = args.max_np
         self.command = args.command
         self.start_timeout = args.start_timeout
+        # Re-scaling waits use their own budget (reference:
+        # elastic/driver.py:81 HOROVOD_ELASTIC_TIMEOUT, default 600):
+        # the initial start keeps --start-timeout.
+        flag_timeout = getattr(args, "elastic_timeout", None)
+        self.elastic_timeout = (
+            flag_timeout if flag_timeout is not None
+            else int(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600")))
         self.reset_limit = args.reset_limit
         self.extra_env = _tuning_env(args)
         self.host_manager = HostManager(HostDiscoveryScript(
@@ -103,7 +111,8 @@ class ElasticDriver:
 
     def _reset(self) -> bool:
         """New rendezvous round. False when min_np cannot be satisfied."""
-        deadline = time.time() + self.start_timeout
+        deadline = time.time() + (self.elastic_timeout if self.version
+                                  else self.start_timeout)
         while True:
             keys = [k for k in self.host_manager.available_slot_keys()
                     if k not in self.done]
@@ -136,7 +145,12 @@ class ElasticDriver:
             env["HOROVOD_ELASTIC"] = "1"
             slot_idx = int(key.rsplit(":", 1)[1])
             self.procs[key] = SlotProcess(
-                a.rank, self.command, env, hostname=a.hostname)
+                a.rank, self.command, env, hostname=a.hostname,
+                ssh_port=getattr(self.args, "ssh_port", None),
+                ssh_identity_file=getattr(self.args,
+                                          "ssh_identity_file", None),
+                prefix_timestamp=getattr(
+                    self.args, "prefix_output_with_timestamp", False))
         return True
 
     # --- main loop ----------------------------------------------------------

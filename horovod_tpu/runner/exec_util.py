@@ -107,7 +107,9 @@ class SlotProcess:
 
     def __init__(self, rank: int, command: List[str], env: Dict[str, str],
                  hostname: str = "localhost", ssh_port: Optional[int] = None,
-                 prefix_output: bool = True, output_file=None):
+                 ssh_identity_file: Optional[str] = None,
+                 prefix_output: bool = True, output_file=None,
+                 prefix_timestamp: bool = False):
         self.rank = rank
         self.hostname = hostname
         if is_local(hostname):
@@ -122,6 +124,8 @@ class SlotProcess:
             ssh_args = ["ssh", "-o", "StrictHostKeyChecking=no"]
             if ssh_port:
                 ssh_args += ["-p", str(ssh_port)]
+            if ssh_identity_file:
+                ssh_args += ["-i", ssh_identity_file]
             remote = "cd %s && %s %s" % (
                 shlex.quote(os.getcwd()), env_str,
                 " ".join(shlex.quote(c) for c in command))
@@ -134,17 +138,26 @@ class SlotProcess:
         _live_slots.add(self)
         _install_cleanup_handlers()
         self._forwarder = threading.Thread(
-            target=self._forward, args=(prefix_output, output_file),
+            target=self._forward,
+            args=(prefix_output, output_file, prefix_timestamp),
             daemon=True)
         self._forwarder.start()
 
-    def _forward(self, prefix_output, output_file):
+    def _forward(self, prefix_output, output_file, prefix_timestamp):
+        import datetime
+
         stream = output_file or sys.stdout
         for line in self.proc.stdout:
+            ts = ""
+            if prefix_timestamp:
+                # reference: --prefix-output-with-timestamp stamps each
+                # forwarded line (runner/launch.py:465-467).
+                ts = datetime.datetime.now().strftime(
+                    "%a %b %d %H:%M:%S %Y") + " "
             if prefix_output:
-                stream.write("[%d]<stdout>: %s" % (self.rank, line))
+                stream.write("%s[%d]<stdout>: %s" % (ts, self.rank, line))
             else:
-                stream.write(line)
+                stream.write(ts + line)
             stream.flush()
 
     def wait(self, timeout: Optional[float] = None) -> int:

@@ -39,20 +39,40 @@ def free_port() -> int:
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    import horovod_tpu
+
     p = argparse.ArgumentParser(
         prog="hvdrun",
         description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-v", "--version", action="version",
+                   version=horovod_tpu.__version__)
     p.add_argument("-np", "--num-proc", type=int, dest="np",
                    help="Total number of worker processes.")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   dest="check_build",
+                   help="Print available frameworks/controllers/"
+                        "operations and exit (reference: launch.py "
+                        "--check-build).")
     p.add_argument("-H", "--hosts", dest="hosts",
                    help="Comma-separated host:slots list.")
     p.add_argument("--hostfile", dest="hostfile",
                    help="Hostfile path (hostname slots=N per line).")
     p.add_argument("--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("-i", "--ssh-identity-file", dest="ssh_identity_file",
+                   help="Private-key identity file passed to ssh for "
+                        "remote slot fan-out.")
     p.add_argument("--start-timeout", type=int, default=120)
+    p.add_argument("--disable-cache", action="store_true",
+                   dest="disable_cache",
+                   help="Disable the coordination response cache "
+                        "(HOROVOD_CACHE_CAPACITY=0): every tensor "
+                        "renegotiates every cycle.")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--output-filename", dest="output_filename",
                    help="Redirect worker output to this file.")
+    p.add_argument("-prefix-timestamp", "--prefix-output-with-timestamp",
+                   action="store_true", dest="prefix_output_with_timestamp",
+                   help="Timestamp each forwarded worker output line.")
     # Elastic (reference: launch.py elastic args).
     p.add_argument("--min-np", type=int, dest="min_np")
     p.add_argument("--max-np", type=int, dest="max_np")
@@ -61,6 +81,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="Elastic: slots per discovered host when the "
                         "discovery script does not specify them.")
     p.add_argument("--reset-limit", type=int, dest="reset_limit")
+    p.add_argument("--elastic-timeout", type=int, dest="elastic_timeout",
+                   default=None,
+                   help="Timeout (s) for elastic re-initialisation after "
+                        "re-scaling; default 600 or "
+                        "HOROVOD_ELASTIC_TIMEOUT.")
     # Core tuning knobs → env (reference: config_parser.py
     # set_env_from_args; flag names match launch.py:304-475).
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
@@ -77,6 +102,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true",
                    default=None, dest="timeline_mark_cycles")
+    p.add_argument("--no-timeline-mark-cycles", action="store_false",
+                   dest="timeline_mark_cycles")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--no-autotune", action="store_false", dest="autotune")
     p.add_argument("--autotune-log-file", default=None)
@@ -105,17 +132,50 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    default=None, dest="log_with_timestamp")
     p.add_argument("--log-without-timestamp", action="store_false",
                    dest="log_with_timestamp")
+    # Legacy spellings (reference: launch.py:468-475 deprecated pair).
+    p.add_argument("--log-hide-timestamp", action="store_false",
+                   dest="log_with_timestamp")
+    p.add_argument("--no-log-hide-timestamp", action="store_true",
+                   dest="log_with_timestamp")
+    p.add_argument("--mpi-threads-disable", action="store_true",
+                   default=None, dest="mpi_threads_disable",
+                   help="Disable MPI threading support (mpirun mode "
+                        "only; reference: launch.py:425-434).")
+    p.add_argument("--no-mpi-threads-disable", action="store_false",
+                   dest="mpi_threads_disable")
+    p.add_argument("--num-nccl-streams", type=int, default=None,
+                   dest="num_nccl_streams",
+                   help="Accepted for reference CLI parity; NCCL stream "
+                        "pools have no TPU equivalent (device "
+                        "collectives are XLA programs) — see the knob "
+                        "registry entry for HOROVOD_NUM_NCCL_STREAMS.")
+    p.add_argument("--tcp", action="store_true", dest="tcp_flag",
+                   help="Use only TCP for communication (always true "
+                        "here: the control plane is the native TCP "
+                        "mesh; accepted for reference CLI parity).")
+    p.add_argument("--gloo-timeout-seconds", type=int, default=None,
+                   dest="gloo_timeout_seconds",
+                   help="Accepted for reference CLI parity; liveness "
+                        "here is enforced by the stall inspector "
+                        "(--stall-check-*).")
+    p.add_argument("--binding-args", dest="binding_args", default=None,
+                   help="Process binding arguments passed through to "
+                        "jsrun (reference: launch.py:438-440).")
     # Controller selection (reference: launch.py run_controller
     # gloo/mpi/jsrun dispatch).
-    p.add_argument("--use-gloo", action="store_true", dest="use_gloo",
+    p.add_argument("--use-gloo", "--gloo", action="store_true",
+                   dest="use_gloo",
                    help="Force the built-in TCP (gloo-style) launcher.")
-    p.add_argument("--use-mpi", action="store_true", dest="use_mpi",
+    p.add_argument("--use-mpi", "--mpi", action="store_true",
+                   dest="use_mpi",
                    help="Launch through a single mpirun command.")
-    p.add_argument("--use-jsrun", action="store_true", dest="use_jsrun",
+    p.add_argument("--use-jsrun", "--jsrun", action="store_true",
+                   dest="use_jsrun",
                    help="Launch through LSF jsrun.")
     p.add_argument("--mpi-args", dest="mpi_args", default=None,
                    help="Extra arguments passed through to mpirun.")
-    p.add_argument("--network-interfaces", dest="nics", default=None,
+    p.add_argument("--network-interfaces", "--network-interface",
+                   dest="nics", default=None,
                    help="Comma-separated NIC allowlist for the data/"
                         "control plane.")
     p.add_argument("--platform", choices=["cpu", "tpu"], default="cpu",
@@ -133,7 +193,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     args = p.parse_args(argv)
     if args.config_file:
         _apply_config_file(p, args)
-    if not args.command:
+    if not args.command and not args.check_build:
         p.error("no command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
@@ -222,6 +282,18 @@ def _tuning_env(args) -> Dict[str, str]:
     if args.log_with_timestamp is not None:
         env["HOROVOD_LOG_TIMESTAMP"] = (
             "1" if args.log_with_timestamp else "0")
+    if args.disable_cache:
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    if args.elastic_timeout is not None:
+        env["HOROVOD_ELASTIC_TIMEOUT"] = str(args.elastic_timeout)
+    if args.mpi_threads_disable is not None:
+        env["HOROVOD_MPI_THREADS_DISABLE"] = (
+            "1" if args.mpi_threads_disable else "0")
+    if args.num_nccl_streams is not None:
+        env["HOROVOD_NUM_NCCL_STREAMS"] = str(args.num_nccl_streams)
+    if args.gloo_timeout_seconds is not None:
+        env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = str(
+            args.gloo_timeout_seconds)
     return env
 
 
@@ -314,7 +386,10 @@ def _run_static(args) -> int:
                            platform=args.platform)
             procs.append(SlotProcess(
                 a.rank, args.command, env, hostname=a.hostname,
-                ssh_port=args.ssh_port, output_file=output_file))
+                ssh_port=args.ssh_port,
+                ssh_identity_file=args.ssh_identity_file,
+                output_file=output_file,
+                prefix_timestamp=args.prefix_output_with_timestamp))
         # Wait; first failure kills the job (reference: gloo_run.py:259-271).
         exit_code = 0
         pending = set(range(len(procs)))
@@ -429,13 +504,70 @@ def _run_jsrun(args) -> int:
         "PYTHONUNBUFFERED": "1",
     })
     try:
-        return js_run(np_, args.command, env)
+        return js_run(np_, args.command, env,
+                      extra_args=args.binding_args)
     finally:
         rendezvous.stop()
 
 
+def check_build(file=None) -> int:
+    """Print the availability matrix (reference: launch.py
+    --check-build prints frameworks / controllers / operations)."""
+    import importlib.util
+    import shutil
+
+    import horovod_tpu
+
+    file = file or sys.stdout
+
+    def _have(mod):
+        return importlib.util.find_spec(mod) is not None
+
+    def _jsrun_available():
+        try:
+            from horovod_tpu.runner.js_run import is_jsrun_installed
+            return is_jsrun_installed()
+        except Exception:
+            return False
+
+    def _box(ok):
+        return "[X]" if ok else "[ ]"
+
+    try:
+        from horovod_tpu.core.build import library_path
+        native_built = library_path(build_if_missing=True) is not None
+    except Exception:
+        native_built = False
+    lines = [
+        "Horovod-TPU v%s:" % horovod_tpu.__version__,
+        "",
+        "Available Frameworks:",
+        "    %s JAX" % _box(_have("jax")),
+        "    %s TensorFlow" % _box(_have("tensorflow")),
+        "    %s Keras" % _box(_have("keras")),
+        "    %s PyTorch" % _box(_have("torch")),
+        "    %s MXNet" % _box(_have("mxnet")),
+        "",
+        "Available Controllers:",
+        "    %s TCP (native full mesh + HTTP rendezvous)" % _box(
+            native_built),
+        "    %s mpirun (process launch only)" % _box(
+            shutil.which("mpirun") is not None),
+        "    %s LSF jsrun" % _box(_jsrun_available()),
+        "",
+        "Available Tensor Operations:",
+        "    %s XLA in-graph collectives (TPU/ICI)" % _box(_have("jax")),
+        "    %s native CPU collectives" % _box(native_built),
+        "    %s TF collective runtime" % _box(_have("tensorflow")),
+    ]
+    file.write("\n".join(lines) + "\n")
+    return 0
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    if args.check_build:
+        return check_build()
     if sum([args.use_gloo, args.use_mpi, args.use_jsrun]) > 1:
         raise ValueError(
             "--use-gloo, --use-mpi and --use-jsrun are mutually exclusive")

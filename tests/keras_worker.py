@@ -54,6 +54,15 @@ def main():
             tf.keras.optimizers.SGD(learning_rate=base_lr)),
         loss="mse", metrics=["mae"])
 
+    # The keras binding must supply its OWN wrapper (not the TF
+    # binding's class): the dynamic subclass keeps the wrapped class
+    # name for serialization and carries the keras-2 legacy hooks.
+    opt = model.optimizer
+    assert getattr(opt, "_hvd_wrapped_base", None) is not None
+    assert type(opt).__name__ == "SGD"
+    assert hasattr(opt, "_aggregate_gradients")
+    assert hasattr(opt, "get_gradients")
+
     # Different weights per rank before broadcast: rank 1 perturbs.
     if r == 1:
         for v in model.trainable_variables:
@@ -83,16 +92,15 @@ def main():
     # averaged gradients keep lockstep).
     flat = np.concatenate([v.numpy().ravel()
                            for v in model.trainable_variables])
-    gathered = hvd.allgather(
-        tf.constant(flat[None, :]), name="kw.gather").numpy()
+    gathered = hvd.allgather(flat[None, :], name="kw.gather")
+    assert isinstance(gathered, np.ndarray)  # keras eval semantics
     np.testing.assert_allclose(gathered[0], gathered[1], atol=1e-6)
 
     # 2. MetricAverageCallback: the recorder (a user callback after it)
     # saw the same averaged loss/mae on every rank.
     for key in ("loss", "mae"):
         mine = np.array([e[key] for e in rec.epoch_logs], np.float64)
-        other = hvd.allgather(
-            tf.constant(mine[None, :]), name="km.%s" % key).numpy()
+        other = hvd.allgather(mine[None, :], name="km.%s" % key)
         np.testing.assert_allclose(other[0], other[1], rtol=1e-5)
 
     # 3. Warmup: epoch 0 LR below the size-scaled target, epoch >=
@@ -109,9 +117,11 @@ def main():
     # 5. Keras-surface collectives + broadcast_object round-trip.
     obj = hvd.broadcast_object({"epoch": 7, "rank": r}, root_rank=0)
     assert obj == {"epoch": 7, "rank": 0}
-    s = hvd.allreduce(tf.constant([float(r + 1)]), op=hvd.Sum,
-                      name="k.ar")
-    np.testing.assert_allclose(s.numpy(), [3.0])
+    s = hvd.allreduce([float(r + 1)], op=hvd.Sum, name="k.ar")
+    assert isinstance(s, np.ndarray)
+    np.testing.assert_allclose(s, [3.0])
+    b = hvd.broadcast(np.array([r + 5.0]), root_rank=1, name="k.bc")
+    np.testing.assert_allclose(b, [6.0])
 
     # 6. Validation metrics are averaged too: per-rank validation
     # shards with rank-dependent labels must surface one agreed
@@ -128,8 +138,8 @@ def main():
            verbose=0,
            callbacks=[hvd_callbacks.BroadcastGlobalVariablesCallback(0),
                       hvd_callbacks.MetricAverageCallback(), rec2])
-    vals = hvd.allgather(tf.constant(
-        [[rec2.epoch_logs[0]["val_loss"]]]), name="k.val").numpy()
+    vals = hvd.allgather([[rec2.epoch_logs[0]["val_loss"]]],
+                         name="k.val")
     np.testing.assert_allclose(vals[0], vals[1], rtol=1e-6)
 
     # 7. LearningRateScheduleCallback staircase stays in lockstep at
@@ -147,6 +157,46 @@ def main():
                       sched, rec3])
     np.testing.assert_allclose(rec3.lrs[0], 0.1, rtol=1e-5)
     np.testing.assert_allclose(rec3.lrs[1], 0.05, rtol=1e-5)
+
+    # 8. load_model round-trip re-wraps the optimizer (reference:
+    # keras/__init__.py:167-201): the deserialized optimizer must be a
+    # distributed wrapper again and keep training in lockstep.
+    saved = os.path.join(tmp, "m3.keras")
+    m3.save(saved)
+    m4 = hvd.load_model(saved)
+    assert getattr(m4.optimizer, "_hvd_wrapped_base", None) is not None
+    assert type(m4.optimizer).__name__ == "SGD"
+    m4.fit(x[:, :2], y, batch_size=8, epochs=1, verbose=0,
+           callbacks=[hvd_callbacks.BroadcastGlobalVariablesCallback(0)])
+    flat4 = np.concatenate([v.numpy().ravel()
+                            for v in m4.trainable_variables])
+    g4 = hvd.allgather(flat4[None, :], name="kw.load_model")
+    np.testing.assert_allclose(g4[0], g4[1], atol=1e-6)
+
+    # 9. backward_passes_per_step: gradients aggregate locally and
+    # communicate every 2nd step; ranks still end identical
+    # (reference: _keras/__init__.py backward_passes_per_step).
+    tf.keras.utils.set_random_seed(7)
+    m5 = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    m5.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.05), backward_passes_per_step=2),
+        loss="mse")
+    m5.fit(x[:, :2], y, batch_size=8, epochs=2, verbose=0,
+           callbacks=[hvd_callbacks.BroadcastGlobalVariablesCallback(0)])
+    flat5 = np.concatenate([v.numpy().ravel()
+                            for v in m5.trainable_variables])
+    g5 = hvd.allgather(flat5[None, :], name="kw.agg")
+    np.testing.assert_allclose(g5[0], g5[1], atol=1e-6)
+
+    # 10. Legacy keras-2 hook: _aggregate_gradients allreduces
+    # grads-and-vars pairs (reference: _keras/__init__.py:109-117).
+    v = tf.Variable([0.0, 0.0])
+    g = tf.constant([float(r + 1), 2.0 * (r + 1)])
+    (rg, rv), = m5.optimizer._aggregate_gradients([(g, v)])
+    assert rv is v
+    np.testing.assert_allclose(
+        np.asarray(rg), [1.5, 3.0], rtol=1e-6)  # mean over ranks
 
     hvd.shutdown()
     print("KERAS_OK rank=%d" % r)

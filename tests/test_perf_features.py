@@ -33,6 +33,24 @@ def test_timeline_single_process(tmp_path):
     assert "B" in phases and "E" in phases
 
 
+def test_timeline_phase_hierarchy_np2(tmp_path):
+    """Per-tensor phase STRUCTURE parity at np=2 (reference:
+    timeline.cc:496-558 + test/parallel/test_timeline.py): each rank's
+    trace must carry, on the tensor's own named lane, a closed
+    NEGOTIATE_ALLREDUCE span (with rank-ready instants on the
+    coordinator), then a top-level ALLREDUCE span nesting QUEUE and the
+    TCP wire op, and fused-buffer memcpys for a grouped allreduce.
+    Assertions live in timeline_worker.py."""
+    env = dict(os.environ, HVD_TL_DIR=str(tmp_path))
+    procs = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(_REPO, "tests",
+                                      "timeline_worker.py")],
+        capture_output=True, text=True, timeout=180, env=env)
+    assert procs.returncode == 0, procs.stdout + procs.stderr
+    assert procs.stdout.count("TIMELINE_OK") == 2, procs.stdout
+
+
 def test_gp_regression_sane():
     from horovod_tpu.utils.autotune import GaussianProcess
 

@@ -169,3 +169,87 @@ def test_stall_check_disable_flag():
     assert _tuning_env(args)["HOROVOD_STALL_CHECK_DISABLE"] == "1"
     args = parse_args(["-np", "2", "python", "t.py"])
     assert "HOROVOD_STALL_CHECK_DISABLE" not in _tuning_env(args)
+
+
+def test_reference_parity_flags():
+    """The ~24 flags added for reference CLI parity (reference:
+    launch.py:242-568): library/compat knobs map to env, aliases hit
+    the same dests, ssh/identity/prefix plumb through."""
+    from horovod_tpu.runner.launch import _tuning_env, parse_args
+
+    args = parse_args([
+        "-np", "2", "--disable-cache", "--elastic-timeout", "300",
+        "--mpi-threads-disable", "--num-nccl-streams", "4",
+        "--gloo-timeout-seconds", "15", "--tcp",
+        "-i", "/tmp/id_rsa", "--prefix-output-with-timestamp",
+        "--no-timeline-mark-cycles", "--binding-args", "-r myrankfile",
+        "python", "t.py"])
+    env = _tuning_env(args)
+    assert env["HOROVOD_CACHE_CAPACITY"] == "0"
+    assert env["HOROVOD_ELASTIC_TIMEOUT"] == "300"
+    assert env["HOROVOD_MPI_THREADS_DISABLE"] == "1"
+    assert env["HOROVOD_NUM_NCCL_STREAMS"] == "4"
+    assert env["HOROVOD_GLOO_TIMEOUT_SECONDS"] == "15"
+    assert args.ssh_identity_file == "/tmp/id_rsa"
+    assert args.prefix_output_with_timestamp
+    assert args.tcp_flag
+    assert args.timeline_mark_cycles is False
+    assert args.binding_args == "-r myrankfile"
+
+    # Controller + nic aliases resolve to the canonical dests.
+    assert parse_args(["--gloo", "-np", "1", "x"]).use_gloo
+    assert parse_args(["--mpi", "-np", "1", "x"]).use_mpi
+    assert parse_args(["--jsrun", "-np", "1", "x"]).use_jsrun
+    assert parse_args(
+        ["--network-interface", "eth0", "-np", "1", "x"]).nics == "eth0"
+    # Legacy timestamp spellings map onto log_with_timestamp.
+    assert parse_args(["--log-hide-timestamp", "-np", "1",
+                       "x"]).log_with_timestamp is False
+    assert parse_args(["--no-log-hide-timestamp", "-np", "1",
+                       "x"]).log_with_timestamp is True
+
+
+def test_check_build_prints_matrix():
+    import io
+
+    from horovod_tpu.runner.launch import check_build, parse_args
+
+    assert parse_args(["-cb"]).check_build  # no command required
+    buf = io.StringIO()
+    assert check_build(buf) == 0
+    out = buf.getvalue()
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "Available Controllers" in out
+    assert "Available Tensor Operations" in out
+
+
+def test_elastic_timeout_reaches_driver(tmp_path):
+    """--elastic-timeout (and the HOROVOD_ELASTIC_TIMEOUT fallback)
+    set the re-scaling rendezvous budget (reference:
+    elastic/driver.py:81)."""
+    import os
+
+    from horovod_tpu.runner.elastic_run import ElasticDriver
+    from horovod_tpu.runner.launch import parse_args
+
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho localhost:2\n")
+    script.chmod(0o755)
+
+    args = parse_args(["-np", "2", "--host-discovery-script",
+                       str(script), "--elastic-timeout", "123",
+                       "python", "t.py"])
+    assert ElasticDriver(args).elastic_timeout == 123
+
+    args = parse_args(["-np", "2", "--host-discovery-script",
+                       str(script), "python", "t.py"])
+    old = os.environ.get("HOROVOD_ELASTIC_TIMEOUT")
+    os.environ["HOROVOD_ELASTIC_TIMEOUT"] = "77"
+    try:
+        assert ElasticDriver(args).elastic_timeout == 77
+    finally:
+        if old is None:
+            del os.environ["HOROVOD_ELASTIC_TIMEOUT"]
+        else:
+            os.environ["HOROVOD_ELASTIC_TIMEOUT"] = old

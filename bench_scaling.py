@@ -92,11 +92,17 @@ def mesh_child() -> int:
         for _ in range(3):  # warmup + compile
             params, opt_state, loss = step(params, opt_state, x, y)
         float(loss)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            params, opt_state, loss = step(params, opt_state, x, y)
-        float(loss)
-        return (time.perf_counter() - t0) / iters
+        # Best of 3 repeats: single-core hosts jitter enough to swing
+        # a one-shot measurement by tens of percent, and the DP-vs-local
+        # OVERHEAD ratio is a difference of two such measurements.
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                params, opt_state, loss = step(params, opt_state, x, y)
+            float(loss)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
 
     host_cores = len(os.sched_getaffinity(0))
     base_tp = None
@@ -113,13 +119,18 @@ def mesh_child() -> int:
         tp = batch / t_dp
         if n == 1:
             base_tp = tp
+        # Field order is the headline order: collective_overhead_pct is
+        # the framework signal on this host; the raw ratio is renamed
+        # to say what it actually measures (N virtual devices contending
+        # for the same cores), so nobody reads it as scaling efficiency.
         records.append({
             "metric": "dp_weak_scaling", "world_size": n,
-            "value": round(tp, 1), "unit": "samples/sec",
-            "host_cores": host_cores,
-            "throughput_ratio_vs_1dev": round(tp / (n * base_tp), 3),
             "collective_overhead_pct": round(
                 max(t_dp / t_local - 1.0, 0.0) * 100, 1),
+            "value": round(tp, 1), "unit": "samples/sec",
+            "host_cores": host_cores,
+            "throughput_ratio_oversubscribed_%dcore" % host_cores:
+                round(tp / (n * base_tp), 3),
         })
     print(json.dumps(records))
     return 0

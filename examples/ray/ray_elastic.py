@@ -1,0 +1,67 @@
+"""Elastic training on a Ray cluster.
+
+Parity workload for the reference's elastic Ray example
+(reference: examples/ray/basic_ray_elastic.py): ElasticRayExecutor
+discovers slots from the live Ray cluster, runs an elastic training
+function under ``hvd.elastic.run``, and rides cluster growth/shrink —
+state is committed each epoch and restored after a reset.
+
+Requires a ray installation: python examples/ray/ray_elastic.py
+(tests inject tests/fake_ray.py to smoke-run the same flow without a
+cluster).
+"""
+
+import argparse
+
+
+def train_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    import horovod_tpu.elastic as elastic
+
+    hvd.init()
+
+    state = elastic.ObjectState(epoch=0, weights=np.zeros(4))
+
+    @elastic.run
+    def loop(state):
+        while state.epoch < 3:
+            # One "epoch": average a rank-dependent vector; with k live
+            # ranks the mean of (rank+1) over ranks is (k+1)/2.
+            grad = np.full(4, float(hvd.rank() + 1))
+            avg = np.asarray(hvd.allreduce(grad, op=hvd.Average,
+                                           name="ray_elastic.step"))
+            state.weights = state.weights + avg
+            state.epoch += 1
+            state.commit()
+        return state.weights
+
+    weights = loop(state)
+    return {"rank": hvd.rank(), "size": hvd.size(),
+            "weights": list(map(float, weights))}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--min-np", type=int, default=1)
+    p.add_argument("--max-np", type=int, default=4)
+    p.add_argument("--cpus-per-slot", type=int, default=1)
+    args = p.parse_args()
+
+    import ray
+
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    ray.init(ignore_reinit_error=True)
+    executor = ElasticRayExecutor(
+        min_np=args.min_np, max_np=args.max_np,
+        cpus_per_slot=args.cpus_per_slot)
+    executor.start()
+    results = executor.run(train_fn)
+    print("elastic results:", results)
+    ray.shutdown()
+
+
+if __name__ == "__main__":
+    main()

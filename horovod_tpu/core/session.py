@@ -262,6 +262,10 @@ class CoreSession:
     def submit(self, kind, name, array, *, group, index, op=1, root_rank=0,
                prescale=1.0, postscale=1.0, ps_id=0, splits=None,
                group_id=-1):
+        # np.ascontiguousarray promotes 0-dim arrays to 1-D; keep the
+        # caller's shape so scalars come back as scalars (the wire
+        # carries the 1-D view; _Pending.shape restores on completion).
+        in_shape = tuple(np.shape(array))
         arr = np.ascontiguousarray(array)
         if kind in (OP_ALLREDUCE, OP_BROADCAST):
             arr = arr.copy()  # in-place target; result buffer
@@ -275,8 +279,7 @@ class CoreSession:
             splits_c = None
             nsplits = 0
         tag = next(self._tags)
-        pending = _Pending(kind, arr, group, index, tuple(arr.shape),
-                           arr.dtype)
+        pending = _Pending(kind, arr, group, index, in_shape, arr.dtype)
         with self._lock:
             self._pending[tag] = pending
         rc = self._lib.hvd_core_enqueue(

@@ -79,6 +79,9 @@ struct Global {
   // Tensors currently inside a NEGOTIATE_* span (guarded by
   // timeline_mutex; mirrors the reference's per-tensor TimelineState).
   std::set<std::string> tl_negotiating;
+  // Open top-level/activity span count per tensor in THIS timeline
+  // session (guarded by timeline_mutex).
+  std::map<std::string, int> tl_open_spans;
   Clock::time_point t_origin = Clock::now();
 
   std::mutex init_mutex;
@@ -155,19 +158,31 @@ void TlNegotiateEnd(const std::string& name) {
   g->timeline->End(name, TlNowUs());
 }
 
-// Begin/end a span on every tensor of a response.
+// Begin/end a span on every tensor of a response. Open-span counts are
+// tracked so a timeline started (or stopped) mid-cycle never records
+// an unbalanced B/E pair on a lane — the same protection the
+// NEGOTIATE spans get from tl_negotiating.
 void TlAllBegin(const Response& resp, const std::string& category) {
   std::lock_guard<std::mutex> lk(g->timeline_mutex);
   if (!g->timeline) return;
   long long now = TlNowUs();
-  for (auto& nm : resp.tensor_names) g->timeline->Begin(nm, category, now);
+  for (auto& nm : resp.tensor_names) {
+    ++g->tl_open_spans[nm];
+    g->timeline->Begin(nm, category, now);
+  }
 }
 
 void TlAllEnd(const Response& resp) {
   std::lock_guard<std::mutex> lk(g->timeline_mutex);
   if (!g->timeline) return;
   long long now = TlNowUs();
-  for (auto& nm : resp.tensor_names) g->timeline->End(nm, now);
+  for (auto& nm : resp.tensor_names) {
+    auto it = g->tl_open_spans.find(nm);
+    if (it == g->tl_open_spans.end() || it->second == 0)
+      continue;  // span opened before this timeline session
+    if (--it->second == 0) g->tl_open_spans.erase(it);
+    g->timeline->End(nm, now);
+  }
 }
 
 // The wire-op activity name (reference analog: MPI_ALLREDUCE /
@@ -926,8 +941,10 @@ void hvd_core_timeline_stop() {
     std::lock_guard<std::mutex> lk(g->timeline_mutex);
     dead = std::move(g->timeline);
     // A later start must not inherit phase state from this session
-    // (stale entries would suppress fresh NEGOTIATE begins).
+    // (stale entries would suppress fresh NEGOTIATE begins or close
+    // spans the new session never opened).
     g->tl_negotiating.clear();
+    g->tl_open_spans.clear();
   }
   if (dead) dead->Stop();
 }

@@ -11,6 +11,10 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional
 
+from horovod_tpu.ray.elastic import (  # noqa: F401
+    ElasticRayExecutor, RayHostDiscovery, StaticHostDiscovery,
+)
+
 
 def _require_ray():
     try:

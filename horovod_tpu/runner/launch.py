@@ -521,7 +521,11 @@ def check_build(file=None) -> int:
     file = file or sys.stdout
 
     def _have(mod):
-        return importlib.util.find_spec(mod) is not None
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except (ImportError, ValueError):
+            # ValueError: a stub in sys.modules with __spec__ = None.
+            return False
 
     def _jsrun_available():
         try:
@@ -533,9 +537,11 @@ def check_build(file=None) -> int:
     def _box(ok):
         return "[X]" if ok else "[ ]"
 
+    # Report-only: do NOT trigger a build from a status command (the
+    # reference's --check-build likewise reports what exists).
     try:
         from horovod_tpu.core.build import library_path
-        native_built = library_path(build_if_missing=True) is not None
+        native_built = library_path(build_if_missing=False) is not None
     except Exception:
         native_built = False
     lines = [

@@ -42,7 +42,9 @@ def _to_numpy(t: torch.Tensor) -> np.ndarray:
 
 
 def _to_torch(a, like: torch.Tensor) -> torch.Tensor:
-    a = np.ascontiguousarray(a)
+    shape = np.shape(a)
+    # np.ascontiguousarray promotes 0-dim to 1-D; restore after.
+    a = np.ascontiguousarray(a).reshape(shape)
     if str(a.dtype) == "bfloat16":
         return torch.from_numpy(a.view(np.int16)).view(torch.bfloat16).to(
             like.dtype)

@@ -1,0 +1,139 @@
+"""np=2 torch-binding edge/error matrix.
+
+Reference pattern: test/parallel/test_torch.py:154+ — the ~100-test
+sweep of dtype x shape x error cases through the FRAMEWORK surface.
+This worker ports its error-path discipline: cross-rank shape/dtype/op
+mismatches must raise coordinator errors *through the binding API* on
+every rank (and leave the job usable), and the edge shapes the
+reference sweeps (scalar, empty, uneven, small ints, bool) must go
+through the same public calls users make.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+from horovod_tpu.common.process_sets import ProcessSet  # noqa: E402
+from matrix_common import expect_error  # noqa: E402
+
+
+def main():
+    singles = [ProcessSet([0]), ProcessSet([1])]
+    hvd.init(process_sets=singles)
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    # --- cross-rank error paths (reference: test_torch.py error suite) ---
+    with expect_error("Mismatched allreduce shapes"):
+        hvd.allreduce(torch.ones(4 + r), name="mx.shape", op=hvd.Sum)
+    # The error is per-tensor: the job keeps working afterwards.
+    out = hvd.allreduce(torch.ones(4), name="mx.recover", op=hvd.Sum)
+    np.testing.assert_allclose(out.numpy(), 2.0)
+
+    with expect_error("Mismatched data types"):
+        hvd.allreduce(
+            torch.ones(4, dtype=torch.float32 if r == 0 else torch.float64),
+            name="mx.dtype", op=hvd.Sum)
+
+    with expect_error("Mismatched reduce op"):
+        hvd.allreduce(torch.ones(4), name="mx.op",
+                      op=hvd.Sum if r == 0 else hvd.Average)
+
+    with expect_error("Mismatched root rank"):
+        hvd.broadcast(torch.ones(3), root_rank=r, name="mx.root")
+
+    with expect_error("Mismatched scale factors"):
+        hvd.allreduce(torch.ones(4), name="mx.scale", op=hvd.Sum,
+                      prescale_factor=1.0 + r)
+
+    # --- grouped allreduce, mixed dtypes in one group ---
+    outs = hvd.grouped_allreduce(
+        [torch.full((3,), float(r + 1)),
+         torch.full((2,), float(r + 1), dtype=torch.float64),
+         torch.full((4,), r + 1, dtype=torch.int32)],
+        name="mx.group", op=hvd.Sum)
+    np.testing.assert_allclose(outs[0].numpy(), 3.0)
+    assert outs[1].dtype == torch.float64
+    np.testing.assert_allclose(outs[1].numpy(), 3.0)
+    assert outs[2].dtype == torch.int32
+    np.testing.assert_array_equal(outs[2].numpy(), 3)
+
+    # --- edge shapes ---
+    s = hvd.allreduce(torch.tensor(float(r + 1)), name="mx.scalar",
+                      op=hvd.Sum)
+    assert s.shape == torch.Size([]) and float(s) == 3.0
+
+    e = hvd.allreduce(torch.zeros(0), name="mx.empty", op=hvd.Sum)
+    assert e.shape == torch.Size([0])
+
+    for dt in (torch.int8, torch.uint8, torch.int32, torch.int64):
+        o = hvd.allreduce(torch.full((5,), 2, dtype=dt),
+                          name="mx.int.%s" % dt, op=hvd.Sum)
+        assert o.dtype == dt, (dt, o.dtype)
+        np.testing.assert_array_equal(o.numpy(), 4)
+
+    # bool rides allgather/broadcast (no arithmetic on the wire).
+    b = hvd.allgather(torch.tensor([r == 0, True]), name="mx.bool")
+    assert b.dtype == torch.bool
+    np.testing.assert_array_equal(b.numpy(), [True, True, False, True])
+    bb = hvd.broadcast(torch.tensor([r == 1]), root_rank=1,
+                       name="mx.bool.bc")
+    np.testing.assert_array_equal(bb.numpy(), [True])
+
+    # --- uneven / empty allgather ---
+    g = hvd.allgather(torch.arange((r + 2) * 3).reshape(r + 2, 3),
+                      name="mx.uneven")
+    assert g.shape == (5, 3), g.shape
+    np.testing.assert_array_equal(g[:2].numpy(),
+                                  np.arange(6).reshape(2, 3))
+    g0 = hvd.allgather(torch.zeros((0, 3)) if r == 0
+                       else torch.ones((2, 3)), name="mx.emptygather")
+    assert g0.shape == (2, 3), g0.shape
+    np.testing.assert_allclose(g0.numpy(), 1.0)
+
+    # --- process sets through the torch surface ---
+    mine = singles[r]
+    solo = hvd.allreduce(torch.full((4,), float(r + 7)), op=hvd.Sum,
+                         name="mx.ps", process_set=mine)
+    np.testing.assert_allclose(solo.numpy(), float(r + 7))  # identity
+    pbc = hvd.broadcast(torch.full((2,), float(r)), root_rank=r,
+                        name="mx.ps.bc", process_set=mine)
+    np.testing.assert_allclose(pbc.numpy(), float(r))
+
+    # --- alltoall with explicit uneven splits ---
+    # rank0 sends [1 row to r0, 2 rows to r1]; rank1 sends [3, 1].
+    rows = 3 if r == 0 else 4
+    x = torch.arange(rows * 2, dtype=torch.float32).reshape(rows, 2) + \
+        10 * (r + 1)
+    splits = torch.tensor([1, 2] if r == 0 else [3, 1])
+    out, rsplits = hvd.alltoall(x, splits=splits, name="mx.a2a")
+    expected_rows = 1 + 3 if r == 0 else 2 + 1
+    assert out.shape == (expected_rows, 2), out.shape
+    assert list(rsplits) == ([1, 3] if r == 0 else [2, 1])
+
+    # --- reducescatter with a dim-0 not divisible by world size ---
+    rs = hvd.reducescatter(
+        torch.ones(3, 2) * (r + 1), op=hvd.Sum, name="mx.rs")
+    # ring convention: 3 rows over 2 ranks -> rank0 2 rows, rank1 1.
+    assert rs.shape == ((2, 2) if r == 0 else (1, 2)), rs.shape
+    np.testing.assert_allclose(rs.numpy(), 3.0)
+
+    # --- prescale/postscale through the binding ---
+    ps = hvd.allreduce(torch.full((4,), 2.0), op=hvd.Sum,
+                       name="mx.prepost", prescale_factor=0.5,
+                       postscale_factor=10.0)
+    np.testing.assert_allclose(ps.numpy(), 0.5 * 2.0 * 2 * 10.0)
+
+    hvd.shutdown()
+    print("BINDING_MATRIX_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,21 @@ def init(*args, **kwargs):
     return None
 
 
+def shutdown():
+    return None
+
+
+def nodes():
+    """One live localhost node, 4 CPUs (the shape RayHostDiscovery
+    reads: Alive / Resources / NodeManagerHostname)."""
+    return [{"Alive": True, "Resources": {"CPU": 4.0},
+             "NodeManagerHostname": "localhost"}]
+
+
+def available_resources():
+    return {"CPU": 4.0}
+
+
 # --- ray.util ---------------------------------------------------------------
 
 class _ReadyNow:
@@ -216,6 +231,9 @@ def install():
     ray_mod.kill = kill
     ray_mod.init = init
     ray_mod.is_initialized = is_initialized
+    ray_mod.shutdown = shutdown
+    ray_mod.nodes = nodes
+    ray_mod.available_resources = available_resources
     exc_mod = types.ModuleType("ray.exceptions")
     exc_mod.RayError = RayError
     exc_mod.RayActorError = RayActorError

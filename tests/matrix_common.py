@@ -1,0 +1,23 @@
+"""Shared assertion helper for the binding edge/error matrix workers."""
+
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+
+class expect_error:
+    """Assert the body raises HorovodInternalError mentioning ``what``
+    (the coordinator's mismatch reason must survive to the API caller,
+    reference: test_torch.py test_horovod_allreduce_error)."""
+
+    def __init__(self, what):
+        self.what = what
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        assert exc_type is not None, (
+            "expected HorovodInternalError(%r), nothing raised"
+            % self.what)
+        assert issubclass(exc_type, HorovodInternalError), exc_type
+        assert self.what in str(exc), (self.what, str(exc))
+        return True

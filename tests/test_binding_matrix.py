@@ -1,0 +1,44 @@
+"""Launchers for the per-binding edge/error matrices.
+
+Reference: test/parallel/test_torch.py + test_tensorflow.py — the
+reference's thickest suites sweep dtype x shape x error cases through
+each framework surface. The matrices live in binding_matrix_worker.py
+(torch) and tf_matrix_worker.py (TF + keras); each asserts that
+coordinator errors raise through the public binding API on every rank
+and that the job keeps working afterwards.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(worker, extra_env=None, timeout=300):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(_REPO, "tests", worker)],
+        cwd=_REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_torch_binding_matrix():
+    proc = _launch("binding_matrix_worker.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("BINDING_MATRIX_OK") == 2, proc.stdout
+
+
+@pytest.mark.tier2
+def test_tf_binding_matrix():
+    # Host-bridge mode must be chosen before TF's eager context exists,
+    # so it rides the environment into the workers.
+    proc = _launch("tf_matrix_worker.py",
+                   extra_env={"HOROVOD_TF_HOST_BRIDGE": "1"},
+                   timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("TF_MATRIX_OK") == 2, proc.stdout

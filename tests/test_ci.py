@@ -16,6 +16,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # a tier-1 stand-in. This map documents the pairing; test_tier2_has_
 # tier1_coverage enforces that the named stand-ins exist.
 TIER2_COVERAGE = {
+    "test_keras_mnist_advanced_example":
+        "tests/test_keras_binding.py::test_keras_multiproc",
+    "test_keras_imagenet_resnet50_example":
+        "tests/test_keras_binding.py::test_keras_multiproc",
+    "test_adasum_bench_example":
+        "tests/test_adasum_hierarchical.py::test_adasum_native_multiproc",
+    "test_tf_binding_matrix":
+        "tests/test_binding_matrix.py::test_torch_binding_matrix",
     "test_pytorch_mnist_example":
         "tests/test_torch_binding.py::test_torch_multiproc",
     "test_keras_mnist_example":

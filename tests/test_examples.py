@@ -102,3 +102,72 @@ def test_elastic_tensorflow2_example():
         ["--epochs", "2", "--steps-per-epoch", "4"], timeout=420)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "elastic tf2 training complete" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_keras_mnist_advanced_example():
+    """Advanced keras recipe (augmentation layers + warmup + staircase
+    + gradient aggregation) through the keras-native binding."""
+    proc = _run_example("examples/keras/keras_mnist_advanced.py", 2,
+                        ["--epochs", "2", "--batch-size", "64"],
+                        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("done rank") == 2
+    assert "checkpoint written:" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_keras_imagenet_resnet50_example():
+    proc = _run_example(
+        "examples/keras/keras_imagenet_resnet50.py", 2,
+        ["--image-size", "64", "--batch-size", "2", "--steps", "2"],
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("done rank") == 2
+    assert "final loss" in proc.stdout
+
+
+def test_jax_process_sets_example():
+    proc = _run_example("examples/jax/jax_process_sets.py", 4, [])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("done rank") == 4
+    assert "even-set sum = 2" in proc.stdout
+    assert "odd-set sum = 4" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_adasum_bench_example():
+    proc = _run_example("examples/adasum/adasum_bench.py", 2,
+                        ["--iters", "3", "--max-mb", "0.5"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "adasum(ms/op)" in proc.stdout
+    assert proc.stdout.count("done rank") == 2
+
+
+def test_ray_elastic_example():
+    """The elastic ray example under the in-tree ray fake (real ray is
+    not installable here; the fake spawns real actor processes)."""
+    import importlib.util
+
+    sys.path.insert(0, os.path.join(_REPO, "tests"))
+    try:
+        import fake_ray
+
+        fake_ray.install()
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "ray_elastic_example",
+                os.path.join(_REPO, "examples/ray/ray_elastic.py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            old_argv = sys.argv
+            sys.argv = ["ray_elastic.py", "--min-np", "1",
+                        "--max-np", "2"]
+            try:
+                mod.main()
+            finally:
+                sys.argv = old_argv
+        finally:
+            fake_ray.uninstall()
+    finally:
+        sys.path.remove(os.path.join(_REPO, "tests"))

@@ -29,9 +29,19 @@ def test_scaling_json_has_all_world_sizes():
     for r in recs:
         assert r["value"] > 0
         # Overhead % is the committed framework signal (VERDICT r2
-        # weak #2: no self-defined "efficiency" metric on this host).
+        # weak #2: no self-defined "efficiency" metric on this host)
+        # and must LEAD the record (VERDICT r3 weak #4: the raw
+        # oversubscribed ratio misled when it came first).
+        keys = list(r)
+        assert keys.index("collective_overhead_pct") < min(
+            i for i, k in enumerate(keys)
+            if k.startswith("throughput_ratio"))
         assert r["collective_overhead_pct"] >= 0.0
         assert "efficiency_proxy" not in r
+        assert "throughput_ratio_vs_1dev" not in r  # renamed: it never
+        # measured per-device scaling, only core oversubscription
+        assert any(k.startswith("throughput_ratio_oversubscribed_")
+                   for k in r)
 
 
 def test_scaling_json_has_bus_bandwidth():

@@ -1,0 +1,108 @@
+"""np=2 TF + Keras binding edge/error matrix.
+
+Reference pattern: test/parallel/test_tensorflow.py — the dtype x
+shape x error sweep through the TF surface. Runs the HOST-BRIDGED
+collective path (HOROVOD_TF_HOST_BRIDGE=1, set by the launcher test
+BEFORE TF initializes): cross-rank mismatches flow to the native
+coordinator, whose per-tensor error responses must raise through the
+TF/Keras APIs and leave the job usable — the in-graph TF collective
+runtime cannot express that (a runtime error poisons the process, so
+its callers pre-validate instead; see tensorflow/ingraph.py alltoall
+pre-flight, covered by tf_ingraph_worker.py).
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+from horovod_tpu.common.process_sets import ProcessSet  # noqa: E402
+from matrix_common import expect_error  # noqa: E402
+
+
+def main():
+    singles = [ProcessSet([0]), ProcessSet([1])]
+    hvd.init(process_sets=singles)
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+    from horovod_tpu.tensorflow import ingraph
+    assert not ingraph.collective_runtime_ready()  # host bridge active
+
+    # --- cross-rank error paths through the TF API ---
+    with expect_error("Mismatched allreduce shapes"):
+        hvd.allreduce(tf.ones([4 + r]), name="tfmx.shape", op=hvd.Sum)
+    out = hvd.allreduce(tf.ones([4]), name="tfmx.recover", op=hvd.Sum)
+    np.testing.assert_allclose(out.numpy(), 2.0)  # job survives
+
+    with expect_error("Mismatched data types"):
+        hvd.allreduce(
+            tf.ones([4], dtype=tf.float32 if r == 0 else tf.float64),
+            name="tfmx.dtype", op=hvd.Sum)
+
+    with expect_error("Mismatched root rank"):
+        hvd.broadcast(tf.ones([3]), root_rank=r, name="tfmx.root")
+
+    # --- grouped allreduce, mixed dtypes ---
+    outs = hvd.grouped_allreduce(
+        [tf.fill([3], float(r + 1)),
+         tf.fill([2], np.float64(r + 1)),
+         tf.fill([4], np.int32(r + 1))],
+        name="tfmx.group", op=hvd.Sum)
+    np.testing.assert_allclose(outs[0].numpy(), 3.0)
+    assert outs[1].dtype == tf.float64
+    np.testing.assert_allclose(outs[1].numpy(), 3.0)
+    assert outs[2].dtype == tf.int32
+    np.testing.assert_array_equal(outs[2].numpy(), 3)
+
+    # --- edge shapes ---
+    s = hvd.allreduce(tf.constant(float(r + 1)), name="tfmx.scalar",
+                      op=hvd.Sum)
+    assert s.shape == () and float(s) == 3.0
+    e = hvd.allreduce(tf.zeros([0]), name="tfmx.empty", op=hvd.Sum)
+    assert tuple(e.shape) == (0,)
+    for dtype in (tf.uint8, tf.int32, tf.int64):
+        o = hvd.allreduce(tf.fill([5], tf.cast(2, dtype)),
+                          name="tfmx.int.%s" % dtype.name, op=hvd.Sum)
+        assert o.dtype == dtype
+        np.testing.assert_array_equal(o.numpy(), 4)
+    b = hvd.allgather(tf.constant([r == 0, True]), name="tfmx.bool")
+    assert b.dtype == tf.bool
+    np.testing.assert_array_equal(b.numpy(), [True, True, False, True])
+
+    # --- uneven allgather ---
+    g = hvd.allgather(tf.reshape(tf.range((r + 2) * 3), [r + 2, 3]),
+                      name="tfmx.uneven")
+    assert tuple(g.shape) == (5, 3), g.shape
+
+    # --- process sets through the TF surface ---
+    mine = singles[r]
+    solo = hvd.allreduce(tf.fill([4], float(r + 7)), op=hvd.Sum,
+                         name="tfmx.ps", process_set=mine)
+    np.testing.assert_allclose(solo.numpy(), float(r + 7))
+
+    # --- keras value surface: mismatch raises + numpy semantics ---
+    import horovod_tpu.keras as hvdk
+
+    with expect_error("Mismatched allreduce shapes"):
+        hvdk.allreduce(np.ones(3 + r, np.float32), name="kmx.shape",
+                       average=False)
+    v = hvdk.allreduce(np.full(4, float(r + 1), np.float32),
+                       name="kmx.ok", average=True)
+    assert isinstance(v, np.ndarray)
+    np.testing.assert_allclose(v, 1.5)
+    ps_v = hvdk.allreduce([1.0 + r], name="kmx.ps", average=False,
+                          process_set=mine)
+    np.testing.assert_allclose(ps_v, 1.0 + r)
+
+    hvd.shutdown()
+    print("TF_MATRIX_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

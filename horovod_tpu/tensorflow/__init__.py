@@ -262,7 +262,26 @@ def barrier(process_set=global_process_set):
 def broadcast_variables(variables, root_rank=0,
                         process_set=global_process_set):
     """In-place broadcast of tf.Variables
-    (reference: horovod/tensorflow/functions.py broadcast_variables)."""
+    (reference: horovod/tensorflow/functions.py broadcast_variables).
+
+    Works inside a ``tf.function`` too — the reference's canonical
+    custom loop broadcasts after the FIRST compiled step so optimizer
+    slots exist — by lowering per-variable in-graph collective
+    broadcasts into the surrounding function."""
+    if tf.inside_function():
+        if not _use_ingraph(process_set):
+            raise RuntimeError(
+                "broadcast_variables inside tf.function needs the TF "
+                "collective runtime (the host-bridged path is "
+                "eager-only); call it outside the tf.function or "
+                "initialize without HOROVOD_TF_HOST_BRIDGE")
+        for i, v in enumerate(variables):
+            # convert_to_tensor reads both tf.Variable and Keras-3
+            # variables (which have no read_value()).
+            v.assign(broadcast(tf.convert_to_tensor(v), root_rank,
+                               name="broadcast_variables.%d" % i,
+                               process_set=process_set))
+        return
     for i, v in enumerate(variables):
         out = eager.synchronize(eager.broadcast_async(
             v.numpy(), root_rank,

@@ -24,6 +24,10 @@ TIER2_COVERAGE = {
         "tests/test_adasum_hierarchical.py::test_adasum_native_multiproc",
     "test_tf_binding_matrix":
         "tests/test_binding_matrix.py::test_torch_binding_matrix",
+    "test_tensorflow2_mnist_example":
+        "tests/test_tf_binding.py::test_allreduce_gradient",
+    "test_pytorch_spark_example":
+        "tests/test_spark_estimators.py::test_torch_estimator_fit_predict",
     "test_pytorch_mnist_example":
         "tests/test_torch_binding.py::test_torch_multiproc",
     "test_keras_mnist_example":

@@ -45,18 +45,21 @@ def test_keras_mnist_example():
     assert proc.stdout.count("done") == 2
 
 
-def test_spark_keras_example():
+def _run_spark_example(rel, num_proc, epochs):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # Direct script run (no -m horovod_tpu.runner): put the repo on the
     # path, preserving any existing entries (e.g. the TPU site dir).
     env["PYTHONPATH"] = os.pathsep.join(
         [_REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
-    proc = subprocess.run(
-        [sys.executable,
-         os.path.join(_REPO, "examples/spark/keras_spark_mnist.py"),
-         "--num-proc", "1", "--epochs", "2"],
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, rel),
+         "--num-proc", str(num_proc), "--epochs", str(epochs)],
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=420)
+
+
+def test_spark_keras_example():
+    proc = _run_spark_example("examples/spark/keras_spark_mnist.py", 1, 2)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "predict([1,0,0,0])" in proc.stdout
 
@@ -142,6 +145,28 @@ def test_adasum_bench_example():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "adasum(ms/op)" in proc.stdout
     assert proc.stdout.count("done rank") == 2
+
+
+@pytest.mark.tier2
+def test_tensorflow2_mnist_example():
+    """Custom-loop family: DistributedGradientTape + post-first-step
+    broadcast (reference: tensorflow2_mnist.py)."""
+    proc = _run_example("examples/tensorflow2/tensorflow2_mnist.py", 2,
+                        ["--epochs", "1", "--steps-per-epoch", "3",
+                         "--batch-size", "16"], timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("done rank") == 2
+    assert "epoch 0 loss" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_pytorch_spark_example():
+    """np=2 estimator fit: tier 2, like test_torch_estimator_fit_np2
+    (the established partition for multi-rank estimator training)."""
+    proc = _run_spark_example("examples/spark/pytorch_spark_mnist.py",
+                              2, 2)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "predict([1,0,0,0])" in proc.stdout
 
 
 def test_ray_elastic_example():

@@ -200,6 +200,18 @@ def traced_collectives_tf(r, n):
     np.testing.assert_allclose(s.numpy(), [total] * 3)
     assert g.shape[0] == n
 
+    # broadcast_variables INSIDE a tf.function (the reference's
+    # canonical post-first-step broadcast hook): the in-graph
+    # per-variable broadcast lowers into the trace.
+    v = tf.Variable([float(r + 3), float(r + 5)])
+
+    @tf.function
+    def bcast_step():
+        hvd.broadcast_variables([v], root_rank=1)
+
+    bcast_step()
+    np.testing.assert_allclose(v.numpy(), [4.0, 6.0])
+
 
 def dtype_matrix_tf(r, n):
     """dtype x op allreduce matrix through the TF surface

@@ -1,0 +1,91 @@
+"""Keras elastic-training surface (reference: horovod/keras/elastic.py
++ horovod/_keras/elastic.py).
+
+``KerasState`` snapshots the model + optimizer for elastic rollback;
+the callbacks keep the state's epoch/batch counters in lockstep with
+``model.fit`` so a reset resumes mid-epoch instead of replaying it.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+
+class KerasState(TensorFlowKerasState):
+    """(reference: keras/elastic.py:22-31) — pulls the optimizer off
+    the compiled model when not given explicitly."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        optimizer = optimizer or getattr(model, "optimizer", None)
+        super().__init__(model=model, optimizer=optimizer, **kwargs)
+
+
+class CommitStateCallback(tf.keras.callbacks.Callback):
+    """Commit the elastic state every ``batches_per_commit`` batches
+    and at every epoch end (reference: _keras/elastic.py:17-38).
+
+    Frequent commits bound how much work a reset can lose; each commit
+    costs a state snapshot, so tune the cadence to taste."""
+
+    def __init__(self, state, batches_per_commit=1):
+        super().__init__()
+        self.state = state
+        self.batches_per_commit = batches_per_commit
+        self.batches_remaining = batches_per_commit
+
+    def on_train_begin(self, logs=None):
+        # Reset on every sync event so all ranks commit in the same
+        # batches.
+        self.batches_remaining = self.batches_per_commit
+
+    def on_batch_end(self, batch, logs=None):
+        self.batches_remaining -= 1
+        if self.batches_remaining == 0:
+            self.state.commit()
+            self.batches_remaining = self.batches_per_commit
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.commit()
+
+
+class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
+    """Track the in-epoch batch position in the state
+    (reference: _keras/elastic.py:41-62).
+
+    The reference additionally shortened the first post-restore epoch
+    by mutating ``self.params['steps']``; under Keras 3 the fit loop
+    ignores that mutation (verified empirically), so resuming mid-epoch
+    is done explicitly instead: pass
+    ``steps_per_epoch=total_steps - state.batch`` to the resumed
+    ``fit()`` call."""
+
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+
+    def on_batch_end(self, batch, logs=None):
+        self.state.batch = batch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0
+
+
+class UpdateEpochStateCallback(tf.keras.callbacks.Callback):
+    """Track the GLOBAL epoch (across resets) in the state
+    (reference: _keras/elastic.py:65-87): Keras restarts epoch
+    numbering at 0 on every fit, so offset by the state's epoch when
+    training (re)began, plus one so a reset right after an epoch end
+    does not replay it."""
+
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+        self.initial_epoch = self.state.epoch
+
+    def on_train_begin(self, logs=None):
+        self.initial_epoch = self.state.epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.epoch = self.initial_epoch + epoch + 1

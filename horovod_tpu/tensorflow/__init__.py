@@ -269,6 +269,8 @@ def broadcast_variables(variables, root_rank=0,
     slots exist — by lowering per-variable in-graph collective
     broadcasts into the surrounding function."""
     if tf.inside_function():
+        if basics.size() <= 1:
+            return  # single process: broadcast is the identity
         if not _use_ingraph(process_set):
             raise RuntimeError(
                 "broadcast_variables inside tf.function needs the TF "

@@ -189,7 +189,35 @@ def main():
     g5 = hvd.allgather(flat5[None, :], name="kw.agg")
     np.testing.assert_allclose(g5[0], g5[1], atol=1e-6)
 
-    # 10. Legacy keras-2 hook: _aggregate_gradients allreduces
+    # 10. Keras elastic surface (reference: keras/elastic.py): the
+    # state callbacks track global epoch across fit(), commit
+    # snapshots, and restore() rolls weights back to the last commit.
+    from horovod_tpu.keras import elastic as hvd_elastic
+
+    tf.keras.utils.set_random_seed(11)
+    m6 = tf.keras.Sequential([
+        tf.keras.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    m6.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.05)), loss="mse")
+    state = hvd_elastic.KerasState(m6, epoch=0, batch=0)
+    assert state._optimizer is m6.optimizer  # pulled off the model
+    m6.fit(x[:, :2], y, batch_size=8, epochs=2, verbose=0,
+           callbacks=[
+               hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+               hvd_elastic.CommitStateCallback(state,
+                                               batches_per_commit=2),
+               hvd_elastic.UpdateBatchStateCallback(state),
+               hvd_elastic.UpdateEpochStateCallback(state)])
+    assert state.epoch == 2, state.epoch  # global epoch advanced
+    assert state.batch == 0  # reset at epoch end
+    committed = [w.copy() for w in m6.get_weights()]
+    m6.trainable_variables[0].assign(
+        m6.trainable_variables[0] + 99.0)  # diverge, then roll back
+    state.restore()
+    for got, want in zip(m6.get_weights(), committed):
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    # 11. Legacy keras-2 hook: _aggregate_gradients allreduces
     # grads-and-vars pairs (reference: _keras/__init__.py:109-117).
     v = tf.Variable([0.0, 0.0])
     g = tf.constant([float(r + 1), 2.0 * (r + 1)])

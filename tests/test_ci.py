@@ -25,7 +25,7 @@ TIER2_COVERAGE = {
     "test_tf_binding_matrix":
         "tests/test_binding_matrix.py::test_torch_binding_matrix",
     "test_tensorflow2_mnist_example":
-        "tests/test_tf_binding.py::test_allreduce_gradient",
+        "tests/test_tf_binding.py::test_tf_ingraph_collectives",
     "test_pytorch_spark_example":
         "tests/test_spark_estimators.py::test_torch_estimator_fit_predict",
     "test_pytorch_mnist_example":

@@ -102,6 +102,21 @@ def main():
     assert any("Collective" in t for t in graph_ops), \
         "no collective op in the traced graph: %s" % sorted(graph_ops)
 
+    # broadcast_variables INSIDE a tf.function (the reference's
+    # post-first-step broadcast hook): per-variable in-graph broadcasts
+    # lower into the trace and align every rank with the root.
+    bv = tf.Variable([float(r + 3), float(r + 5)])
+
+    @tf.function
+    def bcast_step():
+        hvd.broadcast_variables([bv], root_rank=1)
+
+    bcast_step()
+    np.testing.assert_allclose(bv.numpy(), [4.0, 6.0])
+    bops = {op.type for fn in bcast_step._list_all_concrete_functions()
+            for op in fn.graph.get_operations()}
+    assert not any("PyFunc" in t or "EagerPyFunc" in t for t in bops), bops
+
     # Sparse (IndexedSlices) gradients: embedding rows reduce via the
     # allgather path; rows touched by both ranks accumulate.
     emb = tf.keras.layers.Embedding(8, 2, embeddings_initializer="zeros")

@@ -1,0 +1,69 @@
+"""Keras training on a Ray cluster via RayExecutor.
+
+Parity workload for the reference's Ray TF2 example
+(reference: examples/ray/tensorflow2_mnist_ray.py): ``RayExecutor``
+runs a keras-binding training function — DistributedOptimizer,
+broadcast callback, size-scaled LR — on actor-per-slot workers.
+
+Requires a ray installation: python examples/ray/tensorflow2_mnist_ray.py
+(tests inject tests/fake_ray.py to smoke-run without a cluster).
+"""
+
+import argparse
+
+
+def train(num_epochs, steps):
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.keras as hvd
+    from horovod_tpu.keras import callbacks as hvd_callbacks
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    rng = np.random.RandomState(100 + r)  # per-rank shard
+    x = rng.rand(256, 28, 28).astype("float32")
+    y = rng.randint(0, 10, size=256).astype("int64")
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(28, 28)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            tf.keras.optimizers.Adam(0.001 * n)),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True))
+    hist = model.fit(
+        x, y, batch_size=32, epochs=num_epochs,
+        steps_per_epoch=steps, verbose=0,
+        callbacks=[hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+                   hvd_callbacks.MetricAverageCallback()])
+    return {"rank": r, "loss": float(hist.history["loss"][-1])}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-workers", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--steps", type=int, default=4)
+    args = p.parse_args()
+
+    import ray
+
+    from horovod_tpu.ray import RayExecutor
+
+    ray.init(ignore_reinit_error=True)
+    executor = RayExecutor(num_workers=args.num_workers)
+    executor.start()
+    results = executor.run(train, args=(args.epochs, args.steps))
+    print("per-rank results:", results)
+    executor.shutdown()
+    ray.shutdown()
+
+
+if __name__ == "__main__":
+    main()

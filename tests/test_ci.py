@@ -28,6 +28,8 @@ TIER2_COVERAGE = {
         "tests/test_tf_binding.py::test_tf_ingraph_collectives",
     "test_pytorch_spark_example":
         "tests/test_spark_estimators.py::test_torch_estimator_fit_predict",
+    "test_ray_tensorflow2_example":
+        "tests/test_cluster_fakes.py::test_ray_executor_end_to_end",
     "test_pytorch_mnist_example":
         "tests/test_torch_binding.py::test_torch_multiproc",
     "test_keras_mnist_example":

@@ -169,9 +169,9 @@ def test_pytorch_spark_example():
     assert "predict([1,0,0,0])" in proc.stdout
 
 
-def test_ray_elastic_example():
-    """The elastic ray example under the in-tree ray fake (real ray is
-    not installable here; the fake spawns real actor processes)."""
+def _run_ray_example(rel, argv):
+    """Run a ray example's main() under the in-tree ray fake (real ray
+    is not installable here; the fake spawns real actor processes)."""
     import importlib.util
 
     sys.path.insert(0, os.path.join(_REPO, "tests"))
@@ -181,13 +181,11 @@ def test_ray_elastic_example():
         fake_ray.install()
         try:
             spec = importlib.util.spec_from_file_location(
-                "ray_elastic_example",
-                os.path.join(_REPO, "examples/ray/ray_elastic.py"))
+                "ray_example_under_test", os.path.join(_REPO, rel))
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
             old_argv = sys.argv
-            sys.argv = ["ray_elastic.py", "--min-np", "1",
-                        "--max-np", "2"]
+            sys.argv = [os.path.basename(rel)] + argv
             try:
                 mod.main()
             finally:
@@ -196,3 +194,15 @@ def test_ray_elastic_example():
             fake_ray.uninstall()
     finally:
         sys.path.remove(os.path.join(_REPO, "tests"))
+
+
+def test_ray_elastic_example():
+    _run_ray_example("examples/ray/ray_elastic.py",
+                     ["--min-np", "1", "--max-np", "2"])
+
+
+@pytest.mark.tier2
+def test_ray_tensorflow2_example():
+    _run_ray_example("examples/ray/tensorflow2_mnist_ray.py",
+                     ["--num-workers", "2", "--epochs", "1",
+                      "--steps", "2"])

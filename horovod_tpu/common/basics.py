@@ -332,7 +332,8 @@ def start_timeline(file_path: str, mark_cycles: bool = False):
             # execution) beside the op-level Python timeline. Stop any
             # previous core writer first so a restart switches files.
             _ctx.core.stop_core_timeline()
-            _ctx.core.start_core_timeline(file_path + ".core.json")
+            _ctx.core.start_core_timeline(file_path + ".core.json",
+                                          mark_cycles=mark_cycles)
 
 
 def stop_timeline():

@@ -51,7 +51,9 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HOROVOD_TIMELINE", HONORED,
          "common/basics.py -> utils/timeline.py + native TimelineWriter"),
     Knob("HOROVOD_TIMELINE_MARK_CYCLES", HONORED,
-         "utils/timeline.py cycle markers"),
+         "native loop CYCLE_START marks on the trace's loop row "
+         "(core/src/operations.cc; also via start_timeline's "
+         "mark_cycles argument)"),
     Knob("HOROVOD_DISABLE_NVTX_RANGES", REJECTED,
          "NVTX is a CUDA profiler annotation library; TPU profiling "
          "goes through the timeline + XLA/jax.profiler instead"),

@@ -139,7 +139,8 @@ class CoreSession:
         lib.hvd_core_autotune_state.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_int]
         lib.hvd_core_timeline_start.restype = ctypes.c_int
-        lib.hvd_core_timeline_start.argtypes = [ctypes.c_char_p]
+        lib.hvd_core_timeline_start.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int]
 
         addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
         port = int(os.environ.get("HOROVOD_CONTROLLER_PORT", "0"))
@@ -173,10 +174,14 @@ class CoreSession:
 
     # --- native perf subsystem --------------------------------------------
 
-    def start_core_timeline(self, path: str) -> bool:
+    def start_core_timeline(self, path: str,
+                            mark_cycles: bool = False) -> bool:
         """Chrome-trace spans of the native background loop (negotiation
-        + per-response execution); written next to the Python timeline."""
-        return self._lib.hvd_core_timeline_start(path.encode()) == 0
+        + per-response execution); written next to the Python timeline.
+        ``mark_cycles`` stamps CYCLE_START marks on the loop row
+        (also enabled by HOROVOD_TIMELINE_MARK_CYCLES at init)."""
+        return self._lib.hvd_core_timeline_start(
+            path.encode(), 1 if mark_cycles else 0) == 0
 
     def stop_core_timeline(self):
         self._lib.hvd_core_timeline_stop()

@@ -82,6 +82,9 @@ struct Global {
   // Open top-level/activity span count per tensor in THIS timeline
   // session (guarded by timeline_mutex).
   std::map<std::string, int> tl_open_spans;
+  // HOROVOD_TIMELINE_MARK_CYCLES: stamp each background cycle on the
+  // loop row (reference: timeline.cc MarkStartedCycle/WriteMarker).
+  bool tl_mark_cycles = false;
   Clock::time_point t_origin = Clock::now();
 
   std::mutex init_mutex;
@@ -577,6 +580,12 @@ void BackgroundLoop() {
     if (now < target) std::this_thread::sleep_for(target - now);
     last_cycle = Clock::now();
 
+    if (g->tl_mark_cycles) {
+      std::lock_guard<std::mutex> tlk(g->timeline_mutex);
+      if (g->timeline)
+        g->timeline->Event("CYCLE_START", "cycle", TlNowUs(), 0);
+    }
+
     std::vector<ProcessSetState*> sets;
     {
       std::lock_guard<std::mutex> lk(g->ps_mutex);
@@ -734,6 +743,8 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
   g->rank = rank;
   g->size = size;
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
+  if (const char* mc = getenv("HOROVOD_TIMELINE_MARK_CYCLES"))
+    g->tl_mark_cycles = *mc && strcmp(mc, "0") != 0;
   if (fusion_bytes > 0) g->fusion_bytes = fusion_bytes;
   if (cache_cap >= 0) g->cache_cap = cache_cap;
 
@@ -926,11 +937,13 @@ void hvd_core_autotune_state(double* out, int n) {
 // Native chrome-trace timeline of the background loop
 // (reference: timeline.cc TimelineWriter; dynamic start/stop analog of
 // horovod_start_timeline, operations.cc:1011-1041).
-int hvd_core_timeline_start(const char* path) {
+int hvd_core_timeline_start(const char* path, int mark_cycles) {
   if (!g || !path) return -1;
   std::lock_guard<std::mutex> lk(g->timeline_mutex);
   if (g->timeline) return -2;
   g->timeline.reset(new TimelineWriter(path, g->rank));
+  // OR with the env default: either surface can turn marks on.
+  if (mark_cycles) g->tl_mark_cycles = true;
   return 0;
 }
 
@@ -942,9 +955,12 @@ void hvd_core_timeline_stop() {
     dead = std::move(g->timeline);
     // A later start must not inherit phase state from this session
     // (stale entries would suppress fresh NEGOTIATE begins or close
-    // spans the new session never opened).
+    // spans the new session never opened). Cycle marks reset to the
+    // env default; the next start's argument can re-enable them.
     g->tl_negotiating.clear();
     g->tl_open_spans.clear();
+    const char* mc = getenv("HOROVOD_TIMELINE_MARK_CYCLES");
+    g->tl_mark_cycles = mc && *mc && strcmp(mc, "0") != 0;
   }
   if (dead) dead->Stop();
 }

@@ -19,11 +19,14 @@ from typing import Optional
 
 class Timeline:
     def __init__(self, file_path: str, mark_cycles: bool = False):
+        # mark_cycles is accepted for API symmetry but acted on by the
+        # NATIVE writer (the op-level writer has no background cycle to
+        # mark); basics.start_timeline plumbs it through to the core.
+        del mark_cycles
         self._lock = threading.Lock()
         self._f = open(file_path, "w")
         self._f.write("[\n")
         self._t0 = time.perf_counter()
-        self._mark_cycles = mark_cycles
         self._closed = False
         self._buf = []
         self._stop_flusher = threading.Event()

@@ -41,7 +41,8 @@ def test_timeline_phase_hierarchy_np2(tmp_path):
     coordinator), then a top-level ALLREDUCE span nesting QUEUE and the
     TCP wire op, and fused-buffer memcpys for a grouped allreduce.
     Assertions live in timeline_worker.py."""
-    env = dict(os.environ, HVD_TL_DIR=str(tmp_path))
+    env = dict(os.environ, HVD_TL_DIR=str(tmp_path),
+               HOROVOD_TIMELINE_MARK_CYCLES="1")
     procs = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
          sys.executable, os.path.join(_REPO, "tests",

@@ -95,6 +95,12 @@ def main():
     assert depths["TCP_ALLREDUCE"] == 1
     assert names.index("QUEUE") < names.index("TCP_ALLREDUCE")
 
+    # --- cycle marks on the loop row when the knob is set ---
+    if os.environ.get("HOROVOD_TIMELINE_MARK_CYCLES", "") not in ("", "0"):
+        marks = [e for e in events
+                 if e.get("name") == "CYCLE_START" and e.get("tid") == 0]
+        assert marks, "HOROVOD_TIMELINE_MARK_CYCLES set but no marks"
+
     # --- grouped allreduce: fused-buffer memcpys on every member ---
     lanes_checked = 0
     for e in events:

@@ -167,12 +167,18 @@ def init(process_sets=None):
             # "{rank}" placeholder gives per-rank files on shared storage.
             timeline_path = timeline_path.replace(
                 "{rank}", str(_ctx.topology.rank))
+            mark = os.environ.get(
+                "HOROVOD_TIMELINE_MARK_CYCLES", "") not in ("", "0")
             from horovod_tpu.utils.timeline import Timeline
 
-            _ctx.timeline = Timeline(
-                timeline_path,
-                mark_cycles=os.environ.get(
-                    "HOROVOD_TIMELINE_MARK_CYCLES", "") not in ("", "0"))
+            _ctx.timeline = Timeline(timeline_path, mark_cycles=mark)
+            # The env-initiated timeline starts BOTH writers, exactly
+            # like hvd.start_timeline (the native one carries the
+            # per-tensor phase lanes and cycle marks).
+            if _ctx.core is not None:
+                _ctx.core.attach_timeline(_ctx.timeline)
+                _ctx.core.start_core_timeline(
+                    timeline_path + ".core.json", mark_cycles=mark)
         if process_sets:
             from horovod_tpu.common import process_sets as ps_mod
 

@@ -580,9 +580,11 @@ void BackgroundLoop() {
     if (now < target) std::this_thread::sleep_for(target - now);
     last_cycle = Clock::now();
 
-    if (g->tl_mark_cycles) {
+    {
+      // tl_mark_cycles is written under timeline_mutex by the
+      // start/stop API; read it under the same lock.
       std::lock_guard<std::mutex> tlk(g->timeline_mutex);
-      if (g->timeline)
+      if (g->tl_mark_cycles && g->timeline)
         g->timeline->Event("CYCLE_START", "cycle", TlNowUs(), 0);
     }
 

@@ -29,6 +29,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from horovod_tpu.common import basics
@@ -47,10 +48,13 @@ def _is_tracing(grads) -> bool:
 def _axis_in_scope(axis) -> bool:
     """Whether ``axis`` is a bound mesh axis in the current trace.
 
-    Under plain ``jit``/pjit auto-sharding there is no named axis: the
-    gradient pytree is a single logical array and XLA inserts the
-    cross-replica reduction from sharding constraints on its own, so the
-    correct transformation is the identity.
+    Under pjit auto-sharding over a GLOBAL mesh (jax.distributed) there
+    is no named axis: the gradient pytree is a single logical array and
+    XLA inserts the cross-process reduction from sharding constraints
+    on its own, so the correct transformation is the identity. In a
+    launcher-style multi-process job, where each process's jax sees
+    only its own devices, no-axis tracing instead takes the io_callback
+    host bridge (see allreduce_gradients).
     """
     try:
         jax.lax.axis_size(axis)
@@ -96,6 +100,34 @@ def allreduce_gradients(
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
         )
+    elif (_is_tracing(wires) and basics.is_initialized()
+          and basics.size() > 1 and jax.process_count() == 1):
+        # Plain jit in a MULTI-PROCESS job (one chip per process, the
+        # hvdrun launch shape — each process's jax sees only its own
+        # devices, process_count()==1): XLA compiles this process's
+        # program in isolation and cannot know about peer processes,
+        # so "let the compiler insert the reduction" (the pjit story)
+        # would silently train without gradient sync. Bridge to the
+        # native collective from inside the compiled step instead;
+        # ordered=True keeps every rank's collective sequence
+        # identical across steps. In a jax.distributed job
+        # (process_count() > 1) XLA DOES own the cross-process
+        # reduction and the identity branch below stays correct.
+        from jax.experimental import io_callback
+
+        def _host_sync(*flat):
+            handle = eager.grouped_allreduce_async(
+                list(flat), name="DistributedOptimizer",
+                op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set)
+            return tuple(np.asarray(o)
+                         for o in eager.synchronize(handle))
+
+        shapes = tuple(jax.ShapeDtypeStruct(w.shape, w.dtype)
+                       for w in wires)
+        outs = list(io_callback(_host_sync, shapes, *wires,
+                                ordered=True))
     elif (not _is_tracing(wires) and basics.is_initialized()
           and basics.size() > 1):
         paths = [

@@ -1,0 +1,68 @@
+"""np=2 JAX worker: gradient sync under PLAIN ``jax.jit``.
+
+Regression for the silent-desync trap fixed in r4: a multi-process job
+(one device per process — the hvdrun launch shape) that jits its whole
+train step used to hit the identity branch of allreduce_gradients
+(XLA cannot know about peer processes), training without sync. The
+io_callback bridge must now allreduce from inside the compiled step:
+the update equals a step on the MEAN gradient, and ranks stay
+identical.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.jax as hvd_jax  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    tx = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones(4, jnp.float32),
+              "b": jnp.zeros((), jnp.float32)}
+    opt_state = tx.init(params)
+
+    scale = jnp.float32(r + 1)  # rank-dependent gradient
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            return (p["w"] * scale).sum() + p["b"] * scale
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for i in range(3):
+        params, opt_state, loss = step(params, opt_state)
+        # grad = rank+1 -> mean over ranks = 1.5; sgd lr 0.1.
+        np.testing.assert_allclose(
+            np.asarray(params["w"]), 1.0 - 0.1 * 1.5 * (i + 1),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(params["b"]), -0.1 * 1.5 * (i + 1), rtol=1e-6)
+
+    # Cross-rank identity of the final parameters.
+    flat = np.concatenate([np.asarray(params["w"]).ravel(),
+                           np.asarray(params["b"]).ravel()])
+    gathered = np.asarray(hvd.allgather(flat[None, :], name="jj.g"))
+    np.testing.assert_allclose(gathered[0], gathered[1], atol=0)
+
+    hvd.shutdown()
+    print("JAX_JIT_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

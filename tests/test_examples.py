@@ -147,6 +147,17 @@ def test_adasum_bench_example():
     assert proc.stdout.count("done rank") == 2
 
 
+def test_jax_checkpoint_resume_example():
+    """Checkpoint/resume parity: a crashed-and-resumed run must end
+    bit-identical to an uninterrupted control (the example asserts it
+    internally)."""
+    proc = _run_example("examples/jax/jax_checkpoint_resume.py", 2,
+                        ["--steps", "5", "--crash-at", "1"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "resumed from step 1" in proc.stdout
+    assert proc.stdout.count("done rank") == 2
+
+
 @pytest.mark.tier2
 def test_tensorflow2_mnist_example():
     """Custom-loop family: DistributedGradientTape + post-first-step

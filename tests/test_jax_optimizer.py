@@ -150,3 +150,21 @@ def test_sync_batch_norm_module(mesh8):
     # Globally normalized → global mean ~0, var ~1.
     np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-5)
     np.testing.assert_allclose(out.var(0), 1.0, atol=1e-2)
+
+
+def test_jit_step_syncs_across_processes():
+    """np=2, whole train step under plain jax.jit: gradients must sync
+    through the io_callback bridge (r4 regression — the identity
+    branch used to swallow multi-process sync; jax_jit_worker.py
+    asserts step-on-mean-gradient and cross-rank identity)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(repo, "tests", "jax_jit_worker.py")],
+        cwd=repo, capture_output=True, text=True, timeout=300)
+    assert procs.returncode == 0, procs.stdout + procs.stderr
+    assert procs.stdout.count("JAX_JIT_OK") == 2, procs.stdout

@@ -33,6 +33,37 @@ def test_timeline_single_process(tmp_path):
     assert "B" in phases and "E" in phases
 
 
+def test_timeline_env_starts_native_writer(tmp_path):
+    """HOROVOD_TIMELINE (+ MARK_CYCLES) via environment alone — the
+    hvdrun --timeline-filename path — must start the NATIVE writer too:
+    phase lanes land in <path>.core.json with CYCLE_START marks, no
+    explicit hvd.start_timeline call (r4 review fix)."""
+    worker = tmp_path / "env_tl_worker.py"
+    worker.write_text(
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "hvd.allreduce(np.ones(4, np.float32), name='envtl.x',"
+        " op=hvd.Sum)\n"
+        "hvd.shutdown()\n"
+        "print('ENVTL_OK')\n")
+    tl = tmp_path / "tl_{rank}.json"
+    env = dict(os.environ, HOROVOD_TIMELINE=str(tl),
+               HOROVOD_TIMELINE_MARK_CYCLES="1")
+    procs = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=180, env=env)
+    assert procs.returncode == 0, procs.stdout + procs.stderr
+    assert procs.stdout.count("ENVTL_OK") == 2
+    core = tmp_path / "tl_0.json.core.json"
+    assert core.exists(), list(tmp_path.iterdir())
+    text = core.read_text()
+    assert "CYCLE_START" in text
+    assert "envtl.x" in text
+
+
 def test_timeline_phase_hierarchy_np2(tmp_path):
     """Per-tensor phase STRUCTURE parity at np=2 (reference:
     timeline.cc:496-558 + test/parallel/test_timeline.py): each rank's

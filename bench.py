@@ -391,15 +391,29 @@ def _spawn(argv_extra, timeout_s, cpu_env=False):
             pass
         proc.wait()
         return None, "timeout after %ds (backend hang?)" % timeout_s
+    parsed = _last_metric_json(out)
+    if parsed is not None:
+        return parsed, ""
     lines = [ln for ln in (out or "").strip().splitlines() if ln.strip()]
-    for ln in reversed(lines):
+    return None, "rc=%d tail=%r" % (proc.returncode, lines[-8:])
+
+
+def _last_metric_json(text):
+    """Last line of ``text`` that parses as a result dict, or None.
+
+    This is the output contract between the supervisor and its child
+    (and between bench.py and external harnesses such as
+    ci/opportunistic_bench.py): the result is the final JSON object
+    line carrying a "metric" key.
+    """
+    for ln in reversed((text or "").strip().splitlines()):
         try:
             parsed = json.loads(ln)
-            if isinstance(parsed, dict) and "metric" in parsed:
-                return parsed, ""
         except ValueError:
             continue
-    return None, "rc=%d tail=%r" % (proc.returncode, lines[-8:])
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    return None
 
 
 def main():

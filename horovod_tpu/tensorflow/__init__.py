@@ -86,6 +86,20 @@ def _use_ingraph(process_set) -> bool:
     return ingraph.collective_runtime_ready()
 
 
+# TF's collective kernels accept only a subset of the wire dtypes the
+# native (host) plane carries; anything else must fall back to the
+# host bridge or CollectiveReduceV2/GatherV2/BcastV2 reject the
+# NodeDef at execution time (allowed lists read from TF's op
+# registry — CollectiveGatherV2 notably has no bfloat16/bool/uint8/
+# int8 kernel, CollectiveBcastSendV2 no bfloat16/uint8/int8).
+_INGRAPH_REDUCE_DTYPES = frozenset((
+    tf.bfloat16, tf.float16, tf.float32, tf.float64, tf.int32, tf.int64))
+_INGRAPH_GATHER_DTYPES = frozenset((
+    tf.float16, tf.float32, tf.float64, tf.int32, tf.int64))
+_INGRAPH_BCAST_DTYPES = frozenset((
+    tf.bool, tf.float16, tf.float32, tf.float64, tf.int32, tf.int64))
+
+
 def allreduce(tensor, average=None, op=None, name=None,
               prescale_factor=1.0, postscale_factor=1.0,
               compression=None, process_set=global_process_set):
@@ -104,7 +118,11 @@ def allreduce(tensor, average=None, op=None, name=None,
         if op not in (Average, Sum):
             raise NotImplementedError(
                 "IndexedSlices allreduce supports Sum/Average only")
-        if not _use_ingraph(process_set):
+        # Densify when the in-graph runtime can't carry the values
+        # dtype through CollectiveGatherV2 (e.g. bfloat16 slices): the
+        # dense reduce kernel set is wider than the gather set.
+        if (not _use_ingraph(process_set)
+                or tensor.values.dtype not in _INGRAPH_GATHER_DTYPES):
             return allreduce(
                 tf.convert_to_tensor(tensor), op=op, name=name,
                 prescale_factor=prescale_factor,
@@ -130,11 +148,13 @@ def allreduce(tensor, average=None, op=None, name=None,
                         process_set=process_set)
         return compression.decompress(out, ctx)
 
-    if op in (Average, Sum) and _use_ingraph(process_set):
+    tensor = tf.convert_to_tensor(tensor)
+    if (op in (Average, Sum) and _use_ingraph(process_set)
+            and tensor.dtype in _INGRAPH_REDUCE_DTYPES):
         from horovod_tpu.tensorflow import ingraph
 
         return ingraph.allreduce(
-            tf.convert_to_tensor(tensor), name,
+            tensor, name,
             op_is_average=(op == Average),
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
@@ -163,17 +183,19 @@ def allreduce(tensor, average=None, op=None, name=None,
 
         return y, grad
 
-    return _fwd(tf.convert_to_tensor(tensor))
+    return _fwd(tensor)
 
 
 def grouped_allreduce(tensors, average=None, op=None, name=None,
                       process_set=global_process_set):
     op = eager._effective_op(op, average)
     name = name or "HorovodGroupedAllreduce"
-    if op in (Average, Sum) and _use_ingraph(process_set):
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    if (op in (Average, Sum) and _use_ingraph(process_set)
+            and all(t.dtype in _INGRAPH_REDUCE_DTYPES for t in tensors)):
         from horovod_tpu.tensorflow import ingraph
 
-        return [ingraph.allreduce(tf.convert_to_tensor(t),
+        return [ingraph.allreduce(t,
                                   "%s.%d" % (name, i),
                                   op_is_average=(op == Average),
                                   process_set=process_set)
@@ -187,33 +209,57 @@ def grouped_allreduce(tensors, average=None, op=None, name=None,
 
 def allgather(tensor, name=None, process_set=global_process_set):
     name = name or "HorovodAllgather"
-    if _use_ingraph(process_set):
+    tensor = tf.convert_to_tensor(tensor)
+    if _use_ingraph(process_set) and tensor.dtype in _INGRAPH_GATHER_DTYPES:
         from horovod_tpu.tensorflow import ingraph
 
-        return ingraph.allgather(tf.convert_to_tensor(tensor), name,
+        return ingraph.allgather(tensor, name,
                                  process_set=process_set)
-    out = eager.synchronize(eager.allgather_async(
-        np.asarray(tensor), name=name, process_set=process_set))
-    return tf.convert_to_tensor(np.asarray(out))
+
+    def _run(x):
+        return np.asarray(eager.synchronize(eager.allgather_async(
+            np.asarray(x), name=name, process_set=process_set)))
+
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(_run(tensor))
+    # Symbolic (tf.function) caller on the host path — e.g. a dtype
+    # the in-graph runtime has no kernel for: bridge through
+    # numpy_function (stateful, so collective order is preserved).
+    out = tf.numpy_function(_run, [tensor], tensor.dtype)
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
 
 
 def broadcast(tensor, root_rank, name=None,
               process_set=global_process_set):
     name = name or "HorovodBroadcast"
-    if _use_ingraph(process_set):
+    tensor = tf.convert_to_tensor(tensor)
+    if _use_ingraph(process_set) and tensor.dtype in _INGRAPH_BCAST_DTYPES:
         from horovod_tpu.tensorflow import ingraph
 
-        return ingraph.broadcast(tf.convert_to_tensor(tensor), root_rank,
+        return ingraph.broadcast(tensor, root_rank,
                                  name, process_set=process_set)
-    out = eager.synchronize(eager.broadcast_async(
-        np.asarray(tensor), root_rank, name=name, process_set=process_set))
-    return tf.convert_to_tensor(np.asarray(out))
+
+    def _run(x):
+        return np.asarray(eager.synchronize(eager.broadcast_async(
+            np.asarray(x), root_rank, name=name,
+            process_set=process_set)))
+
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(_run(tensor))
+    out = tf.numpy_function(_run, [tensor], tensor.dtype)
+    out.set_shape(tensor.shape)
+    return out
 
 
 def alltoall(tensor, splits=None, name=None,
              process_set=global_process_set):
     name = name or "HorovodAlltoall"
-    if splits is None and _use_ingraph(process_set):
+    tensor = tf.convert_to_tensor(tensor)
+    # Data plane is CollectiveAllToAllV2 — same dtype kernel set as
+    # CollectiveReduceV2 (the sizes pre-flight is always int32).
+    if (splits is None and _use_ingraph(process_set)
+            and tensor.dtype in _INGRAPH_REDUCE_DTYPES):
         # Uniform split: in-graph TF collective. Ragged (explicit
         # splits) stays host-bridged, mirroring the in-graph XLA path's
         # static-shape contract (ops/collective_ops.py alltoall).
@@ -229,26 +275,48 @@ def alltoall(tensor, splits=None, name=None,
         out = ingraph.alltoall(t, name, process_set=process_set)
         rsplits = tf.fill([n], tf.shape(out)[0] // n)
         return out, rsplits
-    out, rsplits = eager.synchronize(eager.alltoall_async(
-        np.asarray(tensor),
-        None if splits is None else np.asarray(splits), name=name,
-        process_set=process_set))
-    return (tf.convert_to_tensor(np.asarray(out)),
-            tf.convert_to_tensor(np.asarray(rsplits)))
+
+    def _run(x, *maybe_splits):
+        s = np.asarray(maybe_splits[0]) if maybe_splits else None
+        o, rs = eager.synchronize(eager.alltoall_async(
+            np.asarray(x), s, name=name, process_set=process_set))
+        return np.asarray(o), np.asarray(rs, np.int32)
+
+    if tf.executing_eagerly():
+        out, rsplits = _run(tensor) if splits is None else _run(tensor,
+                                                                splits)
+        return tf.convert_to_tensor(out), tf.convert_to_tensor(rsplits)
+    inputs = [tensor] if splits is None else [tensor, splits]
+    out, rsplits = tf.numpy_function(_run, inputs,
+                                     [tensor.dtype, tf.int32])
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out, rsplits
 
 
 def reducescatter(tensor, op=Sum, name=None,
                   process_set=global_process_set):
     name = name or "HorovodReducescatter"
-    if op in (Average, Sum) and _use_ingraph(process_set):
+    tensor = tf.convert_to_tensor(tensor)
+    # Both reducescatter algorithms (halving AllToAllV2 pairs, and the
+    # reduce+slice fallback's CollectiveReduceV2) share the reduce
+    # kernel dtype set.
+    if (op in (Average, Sum) and _use_ingraph(process_set)
+            and tensor.dtype in _INGRAPH_REDUCE_DTYPES):
         from horovod_tpu.tensorflow import ingraph
 
-        return ingraph.reducescatter(tf.convert_to_tensor(tensor), name,
+        return ingraph.reducescatter(tensor, name,
                                      op_is_average=(op == Average),
                                      process_set=process_set)
-    out = eager.synchronize(eager.reducescatter_async(
-        np.asarray(tensor), name=name, op=op, process_set=process_set))
-    return tf.convert_to_tensor(np.asarray(out))
+
+    def _run(x):
+        return np.asarray(eager.synchronize(eager.reducescatter_async(
+            np.asarray(x), name=name, op=op, process_set=process_set)))
+
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(_run(tensor))
+    out = tf.numpy_function(_run, [tensor], tensor.dtype)
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
 
 
 def join():

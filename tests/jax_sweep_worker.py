@@ -1,0 +1,325 @@
+"""np=2 JAX-binding sweep: dtype x op x edge-shape matrix through
+``horovod_tpu.jax``.
+
+Reference pattern: test/parallel/test_torch.py:154+ /
+test_tensorflow.py — the per-framework sweep of every dtype x op cell
+with exact expected values, through the binding's PUBLIC surface (not
+the native plane, which tests/dtype_matrix_worker.py already sweeps).
+This worker is the JAX instance of that discipline: inputs are
+``jax.Array``s, outputs must come back as ``jax.Array``s with dtype
+preserved, and the jax-only surfaces (pytree broadcast_parameters /
+broadcast_optimizer_state, allreduce_gradients, DistributedOptimizer
+as an optax transformation, Compression) are asserted on VALUES at
+np=2 — the size-1 identity paths tests/test_jax_optimizer.py covers
+can't see a wrong reduction.
+"""
+
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# The dtype matrix includes float64/int64 cells; without x64 jax
+# silently downcasts them and the dtype-preservation asserts would
+# test nothing.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu.jax as hvd  # noqa: E402
+from matrix_common import expect_error  # noqa: E402
+
+FLOAT_DTYPES = [jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64]
+INT_DTYPES = [jnp.uint8, jnp.int8, jnp.int32, jnp.int64]
+
+
+def _f64(x):
+    return np.asarray(x, np.float64)
+
+
+def allreduce_dtype_op_matrix(r, n):
+    """Every wire dtype x {Sum, Min, Max, Product, Average} with exact
+    expected values; outputs stay jax.Arrays of the input dtype."""
+    base = np.array([[1, 2, 3], [4, 5, 6]], np.float64)
+    scale = [float(k + 1) for k in range(n)]
+    for dt in FLOAT_DTYPES + INT_DTYPES:
+        x = jnp.asarray(base * (r + 1), dtype=dt)
+        name = "jx.%s" % jnp.dtype(dt).name
+        cases = {
+            hvd.Sum: base * sum(scale),
+            hvd.Min: base * min(scale),
+            hvd.Max: base * max(scale),
+            hvd.Product: base ** n * np.prod(scale),
+        }
+        if dt in FLOAT_DTYPES:
+            cases[hvd.Average] = base * (sum(scale) / n)
+        for op, expect in cases.items():
+            out = hvd.allreduce(x, name="%s.%d" % (name, op), op=op)
+            assert isinstance(out, jax.Array), type(out)
+            assert out.dtype == jnp.dtype(dt), (dt, out.dtype)
+            tol = 2e-2 if dt in (jnp.bfloat16, jnp.float16) else 1e-6
+            np.testing.assert_allclose(_f64(out), expect,
+                                       rtol=tol, atol=tol)
+    # Prescale/postscale compose with the reduction (reference:
+    # mpi_ops prescale_factor/postscale_factor contract).
+    out = hvd.allreduce(jnp.full((4,), 2.0, jnp.float32), op=hvd.Sum,
+                        name="jx.prepost", prescale_factor=0.5,
+                        postscale_factor=10.0)
+    np.testing.assert_allclose(_f64(out), 0.5 * 2.0 * n * 10.0)
+
+
+def edge_shapes(r, n):
+    """Scalar (0-d), empty, and high-rank tensors through the jax
+    surface keep shape and dtype."""
+    s = hvd.allreduce(jnp.asarray(float(r + 1)), name="jx.scalar",
+                      op=hvd.Sum)
+    assert s.shape == () and float(s) == float(sum(range(1, n + 1)))
+
+    e = hvd.allreduce(jnp.zeros((0, 3), jnp.float32), name="jx.empty",
+                      op=hvd.Sum)
+    assert e.shape == (0, 3) and e.dtype == jnp.float32
+
+    x4 = jnp.full((2, 1, 3, 2), float(r + 1), jnp.float32)
+    out = hvd.allreduce(x4, name="jx.4d", op=hvd.Sum)
+    assert out.shape == x4.shape
+    np.testing.assert_allclose(_f64(out), float(sum(range(1, n + 1))))
+
+
+def gather_bcast_alltoall(r, n):
+    """allgather (ragged + bool), broadcast (non-zero root, int, 0-d),
+    alltoall (explicit uneven splits), reducescatter (uneven dim 0)."""
+    g = hvd.allgather(jnp.full((r + 1, 2), float(r)), name="jx.rag")
+    assert isinstance(g, jax.Array)
+    expect = np.concatenate([np.full((k + 1, 2), float(k))
+                             for k in range(n)])
+    np.testing.assert_allclose(_f64(g), expect)
+
+    b = hvd.allgather(jnp.asarray([r == 0, True]), name="jx.bool")
+    assert b.dtype == jnp.bool_
+    np.testing.assert_array_equal(
+        np.asarray(b), sum(([k == 0, True] for k in range(n)), []))
+
+    for name, mk in (("f", lambda v: jnp.full((3,), float(v))),
+                     ("i", lambda v: jnp.asarray([v, v], jnp.int32)),
+                     ("s", lambda v: jnp.asarray(float(v)))):
+        out = hvd.broadcast(mk(r), n - 1, name="jx.bc." + name)
+        np.testing.assert_allclose(_f64(out), float(n - 1))
+
+    if n == 2:
+        data = jnp.arange(3, dtype=jnp.float32) + 10.0 * r
+        splits = np.array([1, 2] if r == 0 else [2, 1], np.int32)
+        out, rsplits = hvd.alltoall(data, splits=splits, name="jx.a2a")
+        if r == 0:
+            np.testing.assert_allclose(_f64(out), [0.0, 10.0, 11.0])
+            np.testing.assert_array_equal(np.asarray(rsplits), [1, 2])
+        else:
+            np.testing.assert_allclose(_f64(out), [1.0, 2.0, 12.0])
+            np.testing.assert_array_equal(np.asarray(rsplits), [2, 1])
+
+    from horovod_tpu.ops import reducescatter
+    rs = reducescatter(jnp.ones((3, 2), jnp.float32) * (r + 1),
+                       op=hvd.Sum, name="jx.rs")
+    # Ring convention: 3 rows over 2 ranks -> rank0 2 rows, rank1 1.
+    assert rs.shape == ((2, 2) if r == 0 else (1, 2)), rs.shape
+    np.testing.assert_allclose(_f64(rs), float(sum(range(1, n + 1))))
+
+
+def async_handles_out_of_order(r, n):
+    """Handles synchronize in any order; poll() eventually settles
+    (reference: torch/mpi_ops.py handle discipline, applied to the
+    jax binding's shared eager surface)."""
+    hs = [hvd.allreduce_async(jnp.full((4,), float((r + 1) * (i + 1))),
+                              name="jx.async.%d" % i, op=hvd.Sum)
+          for i in range(4)]
+    total = float(sum(range(1, n + 1)))
+    for i in (3, 1, 2, 0):
+        out = hvd.synchronize(hs[i])
+        np.testing.assert_allclose(_f64(out), total * (i + 1))
+    h = hvd.allreduce_async(jnp.ones(2), name="jx.poll", op=hvd.Sum)
+    deadline = 500  # ~5s of 10ms polls; a cycle is ~ms
+    while not hvd.poll(h) and deadline:
+        time.sleep(0.01)
+        deadline -= 1
+    assert deadline, "poll never settled"
+    np.testing.assert_allclose(_f64(hvd.synchronize(h)), float(n))
+
+
+def grouped_mixed(r, n):
+    """Grouped allreduce mixing float/int/bf16 members reduces each
+    with its own dtype."""
+    xs = [jnp.full((3,), float(r + 1), jnp.float32),
+          jnp.full((2, 2), r + 1, jnp.int64),
+          jnp.full((5,), float(r + 1), jnp.bfloat16)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="jx.gmix")
+    total = float(sum(range(1, n + 1)))
+    for x, out in zip(xs, outs):
+        assert isinstance(out, jax.Array) and out.dtype == x.dtype
+        np.testing.assert_allclose(_f64(out), total, rtol=1e-2)
+
+
+def process_sets(r, n):
+    """Collectives restricted to a registered subset through the jax
+    surface; identity on singletons, real reduction on the pair."""
+    singles = [hvd.add_process_set(hvd.ProcessSet([k])) for k in range(n)]
+    try:
+        mine = singles[r]
+        assert mine.included() and mine.size() == 1
+        solo = hvd.allreduce(jnp.full((4,), float(r + 7)), op=hvd.Sum,
+                             name="jx.ps.solo", process_set=mine)
+        np.testing.assert_allclose(_f64(solo), float(r + 7))
+        # Explicitly passing the global set is the same full reduction
+        # (the full-world set cannot be re-registered: [0..n-1] IS the
+        # global set).
+        both = hvd.allreduce(jnp.full((4,), float(r + 1)), op=hvd.Sum,
+                             name="jx.ps.pair",
+                             process_set=hvd.global_process_set)
+        np.testing.assert_allclose(_f64(both), float(sum(range(1, n + 1))))
+        g = hvd.allgather(jnp.full((2,), float(r)), name="jx.ps.g",
+                          process_set=hvd.global_process_set)
+        np.testing.assert_allclose(
+            _f64(g), np.repeat(np.arange(n, dtype=np.float64), 2))
+    finally:
+        for s in singles:
+            hvd.remove_process_set(s)
+
+
+def pytree_broadcast(r, n):
+    """broadcast_parameters / broadcast_optimizer_state on nested
+    pytrees: every rank ends with rank0's values, tree structure and
+    dtypes intact (reference: torch/functions.py:29-187)."""
+    params = {"dense": {"w": jnp.full((3, 2), float(r + 1)),
+                        "b": jnp.arange(2, dtype=jnp.float32) + r},
+              "scale": jnp.asarray(float(r))}
+    synced = hvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(_f64(synced["dense"]["w"]), 1.0)
+    np.testing.assert_allclose(_f64(synced["dense"]["b"]), [0.0, 1.0])
+    np.testing.assert_allclose(_f64(synced["scale"]), 0.0)
+
+    tx = optax.adam(1e-3)
+    opt_state = tx.init({"w": jnp.full((2,), float(r + 1))})
+    # Perturb rank-1 state, then broadcast root 0's back.
+    if r == 1:
+        opt_state = jax.tree_util.tree_map(
+            lambda l: l + 5 if jnp.issubdtype(
+                jnp.asarray(l).dtype, jnp.floating) else l, opt_state)
+    synced_state = hvd.broadcast_optimizer_state(opt_state, root_rank=0)
+    for leaf in jax.tree_util.tree_leaves(synced_state):
+        arr = _f64(leaf)
+        assert np.all(arr <= 1.0), arr  # rank-1's +5 must be gone
+
+    # Object collectives through the jax surface.
+    objs = hvd.allgather_object({"rank": r, "items": list(range(r + 1))})
+    assert [o["rank"] for o in objs] == list(range(n))
+    obj = hvd.broadcast_object({"from": hvd.rank()} if r == 0 else None,
+                               root_rank=0)
+    assert obj == {"from": 0}
+
+
+def gradient_allreduce_values(r, n):
+    """allreduce_gradients (eager) and DistributedOptimizer (optax) at
+    np=2: the update every rank applies equals the MEAN gradient
+    (reference: test_torch.py optimizer lockstep tests)."""
+    grads = {"w": jnp.full((3,), float(r + 1)),
+             "b": jnp.asarray(float(10 * (r + 1)))}
+    mean = hvd.allreduce_gradients(grads)
+    np.testing.assert_allclose(_f64(mean["w"]), (1.0 + n) / 2.0)
+    np.testing.assert_allclose(_f64(mean["b"]), 10.0 * (1.0 + n) / 2.0)
+
+    summed = hvd.allreduce_gradients(grads, op=hvd.Sum)
+    np.testing.assert_allclose(_f64(summed["w"]), float(sum(range(1, n + 1))))
+
+    # Optax step: SGD with lr 0.1 on mean gradients keeps ranks in
+    # lockstep and matches the hand-computed step.
+    params = {"w": jnp.zeros((3,))}
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    state = tx.init(params)
+    per_rank_grads = {"w": jnp.full((3,), float(r + 1))}
+    updates, state = tx.update(per_rank_grads, state, params)
+    params = optax.apply_updates(params, updates)
+    expect = -0.1 * (1.0 + n) / 2.0
+    np.testing.assert_allclose(_f64(params["w"]), expect, rtol=1e-6)
+    # Lockstep proof: allgather of params is identical per rank.
+    g = hvd.allgather(params["w"][None, :], name="jx.lockstep")
+    np.testing.assert_allclose(_f64(g), expect, rtol=1e-6)
+
+
+def compression_through_allreduce(r, n):
+    """fp16/bf16 compression composes with the eager reduction: wire
+    dtype is compressed, result decompresses to float32 with the mean
+    value (reference: torch/compression.py through the optimizer)."""
+    grads = {"w": jnp.full((64,), float(r + 1), jnp.float32)}
+    for comp, tol in ((hvd.Compression.fp16, 1e-3),
+                      (hvd.Compression.bf16, 2e-2),
+                      (hvd.Compression.none, 1e-7)):
+        out = hvd.allreduce_gradients(grads, compression=comp)
+        assert out["w"].dtype == jnp.float32
+        np.testing.assert_allclose(_f64(out["w"]), (1.0 + n) / 2.0,
+                                   rtol=tol, atol=tol)
+
+
+def backward_passes_accumulation(r, n):
+    """backward_passes_per_step=2: first call emits zero updates, the
+    second reduces the ACCUMULATED gradients across ranks."""
+    params = {"w": jnp.zeros((2,))}
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                  backward_passes_per_step=2)
+    state = tx.init(params)
+    g1 = {"w": jnp.full((2,), float(r + 1))}
+    updates, state = tx.update(g1, state, params)
+    np.testing.assert_allclose(_f64(updates["w"]), 0.0)
+    g2 = {"w": jnp.full((2,), float(r + 1))}
+    updates, state = tx.update(g2, state, params)
+    # optax.MultiSteps accumulates the MEAN over the k passes (not the
+    # sum), then the allreduce averages over ranks; SGD lr=1 -> -mean.
+    expect = -(1.0 + n) / 2.0
+    np.testing.assert_allclose(_f64(updates["w"]), expect, rtol=1e-6)
+
+
+def error_paths(r, n):
+    """Cross-rank mismatches raise HorovodInternalError through the
+    jax surface on every rank and leave the session usable."""
+    with expect_error("Mismatched allreduce shapes"):
+        hvd.allreduce(jnp.ones(4 + r), name="jx.err.shape", op=hvd.Sum)
+    out = hvd.allreduce(jnp.ones(4), name="jx.err.recover", op=hvd.Sum)
+    np.testing.assert_allclose(_f64(out), float(n))
+
+    with expect_error("Mismatched data types"):
+        hvd.allreduce(
+            jnp.ones(4, jnp.float32 if r == 0 else jnp.float64),
+            name="jx.err.dtype", op=hvd.Sum)
+
+    with expect_error("Mismatched reduce op"):
+        hvd.allreduce(jnp.ones(4), name="jx.err.op",
+                      op=hvd.Sum if r == 0 else hvd.Average)
+
+    with expect_error("Mismatched root rank"):
+        hvd.broadcast(jnp.ones(3), root_rank=r, name="jx.err.root")
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    allreduce_dtype_op_matrix(r, n)
+    edge_shapes(r, n)
+    gather_bcast_alltoall(r, n)
+    async_handles_out_of_order(r, n)
+    grouped_mixed(r, n)
+    process_sets(r, n)
+    pytree_broadcast(r, n)
+    gradient_allreduce_values(r, n)
+    compression_through_allreduce(r, n)
+    backward_passes_accumulation(r, n)
+    error_paths(r, n)
+
+    hvd.shutdown()
+    print("JAX_SWEEP_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

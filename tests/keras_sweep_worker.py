@@ -1,0 +1,217 @@
+"""np=2 Keras-binding depth matrix: save -> load_model -> continue,
+Keras-3 custom loop, and value-semantics collectives.
+
+Reference pattern: test/parallel/test_tensorflow2_keras.py
+(test_load_model_custom_optimizers / test_train_model and siblings) —
+the reference proves the keras surface by round-tripping a model
+through save/load_model with the wrapped optimizer and training on
+both sides. The r4 keras-native binding (dynamic optimizer subclass
+overriding Keras-3 ``apply()``, ``load_model`` re-wrap) had only a
+fit-lockstep smoke; this worker asserts exact VALUES: the fit
+trajectory matches a numpy simulation of mean-gradient SGD, the
+re-loaded optimizer is still distributed (and keeps ranks in lockstep
+when training continues), and a no-fit custom loop applies exactly
+lr x mean-gradient.
+
+Launcher passes HVD_KERAS_SWEEP_TMP (shared scratch dir for the
+save/load round-trip).
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+import keras  # noqa: E402
+
+import horovod_tpu.keras as hvd  # noqa: E402
+from horovod_tpu.keras import callbacks as hvd_callbacks  # noqa: E402
+from horovod_tpu.tensorflow import barrier  # noqa: E402
+
+LR = 0.1
+
+
+def _rank_data(r, B=8):
+    """Deterministic per-rank regression batch (different across
+    ranks, so an unsynced optimizer would diverge immediately)."""
+    rng = np.random.RandomState(100 + r)
+    x = rng.randn(B, 2).astype(np.float32)
+    y = rng.randn(B, 1).astype(np.float32)
+    return x, y
+
+
+def _mse_grad(w, x, y):
+    """d/dw mean((xw - y)^2) for Dense(1, no bias): (2/N) x^T (xw-y)
+    with N = total element count of the output (keras MSE averages
+    over every element)."""
+    pred = x @ w
+    return (2.0 / pred.size) * x.T @ (pred - y)
+
+
+def _simulate(w, datas, steps):
+    """numpy reference trajectory: SGD on the MEAN of per-rank
+    gradients — what a correct distributed fit must produce."""
+    w = w.copy()
+    for _ in range(steps):
+        g = np.mean([_mse_grad(w, x, y) for x, y in datas], axis=0)
+        w = w - LR * g
+    return w
+
+
+def _build_model():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(2,)),
+        keras.layers.Dense(1, use_bias=False,
+                           kernel_initializer="zeros"),
+    ])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=LR))
+    model.compile(optimizer=opt, loss="mse")
+    return model
+
+
+def fit_save_load_continue(r, n, tmpdir):
+    """fit matches the mean-gradient simulation; save -> load_model
+    re-wraps the optimizer; continued training stays in lockstep and
+    on the simulated trajectory."""
+    x, y = _rank_data(r)
+    datas = [_rank_data(k) for k in range(n)]
+    model = _build_model()
+
+    model.fit(x, y, batch_size=len(x), epochs=2, shuffle=False,
+              verbose=0,
+              callbacks=[hvd_callbacks.BroadcastGlobalVariablesCallback(0)])
+    w = model.layers[-1].kernel.numpy()
+    expect = _simulate(np.zeros((2, 1), np.float32), datas, steps=2)
+    np.testing.assert_allclose(w, expect, rtol=1e-5, atol=1e-6)
+
+    # Lockstep proof across ranks, through the value-semantics surface.
+    gathered = hvd.allgather(w.reshape(1, -1), name="ks.lockstep")
+    assert gathered.shape == (n, 2)
+    np.testing.assert_allclose(gathered, np.repeat(w.reshape(1, -1), n, 0),
+                               rtol=1e-6)
+
+    # --- save on rank 0, load everywhere, keep training -------------
+    path = os.path.join(tmpdir, "model.keras")
+    if r == 0:
+        model.save(path)
+    barrier()
+    loaded = hvd.load_model(path)
+    opt = loaded.optimizer
+    assert getattr(type(opt), "_hvd_wrapped_base", None) is not None, (
+        "load_model must hand back a DISTRIBUTED optimizer, got %r"
+        % type(opt))
+    assert type(opt).__name__ == "SGD"  # class name survives the trip
+    np.testing.assert_allclose(loaded.layers[-1].kernel.numpy(), expect,
+                               rtol=1e-5, atol=1e-6)
+
+    loaded.fit(x, y, batch_size=len(x), epochs=1, shuffle=False, verbose=0)
+    w3 = loaded.layers[-1].kernel.numpy()
+    expect3 = _simulate(np.zeros((2, 1), np.float32), datas, steps=3)
+    np.testing.assert_allclose(w3, expect3, rtol=1e-5, atol=1e-6)
+    gathered = hvd.allgather(w3.reshape(1, -1), name="ks.lockstep3")
+    np.testing.assert_allclose(gathered, np.repeat(w3.reshape(1, -1), n, 0),
+                               rtol=1e-6)
+
+
+def custom_loop_no_fit(r, n):
+    """Keras-3 custom training loop (no fit): tape gradients +
+    ``optimizer.apply`` must still sync — one step applies exactly
+    lr x mean-gradient (reference: the Keras-3 ``apply()`` funnel the
+    r4 binding overrides)."""
+    model = _build_model()
+    x, y = _rank_data(r)
+    datas = [_rank_data(k) for k in range(n)]
+
+    with tf.GradientTape() as tape:
+        pred = model(x, training=True)
+        loss = tf.reduce_mean(tf.square(pred - y))
+    grads = tape.gradient(loss, model.trainable_variables)
+    model.optimizer.apply(grads, model.trainable_variables)
+
+    w = model.layers[-1].kernel.numpy()
+    expect = _simulate(np.zeros((2, 1), np.float32), datas, steps=1)
+    np.testing.assert_allclose(w, expect, rtol=1e-5, atol=1e-6)
+
+
+def accumulation_through_keras(r, n):
+    """backward_passes_per_step=2 through the keras wrapper: the first
+    apply leaves weights untouched, the second applies the averaged
+    accumulation."""
+    model = keras.Sequential([
+        keras.layers.Input(shape=(2,)),
+        keras.layers.Dense(1, use_bias=False,
+                           kernel_initializer="zeros"),
+    ])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=1.0),
+                                   backward_passes_per_step=2)
+    model.compile(optimizer=opt, loss="mse")
+
+    g = [tf.constant(np.full((2, 1), float(r + 1), np.float32))]
+    opt.apply(g, model.trainable_variables)
+    np.testing.assert_allclose(model.layers[-1].kernel.numpy(), 0.0)
+    opt.apply(g, model.trainable_variables)
+    # Aggregated mean over 2 passes of (r+1), averaged over ranks,
+    # SGD lr=1 -> -mean_r(r+1).
+    expect = -np.mean([k + 1.0 for k in range(n)])
+    np.testing.assert_allclose(model.layers[-1].kernel.numpy(), expect,
+                               rtol=1e-6)
+
+
+def value_semantics_collectives(r, n):
+    """hvd.keras allreduce/allgather/broadcast take values, return
+    numpy (reference: _keras/__init__.py:164-189)."""
+    out = hvd.allreduce([float(r + 1)] * 3, name="ks.val.avg")
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_allclose(out, (1.0 + n) / 2.0)
+    out = hvd.allreduce(np.full((2,), float(r + 1)), average=False,
+                        name="ks.val.sum")
+    np.testing.assert_allclose(out, float(sum(range(1, n + 1))))
+    out = hvd.allgather([[float(r)]], name="ks.val.g")
+    np.testing.assert_allclose(out, np.arange(n, dtype=np.float64)[:, None])
+    out = hvd.broadcast([1.0 + r, 2.0 + r], root_rank=1, name="ks.val.b")
+    np.testing.assert_allclose(out, [2.0, 3.0])
+
+
+def api_contracts(r, n):
+    """Double-wrap rejection and the legacy get_gradients eager
+    guard."""
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD())
+    try:
+        hvd.DistributedOptimizer(opt)
+    except ValueError as e:
+        assert "already a DistributedOptimizer" in str(e)
+    else:
+        raise AssertionError("double wrap must be rejected")
+
+    try:
+        opt.get_gradients(tf.constant(1.0), [tf.Variable(1.0)])
+    except RuntimeError as e:
+        assert "DistributedGradientTape" in str(e)
+    else:
+        raise AssertionError("eager get_gradients must raise")
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+    tmpdir = os.environ["HVD_KERAS_SWEEP_TMP"]
+    keras.utils.set_random_seed(17)
+
+    fit_save_load_continue(r, n, tmpdir)
+    custom_loop_no_fit(r, n)
+    accumulation_through_keras(r, n)
+    value_semantics_collectives(r, n)
+    api_contracts(r, n)
+
+    hvd.shutdown()
+    print("KERAS_SWEEP_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

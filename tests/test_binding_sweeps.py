@@ -1,0 +1,64 @@
+"""Launchers for the second-wave per-binding sweeps.
+
+Reference: test/parallel/test_torch.py / test_tensorflow.py /
+test_tensorflow2_keras.py — the dtype x op x edge-case products the
+reference sweeps through each framework's public API. The matrices
+live in {torch,tf,jax,keras}_sweep_worker.py; every cell asserts
+exact values at np=2 (size-1 runs can't distinguish a correct
+reduction from an identity).
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(worker, extra_env=None, timeout=300):
+    # Scrub the TPU relay trigger too: with the relay hung (not
+    # refused) the pre-registered plugin's init can wedge the worker
+    # even under jax_platforms=cpu (see bench.py _spawn).
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join(_REPO, "tests", worker)],
+        cwd=_REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_torch_sweep():
+    proc = _launch("torch_sweep_worker.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("TORCH_SWEEP_OK") == 2, proc.stdout
+
+
+def test_jax_sweep():
+    proc = _launch("jax_sweep_worker.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("JAX_SWEEP_OK") == 2, proc.stdout
+
+
+@pytest.mark.tier2
+def test_tf_sweep():
+    # Default (in-graph) mode on purpose: the sweep's narrow-dtype
+    # cells prove the dtype-gated fallback routing from the TF
+    # collective runtime to the host plane.
+    proc = _launch("tf_sweep_worker.py", timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("TF_SWEEP_OK") == 2, proc.stdout
+
+
+@pytest.mark.tier2
+def test_keras_sweep():
+    with tempfile.TemporaryDirectory() as tmp:
+        proc = _launch("keras_sweep_worker.py",
+                       extra_env={"HVD_KERAS_SWEEP_TMP": tmp},
+                       timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("KERAS_SWEEP_OK") == 2, proc.stdout

@@ -24,6 +24,10 @@ TIER2_COVERAGE = {
         "tests/test_adasum_hierarchical.py::test_adasum_native_multiproc",
     "test_tf_binding_matrix":
         "tests/test_binding_matrix.py::test_torch_binding_matrix",
+    "test_tf_sweep":
+        "tests/test_tf_binding.py::test_tf_ingraph_collectives",
+    "test_keras_sweep":
+        "tests/test_keras_binding.py::test_keras_multiproc",
     "test_tensorflow2_mnist_example":
         "tests/test_tf_binding.py::test_tf_ingraph_collectives",
     "test_pytorch_spark_example":

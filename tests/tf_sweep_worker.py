@@ -1,0 +1,239 @@
+"""np=2 TF-binding sweep, second wave: cells tests/tf_worker.py and
+tests/tf_matrix_worker.py leave open.
+
+Reference pattern: test/parallel/test_tensorflow.py — the full
+dtype x op product (this file adds Product everywhere plus the
+float16/uint8/int8 columns), uneven alltoall splits, uneven + Average
+reducescatter, and host-path collectives captured inside a
+``tf.function`` (graph mode driving the eager bridge). Exact expected
+values in every cell.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def product_and_narrow_dtypes(r, n):
+    """{float16, uint8, int8, int32, float32} x {Sum, Min, Max,
+    Product} — the op columns dtype_matrix_tf (Sum/Average only)
+    doesn't sweep."""
+    base = np.array([1, 2, 3], np.float64)
+    scale = [float(k + 1) for k in range(n)]
+    for dt in (tf.float16, tf.uint8, tf.int8, tf.int32, tf.float32):
+        x = tf.cast(tf.constant(base * (r + 1)), dt)
+        cases = {
+            hvd.Sum: base * sum(scale),
+            hvd.Min: base * min(scale),
+            hvd.Max: base * max(scale),
+            hvd.Product: base ** n * np.prod(scale),
+        }
+        for op, expect in cases.items():
+            out = hvd.allreduce(x, name="tfs.%s.%s" % (dt.name, op),
+                                op=op)
+            assert out.dtype == dt, (dt, out.dtype)
+            tol = 1e-3 if dt == tf.float16 else 1e-9
+            np.testing.assert_allclose(
+                tf.cast(out, tf.float64).numpy(), expect,
+                rtol=tol, atol=tol)
+
+
+def uneven_alltoall_and_reducescatter(r, n):
+    """Explicit uneven alltoall splits (incl. a zero split) and the
+    uneven-rows reducescatter shard math, through the TF surface."""
+    if n == 2:
+        data = tf.range(3, dtype=tf.float32) + 10.0 * r
+        splits = tf.constant([1, 2] if r == 0 else [2, 1])
+        out, rsplits = hvd.alltoall(data, splits=splits, name="tfs.a2a")
+        if r == 0:
+            np.testing.assert_allclose(out.numpy(), [0.0, 10.0, 11.0])
+            np.testing.assert_array_equal(rsplits.numpy(), [1, 2])
+        else:
+            np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 12.0])
+            np.testing.assert_array_equal(rsplits.numpy(), [2, 1])
+
+        # Zero-length split: rank 0 keeps nothing for itself.
+        data = tf.range(3, dtype=tf.float32) + 100.0 * r
+        splits = tf.constant([0, 3] if r == 0 else [2, 1])
+        out, rsplits = hvd.alltoall(data, splits=splits, name="tfs.a2az")
+        if r == 0:
+            np.testing.assert_allclose(out.numpy(), [100.0, 101.0])
+            np.testing.assert_array_equal(rsplits.numpy(), [0, 2])
+        else:
+            np.testing.assert_allclose(out.numpy(),
+                                       [0.0, 1.0, 2.0, 102.0])
+            np.testing.assert_array_equal(rsplits.numpy(), [3, 1])
+
+    # 2n+1 rows over n ranks: rank 0 owns the extra row; Average op.
+    full = tf.cast(tf.range(2 * n + 1), tf.float32) * float(r + 1)
+    shard = hvd.reducescatter(full, op=hvd.Average, name="tfs.rs")
+    total = sum(range(1, n + 1)) / n
+    rows = 3 if r == 0 else 2
+    offset = r * 2 + min(r, 1)
+    expect = (np.arange(2 * n + 1) * total)[offset:offset + rows]
+    np.testing.assert_allclose(shard.numpy(), expect, rtol=1e-6)
+
+    # int64 reducescatter keeps dtype (Sum only for ints).
+    full_i = tf.cast(tf.range(2 * n), tf.int64) * (r + 1)
+    shard_i = hvd.reducescatter(full_i, op=hvd.Sum, name="tfs.rsi")
+    assert shard_i.dtype == tf.int64
+    expect_i = (np.arange(2 * n) * sum(range(1, n + 1)))[r * 2:(r + 1) * 2]
+    np.testing.assert_array_equal(shard_i.numpy(), expect_i)
+
+
+def grouped_f16_and_scalars(r, n):
+    """Grouped allreduce with a float16 member and a 0-d member."""
+    xs = [tf.fill([4], tf.cast(float(r + 1), tf.float16)),
+          tf.constant(float(10 * (r + 1))),
+          tf.cast(tf.fill([2], r + 1), tf.uint8)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="tfs.g16")
+    total = float(sum(range(1, n + 1)))
+    assert outs[0].dtype == tf.float16
+    np.testing.assert_allclose(
+        tf.cast(outs[0], tf.float32).numpy(), total, rtol=1e-3)
+    assert tuple(outs[1].shape) == ()
+    np.testing.assert_allclose(float(outs[1]), 10.0 * total)
+    assert outs[2].dtype == tf.uint8
+    np.testing.assert_array_equal(outs[2].numpy(), total)
+
+
+def collectives_inside_tf_function(r, n):
+    """Host-path collectives captured by ``tf.function``: graph mode
+    must drive the same eager bridge (reference:
+    test_tensorflow.py's tf.function variants). Min/Product never ride
+    the in-graph router, so this exercises the py_function bridge
+    under tracing."""
+
+    @tf.function
+    def step(v):
+        a = hvd.allreduce(v, op=hvd.Min, name="tfs.fn.min")
+        b = hvd.allreduce(v, op=hvd.Product, name="tfs.fn.prod")
+        return a, b
+
+    a, b = step(tf.fill([3], float(r + 1)))
+    np.testing.assert_allclose(a.numpy(), 1.0)
+    np.testing.assert_allclose(b.numpy(),
+                               float(np.prod(range(1, n + 1))))
+    # Re-tracing with a new shape re-captures the bridge.
+    a2, _ = step(tf.fill([5], float(r + 1)))
+    np.testing.assert_allclose(a2.numpy(), 1.0)
+
+    # Host-path allgather/broadcast/reducescatter/alltoall under
+    # tf.function: dtypes the in-graph kernels can't carry (bf16
+    # gather, uint8 bcast) must bridge symbolically too.
+    @tf.function
+    def gather_bcast(v8, vb):
+        g = hvd.allgather(vb, name="tfs.fn.g.bf16")
+        b = hvd.broadcast(v8, 0, name="tfs.fn.b.u8")
+        rs = hvd.reducescatter(tf.cast(vb, tf.bfloat16) * 0 +
+                               tf.cast(vb, tf.bfloat16),
+                               op=hvd.Sum, name="tfs.fn.rs.bf16")
+        return g, b, rs
+
+    g, b, rs = gather_bcast(
+        tf.fill([3], tf.cast(r + 7, tf.uint8)),
+        tf.cast(tf.fill([2, 2], float(r + 1)), tf.bfloat16))
+    assert g.dtype == tf.bfloat16 and tuple(g.shape) == (2 * n, 2)
+    np.testing.assert_allclose(
+        tf.cast(g, tf.float64).numpy(),
+        np.concatenate([np.full((2, 2), k + 1.0) for k in range(n)]))
+    assert b.dtype == tf.uint8
+    np.testing.assert_array_equal(b.numpy(), np.full(3, 7))
+    assert rs.dtype == tf.bfloat16
+    total = float(sum(range(1, n + 1)))
+    np.testing.assert_allclose(tf.cast(rs, tf.float64).numpy(), total)
+
+    @tf.function
+    def a2a_host(v, s):
+        return hvd.alltoall(v, splits=s, name="tfs.fn.a2a")
+
+    out, rsplits = a2a_host(
+        tf.range(3, dtype=tf.float32) + 10.0 * r,
+        tf.constant([1, 2] if r == 0 else [2, 1]))
+    if r == 0:
+        np.testing.assert_allclose(out.numpy(), [0.0, 10.0, 11.0])
+        np.testing.assert_array_equal(rsplits.numpy(), [1, 2])
+    else:
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 12.0])
+        np.testing.assert_array_equal(rsplits.numpy(), [2, 1])
+
+
+def indexed_slices_bf16_densify(r, n):
+    """bfloat16 IndexedSlices allreduce: the gather kernel set has no
+    bf16, so the binding must densify and ride the (bf16-capable)
+    dense reduce instead of crashing in CollectiveGatherV2."""
+    sl = tf.IndexedSlices(
+        values=tf.cast(tf.fill([1, 3], float(r + 1)), tf.bfloat16),
+        indices=tf.constant([r]),
+        dense_shape=tf.constant([n, 3]))
+    out = hvd.allreduce(sl, op=hvd.Average, name="tfs.slices.bf16")
+    dense = tf.convert_to_tensor(out)
+    expect = np.zeros((n, 3))
+    for k in range(n):
+        expect[k] = (k + 1.0) / n
+    np.testing.assert_allclose(tf.cast(dense, tf.float64).numpy(),
+                               expect, rtol=1e-2)
+
+
+def broadcast_dtype_sweep(r, n):
+    """Broadcast value/dtype preservation across the wire dtypes, both
+    roots (reference: test_tensorflow.py broadcast variants)."""
+    for dt in (tf.float16, tf.bfloat16, tf.float64, tf.uint8, tf.int64):
+        for root in (0, n - 1):
+            x = tf.cast(tf.fill([3], float(r + 2)), dt)
+            out = hvd.broadcast(x, root, name="tfs.bc.%s.%d"
+                                % (dt.name, root))
+            assert out.dtype == dt
+            np.testing.assert_allclose(
+                tf.cast(out, tf.float64).numpy(), float(root + 2))
+    # bool broadcast.
+    bb = hvd.broadcast(tf.constant([r == 1, False]), n - 1,
+                       name="tfs.bc.bool")
+    np.testing.assert_array_equal(bb.numpy(), [True, False])
+
+
+def allgather_shape_matrix(r, n):
+    """Allgather over 1/2/3-D inputs with per-rank dim 0, dtype
+    preserved; trailing dims must match."""
+    for shape_tail in ((), (2,), (2, 2)):
+        x = tf.fill([r + 1] + list(shape_tail), float(r))
+        g = hvd.allgather(x, name="tfs.ag.%d" % len(shape_tail))
+        expect = np.concatenate(
+            [np.full([k + 1] + list(shape_tail), float(k))
+             for k in range(n)])
+        assert tuple(g.shape) == expect.shape
+        np.testing.assert_allclose(g.numpy(), expect)
+    gi = hvd.allgather(tf.cast(tf.fill([2], r + 1), tf.int8),
+                       name="tfs.ag.i8")
+    assert gi.dtype == tf.int8
+    np.testing.assert_array_equal(
+        gi.numpy(), np.repeat(np.arange(1, n + 1), 2))
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    product_and_narrow_dtypes(r, n)
+    uneven_alltoall_and_reducescatter(r, n)
+    grouped_f16_and_scalars(r, n)
+    collectives_inside_tf_function(r, n)
+    indexed_slices_bf16_densify(r, n)
+    broadcast_dtype_sweep(r, n)
+    allgather_shape_matrix(r, n)
+
+    hvd.shutdown()
+    print("TF_SWEEP_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""np=2 torch-binding sweep, second wave: the reference cells
+tests/torch_worker.py and tests/binding_matrix_worker.py don't cover.
+
+Reference pattern: test/parallel/test_torch.py:154-700 — this file
+adds the narrow-int dtype cells (int8/uint8 across every reduce op),
+sparse COO allreduce (mpi_ops.py sparse_allreduce_async), the in-place
+broadcast family, non-contiguous (transposed) inputs, Adasum as a
+direct allreduce op, fp16 compression through the optimizer at np=2,
+gradient flow THROUGH a collective (autograd of allreduce), and
+float16 grouped members. Every cell asserts exact values.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def narrow_int_dtype_ops(r, n):
+    """int8/uint8 x {Sum, Min, Max, Product}: the narrow wire dtypes
+    the reference sweeps (test_torch.py dtype variants) with values
+    chosen to stay in range."""
+    base = np.array([1, 2, 3], np.float64)
+    scale = [float(k + 1) for k in range(n)]
+    for dt in (torch.int8, torch.uint8):
+        x = torch.tensor(base * (r + 1)).to(dt)
+        cases = {
+            hvd.Sum: base * sum(scale),
+            hvd.Min: base * min(scale),
+            hvd.Max: base * max(scale),
+            hvd.Product: base ** n * np.prod(scale),
+        }
+        for op, expect in cases.items():
+            out = hvd.allreduce(x, name="ts.%s.%s" % (dt, op), op=op)
+            assert out.dtype == dt, (dt, out.dtype)
+            np.testing.assert_array_equal(out.to(torch.float64).numpy(),
+                                          expect)
+    # Narrow ints ride allgather/broadcast unchanged too.
+    g = hvd.allgather(torch.full((2,), r + 1, dtype=torch.uint8),
+                      name="ts.u8.g")
+    assert g.dtype == torch.uint8
+    np.testing.assert_array_equal(
+        g.numpy(), np.repeat(np.arange(1, n + 1), 2).astype(np.uint8))
+    b = hvd.broadcast(torch.full((3,), r + 5, dtype=torch.int8),
+                      root_rank=n - 1, name="ts.i8.b")
+    np.testing.assert_array_equal(b.numpy(), np.full(3, n - 1 + 5))
+
+
+def sparse_allreduce(r, n):
+    """Sparse COO allreduce via allgather-of-(indices, values)
+    (reference: torch/mpi_ops.py:515-535): disjoint and overlapping
+    entries, Average and Sum."""
+    # Rank r contributes entry (r, r) = r+1 and a shared entry
+    # (3, 0) = 10*(r+1) into a 4x2... use 4x4 to fit (r, r).
+    i = torch.tensor([[r, 3], [r, 0]])
+    v = torch.tensor([float(r + 1), 10.0 * (r + 1)])
+    sp = torch.sparse_coo_tensor(i, v, (4, 4))
+    out = hvd.sparse_allreduce_async(sp, name="ts.sparse", op=hvd.Sum)()
+    dense = out.to_dense().numpy()
+    expect = np.zeros((4, 4))
+    for k in range(n):
+        expect[k, k] += k + 1.0
+        expect[3, 0] += 10.0 * (k + 1)
+    np.testing.assert_allclose(dense, expect)
+
+    avg = hvd.sparse_allreduce_async(sp, name="ts.sparse.avg",
+                                     op=hvd.Average)()
+    np.testing.assert_allclose(avg.to_dense().numpy(), expect / n)
+
+    # Empty sparse tensor round-trips to empty.
+    empty = torch.sparse_coo_tensor(torch.zeros((2, 0), dtype=torch.long),
+                                    torch.zeros(0), (4, 4))
+    out = hvd.sparse_allreduce_async(empty, name="ts.sparse.e",
+                                     op=hvd.Sum)()
+    assert out._values().numel() == 0
+
+
+def inplace_broadcast_family(r, n):
+    """broadcast_ / broadcast_async_ mutate the caller's storage with
+    the root's values (reference: torch in-place op variants)."""
+    x = torch.full((4,), float(r * 100 + 7))
+    out = hvd.broadcast_(x, root_rank=0, name="ts.bip")
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), np.full(4, 7.0))
+
+    y = torch.arange(3, dtype=torch.float32) + r
+    h = hvd.broadcast_async_(y, root_rank=n - 1, name="ts.bipa")
+    out = hvd.synchronize(h)
+    assert out is y
+    np.testing.assert_allclose(y.numpy(), np.arange(3) + (n - 1.0))
+
+    z = torch.full((2, 2), float(r + 1))
+    out = hvd.allreduce_(z, name="ts.arip", op=hvd.Average)
+    assert out is z
+    np.testing.assert_allclose(z.numpy(), (1.0 + n) / 2.0)
+
+
+def non_contiguous_inputs(r, n):
+    """Transposed (non-contiguous) tensors reduce correctly and keep
+    their logical shape (the wire layer must not trust strides)."""
+    base = torch.arange(6, dtype=torch.float32).reshape(2, 3) * (r + 1)
+    x = base.t()  # 3x2, non-contiguous
+    assert not x.is_contiguous()
+    out = hvd.allreduce(x, name="ts.nc", op=hvd.Sum)
+    total = float(sum(range(1, n + 1)))
+    np.testing.assert_allclose(
+        out.numpy(), np.arange(6).reshape(2, 3).T * total)
+
+    g = hvd.allgather(x, name="ts.nc.g")
+    assert g.shape == (3 * n, 2)
+    expect = np.concatenate([np.arange(6).reshape(2, 3).T * (k + 1)
+                             for k in range(n)])
+    np.testing.assert_allclose(g.numpy(), expect)
+
+
+def adasum_as_allreduce_op(r, n):
+    """op=hvd.Adasum straight through hvd.allreduce (reference:
+    test_torch.py test_horovod_adasum_* — here the np=2 analytic case:
+    orthogonal inputs add, parallel inputs average)."""
+    # Parallel vectors: adasum(a, a) == a (projection halves each,
+    # both halves sum back).
+    x = torch.full((4,), 2.0)
+    out = hvd.allreduce(x, name="ts.adasum.par", op=hvd.Adasum)
+    np.testing.assert_allclose(out.numpy(), np.full(4, 2.0), rtol=1e-6)
+
+    # Orthogonal vectors: adasum == sum.
+    e = torch.zeros(4)
+    e[r] = float(r + 1)
+    out = hvd.allreduce(e, name="ts.adasum.orth", op=hvd.Adasum)
+    expect = np.zeros(4)
+    for k in range(n):
+        expect[k] = k + 1.0
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)
+
+
+def fp16_compression_optimizer(r, n):
+    """DistributedOptimizer with fp16 wire compression at np=2: the
+    step equals the mean-gradient step within fp16 tolerance
+    (reference: test_torch.py test_compression_fp16)."""
+    lin = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        lin.weight.fill_(0.0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(lin.parameters(), lr=1.0),
+        named_parameters=lin.named_parameters(),
+        compression=hvd.Compression.fp16)
+    lin(torch.full((1, 3), float(r + 1))).sum().backward()
+    opt.step()
+    mean = sum(range(1, n + 1)) / n
+    np.testing.assert_allclose(lin.weight.detach().numpy(),
+                               -mean * np.ones((1, 3)), atol=1e-3)
+
+
+def autograd_through_allreduce(r, n):
+    """Gradient THROUGH hvd.allreduce: d(sum(allreduce(x)))/dx is the
+    allreduced upstream gradient (reference: torch/mpi_ops.py
+    HorovodAllreduce.backward)."""
+    x = torch.full((3,), float(r + 1), requires_grad=True)
+    y = hvd.allreduce(x, name="ts.ag", op=hvd.Average)
+    # Per-rank weight (r+1) on the loss makes the upstream grads
+    # differ across ranks, so the backward collective is observable.
+    (y.sum() * (r + 1)).backward()
+    # backward of Average: allreduce(upstream, Average) — mean of the
+    # per-rank weights (k+1) over ranks.
+    expect = np.full(3, sum(k + 1.0 for k in range(n)) / n)
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-6)
+
+
+def float16_grouped_and_scalars(r, n):
+    """float16 members in a mixed group + 0-d members: grouped
+    submission preserves each member's dtype/shape."""
+    xs = [torch.full((4,), float(r + 1), dtype=torch.float16),
+          torch.tensor(float(10 * (r + 1))),
+          torch.full((2,), r + 1, dtype=torch.uint8)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="ts.g16")
+    total = float(sum(range(1, n + 1)))
+    assert outs[0].dtype == torch.float16
+    np.testing.assert_allclose(outs[0].to(torch.float32).numpy(), total,
+                               rtol=1e-3)
+    assert outs[1].shape == torch.Size([])
+    np.testing.assert_allclose(float(outs[1]), 10.0 * total)
+    assert outs[2].dtype == torch.uint8
+    np.testing.assert_array_equal(outs[2].numpy(), total)
+
+
+def alltoall_dtypes_and_zero_splits(r, n):
+    """alltoall keeps dtype across int/float wires; zero-length splits
+    are legal (a rank may send nothing to a peer)."""
+    for dt, name in ((torch.int64, "i64"), (torch.float16, "f16")):
+        x = (torch.arange(n * 2) + 10 * r).to(dt)
+        out, rsplits = hvd.alltoall(x, name="ts.a2a." + name)
+        assert out.dtype == dt
+        assert list(np.asarray(rsplits)) == [2] * n
+        expect = np.concatenate(
+            [(np.arange(2) + 2 * r + 10 * k) for k in range(n)])
+        np.testing.assert_array_equal(out.to(torch.float64).numpy(),
+                                      expect.astype(np.float64))
+
+    if n == 2:
+        # rank0 sends everything to rank1, nothing to itself.
+        x = torch.arange(3, dtype=torch.float32) + 100.0 * r
+        splits = torch.tensor([0, 3] if r == 0 else [2, 1])
+        out, rsplits = hvd.alltoall(x, splits=splits, name="ts.a2a.z")
+        if r == 0:
+            np.testing.assert_allclose(out.numpy(), [100.0, 101.0])
+            assert list(np.asarray(rsplits)) == [0, 2]
+        else:
+            np.testing.assert_allclose(out.numpy(), [0.0, 1.0, 2.0, 102.0])
+            assert list(np.asarray(rsplits)) == [3, 1]
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    narrow_int_dtype_ops(r, n)
+    sparse_allreduce(r, n)
+    inplace_broadcast_family(r, n)
+    non_contiguous_inputs(r, n)
+    adasum_as_allreduce_op(r, n)
+    fp16_compression_optimizer(r, n)
+    autograd_through_allreduce(r, n)
+    float16_grouped_and_scalars(r, n)
+    alltoall_dtypes_and_zero_splits(r, n)
+
+    hvd.shutdown()
+    print("TORCH_SWEEP_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,58 @@ def busbw_child() -> int:
     return 0
 
 
+def adasum_child() -> int:
+    """Delta-Adasum vs plain-Sum gradient-sync throughput on the
+    native plane (rank 0 reports).
+
+    Reference intent: examples/adasum/adasum_bench.ipynb — what does
+    adaptive summation COST relative to a plain allreduce? The
+    workload is one training step's worth of grouped gradient
+    tensors with BERT-base-ish layer shapes (~31 MB total), the
+    grouped submission path DistributedOptimizer drives.
+    """
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rng = np.random.RandomState(hvd.rank())
+    # A transformer block's gradient set (hidden 768): qkv/out
+    # projections, the 4x MLP pair, embeddings slice + norms.
+    shapes = [(768, 768)] * 4 + [(768, 3072), (3072, 768)] + \
+        [(768,)] * 4 + [(1000, 768)]
+    grads = [rng.randn(*s).astype(np.float32) for s in shapes]
+    records = []
+    iters = 8
+    results = {}
+    for opname, op in (("sum", hvd.Sum), ("adasum", hvd.Adasum)):
+        for _ in range(2):  # warm the fusion buffer + cache
+            hvd.grouped_allreduce(grads, op=op,
+                                  name="adasum_bench.%s.warm" % opname)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hvd.grouped_allreduce(grads, op=op,
+                                  name="adasum_bench." + opname)
+        dt = (time.perf_counter() - t0) / iters
+        results[opname] = dt
+        records.append({
+            "metric": "gradient_sync_steps_per_sec",
+            "op": opname, "world_size": hvd.size(),
+            "value": round(1.0 / dt, 2), "unit": "steps/sec",
+            "payload_mb": round(sum(g.nbytes for g in grads) / 1e6, 2),
+        })
+    records.append({
+        "metric": "adasum_overhead_ratio",
+        "world_size": hvd.size(),
+        "value": round(results["adasum"] / results["sum"], 3),
+        "unit": "x plain-Sum step time",
+    })
+    if hvd.rank() == 0:
+        print(json.dumps(records))
+    hvd.shutdown()
+    return 0
+
+
 def native_child() -> int:
     """Native TCP ring allreduce bandwidth (rank 0 reports)."""
     import numpy as np
@@ -231,7 +283,7 @@ def _run_child(mode, timeout=600):
                           out.stderr[-2000:]))
 
 
-def _run_native(np_=2, timeout=300):
+def _run_native(np_=2, timeout=300, child_mode="native-child"):
     port_s = socket.socket()
     port_s.bind(("127.0.0.1", 0))
     port = port_s.getsockname()[1]
@@ -247,7 +299,7 @@ def _run_native(np_=2, timeout=300):
             "HOROVOD_CONTROLLER_PORT": str(port),
         })
         procs.append(subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "native-child"],
+            [sys.executable, os.path.abspath(__file__), child_mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
     outs = [p.communicate(timeout=timeout)[0] for p in procs]
@@ -265,7 +317,7 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("mode", nargs="?", default="all",
                    choices=["all", "mesh-child", "busbw-child",
-                            "native-child"])
+                            "native-child", "adasum-child"])
     p.add_argument("--output", default=os.path.join(_REPO, "SCALING.json"))
     args = p.parse_args()
     if args.mode == "mesh-child":
@@ -274,12 +326,16 @@ def main() -> int:
         return busbw_child()
     if args.mode == "native-child":
         return native_child()
+    if args.mode == "adasum-child":
+        return adasum_child()
 
     records = []
     records += _run_child("mesh-child")
     records += _run_child("busbw-child")
     for np_ in (2, 4):
         records += _run_native(np_)
+    for np_ in (2, 4):
+        records += _run_native(np_, child_mode="adasum-child")
     payload = {
         "generated_by": "bench_scaling.py",
         "device_kind": "virtual-cpu-%d" % N_DEVICES,

@@ -1,0 +1,94 @@
+"""Elastic synthetic benchmark for the torch binding: images/sec that
+keeps running through world-size changes.
+
+Parity workload for the reference's elastic x perf crossover
+(reference: examples/elastic/pytorch/pytorch_synthetic_benchmark_elastic.py
+— synthetic ResNet batches inside hvd.elastic.run, state committed
+every batch-group so a reset loses at most one group).
+
+Run:  python -m horovod_tpu.runner --min-np 2 --max-np 4 \\
+          --host-discovery-script ./discover.sh \\
+          python examples/elastic/pytorch/pytorch_synthetic_benchmark_elastic.py
+(or bin/hvdrun -np 2 for a fixed-size smoke run)
+"""
+
+import argparse
+import time
+
+import torch
+
+import horovod_tpu.elastic as elastic
+import horovod_tpu.torch as hvd
+from horovod_tpu.elastic.state import TorchState
+
+
+def make_model(name: str):
+    try:
+        import torchvision.models as tvm
+
+        return getattr(tvm, name)()
+    except (ImportError, AttributeError):
+        # torchvision-free fallback with a resnet-ish layer mix.
+        return torch.nn.Sequential(
+            torch.nn.Conv2d(3, 64, 7, stride=2, padding=3),
+            torch.nn.ReLU(),
+            torch.nn.Conv2d(64, 128, 3, stride=2, padding=1),
+            torch.nn.ReLU(),
+            torch.nn.AdaptiveAvgPool2d(1),
+            torch.nn.Flatten(),
+            torch.nn.Linear(128, 1000),
+        )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-batches-per-commit", type=int, default=3)
+    p.add_argument("--num-iters", type=int, default=3)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = make_model(args.model)
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size()),
+        named_parameters=model.named_parameters())
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    data = torch.randn(args.batch_size, 3, args.image_size,
+                       args.image_size)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    state = TorchState(model=model, optimizer=optimizer, iteration=0)
+
+    @elastic.run
+    def benchmark(state):
+        """Each elastic 'iteration' is one committed batch group; on a
+        reset the loop resumes from the last commit with rescaled
+        workers."""
+        while state.iteration < args.num_iters:
+            start = time.time()
+            for _ in range(args.num_batches_per_commit):
+                optimizer.zero_grad()
+                loss_fn(model(data), target).backward()
+                optimizer.step()
+            elapsed = time.time() - start
+            imgs = (args.batch_size * args.num_batches_per_commit
+                    / elapsed)
+            if hvd.rank() == 0:
+                print("iter %d: %.1f img/sec per worker, %.1f total "
+                      "(np=%d)" % (state.iteration, imgs,
+                                   imgs * hvd.size(), hvd.size()))
+            state.iteration += 1
+            state.commit()
+
+    benchmark(state)
+    if hvd.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Elastic synthetic benchmark for the TF2 binding: images/sec that
+survives world-size changes.
+
+Parity workload for the reference's elastic x perf crossover
+(reference:
+examples/elastic/tensorflow2/tensorflow2_synthetic_benchmark_elastic.py
+— synthetic batches through DistributedGradientTape inside
+hvd.elastic.run, committing between timed groups).
+
+Run:  python -m horovod_tpu.runner --min-np 2 --max-np 4 \\
+          --host-discovery-script ./discover.sh \\
+          python examples/elastic/tensorflow2/tensorflow2_synthetic_benchmark_elastic.py
+(or bin/hvdrun -np 2 for a fixed-size smoke run)
+"""
+
+import argparse
+import os
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+from horovod_tpu.tensorflow import elastic
+from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+
+def make_model(image_size):
+    return tf.keras.Sequential([
+        tf.keras.Input(shape=(image_size, image_size, 3)),
+        tf.keras.layers.Conv2D(64, 7, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.Conv2D(128, 3, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(1000),
+    ])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=96)
+    p.add_argument("--num-batches-per-commit", type=int, default=3)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    tf.keras.utils.set_random_seed(42)
+
+    model = make_model(args.image_size)
+    optimizer = tf.keras.optimizers.SGD(args.lr * hvd.size())
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    rng = np.random.RandomState(0)
+    data = tf.constant(rng.rand(args.batch_size, args.image_size,
+                                args.image_size, 3), tf.float32)
+    target = tf.constant(rng.randint(0, 1000, args.batch_size))
+
+    state = TensorFlowKerasState(model=model, optimizer=optimizer,
+                                 iteration=0)
+
+    def on_state_reset():
+        optimizer.learning_rate.assign(args.lr * hvd.size())
+
+    state.register_reset_callbacks([on_state_reset])
+
+    def train_step():
+        with hvd.DistributedGradientTape(op=hvd.Average) as tape:
+            loss = loss_fn(target, model(data, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        optimizer.apply_gradients(zip(grads,
+                                      model.trainable_variables))
+        return loss
+
+    @elastic.run
+    def benchmark(state):
+        while state.iteration < args.num_iters:
+            start = time.time()
+            for _ in range(args.num_batches_per_commit):
+                train_step()
+            elapsed = time.time() - start
+            imgs = (args.batch_size * args.num_batches_per_commit
+                    / elapsed)
+            if hvd.rank() == 0:
+                print("iter %d: %.1f img/sec per worker, %.1f total "
+                      "(np=%d)" % (state.iteration, imgs,
+                                   imgs * hvd.size(), hvd.size()))
+            state.iteration += 1
+            state.commit()
+
+    benchmark(state)
+    if hvd.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

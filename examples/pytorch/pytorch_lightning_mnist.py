@@ -1,0 +1,127 @@
+"""Distributed MNIST with a LightningModule.
+
+Parity workload for the reference's Lightning example
+(reference: examples/pytorch/pytorch_lightning_mnist.py — a
+LightningModule trained under Trainer(strategy='horovod')). The
+module is written against the Lightning protocol
+(``training_step`` / ``validation_step`` / ``configure_optimizers``),
+subclassing the real ``pytorch_lightning.LightningModule`` when the
+package is installed; the training loop is the same hvd-distributed
+loop the LightningEstimator runs (horovod_tpu/spark/lightning), so
+the module trains identically with or without the package.
+
+Run: bin/hvdrun -np 2 python examples/pytorch/pytorch_lightning_mnist.py
+"""
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+try:
+    import pytorch_lightning as pl
+
+    _ModuleBase = pl.LightningModule
+except ImportError:  # protocol-compatible without the package
+    _ModuleBase = torch.nn.Module
+
+
+class LitMNIST(_ModuleBase):
+    """(reference: pytorch_lightning_mnist.py Net/LightningModule)"""
+
+    def __init__(self, lr=0.01):
+        super().__init__()
+        self.lr = lr
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = x.view(-1, 784)
+        return F.log_softmax(self.fc2(F.relu(self.fc1(x))), dim=1)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        loss = F.nll_loss(self(x), y)
+        return {"loss": loss}
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        out = self(x)
+        return {"val_loss": F.nll_loss(out, y),
+                "val_acc": (out.argmax(dim=1) == y).float().mean()}
+
+    def configure_optimizers(self):
+        return torch.optim.SGD(self.parameters(), lr=self.lr)
+
+
+def synthetic_loader(batch_size, steps, seed):
+    rng = np.random.RandomState(seed)
+    for i in range(steps):
+        x = torch.from_numpy(rng.rand(batch_size, 784)
+                             .astype(np.float32))
+        y = torch.from_numpy(rng.randint(0, 10, size=batch_size))
+        yield x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps-per-epoch", type=int, default=10)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--ckpt-dir", default=None)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    module = LitMNIST(lr=args.lr * hvd.size())
+    optimizer = module.configure_optimizers()
+    hvd.broadcast_parameters(module.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=module.named_parameters())
+
+    for epoch in range(args.epochs):
+        module.train()
+        losses = []
+        loader = synthetic_loader(args.batch_size, args.steps_per_epoch,
+                                  seed=100 + 10 * epoch + hvd.rank())
+        for batch_idx, batch in enumerate(loader):
+            optimizer.zero_grad()
+            out = module.training_step(batch, batch_idx)
+            loss = out["loss"] if isinstance(out, dict) else out
+            loss.backward()
+            optimizer.step()
+            losses.append(float(loss.detach()))
+
+        module.eval()
+        with torch.no_grad():
+            vx, vy = next(synthetic_loader(args.batch_size, 1, seed=999))
+            val = module.validation_step((vx, vy), 0)
+        # Globally averaged epoch metrics (what Trainer logs under
+        # the horovod strategy).
+        mean_loss = float(hvd.allreduce(
+            torch.tensor(np.mean(losses)), name="pl.loss",
+            op=hvd.Average))
+        val_acc = float(hvd.allreduce(val["val_acc"], name="pl.acc",
+                                      op=hvd.Average))
+        if hvd.rank() == 0:
+            print("epoch %d loss %.4f val_acc %.3f"
+                  % (epoch, mean_loss, val_acc))
+
+    if hvd.rank() == 0:
+        ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="pl_mnist_")
+        path = os.path.join(ckpt_dir, "mnist.ckpt")
+        torch.save({"state_dict": module.state_dict()}, path)
+        print("saved checkpoint to %s" % path)
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

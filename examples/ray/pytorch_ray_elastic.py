@@ -1,0 +1,76 @@
+"""Elastic PyTorch training on a Ray cluster.
+
+Parity workload for the reference's torch x ray x elastic crossover
+(reference: examples/ray/pytorch_ray_elastic.py — ElasticRayExecutor
+running a TorchState commit/restore loop that rides cluster
+growth/shrink).
+
+Requires a ray installation: python examples/ray/pytorch_ray_elastic.py
+(tests inject tests/fake_ray.py to smoke-run the same flow without a
+cluster).
+"""
+
+import argparse
+
+
+def train_fn():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.elastic as elastic
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.elastic.state import TorchState
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = torch.nn.Linear(8, 1)
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    state = TorchState(model=model, optimizer=optimizer, epoch=0)
+
+    @elastic.run
+    def loop(state):
+        while state.epoch < 3:
+            rng = np.random.RandomState(100 + state.epoch + hvd.rank())
+            x = torch.from_numpy(rng.rand(16, 8).astype(np.float32))
+            y = torch.from_numpy(rng.rand(16, 1).astype(np.float32))
+            optimizer.zero_grad()
+            torch.nn.functional.mse_loss(model(x), y).backward()
+            optimizer.step()
+            state.epoch += 1
+            state.commit()
+
+    loop(state)
+    weights = [float(w) for w in model.weight.detach().numpy().ravel()]
+    return {"rank": hvd.rank(), "size": hvd.size(), "weights": weights}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--min-np", type=int, default=1)
+    p.add_argument("--max-np", type=int, default=4)
+    p.add_argument("--cpus-per-slot", type=int, default=1)
+    args = p.parse_args()
+
+    import ray
+
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    ray.init(ignore_reinit_error=True)
+    executor = ElasticRayExecutor(
+        min_np=args.min_np, max_np=args.max_np,
+        cpus_per_slot=args.cpus_per_slot)
+    executor.start()
+    results = executor.run(train_fn)
+    # Every surviving rank reports identical (synchronized) weights.
+    print("elastic torch results:", results)
+    assert len({tuple(r["weights"]) for r in results}) == 1
+    ray.shutdown()
+
+
+if __name__ == "__main__":
+    main()

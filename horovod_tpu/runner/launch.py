@@ -55,9 +55,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "--check-build).")
     p.add_argument("-H", "--hosts", dest="hosts",
                    help="Comma-separated host:slots list.")
-    p.add_argument("--hostfile", dest="hostfile",
+    p.add_argument("-hostfile", "--hostfile", dest="hostfile",
                    help="Hostfile path (hostname slots=N per line).")
-    p.add_argument("--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("-p", "--ssh-port", type=int, dest="ssh_port")
     p.add_argument("-i", "--ssh-identity-file", dest="ssh_identity_file",
                    help="Private-key identity file passed to ssh for "
                         "remote slot fan-out.")

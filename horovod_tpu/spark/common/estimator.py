@@ -68,7 +68,37 @@ def materialize_dataframe(df, path: str, validation=None) -> None:
                 % (validation, sorted(pdf.columns)))
         pdf[VALIDATION_COL] = pdf[validation].astype("int64")
     os.makedirs(path, exist_ok=True)
-    pdf.to_parquet(os.path.join(path, "part-00000.parquet"))
+    from horovod_tpu.spark.common import convert
+
+    if any(pdf[c].dtype == object for c in pdf.columns):
+        # Vector/array/sparse columns take the columnar conversion
+        # path: Arrow list/struct columns + schema sidecar (reference:
+        # spark/common/util.py to_petastorm_fn + _get_col_info).
+        convert.write_columnar(pdf, path)
+    else:
+        # A prior columnar fit may have left its schema sidecar at
+        # this (fixed per-store) path; a stale sidecar would make
+        # readers "restore" plain scalar data as vectors.
+        sidecar = os.path.join(path, convert.SCHEMA_SIDECAR)
+        if os.path.exists(sidecar):
+            os.unlink(sidecar)
+        pdf.to_parquet(os.path.join(path, "part-00000.parquet"))
+
+
+def _restore_columnar(path: str, pdf):
+    """Rebuild ndarray / SparseVector cells when the dataset was
+    materialized through the columnar conversion path (schema sidecar
+    present); plain scalar datasets pass through untouched."""
+    from horovod_tpu.spark.common import convert
+
+    meta = convert.load_schema_sidecar(path)
+    if meta:
+        pdf = convert.restore_dataframe(pdf, meta)
+        # Ride the schema along for consumers that can't re-infer it
+        # from values (build_feature_matrix on an EMPTY shard still
+        # needs each column's flattened width).
+        pdf.attrs["hvd_schema"] = meta
+    return pdf
 
 
 def read_shard(path: str, rank: int, size: int,
@@ -81,6 +111,7 @@ def read_shard(path: str, rank: int, size: int,
     pdf = pd.concat(
         [pd.read_parquet(os.path.join(path, f)) for f in files],
         ignore_index=True)
+    pdf = _restore_columnar(path, pdf)
     if validation_col and validation_col in pdf.columns:
         val = pdf[pdf[validation_col] == 1].drop(columns=[validation_col])
         train = pdf[pdf[validation_col] == 0].drop(
@@ -114,10 +145,13 @@ def read_shard_rowgroups(path: str, rank: int, size: int):
             index += 1
     if not pieces:
         # Empty shard: column-correct zero-row frame without data IO.
+        # Still runs the columnar restore so the schema sidecar rides
+        # pdf.attrs — build_feature_matrix needs it to give the empty
+        # frame its peers' flattened feature width.
         schema = pq.ParquetFile(
             os.path.join(path, files[0])).schema_arrow
-        return schema.empty_table().to_pandas()
-    return pd.concat(pieces, ignore_index=True)
+        return _restore_columnar(path, schema.empty_table().to_pandas())
+    return _restore_columnar(path, pd.concat(pieces, ignore_index=True))
 
 
 class HorovodEstimator(EstimatorParams):

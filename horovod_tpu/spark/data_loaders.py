@@ -24,10 +24,12 @@ class PandasShardDataLoader(BaseDataLoader):
     def __init__(self, pdf, feature_cols: List[str], label_cols: List[str],
                  batch_size: int = 32, shuffle: bool = True,
                  seed: Optional[int] = None):
-        self._x = np.stack([pdf[c].to_numpy() for c in feature_cols],
-                           axis=1)
-        self._y = np.stack([pdf[c].to_numpy() for c in label_cols],
-                           axis=1)
+        from horovod_tpu.spark.common.convert import build_feature_matrix
+
+        # Mixed scalar/array/sparse columns flatten into one design
+        # matrix (reference: util.py shape flattening).
+        self._x = build_feature_matrix(pdf, feature_cols)
+        self._y = build_feature_matrix(pdf, label_cols)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self._rng = np.random.RandomState(seed)

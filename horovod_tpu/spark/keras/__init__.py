@@ -80,10 +80,18 @@ class KerasEstimator(HorovodEstimator):
                 validation_col="__validation__")
             if transformation_fn is not None:
                 train_pdf = transformation_fn(train_pdf)
-            x = np.stack([train_pdf[c].to_numpy()
-                          for c in feature_cols], axis=1)
-            y = np.stack([train_pdf[c].to_numpy()
-                          for c in label_cols], axis=1)
+                if val_pdf is not None:
+                    # Validation must see the same feature space the
+                    # model trains on.
+                    val_pdf = transformation_fn(val_pdf)
+            # Mixed scalar/array/sparse feature columns flatten into
+            # one design matrix (reference: util.py shape flattening).
+            from horovod_tpu.spark.common.convert import (
+                build_feature_matrix,
+            )
+
+            x = build_feature_matrix(train_pdf, feature_cols)
+            y = build_feature_matrix(train_pdf, label_cols)
             model = tf.keras.models.model_from_json(
                 model_json, custom_objects=custom_objects)
             model.set_weights(weights)
@@ -121,10 +129,8 @@ class KerasEstimator(HorovodEstimator):
                 kwargs["sample_weight"] = \
                     train_pdf[sample_weight_col].to_numpy()
             if val_pdf is not None and len(val_pdf):
-                xv = np.stack([val_pdf[c].to_numpy()
-                               for c in feature_cols], axis=1)
-                yv = np.stack([val_pdf[c].to_numpy()
-                               for c in label_cols], axis=1)
+                xv = build_feature_matrix(val_pdf, feature_cols)
+                yv = build_feature_matrix(val_pdf, label_cols)
                 kwargs["validation_data"] = (xv, yv)
                 kwargs["validation_batch_size"] = val_batch_size
                 if val_steps:

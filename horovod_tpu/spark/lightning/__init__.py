@@ -143,6 +143,7 @@ class LightningEstimator(HorovodEstimator):
                 batch_size=batch_size, shuffle=shuffle, seed=seed)
             history = {"loss": [], "val_loss": []}
             module.train()
+            val_xy = [None, None]
             for epoch in range(epochs):
                 epoch_losses = []
                 for batch_idx, (bx, by) in enumerate(loader):
@@ -165,15 +166,23 @@ class LightningEstimator(HorovodEstimator):
                 if val_pdf is not None and hasattr(module,
                                                    "validation_step"):
                     module.eval()
+                    if val_xy[0] is None:
+                        # The validation frame never changes across
+                        # epochs; densify/flatten it once.
+                        from horovod_tpu.spark.common.convert import (
+                            build_feature_matrix,
+                        )
+
+                        val_xy[0] = torch.tensor(
+                            build_feature_matrix(val_pdf, feature_cols),
+                            dtype=torch.float32)
+                        val_xy[1] = torch.tensor(
+                            build_feature_matrix(val_pdf, label_cols),
+                            dtype=torch.float32)
                     with torch.no_grad():
-                        vx = torch.tensor(np.stack(
-                            [val_pdf[c].to_numpy() for c in feature_cols],
-                            axis=1), dtype=torch.float32)
-                        vy = torch.tensor(np.stack(
-                            [val_pdf[c].to_numpy() for c in label_cols],
-                            axis=1), dtype=torch.float32)
                         vloss = _extract_loss(
-                            module.validation_step((vx, vy), 0))
+                            module.validation_step(
+                                (val_xy[0], val_xy[1]), 0))
                     history["val_loss"].append(float(vloss))
                     module.train()
                 if hasattr(module, "on_train_epoch_end"):

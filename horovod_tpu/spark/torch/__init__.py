@@ -64,12 +64,17 @@ class TorchEstimator(HorovodEstimator):
                 validation_col="__validation__")
             if transformation_fn is not None:
                 train_pdf = transformation_fn(train_pdf)
-            x = torch.tensor(np.stack(
-                [train_pdf[c].to_numpy() for c in feature_cols],
-                axis=1), dtype=torch.float32)
-            y = torch.tensor(np.stack(
-                [train_pdf[c].to_numpy() for c in label_cols],
-                axis=1), dtype=torch.float32)
+            # Mixed scalar/array/sparse feature columns flatten into
+            # one design matrix (reference: util.py shape flattening).
+            from horovod_tpu.spark.common.convert import (
+                build_feature_matrix,
+            )
+
+            x = torch.tensor(build_feature_matrix(train_pdf,
+                                                  feature_cols),
+                             dtype=torch.float32)
+            y = torch.tensor(build_feature_matrix(train_pdf, label_cols),
+                             dtype=torch.float32)
             model = torch.load(io.BytesIO(model_bytes),
                                weights_only=False)
             if resume and remote_store.exists(

@@ -100,6 +100,36 @@ _INGRAPH_BCAST_DTYPES = frozenset((
     tf.bool, tf.float16, tf.float32, tf.float64, tf.int32, tf.int64))
 
 
+def _host_bridge(run_fn, inputs, out_dtypes, out_shapes):
+    """Execute a host-plane collective from TF: directly when eager,
+    through ``tf.numpy_function`` when tracing (tf.function callers on
+    dtypes the in-graph kernels can't carry, or host-bridge mode).
+
+    ``run_fn`` takes/returns numpy arrays (a tuple for multi-output);
+    ``out_shapes`` entries may be None when a dimension is only known
+    at run time (ragged allgather / alltoall). numpy_function is
+    stateful, so tracing preserves the cross-rank collective order.
+    Returns a list of tensors, one per entry in ``out_dtypes``.
+    """
+    if tf.executing_eagerly():
+        outs = run_fn(*[np.asarray(x) for x in inputs])
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        return [tf.convert_to_tensor(o) for o in outs]
+    outs = tf.numpy_function(run_fn, list(inputs), out_dtypes)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for o, s in zip(outs, out_shapes):
+        if s is not None:
+            o.set_shape(s)
+    return list(outs)
+
+
+def _tail_shape(tensor):
+    """Static shape with an unknown leading dimension (collectives
+    that change dim 0)."""
+    return tf.TensorShape([None]).concatenate(tensor.shape[1:])
+
+
 def allreduce(tensor, average=None, op=None, name=None,
               prescale_factor=1.0, postscale_factor=1.0,
               compression=None, process_set=global_process_set):
@@ -167,11 +197,7 @@ def allreduce(tensor, average=None, op=None, name=None,
 
     @tf.custom_gradient
     def _fwd(x):
-        if tf.executing_eagerly():
-            y = tf.convert_to_tensor(_run(x.numpy()))
-        else:
-            y = tf.numpy_function(_run, [x], x.dtype)
-            y.set_shape(x.shape)
+        (y,) = _host_bridge(_run, [x], [x.dtype], [x.shape])
 
         def grad(dy):
             # Gradient of allreduce is allreduce with the same op
@@ -200,11 +226,15 @@ def grouped_allreduce(tensors, average=None, op=None, name=None,
                                   op_is_average=(op == Average),
                                   process_set=process_set)
                 for i, t in enumerate(tensors)]
-    arrays = [t.numpy() if hasattr(t, "numpy") else np.asarray(t)
-              for t in tensors]
-    outs = eager.synchronize(eager.grouped_allreduce_async(
-        arrays, name=name, op=op, process_set=process_set))
-    return [tf.convert_to_tensor(np.asarray(o)) for o in outs]
+
+    def _run(*xs):
+        outs = eager.synchronize(eager.grouped_allreduce_async(
+            [np.asarray(x) for x in xs], name=name, op=op,
+            process_set=process_set))
+        return tuple(np.asarray(o) for o in outs)
+
+    return _host_bridge(_run, tensors, [t.dtype for t in tensors],
+                        [t.shape for t in tensors])
 
 
 def allgather(tensor, name=None, process_set=global_process_set):
@@ -220,13 +250,8 @@ def allgather(tensor, name=None, process_set=global_process_set):
         return np.asarray(eager.synchronize(eager.allgather_async(
             np.asarray(x), name=name, process_set=process_set)))
 
-    if tf.executing_eagerly():
-        return tf.convert_to_tensor(_run(tensor))
-    # Symbolic (tf.function) caller on the host path — e.g. a dtype
-    # the in-graph runtime has no kernel for: bridge through
-    # numpy_function (stateful, so collective order is preserved).
-    out = tf.numpy_function(_run, [tensor], tensor.dtype)
-    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    (out,) = _host_bridge(_run, [tensor], [tensor.dtype],
+                          [_tail_shape(tensor)])
     return out
 
 
@@ -245,10 +270,7 @@ def broadcast(tensor, root_rank, name=None,
             np.asarray(x), root_rank, name=name,
             process_set=process_set)))
 
-    if tf.executing_eagerly():
-        return tf.convert_to_tensor(_run(tensor))
-    out = tf.numpy_function(_run, [tensor], tensor.dtype)
-    out.set_shape(tensor.shape)
+    (out,) = _host_bridge(_run, [tensor], [tensor.dtype], [tensor.shape])
     return out
 
 
@@ -265,14 +287,13 @@ def alltoall(tensor, splits=None, name=None,
         # static-shape contract (ops/collective_ops.py alltoall).
         from horovod_tpu.tensorflow import ingraph
 
-        t = tf.convert_to_tensor(tensor)
         # Group size from the same discriminator the collective itself
         # uses (also validates that the set is registered).
         _, n, _, _ = ingraph._group_for(process_set)
         # ingraph.alltoall pre-flights cross-rank dim-0 agreement and
         # divisibility (failing loudly on every rank), so uniform
         # division of the received row count is exact here.
-        out = ingraph.alltoall(t, name, process_set=process_set)
+        out = ingraph.alltoall(tensor, name, process_set=process_set)
         rsplits = tf.fill([n], tf.shape(out)[0] // n)
         return out, rsplits
 
@@ -282,14 +303,9 @@ def alltoall(tensor, splits=None, name=None,
             np.asarray(x), s, name=name, process_set=process_set))
         return np.asarray(o), np.asarray(rs, np.int32)
 
-    if tf.executing_eagerly():
-        out, rsplits = _run(tensor) if splits is None else _run(tensor,
-                                                                splits)
-        return tf.convert_to_tensor(out), tf.convert_to_tensor(rsplits)
     inputs = [tensor] if splits is None else [tensor, splits]
-    out, rsplits = tf.numpy_function(_run, inputs,
-                                     [tensor.dtype, tf.int32])
-    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    out, rsplits = _host_bridge(_run, inputs, [tensor.dtype, tf.int32],
+                                [_tail_shape(tensor), None])
     return out, rsplits
 
 
@@ -312,10 +328,8 @@ def reducescatter(tensor, op=Sum, name=None,
         return np.asarray(eager.synchronize(eager.reducescatter_async(
             np.asarray(x), name=name, op=op, process_set=process_set)))
 
-    if tf.executing_eagerly():
-        return tf.convert_to_tensor(_run(tensor))
-    out = tf.numpy_function(_run, [tensor], tensor.dtype)
-    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    (out,) = _host_bridge(_run, [tensor], [tensor.dtype],
+                          [_tail_shape(tensor)])
     return out
 
 

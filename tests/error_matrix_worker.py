@@ -1,0 +1,201 @@
+"""np=2 third-wave error matrix: every coordinator mismatch class,
+through each binding's public API.
+
+Reference pattern: test/parallel/test_torch.py error suite +
+test_tensorflow.py error cases — the reference asserts that EVERY
+cross-rank inconsistency class surfaces as a framework-level error on
+every rank and leaves the job usable. The first-wave matrices
+(binding_matrix_worker.py, tf_matrix_worker.py) cover allreduce
+shape/dtype/op/root/scale; this worker adds the remaining coordinator
+error classes (controller.cc:262-340): op-TYPE mismatch, broadcast
+shape mismatch, allgather trailing-shape mismatch and
+allgather-of-scalar, the three alltoall splits violations, and the
+duplicate-name-in-flight guard — each through torch, jax, and the
+keras value surface, with a recovery allreduce after every failure.
+
+Runs under HOROVOD_TF_HOST_BRIDGE=1 (keras cells; a TF in-graph
+runtime would be poisoned by collective errors — see
+tensorflow/ingraph.py).
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu.jax as hvd_jax  # noqa: E402
+import horovod_tpu.torch as hvd_torch  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402
+from matrix_common import expect_error  # noqa: E402
+
+
+def _recover(tag, r, n):
+    """The session must stay usable after a per-tensor error."""
+    out = hvd_jax.allreduce(jnp.ones(3), name="em.recover.%s" % tag,
+                            op=hvd_jax.Sum)
+    np.testing.assert_allclose(np.asarray(out, np.float64), float(n))
+
+
+def op_type_mismatch(r, n):
+    """Same tensor name, different COLLECTIVE: rank0 allreduces while
+    rank1 allgathers (controller.cc: 'Mismatched op types')."""
+    with expect_error("Mismatched op types"):
+        if r == 0:
+            hvd_torch.allreduce(torch.ones(4), name="em.optype",
+                                op=hvd_torch.Sum)
+        else:
+            hvd_torch.allgather(torch.ones(4), name="em.optype")
+    _recover("optype", r, n)
+
+
+def broadcast_shape_mismatch(r, n):
+    """Broadcast with per-rank shapes must fail loudly, not truncate
+    (controller.cc: 'Mismatched broadcast shapes')."""
+    with expect_error("Mismatched broadcast shapes"):
+        hvd_jax.broadcast(jnp.ones(3 + r), root_rank=0, name="em.bshape")
+    _recover("bshape", r, n)
+
+
+def allgather_trailing_mismatch(r, n):
+    """Allgather dim 0 may differ; TRAILING dims may not
+    (controller.cc: 'Mismatched allgather trailing shapes')."""
+    with expect_error("Mismatched allgather trailing shapes"):
+        hvd_torch.allgather(torch.ones(2, 3 + r), name="em.gtail")
+    _recover("gtail", r, n)
+
+    # Same class through the jax surface.
+    with expect_error("Mismatched allgather trailing shapes"):
+        hvd_jax.allgather(jnp.ones((2, 2, 4 + r)), name="em.gtail.jax")
+    _recover("gtail.jax", r, n)
+
+
+def allgather_scalar_promotes(r, n):
+    """0-d allgather through the Python bindings: the eager plane
+    ships scalars as 1-element vectors (core/session.py submit keeps
+    the caller's shape explicitly), so the result is the rank-ordered
+    (n,) vector — the coordinator's 'Allgather of scalar' rejection
+    (controller.cc) guards only raw C-API callers that bypass the
+    promotion."""
+    out = hvd_jax.allgather(jnp.asarray(1.0 + r), name="em.gscalar")
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               np.arange(1, n + 1, dtype=np.float64))
+    t = hvd_torch.allgather(torch.tensor(float(10 * (r + 1))),
+                            name="em.gscalar.t")
+    np.testing.assert_allclose(t.numpy(), 10.0 * np.arange(1, n + 1))
+
+
+def alltoall_splits_violations(r, n):
+    """The three alltoall splits error classes (controller.cc):
+    wrong length, wrong sum, and uniform-split indivisibility."""
+    with expect_error("splits length mismatch"):
+        hvd_torch.alltoall(torch.ones(4), splits=torch.ones(
+            n + 1, dtype=torch.int64), name="em.alen")
+    _recover("alen", r, n)
+
+    with expect_error("splits do not sum to dim 0"):
+        hvd_torch.alltoall(torch.ones(4), splits=torch.tensor([1] * n),
+                           name="em.asum")
+    _recover("asum", r, n)
+
+    with expect_error("dim 0 not divisible"):
+        hvd_jax.alltoall(jnp.ones(n * 2 + 1), name="em.adiv")
+    _recover("adiv", r, n)
+
+
+def duplicate_name_in_flight(r, n):
+    """Two outstanding submissions under one name are rejected at
+    enqueue (controller.cc:11-65 tensor-queue guard); the FIRST
+    completes normally. Run on a SINGLETON process set: whether the
+    second submit wins the race is timing-dependent per rank, and on
+    the global set a split outcome (one rank's duplicate accepted,
+    the peer's rejected) would deadlock the accepted rank's
+    negotiation — a hazard of the test construction, not of the
+    contract."""
+    singles = [hvd_jax.add_process_set(hvd_jax.ProcessSet([k]))
+               for k in range(n)]
+    try:
+        mine = singles[r]
+        h1 = hvd_jax.allreduce_async(jnp.full((4,), float(r + 1)),
+                                     name="em.dup", op=hvd_jax.Sum,
+                                     process_set=mine)
+        try:
+            h2 = hvd_jax.allreduce_async(jnp.ones(4), name="em.dup",
+                                         op=hvd_jax.Sum,
+                                         process_set=mine)
+            # The enqueue may have drained h1 already (the TOCTOU
+            # window is real concurrency); then both complete.
+            hvd_jax.synchronize(h2)
+        except HorovodInternalError as e:
+            assert "Duplicate tensor name" in str(e), e
+        out = hvd_jax.synchronize(h1)
+        # Singleton set: the reduction is the rank's own tensor.
+        np.testing.assert_allclose(np.asarray(out, np.float64),
+                                   float(r + 1))
+    finally:
+        for s in singles:
+            hvd_jax.remove_process_set(s)
+    _recover("dup", r, n)
+
+
+def keras_value_surface_errors(r, n):
+    """The keras value-semantics surface propagates coordinator errors
+    too (broadcast shape class) and recovers."""
+    import horovod_tpu.keras as hvd_keras
+
+    with expect_error("Mismatched broadcast shapes"):
+        hvd_keras.broadcast(np.ones(2 + r, np.float32), root_rank=1,
+                            name="em.k.bshape")
+    v = hvd_keras.allreduce(np.full(3, float(r + 1), np.float32),
+                            average=True, name="em.k.recover")
+    np.testing.assert_allclose(v, (1.0 + n) / 2.0)
+
+    with expect_error("Mismatched allgather trailing shapes"):
+        hvd_keras.allgather(np.ones((1, 2 + r), np.float32),
+                            name="em.k.gtail")
+    v = hvd_keras.allgather(np.full((1, 2), float(r), np.float32),
+                            name="em.k.grecover")
+    np.testing.assert_allclose(v, np.arange(n, dtype=np.float64)
+                               .repeat(2).reshape(n, 2))
+
+
+def async_error_surfaces_at_synchronize(r, n):
+    """Submission succeeds; the coordinator error surfaces at
+    synchronize() — the async contract the reference's handle API
+    keeps (torch/mpi_ops.py WaitAndClear)."""
+    h = hvd_torch.allreduce_async(torch.ones(5 + r), name="em.async",
+                                  op=hvd_torch.Sum)
+    try:
+        hvd_torch.synchronize(h)
+    except HorovodInternalError as e:
+        assert "Mismatched allreduce shapes" in str(e), e
+    else:
+        raise AssertionError("async mismatch must raise at synchronize")
+    _recover("async", r, n)
+
+
+def main():
+    hvd_jax.init()
+    r, n = hvd_jax.rank(), hvd_jax.size()
+    assert n == 2
+
+    op_type_mismatch(r, n)
+    broadcast_shape_mismatch(r, n)
+    allgather_trailing_mismatch(r, n)
+    allgather_scalar_promotes(r, n)
+    alltoall_splits_violations(r, n)
+    duplicate_name_in_flight(r, n)
+    keras_value_surface_errors(r, n)
+    async_error_surfaces_at_synchronize(r, n)
+
+    hvd_jax.shutdown()
+    print("ERROR_MATRIX_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

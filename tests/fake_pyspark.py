@@ -162,10 +162,10 @@ class _Builder:
             _Builder._session = _Session()
         return _Builder._session
 
-    def appName(self, _):
+    def appName(self, name):
         return self
 
-    def master(self, _):
+    def master(self, master):
         return self
 
     def config(self, *a, **kw):

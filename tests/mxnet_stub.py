@@ -54,6 +54,13 @@ class Optimizer:
         self.updates = []
 
     def update(self, index, weight, grad, state):
+        # Real mxnet optimizers accept parallel lists of
+        # index/weight/grad/state (mx.optimizer.Optimizer.update's
+        # multi-index form, which gluon's batched updates use).
+        if isinstance(index, (tuple, list)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self.update(i, w, g, s)
+            return
         self.updates.append(index)
         weight[:] = weight.asnumpy() - self.learning_rate * (
             self.rescale_grad * grad.asnumpy())
@@ -130,3 +137,10 @@ def install():
     sys.modules["mxnet.gluon"] = gluon
     sys.modules["mxnet.gluon.parameter"] = parameter
     return mx
+
+
+def uninstall():
+    """Remove the stub so it can't shadow a real installation."""
+    for name in ("mxnet", "mxnet.nd", "mxnet.optimizer", "mxnet.gluon",
+                 "mxnet.gluon.parameter"):
+        sys.modules.pop(name, None)

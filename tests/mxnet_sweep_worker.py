@@ -1,0 +1,163 @@
+"""np=2 MXNet-binding sweep (NDArray stub).
+
+Reference pattern: test/parallel/test_mxnet.py — dtype x op cells,
+grouped/in-place variants, trainer grouping, and error propagation
+through the mxnet surface. The binding duck-types NDArrays
+(horovod_tpu/mxnet/mpi_ops.py), so the stub exercises the identical
+code path the real library would; tests/test_mxnet_binding.py pins
+the stub's surface."""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import mxnet_stub  # noqa: E402
+
+mx = mxnet_stub.install()
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu.mxnet as hvd  # noqa: E402
+from matrix_common import expect_error  # noqa: E402
+
+
+def dtype_op_matrix(r, n):
+    """dtype x {Sum, Average} with exact values; dtype preserved
+    through the NDArray protocol."""
+    base = np.array([1, 2, 3], np.float64)
+    for dtype in (np.float32, np.float64, np.int32, np.int64):
+        x = mx.nd.array((base * (r + 1)).astype(dtype), dtype=dtype)
+        out = hvd.allreduce(x, average=False,
+                            name="mxs.%s" % np.dtype(dtype).name)
+        assert out.asnumpy().dtype == dtype, (dtype, out.asnumpy().dtype)
+        np.testing.assert_allclose(out.asnumpy().astype(np.float64),
+                                   base * sum(range(1, n + 1)))
+        if np.issubdtype(dtype, np.floating):
+            avg = hvd.allreduce(x, average=True,
+                                name="mxs.avg.%s" % np.dtype(dtype).name)
+            np.testing.assert_allclose(
+                avg.asnumpy().astype(np.float64),
+                base * (sum(range(1, n + 1)) / n))
+
+
+def grouped_and_inplace(r, n):
+    """grouped_allreduce (+ in-place flavor) preserves member dtypes
+    and mutates storage in place."""
+    xs = [mx.nd.array([float(r + 1)] * 3),
+          mx.nd.array(np.full(2, r + 1, np.int64), dtype=np.int64)]
+    outs = hvd.grouped_allreduce(xs, average=False, name="mxs.g")
+    total = float(sum(range(1, n + 1)))
+    np.testing.assert_allclose(outs[0].asnumpy(), total)
+    np.testing.assert_array_equal(outs[1].asnumpy(), int(total))
+
+    ys = [mx.nd.array([float(r + 1)]), mx.nd.array([2.0 * (r + 1)])]
+    hvd.grouped_allreduce_(ys, average=True, name="mxs.gi")
+    np.testing.assert_allclose(ys[0].asnumpy(), total / n)
+    np.testing.assert_allclose(ys[1].asnumpy(), 2.0 * total / n)
+
+    z = mx.nd.array([float(r)] * 4)
+    hvd.broadcast_(z, root_rank=n - 1, name="mxs.bi")
+    np.testing.assert_allclose(z.asnumpy(), float(n - 1))
+
+
+def gather_bcast_alltoall(r, n):
+    """Ragged allgather, non-zero-root broadcast, uniform alltoall."""
+    g = hvd.allgather(mx.nd.array(np.full((r + 1, 2), float(r))),
+                      name="mxs.rag")
+    expect = np.concatenate([np.full((k + 1, 2), float(k))
+                             for k in range(n)])
+    np.testing.assert_allclose(g.asnumpy(), expect)
+
+    b = hvd.broadcast(mx.nd.array([float(r), float(r)]), root_rank=n - 1,
+                      name="mxs.bc")
+    np.testing.assert_allclose(b.asnumpy(), float(n - 1))
+
+    a2a = hvd.alltoall(mx.nd.array(np.arange(n * 2, dtype=np.float32)
+                                   + 10.0 * r), name="mxs.a2a")
+    expect = np.concatenate([np.arange(2) + 2 * r + 10.0 * k
+                             for k in range(n)])
+    np.testing.assert_allclose(a2a.asnumpy(), expect)
+
+
+def optimizer_variants(r, n):
+    """gradient_predivide_factor and num_groups through
+    DistributedOptimizer: the applied update equals the mean gradient
+    regardless of the pre/post split (reference:
+    mxnet/__init__.py:41-94 rescale_grad folding)."""
+    for predivide in (1.0, 2.0):
+        opt = mx.optimizer.Optimizer(learning_rate=1.0, rescale_grad=1.0)
+        dopt = hvd.DistributedOptimizer(
+            opt, gradient_predivide_factor=predivide)
+        # rescale_grad absorbs predivide/size; allreduce prescales by
+        # 1/predivide -> net effect: mean gradient.
+        w = mx.nd.array([1.0])
+        g = mx.nd.array([float(r + 1)])
+        dopt.update(0, w, g, None)
+        np.testing.assert_allclose(
+            w.asnumpy(), [1.0 - (1.0 + n) / 2.0], rtol=1e-6)
+
+    # Grouped submission path (list index) with num_groups=2.
+    opt = mx.optimizer.Optimizer(learning_rate=1.0, rescale_grad=1.0)
+    dopt = hvd.DistributedOptimizer(opt, num_groups=2)
+    ws = [mx.nd.array([0.0]) for _ in range(4)]
+    gs = [mx.nd.array([float((r + 1) * (i + 1))]) for i in range(4)]
+    dopt.update([10, 11, 12, 13], ws, gs, [None] * 4)
+    for i, w in enumerate(ws):
+        np.testing.assert_allclose(
+            w.asnumpy(), [-(1.0 + n) / 2.0 * (i + 1)], rtol=1e-6)
+
+
+def compression_and_objects(r, n):
+    """fp16 compression round-trip and the object collectives through
+    the mxnet surface."""
+    x = mx.nd.array(np.full(8, float(r + 1), np.float32))
+    wire, ctx = hvd.Compression.fp16.compress(x)
+    back = hvd.Compression.fp16.decompress(wire, ctx)
+    np.testing.assert_allclose(back.asnumpy(), float(r + 1), rtol=1e-3)
+
+    objs = hvd.allgather_object({"rank": r})
+    assert [o["rank"] for o in objs] == list(range(n))
+    obj = hvd.broadcast_object([1, 2, 3] if r == 0 else None, root_rank=0)
+    assert obj == [1, 2, 3]
+
+
+def error_paths(r, n):
+    """Cross-rank mismatches raise through the mxnet surface and the
+    session recovers (reference: test_mxnet.py error cases)."""
+    with expect_error("Mismatched allreduce shapes"):
+        hvd.allreduce(mx.nd.array([1.0] * (3 + r)), average=False,
+                      name="mxs.err.shape")
+    out = hvd.allreduce(mx.nd.array([1.0]), average=False,
+                        name="mxs.err.recover")
+    np.testing.assert_allclose(out.asnumpy(), float(n))
+
+    with expect_error("Mismatched data types"):
+        hvd.allreduce(
+            mx.nd.array([1.0] * 4,
+                        dtype=np.float32 if r == 0 else np.float64),
+            average=False, name="mxs.err.dtype")
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    dtype_op_matrix(r, n)
+    grouped_and_inplace(r, n)
+    gather_bcast_alltoall(r, n)
+    optimizer_variants(r, n)
+    compression_and_objects(r, n)
+    error_paths(r, n)
+
+    hvd.shutdown()
+    print("MX_SWEEP_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,29 +8,27 @@ coordinator errors raise through the public binding API on every rank
 and that the job keeps working afterwards.
 """
 
-import os
-import subprocess
-import sys
-
 import pytest
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _launch(worker, extra_env=None, timeout=300):
-    env = dict(os.environ)
-    env.update(extra_env or {})
-    return subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
-         sys.executable, os.path.join(_REPO, "tests", worker)],
-        cwd=_REPO, env=env, capture_output=True, text=True,
-        timeout=timeout)
+from launch_util import launch as _launch
 
 
 def test_torch_binding_matrix():
     proc = _launch("binding_matrix_worker.py")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("BINDING_MATRIX_OK") == 2, proc.stdout
+
+
+@pytest.mark.tier2
+def test_error_matrix():
+    """Third wave: the remaining coordinator error classes (op-type,
+    broadcast/allgather shape, alltoall splits, duplicate-name)
+    through torch + jax + keras surfaces."""
+    proc = _launch("error_matrix_worker.py",
+                   extra_env={"HOROVOD_TF_HOST_BRIDGE": "1"},
+                   timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("ERROR_MATRIX_OK") == 2, proc.stdout
 
 
 @pytest.mark.tier2

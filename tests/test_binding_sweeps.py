@@ -8,28 +8,11 @@ exact values at np=2 (size-1 runs can't distinguish a correct
 reduction from an identity).
 """
 
-import os
-import subprocess
-import sys
 import tempfile
 
 import pytest
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _launch(worker, extra_env=None, timeout=300):
-    # Scrub the TPU relay trigger too: with the relay hung (not
-    # refused) the pre-registered plugin's init can wedge the worker
-    # even under jax_platforms=cpu (see bench.py _spawn).
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PALLAS_AXON_POOL_IPS="")
-    env.update(extra_env or {})
-    return subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
-         sys.executable, os.path.join(_REPO, "tests", worker)],
-        cwd=_REPO, env=env, capture_output=True, text=True,
-        timeout=timeout)
+from launch_util import launch as _launch
 
 
 def test_torch_sweep():
@@ -42,6 +25,12 @@ def test_jax_sweep():
     proc = _launch("jax_sweep_worker.py")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("JAX_SWEEP_OK") == 2, proc.stdout
+
+
+def test_mxnet_sweep():
+    proc = _launch("mxnet_sweep_worker.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("MX_SWEEP_OK") == 2, proc.stdout
 
 
 @pytest.mark.tier2

@@ -26,6 +26,8 @@ TIER2_COVERAGE = {
         "tests/test_binding_matrix.py::test_torch_binding_matrix",
     "test_tf_sweep":
         "tests/test_tf_binding.py::test_tf_ingraph_collectives",
+    "test_error_matrix":
+        "tests/test_binding_matrix.py::test_torch_binding_matrix",
     "test_keras_sweep":
         "tests/test_keras_binding.py::test_keras_multiproc",
     "test_tensorflow2_mnist_example":
@@ -42,6 +44,9 @@ TIER2_COVERAGE = {
         "tests/test_adasum_hierarchical.py::test_adasum_native_multiproc",
     "test_torch_estimator_fit_np2":
         "tests/test_spark_estimators.py::test_torch_estimator_fit_predict",
+    "test_torch_estimator_vector_columns_np2":
+        "tests/test_spark_convert.py::"
+        "test_torch_estimator_trains_on_vector_columns",
     "test_mxnet_multiproc":
         "tests/test_mxnet_binding.py::test_allreduce_inplace_and_prescale",
     "test_tf_multiproc":
@@ -67,6 +72,12 @@ TIER2_COVERAGE = {
         "tests/test_elastic.py::test_elastic_failure_recovery",
     "test_elastic_tensorflow2_example":
         "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_elastic_pytorch_synthetic_benchmark":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_elastic_tensorflow2_synthetic_benchmark":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_keras_spark_rossmann_example":
+        "tests/test_examples.py::test_spark_keras_example",
     "test_lightning_estimator_fit_np2":
         "tests/test_spark_estimators.py::test_lightning_estimator_fit_predict",
     "test_scaling_harness_runs_fresh":

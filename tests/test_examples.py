@@ -212,6 +212,97 @@ def test_ray_elastic_example():
                      ["--min-np", "1", "--max-np", "2"])
 
 
+def test_pytorch_ray_elastic_example():
+    """Torch x ray x elastic crossover (reference:
+    examples/ray/pytorch_ray_elastic.py); the example itself asserts
+    cross-rank weight identity."""
+    _run_ray_example("examples/ray/pytorch_ray_elastic.py",
+                     ["--min-np", "1", "--max-np", "2"])
+
+
+def test_pytorch_lightning_example():
+    """LightningModule-protocol training loop (reference:
+    examples/pytorch/pytorch_lightning_mnist.py)."""
+    proc = _run_example(
+        "examples/pytorch/pytorch_lightning_mnist.py", 2,
+        ["--epochs", "1", "--steps-per-epoch", "3",
+         "--batch-size", "16"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "val_acc" in proc.stdout
+    assert "saved checkpoint" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_elastic_pytorch_synthetic_benchmark():
+    """Elastic x perf crossover, torch flavor (reference:
+    examples/elastic/pytorch/pytorch_synthetic_benchmark_elastic.py)."""
+    proc = _run_example(
+        "examples/elastic/pytorch/"
+        "pytorch_synthetic_benchmark_elastic.py", 2,
+        ["--model", "none", "--batch-size", "4", "--image-size", "64",
+         "--num-iters", "2", "--num-batches-per-commit", "2"],
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "img/sec per worker" in proc.stdout
+    assert "done" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_elastic_tensorflow2_synthetic_benchmark():
+    """Elastic x perf crossover, TF2 flavor (reference:
+    examples/elastic/tensorflow2/
+    tensorflow2_synthetic_benchmark_elastic.py)."""
+    proc = _run_example(
+        "examples/elastic/tensorflow2/"
+        "tensorflow2_synthetic_benchmark_elastic.py", 2,
+        ["--batch-size", "4", "--image-size", "32",
+         "--num-iters", "2", "--num-batches-per-commit", "2"],
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "img/sec per worker" in proc.stdout
+    assert "done" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_keras_spark_rossmann_example(tmp_path):
+    """The feature-engineering estimator recipe (reference:
+    examples/spark/keras/keras_spark_rossmann_estimator.py): one-hot
+    array columns ride the columnar Parquet path, predictions come
+    back in sales space."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    sub = str(tmp_path / "submission.csv")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples/spark/"
+                             "keras_spark_rossmann_estimator.py"),
+         "--num-proc", "2", "--epochs", "2", "--rows", "256",
+         "--submission", sub],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "test RMSPE" in proc.stdout
+    assert os.path.exists(sub)
+
+
+def test_mxnet_imagenet_example_gates_cleanly():
+    """mxnet is not installable here (VERDICT row 44: env-blocked);
+    the ImageNet example must gate with the documented message, not a
+    traceback. The binding itself is exercised via tests/mxnet_stub.py
+    (test_mxnet_binding, mxnet_sweep_worker)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples/mxnet/"
+                             "mxnet_imagenet_resnet50.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "needs mxnet installed" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
 @pytest.mark.tier2
 def test_ray_tensorflow2_example():
     _run_ray_example("examples/ray/tensorflow2_mnist_ray.py",

@@ -207,6 +207,11 @@ def test_reference_parity_flags():
                        "x"]).log_with_timestamp is False
     assert parse_args(["--no-log-hide-timestamp", "-np", "1",
                        "x"]).log_with_timestamp is True
+    # Single-dash short forms from the reference CLI
+    # (launch.py:299,485): -p for --ssh-port, -hostfile.
+    assert parse_args(["-p", "2222", "-np", "1", "x"]).ssh_port == 2222
+    assert parse_args(["-hostfile", "/tmp/hf", "-np", "1",
+                       "x"]).hostfile == "/tmp/hf"
 
 
 def test_check_build_prints_matrix():

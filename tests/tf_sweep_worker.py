@@ -150,6 +150,21 @@ def collectives_inside_tf_function(r, n):
     total = float(sum(range(1, n + 1)))
     np.testing.assert_allclose(tf.cast(rs, tf.float64).numpy(), total)
 
+    # Grouped host path under tf.function: a uint8 member forces the
+    # whole group off the in-graph router.
+    @tf.function
+    def grouped_host(a, b):
+        return hvd.grouped_allreduce([a, b], op=hvd.Sum,
+                                     name="tfs.fn.group")
+
+    ga, gb = grouped_host(
+        tf.fill([3], float(r + 1)),
+        tf.fill([2], tf.cast(r + 1, tf.uint8)))
+    total = float(sum(range(1, n + 1)))
+    np.testing.assert_allclose(ga.numpy(), total)
+    assert gb.dtype == tf.uint8
+    np.testing.assert_array_equal(gb.numpy(), total)
+
     @tf.function
     def a2a_host(v, s):
         return hvd.alltoall(v, splits=s, name="tfs.fn.a2a")

@@ -124,6 +124,11 @@ def infer_metadata(pdf) -> Dict[str, Dict[str, Any]]:
                         "column %r: ragged array cells %s vs %s (fixed "
                         "shapes required, reference util.py shape "
                         "agreement)" % (col, shape, arr.shape))
+                else:
+                    # Cells may mix widths (int defaults + float
+                    # features): promote losslessly instead of
+                    # silently casting to the first cell's dtype.
+                    dtype = str(np.result_type(dtype, arr.dtype))
             else:
                 kinds.add("scalar")
                 dtype = dtype or str(np.asarray(v).dtype)
@@ -157,17 +162,21 @@ def _to_arrow(pdf, meta):
                            ("values", pa.list_(pa.float64()))])
             arr = pa.array(
                 [{"size": int(v.size),
-                  "indices": np.asarray(v.indices,
-                                        dtype=np.int64).tolist(),
-                  "values": np.asarray(v.values,
-                                       dtype=np.float64).tolist()}
+                  "indices": np.asarray(v.indices, dtype=np.int64),
+                  "values": np.asarray(v.values, dtype=np.float64)}
                  for v in cells], type=t)
         elif m["kind"] == "array":
+            # numpy cells go to Arrow without per-element Python
+            # boxing: one flat values buffer + row offsets.
             npdtype = np.dtype(m["dtype"])
-            flat = [np.asarray(v, dtype=npdtype).ravel().tolist()
-                    for v in cells]
-            arr = pa.array(flat, type=pa.list_(
-                pa.from_numpy_dtype(npdtype)))
+            width = int(np.prod(m["shape"])) if m["shape"] else 1
+            flat = (np.stack([np.asarray(v, dtype=npdtype).ravel()
+                              for v in cells]).ravel()
+                    if cells else np.empty(0, npdtype))
+            offsets = np.arange(0, (len(cells) + 1) * width, width,
+                                dtype=np.int32)
+            arr = pa.ListArray.from_arrays(pa.array(offsets),
+                                           pa.array(flat))
         else:
             arr = pa.array(cells)
         arrays.append(arr)

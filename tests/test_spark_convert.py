@@ -76,6 +76,19 @@ def test_infer_metadata_rejects_ragged_and_mixed():
                    SparseVector(3, [0], [1.0])]}))
 
 
+def test_mixed_dtype_array_cells_promote(tmp_path):
+    """Array cells mixing int and float dtypes promote losslessly
+    instead of truncating to the first cell's dtype."""
+    pdf = pd.DataFrame({"a": [np.array([1, 2]),
+                              np.array([0.5, 0.7])]})
+    meta = infer_metadata(pdf)
+    assert np.dtype(meta["a"]["dtype"]) == np.float64
+    path = str(tmp_path / "ds")
+    write_columnar(pdf, path)
+    back = read_shard_rowgroups(path, rank=0, size=1)
+    np.testing.assert_allclose(back["a"][1], [0.5, 0.7])
+
+
 def test_parquet_round_trip(tmp_path):
     """Write -> real Parquet on disk -> read -> identical cells."""
     import pyarrow.parquet as pq

@@ -1,0 +1,109 @@
+"""Elastic Keras MNIST — parity with the reference's
+examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py: the
+``model.fit`` training loop made elastic with KerasState and the
+fit-position callbacks (UpdateEpochState / UpdateBatchState /
+CommitState), LR re-scaled to the new world size on every reset.
+
+Run:  python -m horovod_tpu.runner --min-np 2 --max-np 4 \\
+          --host-discovery-script ./discover.sh \\
+          python examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py
+"""
+
+import argparse
+import os
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+import horovod_tpu.elastic as elastic
+from horovod_tpu.keras.elastic import (
+    CommitStateCallback,
+    KerasState,
+    UpdateBatchStateCallback,
+    UpdateEpochStateCallback,
+)
+
+
+def synthetic_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=n).astype(np.int64)
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps-per-epoch", type=int, default=10)
+    p.add_argument("--lr", type=float, default=0.001)
+    args = p.parse_args()
+
+    hvd.init()
+
+    x, y = synthetic_mnist(args.batch_size * args.steps_per_epoch,
+                           seed=hvd.rank())
+    dataset = (tf.data.Dataset.from_tensor_slices((x, y))
+               .repeat().shuffle(1000).batch(args.batch_size))
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(28, 28, 1)),
+        tf.keras.layers.Conv2D(8, [3, 3], activation="relu"),
+        tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(32, activation="relu"),
+        tf.keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    scaled_lr = args.lr * hvd.size()
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.Adam(scaled_lr))
+    model.compile(loss="sparse_categorical_crossentropy",
+                  optimizer=opt, metrics=["accuracy"])
+
+    # One throwaway step materializes the optimizer slots so the state
+    # snapshot below covers them (reference:
+    # tensorflow2_keras_mnist_elastic.py pre-fit).
+    model.fit(dataset, steps_per_epoch=1, epochs=1, verbose=0)
+
+    state = KerasState(model, batch=0, epoch=0)
+
+    def on_state_reset():
+        # Re-scale LR to the new world size and re-join the optimizer
+        # with any new ranks via a sync step.
+        model.optimizer.learning_rate.assign(args.lr * hvd.size())
+        model.fit(dataset, steps_per_epoch=1, epochs=1, verbose=0)
+
+    state.register_reset_callbacks([on_state_reset])
+
+    callbacks = [
+        UpdateEpochStateCallback(state),
+        UpdateBatchStateCallback(state),
+        CommitStateCallback(state, batches_per_commit=5),
+    ]
+
+    @elastic.run
+    def train(state):
+        # Resume: finish the committed partial epoch first (only its
+        # remaining batches — see UpdateBatchStateCallback), THEN run
+        # the outstanding epochs at full length. A single fit with a
+        # shortened steps_per_epoch would under-train every later
+        # epoch, not just the resumed one.
+        if state.batch:
+            model.fit(dataset,
+                      steps_per_epoch=args.steps_per_epoch - state.batch,
+                      epochs=1, callbacks=callbacks, verbose=0)
+        if state.epoch < args.epochs:
+            model.fit(dataset, steps_per_epoch=args.steps_per_epoch,
+                      epochs=args.epochs - state.epoch,
+                      callbacks=callbacks, verbose=0)
+
+    train(state)
+    if hvd.rank() == 0:
+        print("elastic keras training complete (size=%d)" % hvd.size())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""Rossmann on Spark 3 with accelerator-aware scheduling — parity with
+the reference's examples/spark/keras/keras_spark3_rossmann.py. The
+Spark-3 delta over keras_spark_rossmann_run.py is stage-level resource
+scheduling: each barrier task discovers the accelerator Spark assigned
+it via ``TaskContext.resources()`` and pins itself to that device
+before training (the reference pins a GPU; here the TPU/JAX device).
+Everything else — driver-side feature engineering, columnar Parquet,
+row-group-sharded ranks, DistributedOptimizer fit — is shared with the
+run() recipe.
+
+With pyspark >= 3 installed, launch with e.g.
+``--conf spark.task.resource.tpu.amount=1`` and the task-side pinning
+picks up the assignment; without pyspark the local fallback pins by
+local rank, which is the same policy the launcher uses.
+
+Run: python examples/spark/keras_spark3_rossmann.py
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from keras_spark_rossmann_estimator import (  # noqa: E402
+    engineer_features,
+    exp_rmspe,
+    synth_rossmann,
+)
+from keras_spark_rossmann_run import (  # noqa: E402
+    FEATURE_COLS,
+    N_FEATURES,
+)
+
+
+def pin_accelerator():
+    """Pin this rank to the accelerator Spark (or the launcher)
+    assigned it.
+
+    Under Spark 3, ``TaskContext.resources()`` carries the stage-level
+    resource assignment (reference: keras_spark3_rossmann.py's
+    ``get_available_devices`` reading ``resources()['gpu']``). Outside
+    Spark, fall back to local-rank pinning — one visible device per
+    local rank, the launcher's policy.
+
+    Pinning rides the visible-devices env vars the runtimes honor
+    (libtpu: TPU_VISIBLE_DEVICES, CUDA stacks: CUDA_VISIBLE_DEVICES) —
+    they must be set before the accelerator backend initializes, which
+    is why this runs first in train_fn, before hvd.init() or any
+    TF/JAX device use.
+    """
+    addresses = None
+    try:
+        from pyspark import TaskContext
+
+        ctx = TaskContext.get()
+        if ctx is not None:
+            res = ctx.resources()
+            for key in ("tpu", "gpu"):
+                if key in res:
+                    addresses = list(res[key].addresses)
+                    break
+    except ImportError:
+        pass
+    device = (addresses[0] if addresses
+              else os.environ.get("HOROVOD_LOCAL_RANK", "0"))
+    os.environ["TPU_VISIBLE_DEVICES"] = device
+    os.environ["CUDA_VISIBLE_DEVICES"] = device
+    return device
+
+
+def train_fn(data_path, epochs, batch_size, feature_cols, n_features):
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.keras as hvd
+    from horovod_tpu.spark.common.convert import build_feature_matrix
+    from horovod_tpu.spark.common.estimator import read_shard_rowgroups
+
+    device = pin_accelerator()
+    hvd.init()
+
+    pdf = read_shard_rowgroups(data_path, hvd.rank(), hvd.size())
+    x = build_feature_matrix(pdf, feature_cols)
+    y = pdf["log_sales"].to_numpy(np.float32)
+
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(n_features,)),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(1),
+    ])
+    # Architecture snapshot BEFORE compile (a compiled model's to_json
+    # embeds the distributed optimizer wrapper).
+    arch_json = model.to_json()
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.Adam(1e-3 * hvd.size()))
+    model.compile(optimizer=opt, loss="mse")
+    hist = model.fit(
+        x, y, batch_size=batch_size, epochs=epochs, verbose=0,
+        validation_split=0.125,
+        callbacks=[hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                   hvd.callbacks.MetricAverageCallback()])
+
+    return {"device": device,
+            "val_loss": [float(v) for v in hist.history["val_loss"]],
+            "model_json": arch_json if hvd.rank() == 0 else None,
+            "weights": model.get_weights() if hvd.rank() == 0 else None}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-proc", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--rows", type=int, default=2048)
+    p.add_argument("--work-dir", default=None)
+    args = p.parse_args()
+
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="rossmann3_")
+    data_path = os.path.join(work_dir, "train_df.parquet")
+
+    df = engineer_features(synth_rossmann(args.rows))
+    from horovod_tpu.spark.common.convert import write_columnar
+
+    write_columnar(df, data_path,
+                   row_group_rows=max(args.rows // 8, 1))
+
+    fn_args = (data_path, args.epochs, args.batch_size,
+               FEATURE_COLS, N_FEATURES)
+    try:
+        import pyspark  # noqa: F401
+
+        from horovod_tpu import spark as hvd_spark
+
+        results = hvd_spark.run(train_fn, args=fn_args,
+                                num_proc=args.num_proc)
+    except ImportError:
+        from horovod_tpu import runner as hvd_runner
+
+        results = hvd_runner.run(train_fn, args=fn_args,
+                                 np=args.num_proc)
+
+    print("devices: %s" % [r["device"] for r in results])
+    print("val_loss (rank 0, averaged): %s"
+          % [round(v, 4) for v in results[0]["val_loss"]])
+
+    import tensorflow as tf
+
+    model = tf.keras.models.model_from_json(results[0]["model_json"])
+    model.set_weights(results[0]["weights"])
+
+    from horovod_tpu.spark.common.convert import build_feature_matrix
+
+    test = engineer_features(synth_rossmann(256, seed=1))
+    pred_log = model.predict(
+        build_feature_matrix(test, FEATURE_COLS), verbose=0).ravel()
+    print("test RMSPE (sales space): %.4f"
+          % exp_rmspe(test["log_sales"], pred_log))
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Synthetic benchmark for the Keras binding: images/sec through
+``model.fit`` with the wrapped DistributedOptimizer (reference
+workload: examples/tensorflow2/tensorflow2_keras_synthetic_benchmark.py
+— the fit-loop counterpart of tensorflow2_synthetic_benchmark.py's
+GradientTape loop).
+
+``--model resnet50`` benches the real application model;
+the default small conv stack keeps the example runnable anywhere.
+
+Run: bin/hvdrun -np 2 python \\
+         examples/tensorflow2/tensorflow2_keras_synthetic_benchmark.py
+"""
+
+import argparse
+import os
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+
+
+def make_model(name, image_size):
+    if name == "resnet50":
+        return tf.keras.applications.ResNet50(weights=None)
+    return tf.keras.Sequential([
+        tf.keras.Input(shape=(image_size, image_size, 3)),
+        tf.keras.layers.Conv2D(64, 7, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.Conv2D(128, 3, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(1000),
+    ])
+
+
+class TimingCallback(tf.keras.callbacks.Callback):
+    """Per-epoch images/sec, skipping the compile-heavy first epoch
+    (the reference benchmarks post-warmup fit epochs)."""
+
+    def __init__(self, images_per_epoch):
+        super().__init__()
+        self.images_per_epoch = images_per_epoch
+        self.img_secs = []
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.t0 = time.time()
+
+    def on_epoch_end(self, epoch, logs=None):
+        dt = time.time() - self.t0
+        if epoch == 0:  # warmup: tracing + autotune
+            return
+        self.img_secs.append(self.images_per_epoch / dt)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="small",
+                   choices=["small", "resnet50"])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=96)
+    p.add_argument("--batches-per-epoch", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=3,
+                   help="Timed epochs after the warmup epoch.")
+    args = p.parse_args()
+
+    hvd.init()
+    if args.model == "resnet50":
+        args.image_size = 224
+
+    model = make_model(args.model, args.image_size)
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=args.model == "small"),
+        optimizer=opt)
+
+    rng = np.random.RandomState(hvd.rank())
+    n = args.batch_size * args.batches_per_epoch
+    data = rng.rand(n, args.image_size, args.image_size, 3) \
+        .astype(np.float32)
+    target = rng.randint(0, 1000, size=n)
+
+    timing = TimingCallback(images_per_epoch=n)
+    callbacks = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                 timing]
+
+    model.fit(data, target, batch_size=args.batch_size,
+              epochs=1 + args.num_iters, callbacks=callbacks, verbose=0)
+
+    if hvd.rank() == 0:
+        mean = np.mean(timing.img_secs)
+        print("Model: %s, batch size: %d" % (args.model, args.batch_size))
+        print("Img/sec per worker: %.1f +- %.1f"
+              % (mean, 1.96 * np.std(timing.img_secs)))
+        print("Total img/sec on %d worker(s): %.1f"
+              % (hvd.size(), hvd.size() * mean))
+
+
+if __name__ == "__main__":
+    main()

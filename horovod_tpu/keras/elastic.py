@@ -54,21 +54,34 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
     """Track the in-epoch batch position in the state
     (reference: _keras/elastic.py:41-62).
 
+    ``state.batch`` counts batches COMPLETED in the current epoch.
+    Keras numbers batches from 0 within every ``fit``, so on a
+    mid-epoch resume the committed position becomes an offset for the
+    resumed fit's local numbering — repeated resets accumulate
+    correctly instead of resetting the count each time.
+
     The reference additionally shortened the first post-restore epoch
     by mutating ``self.params['steps']``; under Keras 3 the fit loop
     ignores that mutation (verified empirically), so resuming mid-epoch
-    is done explicitly instead: pass
-    ``steps_per_epoch=total_steps - state.batch`` to the resumed
-    ``fit()`` call."""
+    is done explicitly instead: run the partial epoch as
+    ``fit(steps_per_epoch=total_steps - state.batch, epochs=1)``, then
+    the remaining epochs at full length."""
 
     def __init__(self, state):
         super().__init__()
         self.state = state
+        self.offset = 0
+
+    def on_train_begin(self, logs=None):
+        # Resuming mid-epoch: this fit's batch 0 is really batch
+        # ``state.batch`` of the interrupted epoch.
+        self.offset = self.state.batch
 
     def on_batch_end(self, batch, logs=None):
-        self.state.batch = batch
+        self.state.batch = self.offset + batch + 1
 
     def on_epoch_end(self, epoch, logs=None):
+        self.offset = 0
         self.state.batch = 0
 
 

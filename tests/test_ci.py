@@ -78,6 +78,19 @@ TIER2_COVERAGE = {
         "tests/test_elastic.py::test_elastic_failure_recovery",
     "test_keras_spark_rossmann_example":
         "tests/test_examples.py::test_spark_keras_example",
+    "test_keras_spark_rossmann_run_example":
+        "tests/test_examples.py::test_spark_keras_example",
+    "test_keras_spark3_rossmann_example":
+        "tests/test_examples.py::test_spark_keras_example",
+    "test_lightning_spark_mnist_example":
+        "tests/test_spark_estimators.py::"
+        "test_lightning_estimator_fit_predict",
+    "test_elastic_pytorch_imagenet_example":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_elastic_keras_mnist_example":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_tensorflow2_keras_synthetic_benchmark_example":
+        "tests/test_keras_binding.py::test_keras_multiproc",
     "test_lightning_estimator_fit_np2":
         "tests/test_spark_estimators.py::test_lightning_estimator_fit_predict",
     "test_scaling_harness_runs_fresh":

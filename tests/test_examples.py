@@ -45,7 +45,7 @@ def test_keras_mnist_example():
     assert proc.stdout.count("done") == 2
 
 
-def _run_spark_example(rel, num_proc, epochs):
+def _run_spark_example(rel, num_proc, epochs, extra_args=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # Direct script run (no -m horovod_tpu.runner): put the repo on the
@@ -54,7 +54,8 @@ def _run_spark_example(rel, num_proc, epochs):
         [_REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
     return subprocess.run(
         [sys.executable, os.path.join(_REPO, rel),
-         "--num-proc", str(num_proc), "--epochs", str(epochs)],
+         "--num-proc", str(num_proc), "--epochs", str(epochs)]
+        + list(extra_args),
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=420)
 
 
@@ -308,3 +309,87 @@ def test_ray_tensorflow2_example():
     _run_ray_example("examples/ray/tensorflow2_mnist_ray.py",
                      ["--num-workers", "2", "--epochs", "1",
                       "--steps", "2"])
+
+
+@pytest.mark.tier2
+def test_elastic_pytorch_imagenet_example(tmp_path):
+    """Elastic x full-recipe crossover (reference:
+    examples/elastic/pytorch/pytorch_imagenet_resnet50_elastic.py):
+    commit loop + LR schedule + allreduced validation + checkpoint."""
+    proc = _run_example(
+        "examples/elastic/pytorch/pytorch_imagenet_resnet50_elastic.py",
+        2,
+        ["--synthetic", "--epochs", "1", "--steps-per-epoch", "4",
+         "--batch-size", "2", "--image-size", "32",
+         "--checkpoint-format",
+         str(tmp_path / "checkpoint-{epoch}.pth.tar")],
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "elastic imagenet training complete" in proc.stdout
+    assert "val_loss" in proc.stdout
+    assert (tmp_path / "checkpoint-0.pth.tar").exists()
+
+
+@pytest.mark.tier2
+def test_elastic_keras_mnist_example():
+    """Keras fit x elastic state callbacks (reference:
+    examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py)."""
+    proc = _run_example(
+        "examples/elastic/tensorflow2/"
+        "tensorflow2_keras_mnist_elastic.py", 2,
+        ["--epochs", "2", "--steps-per-epoch", "4",
+         "--batch-size", "16"], timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "elastic keras training complete" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_tensorflow2_keras_synthetic_benchmark_example():
+    """fit-loop perf benchmark (reference:
+    examples/tensorflow2/tensorflow2_keras_synthetic_benchmark.py)."""
+    proc = _run_example(
+        "examples/tensorflow2/"
+        "tensorflow2_keras_synthetic_benchmark.py", 2,
+        ["--batch-size", "4", "--image-size", "32",
+         "--batches-per-epoch", "2", "--num-iters", "2"],
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Img/sec per worker" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_lightning_spark_mnist_example():
+    """LightningEstimator recipe (reference:
+    examples/spark/pytorch/pytorch_lightning_spark_mnist.py)."""
+    proc = _run_spark_example(
+        "examples/spark/pytorch_lightning_spark_mnist.py", 2, 2,
+        extra_args=["--rows", "64"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "loss history:" in proc.stdout
+    assert "predict shape: (4, 10)" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_keras_spark_rossmann_run_example():
+    """spark.run()-style hand-rolled Rossmann recipe over the columnar
+    Parquet path (reference:
+    examples/spark/keras/keras_spark_rossmann_run.py)."""
+    proc = _run_spark_example(
+        "examples/spark/keras_spark_rossmann_run.py", 2, 2,
+        extra_args=["--rows", "256"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "train RMSPE (allreduced):" in proc.stdout
+    assert "test RMSPE (sales space):" in proc.stdout
+
+
+@pytest.mark.tier2
+def test_keras_spark3_rossmann_example():
+    """Spark-3 resource-aware variant: task-side accelerator pinning +
+    MetricAverageCallback val averaging (reference:
+    examples/spark/keras/keras_spark3_rossmann.py)."""
+    proc = _run_spark_example(
+        "examples/spark/keras_spark3_rossmann.py", 2, 2,
+        extra_args=["--rows", "256"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "devices: ['0', '1']" in proc.stdout
+    assert "test RMSPE (sales space):" in proc.stdout

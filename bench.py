@@ -182,13 +182,20 @@ def _bench_resnet(args, platform, device_kind):
     }
 
 
-def _bench_transformer(args, platform, device_kind, long_context=False):
+def _bench_transformer(args, platform, device_kind, long_context=False,
+                       big=False):
     """Flagship decoder-only transformer causal-LM step, tokens/sec.
 
     ``long_context=True`` benches the long-sequence configuration
     (seq 2048, Pallas flash attention — measured 1.5x the XLA dense
     path at this length on v5e; at seq 512 dense wins, so each length
     uses its best kernel).
+
+    ``big=True`` benches a GPT-2-small-scale decoder (d_model 768,
+    12 layers, 12 heads, ~124M params, seq 1024): the larger matmuls
+    keep the MXU busier than the 17M-param flagship, so this is the
+    configuration that shows the framework's MFU ceiling rather than
+    dispatch overhead.
 
     MFU uses the standard analytic count: 6 * n_params FLOPs per token
     for the parameter matmuls (fwd + bwd) plus the 12 * L * S * d_model
@@ -212,7 +219,15 @@ def _bench_transformer(args, platform, device_kind, long_context=False):
         (2, 1, 1) if tiny else (args.iters, args.warmup,
                                 args.steps_per_call))
     metric_name = "transformer_tokens_per_sec_per_chip"
-    if long_context:
+    if big:
+        metric_name = "transformer_big_tokens_per_sec_per_chip"
+        if not tiny:
+            cfg = dataclasses.replace(
+                cfg, vocab_size=32000, d_model=768, n_heads=12,
+                n_layers=12, d_ff=3072, max_seq_len=1024)
+            batch, seq = 8, 1024
+            iters, steps_per_call = max(iters // 2, 4), 10
+    elif long_context:
         metric_name = "transformer_long_tokens_per_sec_per_chip"
         if tiny:
             cfg = dataclasses.replace(cfg, attention="flash")
@@ -306,6 +321,9 @@ def run_child(args) -> int:
         elif workload == "transformer_long":
             entries.append(_bench_transformer(args, platform, device_kind,
                                               long_context=True))
+        elif workload == "transformer_big":
+            entries.append(_bench_transformer(args, platform, device_kind,
+                                              big=True))
         else:
             wl_args = argparse.Namespace(**vars(args))
             wl_args.model = workload
@@ -433,7 +451,9 @@ def main():
     p.add_argument("--workloads", default=None,
                    help="Comma list of benchmark workloads, run in order; "
                         "first is the headline metric. "
-                        "resnet18/34/50/101/152, transformer, or transformer_long "
+                        "resnet18/34/50/101/152, transformer, "
+                        "transformer_big (GPT-2-small scale, ~124M params), "
+                        "or transformer_long "
                         "(seq 2048, flash attention). Default: "
                         "'resnet50,transformer', or just --model when "
                         "that legacy flag names a different resnet.")

@@ -7,7 +7,8 @@ round-end ``bench.py`` run fell back to CPU and the framework's MFU
 field was never populated on silicon. This script decouples the
 silicon datapoint from the round-end instant: run it on a timer during
 the round; whenever the relay happens to be up it captures a full TPU
-benchmark (resnet50 + transformer + transformer_long) and stashes the
+benchmark (resnet50 + transformer + transformer_big at GPT-2-small
+scale to show the MFU ceiling + transformer_long) and stashes the
 JSON in ``BENCH_opportunistic.json`` at the repo root, where the judge
 can find it regardless of the relay's state at round end.
 
@@ -59,24 +60,27 @@ def _existing_tpu_result():
     return prev
 
 
-def capture(timeout_s=2100):
+def capture(timeout_s=2700):
     """Run bench.py --backend tpu and stash a genuine-TPU result.
 
     ``timeout_s`` must exceed bench.py's own worst-case schedule
-    (2 x 600s TPU child tries + 30s backoff + 300s CPU fallback
-    ~= 1530s, plus up to ~36s x 2 probes per relay IP when firewalled
+    (2 x 900s TPU child tries + 30s backoff + 300s CPU fallback
+    ~= 2130s, plus up to ~36s x 2 probes per relay IP when firewalled
     ports make the pre-flight connects hang): bench.py kills its
     children's process groups on its internal timeouts, but if *we*
     kill bench.py mid-flight its detached --child grandchild survives
-    and keeps the chip claimed.
+    and keeps the chip claimed. The child budget is 900s (not the
+    600s default) because the four-workload sweep compiles a
+    12-layer model on a host that may be running CI concurrently.
     """
     env = dict(os.environ,
                HVD_BENCH_TPU_RETRIES="2",
                HVD_BENCH_TPU_BACKOFF="30",
-               HVD_BENCH_TIMEOUT="600")
+               HVD_BENCH_TIMEOUT="900")
     cmd = [sys.executable, os.path.join(REPO, "bench.py"),
            "--backend", "tpu",
-           "--workloads", "resnet50,transformer,transformer_long"]
+           "--workloads",
+           "resnet50,transformer,transformer_big,transformer_long"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout_s, env=env, cwd=REPO)

@@ -51,7 +51,8 @@ def synthetic_batch(batch_size, seed, image_size):
             torch.from_numpy(rng.randint(0, 1000, size=batch_size)))
 
 
-def imagefolder_batches(train_dir, batch_size, epoch, skip_batches):
+def imagefolder_batches(data_dir, batch_size, epoch, skip_batches,
+                        train=True):
     """Distributed ImageFolder pipeline, fast-forwarded past the
     batches the elastic state already committed this epoch."""
     from torch.utils import data
@@ -59,10 +60,10 @@ def imagefolder_batches(train_dir, batch_size, epoch, skip_batches):
 
     import horovod_tpu.torch as hvd
 
+    crop = ([transforms.RandomResizedCrop(224)] if train else
+            [transforms.Resize(256), transforms.CenterCrop(224)])
     ds = datasets.ImageFolder(
-        train_dir,
-        transforms.Compose([
-            transforms.RandomResizedCrop(224), transforms.ToTensor()]))
+        data_dir, transforms.Compose(crop + [transforms.ToTensor()]))
     sampler = data.distributed.DistributedSampler(
         ds, num_replicas=hvd.size(), rank=hvd.rank())
     sampler.set_epoch(epoch)
@@ -89,6 +90,12 @@ def adjust_lr(optimizer, base_lr, epoch, warmup_epochs=5):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--train-dir", default=os.environ.get("IMAGENET_DIR"))
+    p.add_argument("--val-dir", default=os.environ.get("IMAGENET_VAL_DIR"),
+                   help="ImageFolder for validation; defaults to the "
+                        "'val' sibling of --train-dir when that exists, "
+                        "else the train split itself")
+    p.add_argument("--val-batches", type=int, default=8,
+                   help="Per-rank validation batches per epoch")
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--epochs", type=int, default=90)
     p.add_argument("--steps-per-epoch", type=int, default=8)
@@ -118,19 +125,40 @@ def main():
 
     state.register_reset_callbacks([on_state_reset])
 
+    val_dir = args.val_dir
+    if val_dir is None and args.train_dir:
+        sibling = os.path.join(
+            os.path.dirname(args.train_dir.rstrip("/")), "val")
+        val_dir = sibling if os.path.isdir(sibling) else args.train_dir
+
     def validate(epoch):
         # Allreduced validation metrics (reference: Metric class +
-        # validate()): every rank contributes, averages agree.
+        # validate()): every rank contributes, averages agree. Real-data
+        # mode evaluates on the real val split (center-crop pipeline);
+        # only --synthetic uses generated batches.
+        import itertools
+
         model.eval()
+        losses, accs = [], []
         with torch.no_grad():
-            x, y = synthetic_batch(args.batch_size, seed=9_000_000 + epoch,
-                                   image_size=args.image_size)
-            logits = model(x)
-            loss = F.cross_entropy(logits, y)
-            acc = (logits.argmax(1) == y).float().mean()
-        loss = hvd.allreduce(loss, name="val.loss")
-        acc = hvd.allreduce(acc, name="val.accuracy")
+            if args.synthetic or not val_dir:
+                batches = [synthetic_batch(
+                    args.batch_size, seed=9_000_000 + epoch,
+                    image_size=args.image_size)]
+            else:
+                batches = itertools.islice(
+                    imagefolder_batches(val_dir, args.batch_size, epoch,
+                                        0, train=False),
+                    args.val_batches)
+            for x, y in batches:
+                logits = model(x)
+                losses.append(F.cross_entropy(logits, y))
+                accs.append((logits.argmax(1) == y).float().mean())
         model.train()
+        if not losses:  # e.g. --val-batches 0: validation disabled
+            return float("nan"), float("nan")
+        loss = hvd.allreduce(torch.stack(losses).mean(), name="val.loss")
+        acc = hvd.allreduce(torch.stack(accs).mean(), name="val.accuracy")
         return float(loss), float(acc)
 
     def epoch_batches(epoch, start_batch):

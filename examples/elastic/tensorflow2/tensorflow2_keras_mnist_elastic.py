@@ -90,8 +90,16 @@ def main():
         # remaining batches — see UpdateBatchStateCallback), THEN run
         # the outstanding epochs at full length. A single fit with a
         # shortened steps_per_epoch would under-train every later
-        # epoch, not just the resumed one.
-        if state.batch:
+        # epoch, not just the resumed one. A commit can land exactly at
+        # the epoch boundary (batch == steps_per_epoch before the
+        # epoch-end callbacks zero it and bump the epoch): that epoch's
+        # updates are all applied, so count it done rather than crash
+        # on fit(steps_per_epoch=0) or silently replay it.
+        if state.batch >= args.steps_per_epoch:
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+        elif state.batch:
             model.fit(dataset,
                       steps_per_epoch=args.steps_per_epoch - state.batch,
                       epochs=1, callbacks=callbacks, verbose=0)

@@ -64,8 +64,11 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
     by mutating ``self.params['steps']``; under Keras 3 the fit loop
     ignores that mutation (verified empirically), so resuming mid-epoch
     is done explicitly instead: run the partial epoch as
-    ``fit(steps_per_epoch=total_steps - state.batch, epochs=1)``, then
-    the remaining epochs at full length."""
+    ``fit(steps_per_epoch=total_steps - state.batch, epochs=1)`` —
+    guarded by ``0 < state.batch < total_steps``, because a commit
+    landing exactly on the epoch boundary leaves ``batch ==
+    total_steps`` and ``fit(steps_per_epoch=0)`` raises — then the
+    remaining epochs at full length."""
 
     def __init__(self, state):
         super().__init__()

@@ -64,8 +64,10 @@ def imagefolder_batches(data_dir, batch_size, epoch, skip_batches,
             [transforms.Resize(256), transforms.CenterCrop(224)])
     ds = datasets.ImageFolder(
         data_dir, transforms.Compose(crop + [transforms.ToTensor()]))
+    # Validation keeps a fixed order so a truncated --val-batches loop
+    # scores the same subset every epoch (comparable metrics).
     sampler = data.distributed.DistributedSampler(
-        ds, num_replicas=hvd.size(), rank=hvd.rank())
+        ds, num_replicas=hvd.size(), rank=hvd.rank(), shuffle=train)
     sampler.set_epoch(epoch)
     loader = data.DataLoader(ds, batch_size=batch_size, sampler=sampler)
     for i, batch in enumerate(loader):
@@ -141,7 +143,9 @@ def main():
         model.eval()
         losses, accs = [], []
         with torch.no_grad():
-            if args.synthetic or not val_dir:
+            if args.val_batches <= 0:  # validation disabled
+                batches = []
+            elif args.synthetic or not val_dir:
                 batches = [synthetic_batch(
                     args.batch_size, seed=9_000_000 + epoch,
                     image_size=args.image_size)]

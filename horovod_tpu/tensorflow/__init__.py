@@ -334,6 +334,18 @@ def reducescatter(tensor, op=Sum, name=None,
 
 
 def join():
+    """Uneven-data Join (reference: horovod/common/operations.cc Join
+    accounting). Host-plane only: the TF collective runtime's group
+    membership is static, so once a rank joined, the remaining ranks'
+    in-graph collectives would wait on it forever. Fail fast with the
+    remedy instead of deadlocking the job."""
+    if _use_ingraph(global_process_set):
+        raise RuntimeError(
+            "hvd.join() requires the host-bridged eager plane: the TF "
+            "collective runtime has static group membership, so a "
+            "joined rank would deadlock the remaining ranks' in-graph "
+            "collectives. Launch with HOROVOD_TF_HOST_BRIDGE=1 to use "
+            "join() with uneven data.")
     return eager.join()
 
 

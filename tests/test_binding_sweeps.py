@@ -44,6 +44,18 @@ def test_tf_sweep():
 
 
 @pytest.mark.tier2
+def test_tf_sweep2_host_bridge():
+    # Third wave rides the host-bridged eager plane on purpose: it is
+    # the plane with joined-rank accounting (the join cell) and the
+    # full wire dtype set; in-graph coverage lives in test_tf_sweep.
+    proc = _launch("tf_sweep2_worker.py",
+                   extra_env={"HOROVOD_TF_HOST_BRIDGE": "1"},
+                   timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("TF_SWEEP2_OK") == 2, proc.stdout
+
+
+@pytest.mark.tier2
 def test_keras_sweep():
     with tempfile.TemporaryDirectory() as tmp:
         proc = _launch("keras_sweep_worker.py",

@@ -1,0 +1,161 @@
+"""np=2 TF-binding sweep, third wave: the host-bridged eager plane.
+
+Runs with ``HOROVOD_TF_HOST_BRIDGE=1`` — every collective rides the
+native core (the plane with joined-rank accounting and the full wire
+dtype set), complementing the in-graph coverage in tf_sweep_worker.py.
+
+Reference pattern: test/parallel/test_tensorflow.py —
+prescale/postscale factor cases, Join with uneven data,
+broadcast_object/allgather_object, and the compression + sparse
+variants of DistributedGradientTape / DistributedOptimizer. Exact
+expected values in every cell.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def prescale_postscale(r, n):
+    """Factors apply around the reduction: sum_r(pre * x_r) * post
+    (reference: test_horovod_allreduce_prescale/postscale)."""
+    base = np.array([1.0, 2.0, 3.0], np.float64)
+    scale_sum = float(sum(range(1, n + 1)))
+
+    x32 = tf.constant((base * (r + 1)).astype(np.float32))
+    out = hvd.allreduce(x32, op=hvd.Sum, name="tf3.pre.f32",
+                        prescale_factor=0.5, postscale_factor=4.0)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(
+        out.numpy(), base * scale_sum * 0.5 * 4.0, rtol=1e-6)
+
+    # Average with a prescale on the narrow fp16 wire.
+    x16 = tf.constant((base * (r + 1)).astype(np.float16))
+    out = hvd.allreduce(x16, op=hvd.Average, name="tf3.pre.f16",
+                        prescale_factor=2.0)
+    assert out.dtype == tf.float16
+    np.testing.assert_allclose(
+        out.numpy().astype(np.float64),
+        base * (scale_sum / n) * 2.0, rtol=1e-2)
+
+
+def join_uneven_data(r, n):
+    """Joined ranks contribute zeros; join() returns the last rank to
+    join (reference: controller.cc Join accounting; the torch twin is
+    tests/torch_worker.py join_through_binding)."""
+    if r == 0:
+        out = hvd.allreduce(tf.ones([3]), op=hvd.Sum, name="tf3.join.ar")
+        np.testing.assert_allclose(out.numpy(), np.ones(3))
+    last = hvd.join()
+    assert last == 1, last
+
+
+def object_collectives_and_barrier(r, n):
+    """Pickled-object collectives through the TF namespace (reference:
+    broadcast_object/allgather_object in horovod/tensorflow)."""
+    obj = {"rank": r, "arr": np.arange(3) * (r + 1), "nested": ("x", r)}
+    got = hvd.broadcast_object(obj, root_rank=1, name="tf3.bobj")
+    assert got["rank"] == 1 and got["nested"] == ("x", 1), got
+    np.testing.assert_array_equal(got["arr"], np.arange(3) * 2)
+
+    gathered = hvd.allgather_object(("payload", r), name="tf3.agobj")
+    assert gathered == [("payload", k) for k in range(n)], gathered
+
+    hvd.barrier()
+
+
+def indexed_slices_densify(r, n):
+    """Off the in-graph plane, IndexedSlices allreduce densifies (the
+    reference's sparse_as_dense fallback): result equals the dense
+    scatter of every rank's slices."""
+    sl = tf.IndexedSlices(values=tf.fill([1, 4], float(r + 1)),
+                          indices=tf.constant([r], tf.int64),
+                          dense_shape=tf.constant([n, 4], tf.int64))
+    out = hvd.allreduce(sl, op=hvd.Sum, name="tf3.slices")
+    expect = np.zeros((n, 4), np.float32)
+    for k in range(n):
+        expect[k] = k + 1
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def tape_compression(r, n):
+    """DistributedGradientTape with fp16 wire compression still
+    averages exactly (values representable in fp16)."""
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as t:
+        loss = tf.reduce_sum(v * float(r + 1))
+    tape = hvd.DistributedGradientTape(
+        t, compression=hvd.Compression.fp16)
+    (g,) = tape.gradient(loss, [v])
+    # Rank k's grad is (k+1) * ones; mean over ranks 1..n.
+    expect = float(sum(range(1, n + 1))) / n
+    np.testing.assert_allclose(g.numpy(), [expect, expect], rtol=1e-3)
+
+
+def optimizer_sparse_as_dense(r, n):
+    """DistributedOptimizer(sparse_as_dense=True) densifies embedding
+    gradients before the grouped reduce; the applied update equals the
+    cross-rank mean of the dense gradients."""
+    emb = tf.Variable(np.zeros((4, 2), np.float32))
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        sparse_as_dense=True, compression=hvd.Compression.fp16)
+    with tf.GradientTape() as t:
+        rows = tf.gather(emb, [r])  # rank-specific row -> IndexedSlices
+        loss = tf.reduce_sum(rows) * float(r + 1)
+    grads = t.gradient(loss, [emb])
+    assert isinstance(grads[0], tf.IndexedSlices), type(grads[0])
+    opt.apply_gradients(zip(grads, [emb]))
+    # Dense grad on rank k: row k = (k+1), rest 0. Averaged over n
+    # ranks, SGD lr=1 -> emb row k = -(k+1)/n.
+    expect = np.zeros((4, 2), np.float32)
+    for k in range(n):
+        expect[k] = -(k + 1) / n
+    np.testing.assert_allclose(emb.numpy(), expect, rtol=1e-3)
+
+
+def sparse_allgather_path_disabled(r, n):
+    """Without the in-graph runtime the sparse allgather path cannot
+    carry symbolic tensors, so Sum/Average are the only legal slice
+    ops and anything else raises (reference: IndexedSlices branch op
+    restriction)."""
+    sl = tf.IndexedSlices(values=tf.ones([1, 2]),
+                          indices=tf.constant([0], tf.int64),
+                          dense_shape=tf.constant([2, 2], tf.int64))
+    try:
+        hvd.allreduce(sl, op=hvd.Min, name="tf3.slices.min")
+    except NotImplementedError:
+        pass
+    else:
+        raise AssertionError("IndexedSlices Min allreduce must raise")
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+    from horovod_tpu.tensorflow import ingraph
+    assert not ingraph.collective_runtime_ready()  # host bridge active
+
+    prescale_postscale(r, n)
+    object_collectives_and_barrier(r, n)
+    indexed_slices_densify(r, n)
+    tape_compression(r, n)
+    optimizer_sparse_as_dense(r, n)
+    sparse_allgather_path_disabled(r, n)
+    join_uneven_data(r, n)  # last: join ends this rank's data flow
+
+    hvd.shutdown()
+    print("TF_SWEEP2_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -232,11 +232,24 @@ def allgather_shape_matrix(r, n):
         gi.numpy(), np.repeat(np.arange(1, n + 1), 2))
 
 
+def join_requires_host_plane(r, n):
+    """join() must refuse to run on the in-graph plane (static TF
+    collective groups would deadlock the non-joined ranks) and point
+    at HOROVOD_TF_HOST_BRIDGE=1 instead."""
+    try:
+        hvd.join()
+    except RuntimeError as e:
+        assert "HOROVOD_TF_HOST_BRIDGE" in str(e), e
+    else:
+        raise AssertionError("join() on the in-graph plane must raise")
+
+
 def main():
     hvd.init()
     r, n = hvd.rank(), hvd.size()
     assert n == 2
 
+    join_requires_host_plane(r, n)
     product_and_narrow_dtypes(r, n)
     uneven_alltoall_and_reducescatter(r, n)
     grouped_f16_and_scalars(r, n)

@@ -13,26 +13,26 @@ cd "$(dirname "$0")/.."
 
 TIER="${1:-all}"
 
-# Tier-1 wall budget: the final r4 suite (253 tests; binding matrix,
-# per-tensor timeline structure, new example smokes) measured 690.75s
-# on this 1-core host. 1050s keeps ~34% headroom for loaded CI
-# machines — the r2 margin (636s vs 720s) proved too thin.
+# Tier-1 wall budget: the r5 suite (288 tests; adds runner-selection,
+# per-binding sweep launchers, fake contracts, spark convert) measured
+# 876.79s on this quiet 1-core host (r4: 253 tests, 690.75s). 1200s
+# keeps ~37% headroom for loaded CI machines — the r2 margin (636s vs
+# 720s) proved too thin.
 run_tier1() {
     echo "=== tier 1 (default suite) ==="
-    timeout "${HVD_CI_TIER1_BUDGET:-1050}" \
+    timeout "${HVD_CI_TIER1_BUDGET:-1200}" \
         python -m pytest tests/ -q -p no:cacheprovider
 }
 
-# Tier-2 wall budget: the r3 value (720s) was breached on a cold XLA
-# cache (rc=124, judged round 3). Re-measured r4 on this (1-core) host
-# after `rm -rf /tmp/hvd_tpu_jax_cache` each time (np=4/np=8 workers
-# compile fresh XLA programs). Final r4 set (26 tier-2 tests), two
-# consecutive cold runs on a quiet host: 762.00s then 756.67s — both
-# green; 1020s gives ~25% headroom over the worst cold run. (Interim
-# r4 measurements: 19 tests 530.78s; 23 tests 634.98s/643.78s.)
+# Tier-2 wall budget: re-measured whenever the tier grows (the r3
+# budget breach on a cold cache taught that lesson; r4 re-measured 26
+# tests at 756-762s cold). The r5 tier is 40 tests (new example
+# smokes, per-binding sweeps, elastic crossovers); a cold-cache run
+# (`rm -rf /tmp/hvd_tpu_jax_cache`, quiet 1-core host) measured
+# 1401.27s. 1800s gives ~28% headroom over that worst cold run.
 run_tier2() {
     echo "=== tier 2 (heavyweight integration) ==="
-    timeout "${HVD_CI_TIER2_BUDGET:-1020}" \
+    timeout "${HVD_CI_TIER2_BUDGET:-1800}" \
         python -m pytest tests/ -q -p no:cacheprovider \
         --override-ini 'addopts=' -m tier2
 }

@@ -1,19 +1,18 @@
-"""Object broadcast/allgather for the MXNet binding
-(reference: horovod/mxnet/functions.py:27-100)."""
+"""Object broadcast/allgather for the MXNet binding.
+
+The reference's ``horovod/mxnet/functions.py:27-100`` needs its own
+implementation because its wire tensors must be MXNet NDArrays for the
+MPI/NCCL ops to carry them. Here the eager data plane is
+framework-neutral (numpy), so the pickle → size-exchange → payload
+protocol lives once in ``horovod_tpu/common/objects.py`` and every
+binding exposes it from its own namespace; this module is that
+API-location shim for ``horovod_tpu.mxnet``. The np=2 ragged-size and
+cross-rank cells in ``tests/mxnet_sweep_worker.py`` exercise the
+shared protocol through this surface.
+"""
 
 from __future__ import annotations
 
-from horovod_tpu.common.process_sets import global_process_set
-
-
-def broadcast_object(obj, root_rank=0, name=None,
-                     process_set=global_process_set):
-    from horovod_tpu.jax.functions import broadcast_object as _bo
-
-    return _bo(obj, root_rank, name=name, process_set=process_set)
-
-
-def allgather_object(obj, name=None, process_set=global_process_set):
-    from horovod_tpu.jax.functions import allgather_object as _ao
-
-    return _ao(obj, name=name, process_set=process_set)
+from horovod_tpu.common.objects import (  # noqa: F401
+    allgather_object, broadcast_object,
+)

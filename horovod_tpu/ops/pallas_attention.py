@@ -27,6 +27,7 @@ interpret mode, so the same code path is exercised everywhere.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -375,7 +376,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     scale: Optional[float] = None,
                     interpret: Optional[bool] = None):
     """Fused streaming-softmax attention.
@@ -385,7 +387,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
         ``horovod_tpu.models.transformer``).
       causal: apply a causal (lower-triangular) mask.
       block_q / block_k: VMEM tile sizes (clamped and made to divide the
-        padded sequence length).
+        padded sequence length). Defaults 256/512 (best of the v5e
+        sweep at seq 2048, ci/flash_block_sweep.py); overridable
+        per-job via HVD_FLASH_BLOCK_Q / HVD_FLASH_BLOCK_K for tuning
+        on other chip generations without a code change.
       scale: score scaling; defaults to 1/sqrt(head_dim).
       interpret: force Pallas interpret mode (defaults to True off-TPU).
 
@@ -398,6 +403,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     d = q.shape[-1]
     if scale is None:
         scale = float(d) ** -0.5
+    if block_q is None:
+        block_q = int(os.environ.get("HVD_FLASH_BLOCK_Q", "256"))
+    if block_k is None:
+        block_k = int(os.environ.get("HVD_FLASH_BLOCK_K", "512"))
     # Kernel layout is (B, H, S, D).
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

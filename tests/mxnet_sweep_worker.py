@@ -124,6 +124,17 @@ def compression_and_objects(r, n):
     obj = hvd.broadcast_object([1, 2, 3] if r == 0 else None, root_rank=0)
     assert obj == [1, 2, 3]
 
+    # Per-rank pickle sizes differ -> the payload allgather is ragged
+    # along dim 0 (reference: functions.py sizes-first protocol).
+    ragged = hvd.allgather_object("x" * (10 ** (r + 1)))
+    assert [len(s) for s in ragged] == [10 ** (k + 1) for k in range(n)]
+    # Non-root payload arg is ignored; root may broadcast from any rank.
+    big = hvd.broadcast_object(
+        {"arr": np.arange(5), "tag": "root1"} if r == 1 else "ignored",
+        root_rank=1)
+    assert big["tag"] == "root1"
+    np.testing.assert_array_equal(big["arr"], np.arange(5))
+
 
 def error_paths(r, n):
     """Cross-rank mismatches raise through the mxnet surface and the

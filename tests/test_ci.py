@@ -26,6 +26,8 @@ TIER2_COVERAGE = {
         "tests/test_binding_matrix.py::test_torch_binding_matrix",
     "test_tf_sweep":
         "tests/test_tf_binding.py::test_tf_ingraph_collectives",
+    "test_tf_sweep2_host_bridge":
+        "tests/test_tf_binding.py::test_tf_multiproc_host_bridge",
     "test_error_matrix":
         "tests/test_binding_matrix.py::test_torch_binding_matrix",
     "test_keras_sweep":

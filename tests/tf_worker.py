@@ -305,11 +305,17 @@ def error_propagation_tf(r, n):
 def join_tf(r, n):
     """Join through the TF surface (reference: uneven-data Join): the
     joined rank contributes zeros to the straggler's allreduce. The
-    partner allreduce is negotiation-path-only, so the full scenario
-    runs in the host-bridge spawn; the in-graph spawn still checks
-    join() agreement itself."""
+    full scenario runs in the host-bridge spawn; on the in-graph plane
+    join() fails fast instead (static TF collective groups cannot
+    account for a joined rank, so uneven data would deadlock — the
+    degenerate all-ranks-join case is just a barrier)."""
     if not _host_bridged():
-        assert hvd.join() == 1
+        try:
+            hvd.join()
+        except RuntimeError as e:
+            assert "HOROVOD_TF_HOST_BRIDGE" in str(e), e
+        else:
+            raise AssertionError("join() on the in-graph plane must raise")
         return
     if r == 0:
         out = hvd.allreduce(tf.ones([3]), name="tf.join", op=hvd.Sum)

@@ -13,6 +13,7 @@ import ctypes
 import itertools
 import os
 import threading
+from collections import defaultdict
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
@@ -370,7 +371,14 @@ class NativeBackend:
 
     def __init__(self, session: CoreSession):
         self._s = session
-        self._barrier_counter = itertools.count()
+        # Per-set barrier sequence numbers. A single per-rank counter
+        # desynchronizes: after a subset barrier, members sit one count
+        # ahead of non-members, so the next GLOBAL barrier (e.g. the one
+        # inside shutdown()) submits different names on different ranks
+        # and the name-keyed negotiation never completes. Barriers are
+        # collective per set, so counting per ps_id keeps every
+        # participant's sequence aligned.
+        self._barrier_counters = defaultdict(itertools.count)
 
     @staticmethod
     def _ps_id(process_set) -> int:
@@ -418,7 +426,9 @@ class NativeBackend:
         ps_id = self._ps_id(process_set)
         import horovod_tpu.ops.eager as eager_mod
 
-        name = eager_mod._auto_name("alltoall.native")
+        # Per-set counting (same desync hazard as the barrier sequence
+        # numbers above).
+        name = eager_mod._auto_name("alltoall.native", process_set)
         self._s.submit(OP_ALLTOALL, name, np.asarray(array), group=group,
                        index=0, ps_id=ps_id, splits=splits)
         fut = Future()
@@ -436,7 +446,8 @@ class NativeBackend:
     def barrier(self, process_set):
         group = _Group(1)
         ps_id = self._ps_id(process_set)
-        name = "__barrier__.%d" % next(self._barrier_counter)
+        name = "__barrier__.%d.%d" % (ps_id,
+                                      next(self._barrier_counters[ps_id]))
         self._s.submit(OP_BARRIER, name, np.zeros(0, np.uint8), group=group,
                        index=0, ps_id=ps_id)
         return group.future.result(timeout=300)

@@ -60,7 +60,7 @@ def _assign_inplace(tensor, arr: np.ndarray):
 def _allreduce_numpy(tensor, average, name, prescale_factor,
                      postscale_factor, process_set) -> np.ndarray:
     return np.asarray(eager.synchronize(eager.allreduce_async(
-        _to_numpy(tensor), name=name or eager._auto_name("mx.allreduce"),
+        _to_numpy(tensor), name=name or eager._auto_name("mx.allreduce", process_set),
         op=Average if average else Sum,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set)))
@@ -90,7 +90,7 @@ def _grouped_allreduce_numpy(tensors, average, name, prescale_factor,
                              postscale_factor, process_set):
     outs = eager.synchronize(eager.grouped_allreduce_async(
         [_to_numpy(t) for t in tensors],
-        name=name or eager._auto_name("mx.grouped_allreduce"),
+        name=name or eager._auto_name("mx.grouped_allreduce", process_set),
         op=Average if average else Sum,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set))
@@ -126,7 +126,7 @@ def allgather(tensor, name=None, priority=0,
     """(reference: mxnet/mpi_ops.py:245-284)"""
     del priority
     out = eager.synchronize(eager.allgather_async(
-        _to_numpy(tensor), name=name or eager._auto_name("mx.allgather"),
+        _to_numpy(tensor), name=name or eager._auto_name("mx.allgather", process_set),
         process_set=process_set))
     return _from_numpy(np.asarray(out), tensor)
 
@@ -137,7 +137,7 @@ def broadcast(tensor, root_rank, name=None, priority=0,
     del priority
     out = eager.synchronize(eager.broadcast_async(
         _to_numpy(tensor), root_rank,
-        name=name or eager._auto_name("mx.broadcast"),
+        name=name or eager._auto_name("mx.broadcast", process_set),
         process_set=process_set))
     return _from_numpy(np.asarray(out), tensor)
 
@@ -148,7 +148,7 @@ def broadcast_(tensor, root_rank, name=None, priority=0,
     del priority
     out = np.asarray(eager.synchronize(eager.broadcast_async(
         _to_numpy(tensor), root_rank,
-        name=name or eager._auto_name("mx.broadcast"),
+        name=name or eager._auto_name("mx.broadcast", process_set),
         process_set=process_set)))
     return _assign_inplace(tensor, out)
 
@@ -160,6 +160,6 @@ def alltoall(tensor, splits=None, name=None, priority=0,
     out, _rsplits = eager.synchronize(eager.alltoall_async(
         _to_numpy(tensor),
         None if splits is None else _to_numpy(splits),
-        name=name or eager._auto_name("mx.alltoall"),
+        name=name or eager._auto_name("mx.alltoall", process_set),
         process_set=process_set))
     return _from_numpy(np.asarray(out), tensor)

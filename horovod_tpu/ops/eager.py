@@ -39,13 +39,24 @@ _next_handle = itertools.count(1)
 _name_counters = {}
 
 
-def _auto_name(kind: str) -> str:
+def _auto_name(kind: str, process_set=None) -> str:
     # Matches the reference's 'allreduce.noname.<n>' naming scheme
-    # (horovod/torch/mpi_ops.py handle naming).
+    # (horovod/torch/mpi_ops.py handle naming) — but counted PER
+    # PROCESS SET: negotiation is keyed by name, and a single per-rank
+    # counter desynchronizes when only a subset runs unnamed ops (set
+    # members end up ahead of non-members, so the next unnamed GLOBAL
+    # op submits different names on different ranks and never
+    # negotiates — the same failure the per-set barrier sequence fix
+    # in core/session.py addresses). The global set keeps the exact
+    # legacy format.
+    ps_id = getattr(process_set, "process_set_id", 0) or 0
+    key = (kind, ps_id)
     with _handle_lock:
-        n = _name_counters.get(kind, 0)
-        _name_counters[kind] = n + 1
-    return "%s.noname.%d" % (kind, n + 1)
+        n = _name_counters.get(key, 0)
+        _name_counters[key] = n + 1
+    if ps_id == 0:
+        return "%s.noname.%d" % (kind, n + 1)
+    return "%s.noname.ps%d.%d" % (kind, ps_id, n + 1)
 
 
 def _register(future: Future) -> int:
@@ -190,7 +201,7 @@ def allreduce_async(tensor, *, name: Optional[str] = None, op: Optional[int] = N
                     process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
     op = _effective_op(op, average)
-    name = name or _auto_name("allreduce")
+    name = name or _auto_name("allreduce", process_set)
     fut = _backend().allreduce_async([tensor], [name], op, prescale_factor,
                                      postscale_factor, process_set)
     out = Future()
@@ -210,7 +221,7 @@ def grouped_allreduce_async(tensors: Sequence, *, name: Optional[str] = None,
                             process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
     op = _effective_op(op, None)
-    base = name or _auto_name("grouped_allreduce")
+    base = name or _auto_name("grouped_allreduce", process_set)
     names = ["%s.%d" % (base, i) for i in range(len(tensors))]
     fut = _backend().allreduce_async(list(tensors), names, op, prescale_factor,
                                      postscale_factor, process_set)
@@ -228,7 +239,7 @@ def grouped_allreduce(tensors, **kwargs):
 def allgather_async(tensor, *, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
-    name = name or _auto_name("allgather")
+    name = name or _auto_name("allgather", process_set)
     fut = _backend().allgather_async([tensor], [name], process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
@@ -243,7 +254,7 @@ def allgather(tensor, **kwargs):
 def broadcast_async(tensor, root_rank: int, *, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
-    name = name or _auto_name("broadcast")
+    name = name or _auto_name("broadcast", process_set)
     fut = _backend().broadcast_async([tensor], [name], root_rank, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
@@ -258,7 +269,7 @@ def broadcast(tensor, root_rank: int, **kwargs):
 def alltoall_async(tensor, splits=None, *, name: Optional[str] = None,
                    process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
-    name = name or _auto_name("alltoall")
+    name = name or _auto_name("alltoall", process_set)
     fut = _backend().alltoall_async(tensor, splits, process_set)
     out = Future()
     _chain(fut, out,
@@ -281,7 +292,7 @@ def reducescatter_async(tensor, *, name: Optional[str] = None,
         # identity path (reference: reducescatter supports Sum/Average).
         raise ValueError(
             "reducescatter supports Sum/Average, got op=%r" % (op,))
-    name = name or _auto_name("reducescatter")
+    name = name or _auto_name("reducescatter", process_set)
     fut = _backend().reducescatter_async([tensor], [name], op, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))

@@ -51,6 +51,69 @@ def main():
                         name="ps.bcast", process_set=mine)
     np.testing.assert_allclose(out, float(peer_vals[1]))
 
+    # --- remaining collective families, per set -------------------------
+    # Non-adjacent membership: set-local rank/size/included introspection.
+    duo = hvd.add_process_set(hvd.ProcessSet([0, 3]))
+    if r in (0, 3):
+        set_rank = 0 if r == 0 else 1
+        assert duo.included() and duo.rank() == set_rank
+        assert duo.size() == 2
+
+        # reducescatter: dim-0 shards across the SET, not the world.
+        full = np.tile(np.arange(4, dtype=np.float32)[:, None],
+                       (1, 3)) * (r + 1)
+        shard = hvd.reducescatter(full, op=hvd.Sum, name="duo.rs",
+                                  process_set=duo)
+        start = set_rank * 2
+        expect = (np.arange(4, dtype=np.float32)[start:start + 2, None]
+                  * np.ones((1, 3)) * (1 + 4))  # (r+1) summed: 1 + 4
+        np.testing.assert_allclose(shard, expect)
+
+        # alltoall with explicit ragged splits inside the set.
+        payload = np.arange(3, dtype=np.float32) + 10 * r
+        splits = np.array([1, 2] if set_rank == 0 else [2, 1], np.int32)
+        out, rsplits = hvd.alltoall(payload, splits=splits,
+                                    name="duo.a2a", process_set=duo)
+        if set_rank == 0:
+            np.testing.assert_allclose(out, [0.0, 30.0, 31.0])
+            np.testing.assert_array_equal(rsplits, [1, 2])
+        else:
+            np.testing.assert_allclose(out, [1.0, 2.0, 32.0])
+            np.testing.assert_array_equal(rsplits, [2, 1])
+
+        # Grouped allreduce rides the set too.
+        outs = hvd.grouped_allreduce(
+            [np.full(2, float(r + 1), np.float32),
+             np.full(3, float(r), np.float32)],
+            op=hvd.Sum, name="duo.group", process_set=duo)
+        np.testing.assert_allclose(outs[0], 5.0)   # 1 + 4
+        np.testing.assert_allclose(outs[1], 3.0)   # 0 + 3
+
+        # Object collectives honor the set boundary.
+        objs = hvd.allgather_object({"r": r}, name="duo.obj",
+                                    process_set=duo)
+        assert [o["r"] for o in objs] == [0, 3]
+
+        hvd.barrier(process_set=duo)
+
+        # UNNAMED set-local op: auto-names are counted per set, so
+        # this must not desync the unnamed-global-op sequence below
+        # (regression: per-rank auto-name counters made the next
+        # unnamed global op negotiate under different names on
+        # members vs non-members and hang).
+        out = hvd.allreduce(np.full(2, float(r), np.float32),
+                            op=hvd.Sum, process_set=duo)
+        np.testing.assert_allclose(out, 3.0)  # 0 + 3
+    else:
+        assert not duo.included()
+        try:
+            duo.rank()
+        except RuntimeError:
+            pass  # non-members have no set-local rank (contract)
+        else:
+            raise AssertionError("duo.rank() must raise off-set")
+    hvd.remove_process_set(duo)
+
     # Dynamic removal + re-add under a different membership.
     hvd.remove_process_set(evens)
     hvd.remove_process_set(odds)
@@ -61,6 +124,11 @@ def main():
                             process_set=trio)
         np.testing.assert_allclose(out, 3.0)
     hvd.remove_process_set(trio)
+
+    # Unnamed GLOBAL op after the members-only unnamed op above: all
+    # ranks must agree on its auto-name (see the duo cell).
+    out = hvd.allreduce(np.full(4, float(r), np.float32), op=hvd.Sum)
+    np.testing.assert_allclose(out, float(sum(range(n))))
 
     out = hvd.allreduce(np.full(4, 2.0, np.float32), name="glob.final",
                         op=hvd.Average)

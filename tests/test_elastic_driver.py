@@ -1,0 +1,161 @@
+"""ElasticDriver / discovery unit tests — no cluster, no workers.
+
+Reference pattern: test/single/test_elastic_driver.py (512 LoC): fake
+discovery scripts, blacklist semantics, assignment stability across
+world changes, timeout give-up. The end-to-end elastic growth/respawn
+cycles live in tests/test_elastic.py; this file pins the driver's
+pure logic.
+"""
+
+import argparse
+import os
+import stat
+
+import pytest
+
+from horovod_tpu.runner.discovery import HostDiscoveryScript, HostManager
+from horovod_tpu.runner.elastic_run import ElasticDriver
+
+
+def _script(tmp_path, body):
+    path = tmp_path / "discover.sh"
+    path.write_text("#!/bin/sh\n" + body + "\n")
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def _driver_args(**over):
+    base = dict(discovery_script="./d.sh", min_np=2, max_np=None, np=None,
+                command=["true"], start_timeout=2, reset_limit=None,
+                slots_per_host=1, elastic_timeout=None)
+    base.update(over)
+    ns = argparse.Namespace(**base)
+    # _tuning_env reads the full flag surface; reuse real parse defaults.
+    from horovod_tpu.runner.launch import parse_args
+
+    defaults = parse_args(["-np", "1", "true"])
+    for key, value in vars(defaults).items():
+        if not hasattr(ns, key):
+            setattr(ns, key, value)
+    return ns
+
+
+class _FakeDiscovery:
+    """Scripted discovery: each refresh pops the next host list."""
+
+    def __init__(self, *rounds):
+        self.rounds = list(rounds)
+
+    def find_available_hosts(self):
+        from horovod_tpu.runner.hosts import HostInfo
+
+        if not self.rounds:
+            return []
+        current = self.rounds[0]
+        if len(self.rounds) > 1:
+            self.rounds.pop(0)
+        return [HostInfo.from_string(h) for h in current]
+
+
+def test_discovery_script_parsing(tmp_path):
+    """hostname[:slots] lines; bare hostnames take default_slots
+    (reference: elastic/discovery.py HostDiscoveryScript)."""
+    script = _script(tmp_path, "echo h1:2; echo h2; echo; echo h3:1")
+    found = HostDiscoveryScript(script, default_slots=4).find_available_hosts()
+    assert [(h.hostname, h.slots) for h in found] == [
+        ("h1", 2), ("h2", 4), ("h3", 1)]
+
+
+def test_discovery_script_failure_returns_empty(tmp_path):
+    script = _script(tmp_path, "exit 3")
+    assert HostDiscoveryScript(script).find_available_hosts() == []
+    assert HostDiscoveryScript(
+        str(tmp_path / "missing.sh")).find_available_hosts() == []
+
+
+def test_host_manager_refresh_and_blacklist():
+    mgr = HostManager.__new__(HostManager)
+    mgr._discovery = _FakeDiscovery(["h1:2", "h2:1"], ["h1:2"])
+    mgr.current, mgr.blacklist = [], set()
+
+    assert mgr.refresh() is True  # first population is a change
+    assert mgr.available_slot_keys() == ["h1:0", "h1:1", "h2:0"]
+
+    mgr.blacklist_slot("h1:1")
+    assert mgr.available_slot_keys() == ["h1:0", "h2:0"]
+
+    assert mgr.refresh() is True  # h2 disappeared
+    assert mgr.available_slot_keys() == ["h1:0"]
+    # A vanished-then-returned host does not clear the blacklist.
+    mgr._discovery = _FakeDiscovery(["h1:2", "h2:1"])
+    assert mgr.refresh() is True
+    assert "h1:1" not in mgr.available_slot_keys()
+
+    # Empty discovery output is treated as a transient failure, not an
+    # all-hosts-gone event.
+    mgr._discovery = _FakeDiscovery()
+    assert mgr.refresh() is False
+    assert mgr.available_slot_keys() == ["h1:0", "h2:0"]
+
+
+def test_assignment_packing_and_stable_keys():
+    """Ranks pack in host order; every SlotInfo keeps its original slot
+    key as identity (reference: driver.py:233-275 stable ordering)."""
+    driver = ElasticDriver(_driver_args())
+    keyed = driver._compute_assignments(["h1:0", "h1:1", "h2:0"])
+    assert keyed["h1:0"].rank == 0
+    assert keyed["h1:1"].rank == 1
+    assert keyed["h2:0"].rank == 2
+    assert keyed["h2:0"].cross_rank == 1
+    assert keyed["h2:0"].local_rank == 0
+    assert all(a.size == 3 for a in keyed.values())
+
+    # h1:1 dies; the remaining keys re-pack but keep their identity.
+    keyed2 = driver._compute_assignments(["h1:0", "h2:0"])
+    assert set(keyed2) == {"h1:0", "h2:0"}
+    assert keyed2["h1:0"].rank == 0
+    assert keyed2["h2:0"].rank == 1
+    assert all(a.size == 2 for a in keyed2.values())
+
+
+def test_assignment_sparse_slot_keys():
+    """Surviving slot keys may be sparse (slot 1 alive, slot 0
+    blacklisted): local ranks re-pack densely, identity keys remain."""
+    driver = ElasticDriver(_driver_args())
+    keyed = driver._compute_assignments(["h1:1", "h2:0"])
+    assert keyed["h1:1"].rank == 0
+    assert keyed["h1:1"].local_rank == 0   # dense within the host
+    assert keyed["h2:0"].rank == 1
+
+
+def test_assignment_max_np_clamp():
+    driver = ElasticDriver(_driver_args(max_np=2))
+    keyed = driver._compute_assignments(["h1:0", "h1:1", "h2:0"])
+    assert len(keyed) == 2
+    assert sorted(a.rank for a in keyed.values()) == [0, 1]
+
+
+def test_driver_requires_discovery_script():
+    with pytest.raises(ValueError):
+        ElasticDriver(_driver_args(discovery_script=None))
+
+
+def test_reset_gives_up_below_min_np(tmp_path):
+    """_reset returns False once the start timeout passes with fewer
+    than min_np slots (reference: driver wait/timeout semantics)."""
+    script = _script(tmp_path, "echo h1:1")
+    driver = ElasticDriver(_driver_args(
+        discovery_script=script, min_np=2, start_timeout=1))
+    driver.host_manager.refresh()
+    assert driver._reset() is False
+
+
+def test_elastic_timeout_flag_beats_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_ELASTIC_TIMEOUT", "123")
+    assert ElasticDriver(
+        _driver_args(elastic_timeout=45)).elastic_timeout == 45
+    assert ElasticDriver(
+        _driver_args(elastic_timeout=None)).elastic_timeout == 123
+    monkeypatch.delenv("HOROVOD_ELASTIC_TIMEOUT")
+    assert ElasticDriver(
+        _driver_args(elastic_timeout=None)).elastic_timeout == 600

@@ -543,17 +543,43 @@ def main():
     if result is not None:
         if error:
             result["error"] = error
+        _attach_tpu_capture(result)
         print(json.dumps(result))
         return 0
 
-    print(json.dumps({
+    fallback = {
         "metric": "%s_images_per_sec_per_chip" % args.model,
         "value": 0.0,
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
         "error": "%s; cpu child failed: %s" % (error or "", diag),
-    }))
+    }
+    _attach_tpu_capture(fallback)
+    print(json.dumps(fallback))
     return 0
+
+
+def _attach_tpu_capture(result):
+    """Fold the opportunistic silicon capture into a non-TPU result.
+
+    The relay fronting the chip is intermittent (closed at the r3 and
+    r4 round ends); ci/opportunistic_bench.py stashes a genuine-TPU
+    run whenever the relay happens to be up mid-round. Embedding that
+    capture here means the round-end artifact carries the silicon
+    datapoint (with its capture time) even when the relay is down at
+    the instant this supervisor runs.
+    """
+    if result.get("platform") == "tpu":
+        return  # a real silicon result needs no embedded capture
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_opportunistic.json")
+    try:
+        with open(path) as f:
+            capture = json.load(f)
+    except (OSError, ValueError):
+        return
+    if isinstance(capture, dict) and capture.get("platform") == "tpu":
+        result["tpu_capture"] = capture
 
 
 if __name__ == "__main__":

@@ -13,7 +13,6 @@ import ctypes
 import itertools
 import os
 import threading
-from collections import defaultdict
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
@@ -371,14 +370,6 @@ class NativeBackend:
 
     def __init__(self, session: CoreSession):
         self._s = session
-        # Per-set barrier sequence numbers. A single per-rank counter
-        # desynchronizes: after a subset barrier, members sit one count
-        # ahead of non-members, so the next GLOBAL barrier (e.g. the one
-        # inside shutdown()) submits different names on different ranks
-        # and the name-keyed negotiation never completes. Barriers are
-        # collective per set, so counting per ps_id keeps every
-        # participant's sequence aligned.
-        self._barrier_counters = defaultdict(itertools.count)
 
     @staticmethod
     def _ps_id(process_set) -> int:
@@ -446,8 +437,13 @@ class NativeBackend:
     def barrier(self, process_set):
         group = _Group(1)
         ps_id = self._ps_id(process_set)
-        name = "__barrier__.%d.%d" % (ps_id,
-                                      next(self._barrier_counters[ps_id]))
+        import horovod_tpu.ops.eager as eager_mod
+
+        # Per-set sequence numbering via the shared auto-name counters
+        # (see _auto_name: a per-rank counter desynchronizes members
+        # from non-members after a subset barrier, and the next GLOBAL
+        # barrier — e.g. the one inside shutdown() — never negotiates).
+        name = eager_mod._auto_name("__barrier__", process_set)
         self._s.submit(OP_BARRIER, name, np.zeros(0, np.uint8), group=group,
                        index=0, ps_id=ps_id)
         return group.future.result(timeout=300)

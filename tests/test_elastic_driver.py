@@ -74,9 +74,7 @@ def test_discovery_script_failure_returns_empty(tmp_path):
 
 
 def test_host_manager_refresh_and_blacklist():
-    mgr = HostManager.__new__(HostManager)
-    mgr._discovery = _FakeDiscovery(["h1:2", "h2:1"], ["h1:2"])
-    mgr.current, mgr.blacklist = [], set()
+    mgr = HostManager(_FakeDiscovery(["h1:2", "h2:1"], ["h1:2"]))
 
     assert mgr.refresh() is True  # first population is a change
     assert mgr.available_slot_keys() == ["h1:0", "h1:1", "h2:0"]

@@ -156,6 +156,11 @@ def init(process_sets=None):
 
         knobs.apply_aliases()
         knobs.warn_rejected()
+        # Unnamed-collective sequence numbers are per-world: reset so
+        # elastic-reset survivors and fresh respawns start aligned.
+        from horovod_tpu.ops import eager
+
+        eager._reset_name_counters()
         _ctx.topology = _topology_from_env()
         if _ctx.topology.size > 1:
             from horovod_tpu.core import CoreSession

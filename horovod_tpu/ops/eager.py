@@ -39,6 +39,19 @@ _next_handle = itertools.count(1)
 _name_counters = {}
 
 
+def _reset_name_counters():
+    """Auto-name sequence state is per-WORLD, not per-process:
+    ``basics.init()`` calls this on every (re)init so survivors of an
+    elastic reset — whose counters advanced in the previous world,
+    including the barrier inside ``shutdown()`` — and freshly spawned
+    replacement workers agree on the next unnamed-op sequence number.
+    Without the reset, the first unnamed collective after a recovery
+    negotiates under different names on old vs new processes and
+    hangs."""
+    with _handle_lock:
+        _name_counters.clear()
+
+
 def _auto_name(kind: str, process_set=None) -> str:
     # Matches the reference's 'allreduce.noname.<n>' naming scheme
     # (horovod/torch/mpi_ops.py handle naming) — but counted PER

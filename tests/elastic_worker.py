@@ -24,6 +24,7 @@ TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "25"))
 LOG_DIR = os.environ["ELASTIC_LOG_DIR"]
 FAIL_RANK = os.environ.get("ELASTIC_FAIL_RANK")
 FAIL_STEP = int(os.environ.get("ELASTIC_FAIL_STEP", "-1"))
+FAIL_MODE = os.environ.get("ELASTIC_FAIL_MODE", "once")
 FAIL_MARKER = os.path.join(LOG_DIR, "fail_marker")
 # Step-anchored discovery trigger (the reference anchors its discovery
 # schedules on observed progress, not wall clock — elastic_common.py's
@@ -54,7 +55,12 @@ def main():
         while state.step < TOTAL_STEPS:
             if (FAIL_RANK is not None and hvd.rank() == int(FAIL_RANK)
                     and state.step == FAIL_STEP
-                    and not os.path.exists(FAIL_MARKER)):
+                    and (FAIL_MODE == "always"
+                         or not os.path.exists(FAIL_MARKER))):
+                # 'once' (default): the marker suppresses repeats, so
+                # recovery is tested. 'always': every respawn dies at
+                # the same step, driving the slot into the driver's
+                # blacklist / reset-limit handling.
                 open(FAIL_MARKER, "w").close()
                 os._exit(17)
             # One "training step": allreduce a step-dependent value; all
@@ -63,6 +69,12 @@ def main():
                 np.full(4, float(state.step), np.float32),
                 name="elastic.step", op=hvd.Average)
             np.testing.assert_allclose(out, float(state.step))
+            # UNNAMED collective: auto-name sequence numbers must stay
+            # aligned between elastic-reset survivors (whose counters
+            # advanced in the previous world) and fresh respawns
+            # (regression: counters are reset per-world at init).
+            ones = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum)
+            np.testing.assert_allclose(ones, float(hvd.size()))
             state.weights = state.weights + np.asarray(out)
             state.step += 1
             log(state.step)

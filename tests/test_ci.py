@@ -28,6 +28,12 @@ TIER2_COVERAGE = {
         "tests/test_tf_binding.py::test_tf_ingraph_collectives",
     "test_tf_sweep2_host_bridge":
         "tests/test_tf_binding.py::test_tf_multiproc_host_bridge",
+    "test_elastic_world_shrink":
+        "tests/test_elastic.py::test_elastic_world_growth",
+    "test_elastic_blacklist_persistent_failure":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
+    "test_elastic_reset_limit_exceeded":
+        "tests/test_elastic.py::test_elastic_failure_recovery",
     "test_error_matrix":
         "tests/test_binding_matrix.py::test_torch_binding_matrix",
     "test_keras_sweep":

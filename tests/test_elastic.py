@@ -42,7 +42,7 @@ def _read_logs(log_dir):
 
 
 def _run_elastic(tmp_path, discovery, min_np, max_np, extra_env=None,
-                 timeout=300):
+                 timeout=300, extra_args=()):
     log_dir = tmp_path / "logs"
     log_dir.mkdir(exist_ok=True)
     env = dict(os.environ)
@@ -56,7 +56,7 @@ def _run_elastic(tmp_path, discovery, min_np, max_np, extra_env=None,
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner",
          "--min-np", str(min_np), "--max-np", str(max_np),
-         "--host-discovery-script", discovery,
+         "--host-discovery-script", discovery, *extra_args,
          sys.executable, os.path.join(_REPO, "tests", "elastic_worker.py")],
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=timeout)
     return proc, _read_logs(log_dir)
@@ -113,3 +113,62 @@ def test_elastic_failure_recovery(tmp_path):
     # Failure actually happened (marker exists) and steps around 5 were
     # re-run after restore on some rank.
     assert os.path.exists(str(tmp_path / "logs" / "fail_marker"))
+
+
+@pytest.mark.tier2
+def test_elastic_world_shrink(tmp_path):
+    """Hosts shrink from 3 to 2 slots at step 5: the dropped slot's
+    worker exits cleanly when its key vanishes from the new
+    rendezvous, survivors re-rendezvous at size 2 and finish
+    (reference: elastic_common.py hosts-removed case)."""
+    trigger = str(tmp_path / "shrink_trigger")
+    discovery = _write_triggered_discovery(
+        tmp_path, "localhost:3", "localhost:2", trigger)
+    proc, records = _run_elastic(
+        tmp_path, discovery, min_np=2, max_np=3,
+        extra_env={"ELASTIC_TRIGGER_FILE": trigger,
+                   "ELASTIC_TRIGGER_STEP": "5"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sizes = {r["size"] for r in records}
+    assert sizes == {3, 2}, sizes
+    assert max(r["step"] for r in records) == 25
+    # After the shrink only ranks 0 and 1 run.
+    assert {r["rank"] for r in records if r["size"] == 2} == {0, 1}
+
+
+@pytest.mark.tier2
+def test_elastic_blacklist_persistent_failure(tmp_path):
+    """A slot that keeps dying at the same step gets blacklisted after
+    MAX_SLOT_FAILURES; the job completes on the remaining slots
+    (reference: elastic_common.py blacklisting case)."""
+    discovery = _write_discovery(tmp_path, [(0, "localhost:3")])
+    proc, records = _run_elastic(
+        tmp_path, discovery, min_np=2, max_np=3,
+        extra_env={"ELASTIC_FAIL_RANK": "2", "ELASTIC_FAIL_STEP": "5",
+                   "ELASTIC_FAIL_MODE": "always"},
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert max(r["step"] for r in records) == 25
+    # Ran at size 3 before the blacklist, finished at size 2 without
+    # the failing slot's rank.
+    sizes = {r["size"] for r in records}
+    assert sizes == {3, 2}, sizes
+    assert {r["rank"] for r in records if r["size"] == 2} == {0, 1}
+    assert proc.stderr.count("exited with code 17") >= 3
+
+
+@pytest.mark.tier2
+def test_elastic_reset_limit_exceeded(tmp_path):
+    """--reset-limit bounds recovery attempts: a persistently failing
+    world exhausts it and the job fails loudly instead of cycling
+    forever (reference: elastic_common.py reset_limit case)."""
+    discovery = _write_discovery(tmp_path, [(0, "localhost:2")])
+    proc, records = _run_elastic(
+        tmp_path, discovery, min_np=2, max_np=2,
+        extra_env={"ELASTIC_FAIL_RANK": "1", "ELASTIC_FAIL_STEP": "3",
+                   "ELASTIC_FAIL_MODE": "always"},
+        extra_args=("--reset-limit", "1"), timeout=420)
+    assert proc.returncode != 0
+    assert "reset limit" in proc.stderr, proc.stderr
+    # The job made progress before giving up but never finished.
+    assert records and max(r["step"] for r in records) < 25

@@ -26,8 +26,11 @@ from __future__ import annotations
 import numpy as np
 
 from horovod_tpu.common.basics import (  # noqa: F401
-    cross_rank, cross_size, is_initialized, local_rank, local_size,
-    rank, size,
+    ccl_built, check_extension, cross_rank, cross_size, cuda_built,
+    ddl_built, gloo_built, gloo_enabled, is_initialized, local_rank,
+    local_size, mpi_built, mpi_enabled, mpi_threads_supported,
+    nccl_built, rank, rocm_built, size, start_timeline, stop_timeline,
+    tpu_built,
 )
 from horovod_tpu.common.process_sets import global_process_set
 from horovod_tpu.tensorflow import (  # noqa: F401
@@ -45,6 +48,7 @@ from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: F401
     SyncBatchNormalization,
 )
 from horovod_tpu.keras import callbacks  # noqa: F401
+from horovod_tpu.keras import elastic  # noqa: F401
 
 
 def _distributed_optimizer_class(base, name=None, op=Average,

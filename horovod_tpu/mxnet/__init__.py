@@ -17,9 +17,11 @@ from horovod_tpu.common import (  # noqa: F401
     add_process_set, global_process_set, remove_process_set,
 )
 from horovod_tpu.common.basics import (  # noqa: F401
-    cross_rank, cross_size, init, is_homogeneous, is_initialized,
-    local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
-    rocm_built, shutdown, size, start_timeline, stop_timeline, tpu_built,
+    ccl_built, check_extension, cross_rank, cross_size, cuda_built,
+    ddl_built, gloo_built, gloo_enabled, init, is_homogeneous,
+    is_initialized, local_rank, local_size, mpi_built, mpi_enabled,
+    mpi_threads_supported, nccl_built, rank, rocm_built, shutdown,
+    size, start_timeline, stop_timeline, tpu_built,
 )
 from horovod_tpu.common.util import split_list
 from horovod_tpu.mxnet.compression import Compression  # noqa: F401

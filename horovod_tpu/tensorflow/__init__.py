@@ -26,8 +26,10 @@ from horovod_tpu.common import (  # noqa: F401
     add_process_set, global_process_set, remove_process_set,
 )
 from horovod_tpu.common.basics import (  # noqa: F401
-    cross_rank, cross_size, is_homogeneous, is_initialized,
-    local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
+    ccl_built, check_extension, cross_rank, cross_size, cuda_built,
+    ddl_built, gloo_built, gloo_enabled, is_homogeneous, is_initialized,
+    local_rank, local_size, mpi_built, mpi_enabled,
+    mpi_threads_supported, nccl_built, rank, rocm_built,
     size, start_timeline, stop_timeline, tpu_built,
 )
 from horovod_tpu.common import basics
@@ -386,6 +388,48 @@ def broadcast_variables(variables, root_rank=0,
         v.assign(np.asarray(out).reshape(v.shape))
 
 
+def broadcast_global_variables(root_rank=0):
+    """Broadcast every TF1-style global variable from ``root_rank``
+    (reference: horovod/tensorflow/__init__.py
+    broadcast_global_variables). Eager execution broadcasts the
+    ``tf.compat.v1.global_variables()`` collection in place; TF1 graph
+    sessions are outside this binding's support (the TF1 example
+    family is descoped — use ``broadcast_variables`` on an explicit
+    variable list from TF2 code)."""
+    if not tf.executing_eagerly():
+        raise RuntimeError(
+            "broadcast_global_variables() requires eager execution in "
+            "horovod_tpu (TF1 graph sessions are descoped); use "
+            "hvd.broadcast_variables(<variables>, root_rank) instead")
+    variables = tf.compat.v1.global_variables()
+    if not variables:
+        raise ValueError(
+            "no global variables registered; TF2 code should call "
+            "hvd.broadcast_variables(model.variables, root_rank)")
+    return broadcast_variables(variables, root_rank=root_rank)
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """Estimator/MonitoredSession hook that broadcasts global
+    variables once after session creation (reference:
+    horovod/tensorflow/__init__.py BroadcastGlobalVariablesHook).
+    Provided for API parity; running it requires a TF1 graph session,
+    which this binding descopes, so the hook raises at ``begin()``
+    with the TF2 replacement."""
+
+    def __init__(self, root_rank=0, device=""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.device = device
+
+    def begin(self):
+        raise RuntimeError(
+            "BroadcastGlobalVariablesHook needs a TF1 graph session, "
+            "which horovod_tpu descopes; broadcast with "
+            "hvd.broadcast_variables(model.variables, root_rank=%d) "
+            "after building the model instead" % self.root_rank)
+
+
 def broadcast_object(obj, root_rank=0, name=None,
                      process_set=global_process_set):
     from horovod_tpu.jax.functions import broadcast_object as _bo
@@ -550,3 +594,8 @@ def DistributedOptimizer(optimizer, op=Average, name=None,
     cls = type(base.__name__, (base,),
                {"apply_gradients": apply_gradients})
     return cls.from_config(optimizer.get_config())
+
+
+# Submodule access parity (reference: horovod/tensorflow exposes its
+# elastic module as an attribute).
+from horovod_tpu.tensorflow import elastic  # noqa: E402,F401

@@ -6,8 +6,10 @@ from horovod_tpu.common import (  # noqa: F401
     add_process_set, global_process_set, remove_process_set,
 )
 from horovod_tpu.common.basics import (  # noqa: F401
-    cross_rank, cross_size, init, is_homogeneous, is_initialized,
-    local_rank, local_size, mpi_built, mpi_enabled, nccl_built, rank,
+    ccl_built, check_extension, cross_rank, cross_size, cuda_built,
+    ddl_built, gloo_built, gloo_enabled, init, is_homogeneous,
+    is_initialized, local_rank, local_size, mpi_built, mpi_enabled,
+    mpi_threads_supported, nccl_built, rank, rocm_built,
     shutdown, size, start_timeline, stop_timeline, tpu_built,
 )
 from horovod_tpu.torch.compression import Compression  # noqa: F401
@@ -24,8 +26,14 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     alltoall, alltoall_async,
     barrier,
     broadcast, broadcast_, broadcast_async, broadcast_async_,
-    grouped_allreduce, grouped_allreduce_async,
+    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async,
+    grouped_allreduce_async_,
     join, poll, reducescatter, sparse_allreduce_async, synchronize,
 )
 from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
+
+# Submodule access parity: `hvd.elastic.TorchState` etc. work after
+# `import horovod_tpu.torch as hvd` (reference: horovod/torch exposes
+# its elastic package the same way).
+from horovod_tpu.torch import elastic  # noqa: E402,F401

@@ -80,14 +80,24 @@ def synchronize(handle: int) -> torch.Tensor:
         raise ValueError("Unknown handle %r" % handle)
     result = eager.synchronize(h.inner)
     if isinstance(h.template, (list, tuple)):  # grouped handle
-        return [_to_torch(a, t) for a, t in zip(result, h.template)]
+        outs = [_to_torch(a, t) for a, t in zip(result, h.template)]
+        if h.inplace_target is not None:
+            # no_grad: copy_ on a requires-grad leaf (e.g. an
+            # nn.Parameter reduced in place, the reference's common
+            # case) is otherwise an autograd error.
+            with torch.no_grad():
+                for target, out in zip(h.inplace_target, outs):
+                    target.copy_(out)
+            return list(h.inplace_target)
+        return outs
     if isinstance(result, tuple):  # alltoall
         out = _to_torch(result[0], h.template)
         splits = torch.from_numpy(np.asarray(result[1]).astype(np.int64))
         return out, splits
     out = _to_torch(result, h.template)
     if h.inplace_target is not None:
-        h.inplace_target.copy_(out)
+        with torch.no_grad():
+            h.inplace_target.copy_(out)
         return h.inplace_target
     return out
 
@@ -176,6 +186,28 @@ def grouped_allreduce(tensors, **kwargs):
     h = _handles.pop(hid)
     results = eager.synchronize(h.inner)
     return [_to_torch(r, t) for r, t in zip(results, h.template)]
+
+
+def grouped_allreduce_async_(tensors: Sequence[torch.Tensor], average=None,
+                             name=None, op=None,
+                             process_set=global_process_set) -> int:
+    """In-place grouped allreduce (reference: horovod/torch/mpi_ops.py
+    grouped_allreduce_async_): results copy back into the inputs at
+    synchronize time."""
+    op = eager._effective_op(op, average)
+    inner = eager.grouped_allreduce_async(
+        [_to_numpy(t) for t in tensors], name=name, op=op,
+        process_set=process_set)
+    targets = list(tensors)
+    return _register(_TorchHandle(inner, targets, inplace_target=targets))
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       process_set=global_process_set):
+    """(reference: horovod/torch/mpi_ops.py grouped_allreduce_)"""
+    return synchronize(grouped_allreduce_async_(
+        tensors, average=average, name=name, op=op,
+        process_set=process_set))
 
 
 def allgather_async(tensor, name=None,

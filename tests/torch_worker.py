@@ -169,6 +169,7 @@ def main():
                                ref.weight.detach().numpy(), atol=1e-5)
 
     dtype_op_matrix(r, n)
+    grouped_inplace(r, n)
     grouped_mixed_dtypes(r, n)
     collective_surfaces(r, n)
     async_handles(r, n)
@@ -381,6 +382,26 @@ def dtype_op_matrix(r, n):
             tol = 2e-2 if dt in (torch.bfloat16, torch.float16) else 1e-6
             np.testing.assert_allclose(
                 out.to(torch.float64).numpy(), expect, rtol=tol, atol=tol)
+
+
+def grouped_inplace(r, n):
+    """grouped_allreduce_ writes results back into the input tensors
+    (reference: torch/mpi_ops.py grouped_allreduce_/async_)."""
+    xs = [torch.full((3,), float(r + 1)), torch.full((2,), float(r * 2))]
+    outs = hvd.grouped_allreduce_(xs, op=hvd.Sum, name="ginp")
+    assert outs[0] is xs[0] and outs[1] is xs[1]  # same storage
+    np.testing.assert_allclose(xs[0].numpy(), 3.0)   # 1 + 2
+    np.testing.assert_allclose(xs[1].numpy(), 2.0)   # 0 + 2
+
+    # Requires-grad leaves (nn.Parameter) must reduce in place too —
+    # the reference's common case for parameter averaging.
+    p = torch.nn.Parameter(torch.full((3,), float(r + 1)))
+    (out,) = hvd.grouped_allreduce_([p], op=hvd.Average, name="ginp.p")
+    assert out is p
+    np.testing.assert_allclose(p.detach().numpy(), 1.5)
+    q = torch.nn.Parameter(torch.full((2,), float(r)))
+    hvd.allreduce_(q, op=hvd.Sum, name="ginp.q")
+    np.testing.assert_allclose(q.detach().numpy(), 1.0)
 
 
 def grouped_mixed_dtypes(r, n):

@@ -114,3 +114,13 @@ from horovod_tpu.parallel import (  # noqa: F401
     make_mesh,
     set_global_mesh,
 )
+
+
+def run(*args, **kwargs):
+    """Programmatic launcher at the package root (reference:
+    horovod/__init__.py re-exports horovod.runner.run). Imported
+    lazily: the runner pulls in cloudpickle/subprocess machinery that
+    plain training imports never need."""
+    from horovod_tpu.runner import run as _run
+
+    return _run(*args, **kwargs)

@@ -292,27 +292,26 @@ def gloo_built() -> bool:
     return core_built()
 
 
-def check_extension(ext_base_name: str = "horovod_tpu") -> None:
+def check_extension(ext_base_name: str = "horovod_tpu",
+                    *compat_args) -> None:
     """Fail fast when the native core cannot be used (reference:
     horovod/common/util.py check_extension, which raises ImportError
-    when the framework extension was not compiled in). The core here
-    builds lazily, so the check triggers that build: a fresh checkout
-    with a working toolchain passes (compiling if needed); only a
-    genuinely unbuildable core raises."""
+    when the framework extension was not compiled in; its extra
+    ``ext_env_var``/``pkg_path`` arguments are accepted and ignored so
+    reference call sites work verbatim). The core here builds lazily,
+    so the check triggers that build: a fresh checkout with a working
+    toolchain passes (compiling if needed); only a genuinely
+    unbuildable core raises."""
+    del compat_args
     try:
         from horovod_tpu.core.build import library_path
 
-        ok = library_path(build_if_missing=True) is not None
+        library_path(build_if_missing=True)
     except Exception as e:  # compiler/source failure surfaces as the error
         raise ImportError(
             "%s native core unavailable (build failed: %s); "
             "multi-process collectives cannot run" % (ext_base_name, e)
         ) from e
-    if not ok:
-        raise ImportError(
-            "%s native core unavailable: the C++ core could not be "
-            "built, so multi-process collectives cannot run"
-            % ext_base_name)
 
 
 def nccl_built() -> int:

@@ -195,10 +195,10 @@ def grouped_allreduce_async_(tensors: Sequence[torch.Tensor], average=None,
     grouped_allreduce_async_): results copy back into the inputs at
     synchronize time."""
     op = eager._effective_op(op, average)
+    targets = list(tensors)  # materialize once: generators exhaust
     inner = eager.grouped_allreduce_async(
-        [_to_numpy(t) for t in tensors], name=name, op=op,
+        [_to_numpy(t) for t in targets], name=name, op=op,
         process_set=process_set)
-    targets = list(tensors)
     return _register(_TorchHandle(inner, targets, inplace_target=targets))
 
 

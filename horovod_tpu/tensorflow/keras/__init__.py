@@ -1,0 +1,19 @@
+"""tf.keras binding namespace: ``import horovod_tpu.tensorflow.keras as hvd``.
+
+The reference ships two Keras surfaces over one shared implementation
+(reference: horovod/tensorflow/keras/__init__.py re-exporting
+horovod/_keras; horovod/keras/__init__.py likewise): the tf.keras
+flavor and the standalone-Keras flavor. On this image Keras 3 IS
+tf.keras's successor, so both namespaces here resolve to the same
+binding in ``horovod_tpu.keras``; this module exists so the
+reference's modern import idiom works verbatim after the package
+rename.
+"""
+
+from horovod_tpu.keras import *  # noqa: F401,F403
+from horovod_tpu.keras import (  # noqa: F401  (non-star surface)
+    DistributedOptimizer, callbacks, elastic, load_model,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    broadcast_global_variables,
+)

@@ -1,0 +1,12 @@
+"""tf.keras callbacks namespace (reference:
+horovod/tensorflow/keras/callbacks.py re-exporting horovod/_keras
+callbacks). Same implementation as ``horovod_tpu.keras.callbacks``."""
+
+from horovod_tpu.keras.callbacks import *  # noqa: F401,F403
+from horovod_tpu.keras.callbacks import (  # noqa: F401
+    BestModelCheckpoint,
+    BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)

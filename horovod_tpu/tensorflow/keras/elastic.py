@@ -1,0 +1,11 @@
+"""tf.keras elastic namespace (reference:
+horovod/tensorflow/keras/elastic.py). Same implementation as
+``horovod_tpu.keras.elastic``."""
+
+from horovod_tpu.keras.elastic import *  # noqa: F401,F403
+from horovod_tpu.keras.elastic import (  # noqa: F401
+    CommitStateCallback,
+    KerasState,
+    UpdateBatchStateCallback,
+    UpdateEpochStateCallback,
+)

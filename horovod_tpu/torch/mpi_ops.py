@@ -175,6 +175,7 @@ def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,
                             name=None, op=None,
                             process_set=global_process_set) -> int:
     op = eager._effective_op(op, average)
+    tensors = list(tensors)  # materialize once: generators exhaust
     inner = eager.grouped_allreduce_async(
         [_to_numpy(t) for t in tensors], name=name, op=op,
         process_set=process_set)

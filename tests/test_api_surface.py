@@ -39,6 +39,20 @@ SURFACE = {
     "horovod_tpu.mxnet": PREDICATES + [
         "broadcast_parameters", "allgather_object", "broadcast_object",
     ],
+    # The reference's modern idiom `import horovod.tensorflow.keras`
+    # resolves to the shared keras binding here (Keras 3 is tf.keras's
+    # successor on this image).
+    "horovod_tpu.tensorflow.keras": PREDICATES + [
+        "elastic", "callbacks", "DistributedOptimizer", "load_model",
+        "broadcast_global_variables",
+    ],
+    "horovod_tpu.tensorflow.keras.callbacks": [
+        "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+        "LearningRateWarmupCallback", "BestModelCheckpoint",
+    ],
+    "horovod_tpu.tensorflow.keras.elastic": [
+        "KerasState", "CommitStateCallback", "UpdateBatchStateCallback",
+    ],
 }
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional
 
+from horovod_tpu.ray.utils import BaseHorovodWorker  # noqa: F401
 from horovod_tpu.ray.elastic import (  # noqa: F401
     ElasticRayExecutor, RayHostDiscovery, StaticHostDiscovery,
 )

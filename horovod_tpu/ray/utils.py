@@ -15,29 +15,37 @@ def free_port() -> int:
     return _fp()
 
 
+class BaseHorovodWorker:
+    """Un-decorated worker actor body (reference:
+    horovod/ray/worker.py:8-40 BaseHorovodWorker): exported so users
+    can subclass/inspect the worker the executors spawn;
+    ``make_worker_cls`` applies ``ray.remote`` resource options to it."""
+
+    def __init__(self, env=None):
+        if env:
+            os.environ.update(env)
+
+    def hostname(self) -> str:
+        return socket.gethostname()
+
+    def node_id(self) -> str:
+        return self.hostname()
+
+    def pick_port(self) -> int:
+        return free_port()
+
+    def setup(self, env: Dict[str, str]) -> bool:
+        os.environ.update(env)
+        return True
+
+    def execute(self, fn, args=(), kwargs=None):
+        return fn(*args, **(kwargs or {}))
+
+
 def make_worker_cls(ray, num_cpus: int = 1, num_gpus: int = 0):
     """One actor class shared by RayExecutor and ElasticRayExecutor."""
-
-    @ray.remote(num_cpus=num_cpus, num_gpus=num_gpus)
-    class Worker:
-        def __init__(self, env=None):
-            if env:
-                os.environ.update(env)
-
-        def hostname(self) -> str:
-            return socket.gethostname()
-
-        def pick_port(self) -> int:
-            return free_port()
-
-        def setup(self, env: Dict[str, str]) -> bool:
-            os.environ.update(env)
-            return True
-
-        def execute(self, fn, args=(), kwargs=None):
-            return fn(*args, **(kwargs or {}))
-
-    return Worker
+    return ray.remote(num_cpus=num_cpus,
+                      num_gpus=num_gpus)(BaseHorovodWorker)
 
 
 def assign_topology(hostnames: List[str]) -> List[Dict[str, str]]:

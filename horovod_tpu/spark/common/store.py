@@ -158,6 +158,8 @@ class Store:
     def create(prefix_path: str, *args, **kwargs) -> "Store":
         if HDFSStore.matches(prefix_path):
             return HDFSStore(prefix_path, *args, **kwargs)
+        if DBFSLocalStore.matches_dbfs(prefix_path):
+            return DBFSLocalStore(prefix_path, *args, **kwargs)
         return FilesystemStore(prefix_path, *args, **kwargs)
 
 
@@ -404,3 +406,52 @@ class HDFSStore(Store):
                             recursive=True)
         self._fs.create_dir(self._strip(self.get_logs_path(run_id)),
                             recursive=True)
+
+
+# The reference's class split names the filesystem base
+# AbstractFilesystemStore (store.py:165); here the base and the
+# concrete store are one class, so the reference name is an alias.
+AbstractFilesystemStore = FilesystemStore
+
+
+def is_databricks() -> bool:
+    """(reference: spark/common/util.py:710-711)"""
+    return "DATABRICKS_RUNTIME_VERSION" in os.environ
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Store over Databricks DBFS local-file APIs (reference:
+    store.py:487-520): normalizes `dbfs:/...` and `file:///dbfs/...`
+    forms to `/dbfs/...` and warns when the path is outside /dbfs
+    (such paths are ephemeral on Databricks clusters)."""
+
+    def __init__(self, prefix_path: str, *args, **kwargs):
+        if not self.normalize_path(prefix_path).startswith("/dbfs/"):
+            import warnings
+
+            warnings.warn(
+                "The provided prefix_path might be ephemeral: %s — "
+                "prefer a prefix_path under /dbfs/" % prefix_path)
+        # Every path argument (train/val/test/runs too, not just the
+        # prefix) routes through _normalize below.
+        super().__init__(prefix_path, *args, **kwargs)
+
+    @staticmethod
+    def _normalize(path: Optional[str]) -> Optional[str]:
+        path = FilesystemStore._normalize(path)
+        if path is None:
+            return None
+        return DBFSLocalStore.normalize_path(path)
+
+    @classmethod
+    def matches_dbfs(cls, path: str) -> bool:
+        return (path.startswith("dbfs:/") or path.startswith("/dbfs/")
+                or path.startswith("file:///dbfs/"))
+
+    @staticmethod
+    def normalize_path(path: str) -> str:
+        if path.startswith("dbfs:/"):
+            return "/dbfs" + path[len("dbfs:"):]
+        if path.startswith("file:///dbfs/"):
+            return path[len("file://"):]
+        return path

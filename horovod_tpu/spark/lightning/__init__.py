@@ -261,3 +261,11 @@ class LightningModel(HorovodModel):
         module = cloudpickle.loads(blob)
         return cls(module, meta["history"], meta["run_id"], store,
                    feature_cols=meta["feature_cols"])
+
+
+# Reference-name aliases: horovod.spark.lightning exports its estimator
+# pair as TorchEstimator/TorchModel (reference:
+# horovod/spark/lightning/__init__.py:16) — the Lightning estimator IS
+# the torch estimator in that namespace. Both spellings work here.
+TorchEstimator = LightningEstimator
+TorchModel = LightningModel

@@ -11,9 +11,10 @@ rename.
 """
 
 from horovod_tpu.keras import *  # noqa: F401,F403
-from horovod_tpu.keras import (  # noqa: F401  (non-star surface)
-    DistributedOptimizer, callbacks, elastic, load_model,
-)
-from horovod_tpu.tensorflow import (  # noqa: F401
-    broadcast_global_variables,
+from horovod_tpu.keras import (  # noqa: F401  (non-star surface;
+    # includes the KERAS-flavored broadcast_global_variables(root_rank,
+    # model=None) — the TF1-collection flavor in the parent tensorflow
+    # namespace must not shadow it here)
+    DistributedOptimizer, broadcast_global_variables, callbacks,
+    elastic, load_model,
 )

@@ -53,6 +53,17 @@ SURFACE = {
     "horovod_tpu.tensorflow.keras.elastic": [
         "KerasState", "CommitStateCallback", "UpdateBatchStateCallback",
     ],
+    # Reference-name aliases in the cluster integrations.
+    "horovod_tpu.spark.lightning": [
+        "LightningEstimator", "LightningModel",
+        "TorchEstimator", "TorchModel",  # reference spelling
+    ],
+    "horovod_tpu.spark.common.store": [
+        "Store", "FilesystemStore", "AbstractFilesystemStore",
+        "LocalStore", "HDFSStore", "DBFSLocalStore", "is_databricks",
+    ],
+    "horovod_tpu.ray": ["RayExecutor", "ElasticRayExecutor",
+                        "BaseHorovodWorker"],
 }
 
 

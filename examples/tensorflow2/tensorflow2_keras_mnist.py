@@ -11,7 +11,7 @@ import numpy as np
 import tensorflow as tf
 from tensorflow import keras
 
-import horovod_tpu.keras as hvd
+import horovod_tpu.tensorflow.keras as hvd
 from horovod_tpu.keras import callbacks as hvd_callbacks
 
 

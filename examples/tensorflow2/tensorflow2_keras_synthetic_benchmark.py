@@ -20,7 +20,7 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 import numpy as np
 import tensorflow as tf
 
-import horovod_tpu.keras as hvd
+import horovod_tpu.tensorflow.keras as hvd
 
 
 def make_model(name, image_size):

@@ -599,3 +599,106 @@ def DistributedOptimizer(optimizer, op=Average, name=None,
 # Submodule access parity (reference: horovod/tensorflow exposes its
 # elastic module as an attribute).
 from horovod_tpu.tensorflow import elastic  # noqa: E402,F401
+from horovod_tpu.common.util import split_list  # noqa: E402,F401
+from horovod_tpu.tensorflow.gradient_aggregation import (  # noqa: E402,F401
+    LocalGradientAggregationHelper,
+)
+
+
+def size_op(process_set_id=0, name=None):
+    """World (or process-set) size read at graph EXECUTION time, so a
+    graph built in one environment runs in another — the elastic
+    use case (reference: tensorflow/mpi_ops.py:361-374)."""
+    del name
+
+    def _read():
+        from horovod_tpu.common import process_sets as _ps
+
+        # id 0 is the global set, whose size() is the world size.
+        return np.int32(_ps.get_process_set(process_set_id).size())
+
+    return tf.py_function(_read, [], tf.int32)
+
+
+def rank_op(name=None):
+    """(reference: tensorflow/mpi_ops.py:413-426)"""
+    del name
+    return tf.py_function(lambda: np.int32(basics.rank()), [], tf.int32)
+
+
+def local_rank_op(name=None):
+    """(reference: tensorflow/mpi_ops.py:429-443)"""
+    del name
+    return tf.py_function(lambda: np.int32(basics.local_rank()), [],
+                          tf.int32)
+
+
+def local_size_op(name=None):
+    """(reference: tensorflow/mpi_ops.py local_size_op)"""
+    del name
+    return tf.py_function(lambda: np.int32(basics.local_size()), [],
+                          tf.int32)
+
+
+def process_set_included_op(process_set_id=0, name=None):
+    """1/0 whether this process is in the set; -1 when horovod_tpu is
+    not initialized, -2 for an unknown set — read at execution time
+    (reference: tensorflow/mpi_ops.py:377-396)."""
+    del name
+
+    def _read():
+        if not basics.is_initialized():
+            return np.int32(-1)
+        from horovod_tpu.common import process_sets as _ps
+
+        try:
+            included = _ps.get_process_set(process_set_id).included()
+        except KeyError:
+            return np.int32(-2)
+        return np.int32(1 if included else 0)
+
+    return tf.py_function(_read, [], tf.int32)
+
+
+def check_num_rank_power_of_2(num_rank):
+    """Reference compat shim (reference: tensorflow/__init__.py
+    check_num_rank_power_of_2, which RAISES because its Adasum tree
+    needs a power-of-two world). horovod_tpu's Adasum merge tree
+    carries the odd element at every level (parallel/adasum.py), so a
+    non-power-of-two world works here — migrated call sites get a
+    warning instead of a spurious abort."""
+    if num_rank <= 0:
+        raise ValueError("number of ranks must be positive, got %d"
+                         % num_rank)
+    if num_rank & (num_rank - 1):
+        import warnings
+
+        warnings.warn(
+            "the reference requires a power-of-two world for Adasum; "
+            "horovod_tpu's merge tree handles %d ranks, continuing"
+            % num_rank)
+
+
+def gpu_available(*_compat_args):
+    """Whether TF sees any GPU (reference: tensorflow/util.py
+    gpu_available). Always False on TPU images; kept for migrated
+    call sites."""
+    return bool(tf.config.list_physical_devices("GPU"))
+
+
+def broadcast_object_fn(root_rank=0, session=None, name=None,
+                        process_set=global_process_set):
+    """Return a callable broadcasting arbitrary objects (reference:
+    tensorflow/functions.py:103-140 — there a TF1 placeholder/session
+    construction; here a closure over the eager object broadcast,
+    since TF1 sessions are descoped)."""
+    if session is not None:
+        raise RuntimeError(
+            "broadcast_object_fn(session=...) is TF1-session specific "
+            "and descoped; call the returned function eagerly instead")
+
+    def _bcast(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+
+    return _bcast

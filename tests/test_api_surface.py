@@ -30,7 +30,11 @@ SURFACE = {
     "horovod_tpu.tensorflow": PREDICATES + [
         "elastic", "broadcast_global_variables",
         "BroadcastGlobalVariablesHook", "DistributedGradientTape",
-        "broadcast_variables",
+        "broadcast_variables", "size_op", "rank_op", "local_rank_op",
+        "local_size_op", "process_set_included_op",
+        "check_num_rank_power_of_2", "gpu_available",
+        "broadcast_object_fn", "LocalGradientAggregationHelper",
+        "split_list",
     ],
     "horovod_tpu.keras": PREDICATES + [
         "elastic", "callbacks", "start_timeline", "stop_timeline",
@@ -100,6 +104,30 @@ def test_predicate_values():
     assert hvd.ddl_built() is False
     assert hvd.mpi_threads_supported() is False
     assert hvd.nccl_built() == 0
+
+
+def test_tf_execution_time_ops():
+    """size_op/rank_op read at graph EXECUTION time (reference:
+    tensorflow/mpi_ops.py:361-443), so they work eagerly and inside
+    tf.function; power-of-2 check and broadcast_object_fn round-trip."""
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    assert int(hvd.size_op()) == hvd.size()
+    assert int(hvd.rank_op()) == hvd.rank()
+    assert int(hvd.local_size_op()) == hvd.local_size()
+    assert int(hvd.process_set_included_op(0)) == 1
+    assert int(hvd.process_set_included_op(10 ** 6)) == -2
+    hvd.check_num_rank_power_of_2(8)
+    # Non-power-of-2 warns (horovod_tpu's Adasum tree handles it)
+    # instead of raising like the reference; non-positive still raises.
+    with pytest.warns(UserWarning):
+        hvd.check_num_rank_power_of_2(6)
+    with pytest.raises(ValueError):
+        hvd.check_num_rank_power_of_2(0)
+    assert hvd.broadcast_object_fn(0)({"k": [1, 2]}) == {"k": [1, 2]}
+    with pytest.raises(RuntimeError):
+        hvd.broadcast_object_fn(0, session=object())
 
 
 def test_tf1_surface_errors_point_at_tf2_path():

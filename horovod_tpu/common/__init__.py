@@ -14,6 +14,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
 )
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError,
+    HorovodVersionMismatchError,
     HostsUpdatedInterrupt,
 )
 from horovod_tpu.common.process_sets import (  # noqa: F401

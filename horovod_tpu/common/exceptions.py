@@ -38,3 +38,26 @@ class TensorShapeMismatchError(ValueError):
 
 class TensorDtypeMismatchError(ValueError):
     """Ranks submitted inconsistent dtypes for the same named tensor."""
+
+
+def get_version_mismatch_message(name, version, installed_version):
+    """(reference: horovod/common/exceptions.py:35-38)"""
+    return ("Framework %s installed with version %s but found version "
+            "%s. This can result in unexpected behavior including "
+            "runtime errors; rebuild horovod_tpu against the running "
+            "framework version." % (name, installed_version, version))
+
+
+class HorovodVersionMismatchError(Exception):
+    """A framework's runtime version differs from its version at
+    install time (reference: horovod/common/exceptions.py:41-49).
+    horovod_tpu's bindings are pure Python over a self-contained C++
+    core, so the classic ABI-skew failure cannot happen here — the
+    class exists so migrated except-clauses keep working."""
+
+    def __init__(self, name, version, installed_version):
+        super().__init__(get_version_mismatch_message(
+            name, version, installed_version))
+        self.name = name
+        self.version = version
+        self.installed_version = installed_version

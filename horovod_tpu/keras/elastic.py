@@ -10,6 +10,9 @@ from __future__ import annotations
 
 import tensorflow as tf
 
+from horovod_tpu.elastic.worker import run  # noqa: F401  (decorator
+# parity: reference horovod/keras/elastic.py exposes run alongside
+# the state/callbacks)
 from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
 
 

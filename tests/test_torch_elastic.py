@@ -1,0 +1,114 @@
+"""TorchState commit/restore unit tests — single process, no cluster.
+
+Reference pattern: test/single/test_torch_elastic.py — the torch
+elastic state must snapshot model + optimizer + scalar attributes on
+commit and roll every one of them back on restore, with reset
+callbacks firing on reset events. The multi-process sync leg is
+covered end-to-end in tests/test_elastic.py.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+from horovod_tpu.common import basics  # noqa: E402
+from horovod_tpu.elastic.state import TorchState  # noqa: E402
+
+
+def _tiny_model():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(torch.nn.Linear(4, 3), torch.nn.ReLU(),
+                               torch.nn.Linear(3, 1))
+
+
+def _train_step(model, optimizer):
+    optimizer.zero_grad()
+    loss = model(torch.ones(2, 4)).sum()
+    loss.backward()
+    optimizer.step()
+
+
+def test_commit_restore_rolls_back_model_and_optimizer():
+    basics.init()
+    model = _tiny_model()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    state = TorchState(model=model, optimizer=optimizer, epoch=3, batch=7)
+
+    _train_step(model, optimizer)  # momentum buffers now exist
+    state.commit()
+    committed = {k: v.clone() for k, v in model.state_dict().items()}
+    committed_mom = [
+        optimizer.state[p]["momentum_buffer"].clone()
+        for p in model.parameters()]
+
+    # Diverge: more training + attribute changes.
+    for _ in range(3):
+        _train_step(model, optimizer)
+    state.epoch, state.batch = 9, 0
+    changed = any(
+        not torch.equal(v, committed[k])
+        for k, v in model.state_dict().items())
+    assert changed, "training did not change the weights"
+
+    state.restore()
+    for k, v in model.state_dict().items():
+        assert torch.equal(v, committed[k]), k
+    for p, saved in zip(model.parameters(), committed_mom):
+        assert torch.equal(optimizer.state[p]["momentum_buffer"], saved)
+    assert state.epoch == 3 and state.batch == 7
+
+
+def test_restore_without_commit_uses_constructor_snapshot():
+    basics.init()
+    state = TorchState(epoch=1, batch=2)
+    state.epoch = 50
+    state.restore()
+    assert state.epoch == 1 and state.batch == 2
+
+
+def test_reset_callbacks_fire_once_per_reset():
+    basics.init()
+    state = TorchState(epoch=0)
+    calls = []
+    state.register_reset_callbacks([lambda: calls.append("a"),
+                                    lambda: calls.append("b")])
+    state.on_reset()
+    assert calls == ["a", "b"]
+    state.on_reset()
+    assert calls == ["a", "b", "a", "b"]
+
+
+def test_new_attributes_commit_after_registration():
+    """Attributes added via __setattr__ after construction are plain
+    python attributes; only constructor kwargs participate in
+    commit/restore (the reference's contract: state variables are
+    declared up front)."""
+    basics.init()
+    state = TorchState(step=0)
+    state.step = 5
+    state.commit()
+    state.step = 11
+    state.restore()
+    assert state.step == 5
+
+
+def test_torch_state_with_sampler_reshards():
+    """An ElasticSampler attribute gets handler semantics: commit
+    snapshots its progress, restore rolls it back."""
+    from horovod_tpu.torch.elastic import ElasticSampler
+
+    basics.init()
+    sampler = ElasticSampler(list(range(12)), shuffle=False)
+    sampler.set_epoch(0)
+    state = TorchState(sampler=sampler, batch=0)
+    first = list(sampler)[:2]
+    sampler.record_batch(0, 2)
+    state.commit()
+    sampler.record_batch(1, 2)
+    assert len(sampler.processed_indices) == 4
+    state.restore()
+    assert len(sampler.processed_indices) == 2
+    assert first and len(first) == 2

@@ -121,6 +121,19 @@ def optimizer_sparse_as_dense(r, n):
     np.testing.assert_allclose(emb.numpy(), expect, rtol=1e-3)
 
 
+def adasum_through_tf(r, n):
+    """op=Adasum through the TF binding rides the native Adasum
+    (reference: test_adasum_tensorflow.py): parallel vectors project
+    to themselves (adasum(a, a) == a), orthogonal vectors add."""
+    par = tf.constant([1.0, 2.0, 0.0, 0.0])
+    out = hvd.allreduce(par, op=hvd.Adasum, name="tf3.adasum.par")
+    np.testing.assert_allclose(out.numpy(), par.numpy(), rtol=1e-6)
+
+    ortho = tf.constant([1.0, 0.0] if r == 0 else [0.0, 1.0])
+    out = hvd.allreduce(ortho, op=hvd.Adasum, name="tf3.adasum.orth")
+    np.testing.assert_allclose(out.numpy(), [1.0, 1.0], rtol=1e-6)
+
+
 def sparse_allgather_path_disabled(r, n):
     """Without the in-graph runtime the sparse allgather path cannot
     carry symbolic tensors, so Sum/Average are the only legal slice
@@ -149,6 +162,7 @@ def main():
     indexed_slices_densify(r, n)
     tape_compression(r, n)
     optimizer_sparse_as_dense(r, n)
+    adasum_through_tf(r, n)
     sparse_allgather_path_disabled(r, n)
     join_uneven_data(r, n)  # last: join ends this rank's data flow
 

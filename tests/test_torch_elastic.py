@@ -89,10 +89,14 @@ def test_new_attributes_commit_after_registration():
     basics.init()
     state = TorchState(step=0)
     state.step = 5
+    state.extra = "post-construction"  # NOT a declared state variable
     state.commit()
     state.step = 11
+    state.extra = "mutated"
     state.restore()
     assert state.step == 5
+    # Undeclared attributes are untouched by restore.
+    assert state.extra == "mutated"
 
 
 def test_torch_state_with_sampler_reshards():
@@ -111,4 +115,5 @@ def test_torch_state_with_sampler_reshards():
     assert len(sampler.processed_indices) == 4
     state.restore()
     assert len(sampler.processed_indices) == 2
-    assert first and len(first) == 2
+    # shuffle=False, world size 1: iteration is the identity order.
+    assert first == [0, 1]

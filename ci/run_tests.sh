@@ -17,8 +17,8 @@ TIER="${1:-all}"
 # per-binding sweep launchers, fake contracts, spark convert) measured
 # 876.79s on this quiet 1-core host (r4: 253 tests, 690.75s). 1200s
 # keeps ~37% headroom for loaded CI machines — the r2 margin (636s vs
-# 720s) proved too thin. (Final r5 suite, 297 tests, cold cache:
-# 941.69s — holds.)
+# 720s) proved too thin. (Final r5 suite, 316 tests, cold cache:
+# 868.40s — holds.)
 run_tier1() {
     echo "=== tier 1 (default suite) ==="
     timeout "${HVD_CI_TIER1_BUDGET:-1200}" \
@@ -33,7 +33,7 @@ run_tier1() {
 # 1401.27s at 40 tests, plus 78.4s measured for the three elastic
 # shrink/blacklist/reset-limit cases added after ≈ 1480s. 1800s keeps
 # ~21% headroom over that worst cold run. (Final r5 suite, 43 tests,
-# cold cache, quiet host: 1231.18s — holds with ~32%.)
+# cold cache, quiet host: 1231.18s and 1258.37s — holds with ~30%.)
 run_tier2() {
     echo "=== tier 2 (heavyweight integration) ==="
     timeout "${HVD_CI_TIER2_BUDGET:-1800}" \

@@ -17,7 +17,8 @@ from horovod_tpu.ops import (  # noqa: F401
     Adasum, Average, Max, Min, Product, Sum,
     allgather, allgather_async, allreduce, allreduce_async,
     alltoall, alltoall_async, barrier, broadcast, broadcast_async,
-    grouped_allreduce, grouped_allreduce_async, join, poll, synchronize,
+    grouped_allreduce, grouped_allreduce_async, join, poll,
+    reducescatter, reducescatter_async, synchronize,
     allreduce_ingraph, allgather_ingraph, broadcast_ingraph,
     alltoall_ingraph, reducescatter_ingraph, grouped_allreduce_ingraph,
 )

@@ -299,11 +299,39 @@ def error_paths(r, n):
         hvd.broadcast(jnp.ones(3), root_rank=r, name="jx.err.root")
 
 
+def adasum_and_reducescatter(r, n):
+    """op=Adasum invariants and the namespace-level reducescatter
+    (uneven dim 0, Average) through the jax surface."""
+    par = jnp.asarray([2.0, 0.0, 4.0])
+    out = hvd.allreduce(par, op=hvd.Adasum, name="jx.adasum.par")
+    np.testing.assert_allclose(_f64(out), np.asarray(par), rtol=1e-6)
+    ortho = jnp.asarray([1.0, 0.0] if r == 0 else [0.0, 3.0])
+    out = hvd.allreduce(ortho, op=hvd.Adasum, name="jx.adasum.orth")
+    np.testing.assert_allclose(_f64(out), [1.0, 3.0], rtol=1e-6)
+
+    # 2n+1 rows: rank 0 owns the extra row; Average keeps dtype.
+    full = jnp.ones((2 * n + 1, 3), jnp.float32) * (r + 1)
+    shard = hvd.reducescatter(full, op=hvd.Average, name="jx.rs.uneven")
+    rows = 3 if r == 0 else 2
+    assert shard.shape == (rows, 3), shard.shape
+    np.testing.assert_allclose(_f64(shard), 1.5)  # mean of 1, 2
+
+
+def join_through_jax(r, n):
+    """Joined ranks contribute zeros; join() returns the last rank to
+    join (mirrors the torch/TF twins on the shared native plane)."""
+    if r == 0:
+        out = hvd.allreduce(jnp.ones(3), op=hvd.Sum, name="jx.join.ar")
+        np.testing.assert_allclose(_f64(out), 1.0)
+    assert hvd.join() == 1
+
+
 def main():
     hvd.init()
     r, n = hvd.rank(), hvd.size()
     assert n == 2
 
+    adasum_and_reducescatter(r, n)
     allreduce_dtype_op_matrix(r, n)
     edge_shapes(r, n)
     gather_bcast_alltoall(r, n)
@@ -315,6 +343,7 @@ def main():
     compression_through_allreduce(r, n)
     backward_passes_accumulation(r, n)
     error_paths(r, n)
+    join_through_jax(r, n)  # last: join ends this rank's data flow
 
     hvd.shutdown()
     print("JAX_SWEEP_OK rank=%d" % r)

@@ -325,7 +325,9 @@ def barrier(process_set: ProcessSet = global_process_set):
 
 def join() -> int:
     """Signal that this rank is out of data; blocks until all ranks join.
-    Returns the last rank to join (reference:
+    Returns the highest-indexed joined rank at the completion cycle —
+    the controller folds join announcements in member-rank order, so
+    the value is stable regardless of join timing (reference:
     horovod/common/operations.cc:1714-1742, torch/mpi_ops.py:888)."""
     basics._check_initialized()
     return _backend().join()

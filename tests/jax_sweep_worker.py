@@ -119,9 +119,8 @@ def gather_bcast_alltoall(r, n):
             np.testing.assert_allclose(_f64(out), [1.0, 2.0, 12.0])
             np.testing.assert_array_equal(np.asarray(rsplits), [2, 1])
 
-    from horovod_tpu.ops import reducescatter
-    rs = reducescatter(jnp.ones((3, 2), jnp.float32) * (r + 1),
-                       op=hvd.Sum, name="jx.rs")
+    rs = hvd.reducescatter(jnp.ones((3, 2), jnp.float32) * (r + 1),
+                           op=hvd.Sum, name="jx.rs")
     # Ring convention: 3 rows over 2 ranks -> rank0 2 rows, rank1 1.
     assert rs.shape == ((2, 2) if r == 0 else (1, 2)), rs.shape
     np.testing.assert_allclose(_f64(rs), float(sum(range(1, n + 1))))
@@ -304,22 +303,28 @@ def adasum_and_reducescatter(r, n):
     (uneven dim 0, Average) through the jax surface."""
     par = jnp.asarray([2.0, 0.0, 4.0])
     out = hvd.allreduce(par, op=hvd.Adasum, name="jx.adasum.par")
+    assert isinstance(out, jax.Array) and out.dtype == par.dtype
     np.testing.assert_allclose(_f64(out), np.asarray(par), rtol=1e-6)
     ortho = jnp.asarray([1.0, 0.0] if r == 0 else [0.0, 3.0])
     out = hvd.allreduce(ortho, op=hvd.Adasum, name="jx.adasum.orth")
+    assert isinstance(out, jax.Array)
     np.testing.assert_allclose(_f64(out), [1.0, 3.0], rtol=1e-6)
 
     # 2n+1 rows: rank 0 owns the extra row; Average keeps dtype.
     full = jnp.ones((2 * n + 1, 3), jnp.float32) * (r + 1)
     shard = hvd.reducescatter(full, op=hvd.Average, name="jx.rs.uneven")
+    assert isinstance(shard, jax.Array) and shard.dtype == jnp.float32
     rows = 3 if r == 0 else 2
     assert shard.shape == (rows, 3), shard.shape
     np.testing.assert_allclose(_f64(shard), 1.5)  # mean of 1, 2
 
 
 def join_through_jax(r, n):
-    """Joined ranks contribute zeros; join() returns the last rank to
-    join (mirrors the torch/TF twins on the shared native plane)."""
+    """Joined ranks contribute zeros; join() returns the
+    highest-indexed joined rank at the completion cycle (the
+    controller folds join announcements in member-rank order, so this
+    is stable regardless of join timing). Mirrors the torch/TF twins
+    on the shared native plane."""
     if r == 0:
         out = hvd.allreduce(jnp.ones(3), op=hvd.Sum, name="jx.join.ar")
         np.testing.assert_allclose(_f64(out), 1.0)

@@ -47,8 +47,9 @@ def prescale_postscale(r, n):
 
 
 def join_uneven_data(r, n):
-    """Joined ranks contribute zeros; join() returns the last rank to
-    join (reference: controller.cc Join accounting; the torch twin is
+    """Joined ranks contribute zeros; join() returns the
+    highest-indexed joined rank at the completion cycle (reference:
+    controller.cc Join accounting; the torch twin is
     tests/torch_worker.py join_through_binding)."""
     if r == 0:
         out = hvd.allreduce(tf.ones([3]), op=hvd.Sum, name="tf3.join.ar")

@@ -483,7 +483,9 @@ def process_sets_through_binding(r, n):
 def join_through_binding(r, n):
     """Uneven-data Join through the torch API (reference:
     torch/mpi_ops.py:888, controller.cc:262-317): the joined rank
-    contributes zeros, join() returns the last rank to join."""
+    contributes zeros; join() returns the highest-indexed joined rank
+    at the completion cycle (announcements fold in member-rank order,
+    stable regardless of join timing)."""
     if r == 0:
         out = hvd.allreduce(torch.ones(3), name="join.ar", op=hvd.Sum)
         # Rank 1 already joined -> contributes zeros.

@@ -1,0 +1,110 @@
+"""np=3 sweep: every uneven-division path at an ODD world size.
+
+The np=2/np=4 matrices never exercise remainder handling where world
+size does not divide row counts (reference: test_torch.py and
+test_tensorflow.py parametrize odd world sizes through mpirun -np 3).
+Exact expected values in every cell.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def ragged_allgather(r, n):
+    """Rank r contributes r+1 rows; output is rank-ordered."""
+    part = np.full((r + 1, 2), float(r), np.float32)
+    out = hvd.allgather(part, name="odd.ag")
+    assert out.shape == (6, 2), out.shape
+    expect = np.concatenate(
+        [np.full((k + 1, 2), float(k), np.float32) for k in range(n)])
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def uneven_reducescatter(r, n):
+    """7 rows over 3 ranks: shards of 3/2/2 rows, Sum semantics."""
+    full = np.arange(7, dtype=np.float32)[:, None] * np.ones((1, 2))
+    shard = hvd.reducescatter(full * (r + 1), op=hvd.Sum, name="odd.rs")
+    rows = 3 if r == 0 else 2
+    start = 3 if r == 1 else (5 if r == 2 else 0)
+    assert shard.shape == (rows, 2), shard.shape
+    expect = (np.arange(start, start + rows, dtype=np.float32)[:, None]
+              * np.ones((1, 2)) * 6.0)  # (1+2+3)
+    np.testing.assert_allclose(np.asarray(shard), expect)
+
+
+def ragged_alltoall(r, n):
+    """Asymmetric splits: rank r sends k+1 items to each rank k,
+    scaled by 100*r for provenance."""
+    splits = np.array([1, 2, 3], np.int32)
+    payload = np.arange(6, dtype=np.float32) + 100.0 * r
+    out, rsplits = hvd.alltoall(payload, splits=splits, name="odd.a2a")
+    # Rank r receives r+1 items from each sender, in sender order.
+    np.testing.assert_array_equal(np.asarray(rsplits), [r + 1] * n)
+    starts = {0: 0, 1: 1, 2: 3}[r]
+    expect = np.concatenate([
+        np.arange(starts, starts + r + 1, dtype=np.float32) + 100.0 * k
+        for k in range(n)])
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def reductions_and_broadcast(r, n):
+    out = hvd.allreduce(np.full(3, float(r + 1), np.float32),
+                        op=hvd.Average, name="odd.avg")
+    np.testing.assert_allclose(np.asarray(out), 2.0)  # mean of 1,2,3
+
+    # Adasum at an odd world: the merge tree carries the odd element
+    # (identical vectors stay the projection fixed point).
+    par = np.asarray([3.0, 0.0, 1.0], np.float32)
+    out = hvd.allreduce(par, op=hvd.Adasum, name="odd.adasum")
+    np.testing.assert_allclose(np.asarray(out), par, rtol=1e-6)
+
+    out = hvd.broadcast(np.full(2, float(r), np.float32), root_rank=2,
+                        name="odd.bcast")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    outs = hvd.grouped_allreduce(
+        [np.full(2, float(r), np.float32),
+         np.full(4, 1.0, np.float32)], op=hvd.Sum, name="odd.group")
+    np.testing.assert_allclose(np.asarray(outs[0]), 3.0)  # 0+1+2
+    np.testing.assert_allclose(np.asarray(outs[1]), 3.0)
+
+
+def subset_process_set(r, n):
+    """A 2-member set inside the odd world: members reduce among
+    themselves while the third rank runs global ops concurrently."""
+    duo = hvd.add_process_set(hvd.ProcessSet([0, 2]))
+    if r in (0, 2):
+        out = hvd.allreduce(np.full(2, float(r + 1), np.float32),
+                            op=hvd.Sum, name="odd.duo", process_set=duo)
+        np.testing.assert_allclose(np.asarray(out), 4.0)  # 1 + 3
+    out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                        name="odd.glob")
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    hvd.remove_process_set(duo)
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 3
+
+    ragged_allgather(r, n)
+    uneven_reducescatter(r, n)
+    ragged_alltoall(r, n)
+    reductions_and_broadcast(r, n)
+    subset_process_set(r, n)
+
+    hvd.shutdown()
+    print("ODD_WORLD_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,14 @@ def test_jax_sweep():
     assert proc.stdout.count("JAX_SWEEP_OK") == 2, proc.stdout
 
 
+def test_odd_world_np3():
+    # Odd world size: remainder handling in every uneven-division
+    # path (the np=2/np=4 matrices never hit it).
+    proc = _launch("odd_world_worker.py", np=3)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("ODD_WORLD_OK") == 3, proc.stdout
+
+
 def test_mxnet_sweep():
     proc = _launch("mxnet_sweep_worker.py")
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,98 @@
+"""np=2 randomized collective fuzz: 60 seeded random ops, exact
+expected values computed locally on every rank.
+
+The hand-written matrices cover known cells; this sweeps a seeded
+random mix of op kind x dtype x shape (0-sized dims, 0-dim scalars,
+odd strides of row counts, long names) through the same wire path to
+catch serialization and remainder corners nobody enumerated.
+Deterministic seed => identical op sequence on every rank, as the
+negotiation protocol requires.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.float16,
+          np.uint8, np.int8]
+N_OPS = 60
+
+
+def _rand_shape(rng):
+    kind = rng.randint(4)
+    if kind == 0:
+        return ()                          # 0-dim scalar
+    if kind == 1:
+        return (int(rng.randint(0, 3)),)   # may be 0-sized
+    if kind == 2:
+        return (int(rng.randint(1, 9)),)
+    return (int(rng.randint(1, 5)), int(rng.randint(1, 4)))
+
+
+def _payload(rng, shape, dt, r):
+    if np.issubdtype(dt, np.integer):
+        # Small magnitudes: int8 must survive a Sum over 2 ranks.
+        return (np.asarray(rng.randint(0, 20, size=shape), dt)
+                + np.asarray(r, dt))
+    return (np.asarray(rng.rand(*shape), dt) + np.asarray(r, dt))
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    rng = np.random.RandomState(20260731)  # same stream on every rank
+    for i in range(N_OPS):
+        kind = rng.choice(["allreduce", "allgather", "broadcast"])
+        dt = DTYPES[rng.randint(len(DTYPES))]
+        shape = _rand_shape(rng)
+        name = "fz.%04d.%s" % (i, "x" * int(rng.randint(1, 40)))
+        # Payload must be a deterministic function of (stream, rank) so
+        # every rank can compute every rank's contribution locally.
+        seed_i = int(rng.randint(1 << 30))
+        locals_ = [
+            _payload(np.random.RandomState(seed_i + k), shape, dt, k)
+            for k in range(n)]
+
+        if kind == "allreduce":
+            if np.issubdtype(dt, np.integer):
+                op, expect = hvd.Sum, sum(locals_)
+            else:
+                op, expect = hvd.Average, sum(locals_) / n
+            out = hvd.allreduce(locals_[r], op=op, name=name)
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), np.asarray(expect, np.float64),
+                rtol=2e-3 if dt == np.float16 else 1e-6,
+                atol=2e-3 if dt == np.float16 else 1e-9)
+            assert np.asarray(out).dtype == dt, (np.asarray(out).dtype, dt)
+        elif kind == "allgather":
+            if len(shape) == 0:
+                continue  # scalar allgather promotion covered elsewhere
+            out = hvd.allgather(locals_[r], name=name)
+            expect = np.concatenate(locals_) if shape[0] else locals_[0]
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64),
+                np.asarray(expect, np.float64), rtol=1e-3)
+            assert np.asarray(out).dtype == dt
+        else:
+            root = int(rng.randint(n))
+            out = hvd.broadcast(locals_[r], root_rank=root, name=name)
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64),
+                np.asarray(locals_[root], np.float64), rtol=1e-6)
+            assert np.asarray(out).dtype == dt
+
+    hvd.shutdown()
+    print("FUZZ_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,14 @@ def test_jax_sweep():
     assert proc.stdout.count("JAX_SWEEP_OK") == 2, proc.stdout
 
 
+def test_fuzz_np2():
+    # Seeded random op mix through the wire path; exact local
+    # expectations per cell (see fuzz_worker.py docstring).
+    proc = _launch("fuzz_worker.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("FUZZ_OK") == 2, proc.stdout
+
+
 def test_odd_world_np3():
     # Odd world size: remainder handling in every uneven-division
     # path (the np=2/np=4 matrices never hit it).

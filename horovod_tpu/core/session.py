@@ -272,8 +272,13 @@ class CoreSession:
         # carries the 1-D view; _Pending.shape restores on completion).
         in_shape = tuple(np.shape(array))
         arr = np.ascontiguousarray(array)
-        if kind in (OP_ALLREDUCE, OP_BROADCAST):
-            arr = arr.copy()  # in-place target; result buffer
+        if kind in (OP_ALLREDUCE, OP_BROADCAST, OP_REDUCESCATTER):
+            # These ops use the submitted buffer as the in-place
+            # reduce/result target (ExecuteReducescatter runs the ring
+            # reduce directly on it); without the copy, a contiguous
+            # caller array is silently clobbered (found by
+            # tests/fuzz_worker.py input-immutability checks).
+            arr = arr.copy()
         dtype_code = _dtype_code(arr.dtype)
         shape = (ctypes.c_longlong * arr.ndim)(*arr.shape)
         if splits is not None:

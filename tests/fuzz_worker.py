@@ -50,7 +50,8 @@ def main():
 
     rng = np.random.RandomState(20260731)  # same stream on every rank
     for i in range(N_OPS):
-        kind = rng.choice(["allreduce", "allgather", "broadcast"])
+        kind = rng.choice(["allreduce", "allgather", "broadcast",
+                           "reducescatter", "alltoall", "grouped"])
         dt = DTYPES[rng.randint(len(DTYPES))]
         shape = _rand_shape(rng)
         name = "fz.%04d.%s" % (i, "x" * int(rng.randint(1, 40)))
@@ -60,6 +61,10 @@ def main():
         locals_ = [
             _payload(np.random.RandomState(seed_i + k), shape, dt, k)
             for k in range(n)]
+        # Input immutability: collectives must never clobber the
+        # caller's array (regression: reducescatter ran the ring
+        # reduce in place on the submitted buffer).
+        before = np.array(locals_[r], copy=True)
 
         if kind == "allreduce":
             if np.issubdtype(dt, np.integer):
@@ -81,13 +86,80 @@ def main():
                 np.asarray(out, np.float64),
                 np.asarray(expect, np.float64), rtol=1e-3)
             assert np.asarray(out).dtype == dt
-        else:
+        elif kind == "broadcast":
             root = int(rng.randint(n))
             out = hvd.broadcast(locals_[r], root_rank=root, name=name)
             np.testing.assert_allclose(
                 np.asarray(out, np.float64),
                 np.asarray(locals_[root], np.float64), rtol=1e-6)
             assert np.asarray(out).dtype == dt
+        elif kind == "reducescatter":
+            if len(shape) == 0 or np.issubdtype(dt, np.integer):
+                continue  # scalar rs covered elsewhere; keep float sums
+            rows = shape[0]
+            out = hvd.reducescatter(locals_[r], op=hvd.Sum, name=name)
+            total = np.asarray(sum(x.astype(np.float64)
+                                   for x in locals_))
+            mine = rows - rows // n if r == 0 else rows // n
+            start = 0 if r == 0 else rows - rows // n
+            assert np.asarray(out).shape[:1] == (mine,), (
+                np.asarray(out).shape, rows)
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), total[start:start + mine],
+                rtol=2e-3 if dt == np.float16 else 1e-5,
+                atol=2e-3 if dt == np.float16 else 0)
+            assert np.asarray(out).dtype == dt
+        elif kind == "alltoall":
+            if len(shape) == 0 or shape[0] < n:
+                continue
+            rows = shape[0]
+            cut = int(rng.randint(0, rows + 1))
+            splits = np.array([cut, rows - cut], np.int32)
+            out, rsplits = hvd.alltoall(locals_[r], splits=splits,
+                                        name=name)
+            # Both ranks use the same (seeded) splits: rank 0 receives
+            # the first cut rows of each sender, rank 1 the rest.
+            if r == 0:
+                expect = np.concatenate([x[:cut] for x in locals_])
+            else:
+                expect = np.concatenate([x[cut:] for x in locals_])
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64),
+                np.asarray(expect, np.float64), rtol=1e-5)
+            np.testing.assert_array_equal(
+                np.asarray(rsplits),
+                [cut, cut] if r == 0 else [rows - cut, rows - cut])
+        elif kind == "grouped":
+            k = int(rng.randint(1, 4))
+            members, expects = [], []
+            for j in range(k):
+                mdt = DTYPES[rng.randint(len(DTYPES))]
+                mshape = (int(rng.randint(1, 6)),)
+                mseed = int(rng.randint(1 << 30))
+                mlocals = [
+                    _payload(np.random.RandomState(mseed + q), mshape,
+                             mdt, q) for q in range(n)]
+                members.append(mlocals[r])
+                expects.append((sum(x.astype(np.float64)
+                                    for x in mlocals), mdt))
+            member_snaps = [np.array(m, copy=True) for m in members]
+            outs = hvd.grouped_allreduce(members, op=hvd.Sum, name=name)
+            for out, (expect, mdt) in zip(outs, expects):
+                np.testing.assert_allclose(
+                    np.asarray(out, np.float64), expect,
+                    rtol=2e-3 if mdt == np.float16 else 1e-6,
+                    atol=2e-3 if mdt == np.float16 else 1e-9)
+                assert np.asarray(out).dtype == mdt
+            for member, snap in zip(members, member_snaps):
+                np.testing.assert_array_equal(
+                    member, snap,
+                    err_msg="group member mutated (%s)" % name)
+
+        # Input immutability, every kind: collectives must never
+        # clobber the caller's array.
+        np.testing.assert_array_equal(
+            locals_[r], before,
+            err_msg="input mutated by %s (%s)" % (kind, name))
 
     hvd.shutdown()
     print("FUZZ_OK rank=%d" % r)

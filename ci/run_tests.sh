@@ -33,7 +33,8 @@ run_tier1() {
 # 1401.27s at 40 tests, plus 78.4s measured for the three elastic
 # shrink/blacklist/reset-limit cases added after ≈ 1480s. 1800s keeps
 # ~21% headroom over that worst cold run. (Final r5 suite, 43 tests,
-# cold cache, quiet host: 1231.18s and 1258.37s — holds with ~30%.)
+# consecutive cold-cache quiet-host runs: 1231.18s, 1258.37s,
+# 1346.19s — worst holds with ~25%.)
 run_tier2() {
     echo "=== tier 2 (heavyweight integration) ==="
     timeout "${HVD_CI_TIER2_BUDGET:-1800}" \

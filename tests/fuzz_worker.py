@@ -9,6 +9,7 @@ Deterministic seed => identical op sequence on every rank, as the
 negotiation protocol requires.
 """
 
+import os
 import sys
 
 import jax
@@ -18,6 +19,8 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
+
+SEED = int(os.environ.get("HVD_FUZZ_SEED", "20260731"))
 
 DTYPES = [np.float32, np.float64, np.int32, np.int64, np.float16,
           np.uint8, np.int8]
@@ -48,7 +51,7 @@ def main():
     r, n = hvd.rank(), hvd.size()
     assert n == 2
 
-    rng = np.random.RandomState(20260731)  # same stream on every rank
+    rng = np.random.RandomState(SEED)  # same stream on every rank
     for i in range(N_OPS):
         kind = rng.choice(["allreduce", "allgather", "broadcast",
                            "reducescatter", "alltoall", "grouped"])

@@ -27,10 +27,13 @@ def test_jax_sweep():
     assert proc.stdout.count("JAX_SWEEP_OK") == 2, proc.stdout
 
 
-def test_fuzz_np2():
+@pytest.mark.parametrize("seed", ["20260731", "424242"])
+def test_fuzz_np2(seed):
     # Seeded random op mix through the wire path; exact local
-    # expectations per cell (see fuzz_worker.py docstring).
-    proc = _launch("fuzz_worker.py")
+    # expectations per cell (see fuzz_worker.py docstring). Two seeds
+    # double the sampled corner set; the seed is part of the test id
+    # so a failure is reproducible verbatim.
+    proc = _launch("fuzz_worker.py", extra_env={"HVD_FUZZ_SEED": seed})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("FUZZ_OK") == 2, proc.stdout
 

@@ -208,3 +208,72 @@ def test_mesh_factory():
         make_mesh({"data": 3})
     with pytest.raises(ValueError):
         make_mesh({"data": -1, "model": -1})
+
+
+def test_ingraph_fuzz(mesh8):
+    """Seeded random op x dtype x shape sweep on the in-graph plane:
+    12 cells through shard_map over the virtual 8-device mesh, exact
+    expectations computed in numpy (the enumerated tests above cover
+    the named cells; this samples the cross-product corners)."""
+    rng = np.random.RandomState(31072026)
+    for i in range(12):
+        kind = rng.choice(["allreduce", "allgather", "reducescatter",
+                           "broadcast"])
+        dt = [np.float32, np.bfloat16 if hasattr(np, "bfloat16")
+              else np.float32, np.int32][rng.randint(3)]
+        inner = (int(rng.randint(1, 4)),)
+        rows_per_rank = int(rng.randint(1, 3))
+        # x[r] block = (r+1) * seeded values, one block per rank.
+        base = rng.rand(8 * rows_per_rank, *inner)
+        if np.issubdtype(dt, np.integer):
+            base = (base * 10).astype(dt)
+        else:
+            base = base.astype(dt)
+        scale = np.repeat(np.arange(1, 9, dtype=np.float64),
+                          rows_per_rank)[:, None]
+        x = (base.astype(np.float64) * scale).astype(dt)
+        blocks = [x[r * rows_per_rank:(r + 1) * rows_per_rank]
+                  for r in range(8)]
+
+        if kind == "allreduce":
+            out = _per_rank(mesh8, lambda s: C.allreduce(s, op=C.Sum), x)
+            # Per-device shard: sum over ranks of each rank's block.
+            expect = np.tile(
+                sum(b.astype(np.float64) for b in blocks), (8, 1))
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), expect,
+                rtol=2e-2 if dt not in (np.float32, np.int32) else 1e-5)
+        elif kind == "allgather":
+            out = _per_rank(mesh8, lambda s: C.allgather(s), x,
+                            check_vma=False)
+            expect = np.tile(x.astype(np.float64), (8, 1))
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), expect, rtol=1e-6)
+        elif kind == "reducescatter":
+            # scatter_dim rows must divide the axis: rebuild this
+            # cell's input with 8 rows per device.
+            base8 = rng.rand(64, *inner)
+            base8 = ((base8 * 10).astype(dt)
+                     if np.issubdtype(dt, np.integer)
+                     else base8.astype(dt))
+            scale8 = np.repeat(np.arange(1, 9, dtype=np.float64),
+                               8)[:, None]
+            x8 = (base8.astype(np.float64) * scale8).astype(dt)
+            blocks8 = [x8[q * 8:(q + 1) * 8] for q in range(8)]
+            out = _per_rank(
+                mesh8, lambda s: C.reducescatter(s, op=C.Sum), x8,
+                check_vma=False)
+            total = sum(b.astype(np.float64) for b in blocks8)
+            # Device q's shard is row q of the reduced block; stacked
+            # over devices that is exactly `total`.
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), total,
+                rtol=2e-2 if dt not in (np.float32, np.int32) else 1e-5)
+        else:
+            root = int(rng.randint(8))
+            out = _per_rank(
+                mesh8, lambda s: C.broadcast(s, root_rank=root), x,
+                check_vma=False)
+            expect = np.tile(blocks[root].astype(np.float64), (8, 1))
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), expect, rtol=1e-6)

@@ -434,6 +434,21 @@ def _last_metric_json(text):
     return None
 
 
+def _git_sha():
+    """Current HEAD commit of the repo this file lives in, or None
+    (detached tarballs, git missing). Used to stamp opportunistic TPU
+    captures at stash time and to flag staleness when one is embedded
+    into a later run's result (ADVICE.md round 5)."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = (proc.stdout or "").strip()
+    return sha if proc.returncode == 0 and sha else None
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--child", action="store_true",
@@ -579,6 +594,23 @@ def _attach_tpu_capture(result):
     except (OSError, ValueError):
         return
     if isinstance(capture, dict) and capture.get("platform") == "tpu":
+        # Staleness check: a capture taken at a different commit is
+        # still the best silicon datapoint available, but it must never
+        # be silently presented as measuring the current code.
+        current = _git_sha()
+        captured = capture.get("git_sha")
+        if captured is None:
+            capture["stale_capture_warning"] = (
+                "capture predates git-sha stamping; the commit it "
+                "measured is unknown")
+        elif current is not None and captured != current:
+            capture["stale_capture_warning"] = (
+                "captured at commit %s but this run is at %s; the "
+                "silicon numbers may not reflect current code"
+                % (captured[:12], current[:12]))
+        if capture.get("stale_capture_warning"):
+            print("warning: embedded tpu_capture is stale: %s"
+                  % capture["stale_capture_warning"], file=sys.stderr)
         result["tpu_capture"] = capture
 
 

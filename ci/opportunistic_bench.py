@@ -44,6 +44,7 @@ OUT_PATH = os.path.join(REPO, "BENCH_opportunistic.json")
 LOCK_PATH = "/tmp/hvd_opportunistic_bench.lock"
 
 sys.path.insert(0, REPO)
+from bench import _git_sha  # noqa: E402
 from bench import _last_metric_json  # noqa: E402
 from bench import _tpu_relay_reachable as relay_reachable  # noqa: E402
 
@@ -73,6 +74,10 @@ def capture(timeout_s=2700):
     600s default) because the four-workload sweep compiles a
     12-layer model on a host that may be running CI concurrently.
     """
+    # Read HEAD before the (up to ~45 min) run: the child imports the
+    # code present NOW, so this is the commit the measurement belongs
+    # to even if the developer commits mid-run.
+    sha_at_start = _git_sha()
     env = dict(os.environ,
                HVD_BENCH_TPU_RETRIES="2",
                HVD_BENCH_TPU_BACKOFF="30",
@@ -114,6 +119,16 @@ def capture(timeout_s=2700):
               % (result.get("value", 0), prev.get("value", 0)))
         return 0
     result["captured_unix_time"] = int(time.time())
+    # Stamp the commit the capture measured: _attach_tpu_capture
+    # (bench.py) compares it to HEAD when embedding, so stale silicon
+    # numbers are flagged instead of silently presented as current.
+    if sha_at_start:
+        result["git_sha"] = sha_at_start
+        sha_now = _git_sha()
+        if sha_now and sha_now != sha_at_start:
+            print("capture: HEAD moved %s -> %s during the run; "
+                  "stamping the start commit (the code measured)"
+                  % (sha_at_start[:12], sha_now[:12]))
     tmp = OUT_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(result, f)

@@ -20,7 +20,15 @@ TIER="${1:-all}"
 # 720s) proved too thin. (Final r5 suite, 316 tests, cold cache:
 # 868.40s — holds.)
 run_tier1() {
-    echo "=== tier 1 (default suite) ==="
+    echo "=== tier 1: metrics subsystem fast-fail ==="
+    # The metrics registry underpins scrape-based dashboards and the
+    # /metrics route every runner HTTP server exposes; if it is broken,
+    # fail in seconds before the full tier burns its wall budget. The
+    # np=2 bridge test is excluded here — the full tier runs it.
+    timeout "${HVD_CI_METRICS_BUDGET:-180}" \
+        python -m pytest tests/test_metrics.py -q -p no:cacheprovider \
+        -k "not bridge"
+    echo "=== tier 1 (default suite, includes tests/test_metrics.py) ==="
     timeout "${HVD_CI_TIER1_BUDGET:-1200}" \
         python -m pytest tests/ -q -p no:cacheprovider
 }

@@ -6,10 +6,13 @@ from horovod_tpu.common.basics import (  # noqa: F401
     is_initialized,
     local_rank,
     local_size,
+    metrics_snapshot,
     rank,
     shutdown,
     size,
+    start_metrics_server,
     start_timeline,
+    stop_metrics_server,
     stop_timeline,
 )
 from horovod_tpu.common.exceptions import (  # noqa: F401

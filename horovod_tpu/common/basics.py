@@ -51,6 +51,13 @@ class _Context:
     core: Optional[object] = None
     # Timeline state (horovod_tpu.utils.timeline.Timeline), lazily created.
     timeline: Optional[object] = None
+    # /metrics HTTP server (runner.http_server.KVStoreServer), started
+    # via start_metrics_server() or the HVD_METRICS_PORT env knob.
+    metrics_server: Optional[object] = None
+    # Bound port to re-serve after an elastic shutdown/init cycle: a
+    # programmatically started server must survive resets the same way
+    # the env-knob path does (scrapers keep targeting the same port).
+    metrics_restart_port: Optional[int] = None
     lock: threading.RLock = field(default_factory=threading.RLock)
 
 
@@ -189,6 +196,29 @@ def init(process_sets=None):
 
             for ps in process_sets:
                 ps_mod.add_process_set(ps)
+        # Stall/health reporter: keeps hvd_seconds_since_last_collective
+        # and the core's pending/stalled gauges fresh between scrapes
+        # (docs/metrics.md). Registry and counters deliberately survive
+        # shutdown/init cycles (elastic resets are themselves counted).
+        from horovod_tpu.utils import metrics as metrics_mod
+
+        metrics_mod.start_health_reporter()
+        port_env = os.environ.get("HVD_METRICS_PORT")
+        if port_env not in (None, ""):
+            _try_start_metrics_server(
+                port_env, "HVD_METRICS_PORT=%s" % port_env,
+                offset_local_rank=True)
+            _ctx.metrics_restart_port = None
+        elif _ctx.metrics_restart_port is not None:
+            # A server the user started programmatically before an
+            # elastic reset: rebind the same (already rank-offset)
+            # port so scrapers keep working across the new world. A
+            # transient bind failure keeps the port remembered so the
+            # NEXT reset retries instead of going dark for good.
+            if _try_start_metrics_server(
+                    _ctx.metrics_restart_port,
+                    "metrics server restart after reset") is not None:
+                _ctx.metrics_restart_port = None
         atexit.register(shutdown)
 
 
@@ -202,10 +232,16 @@ def shutdown():
                 # Barrier first so no rank tears the TCP mesh down while a
                 # peer is still mid-cycle (avoids spurious "broken pipe"
                 # coordination errors on clean exits).
+                from horovod_tpu.common.process_sets import (
+                    global_process_set,
+                )
                 from horovod_tpu.ops import eager
 
                 try:
-                    eager.barrier()
+                    # Backend call, not eager.barrier(): this barrier's
+                    # failure is EXPECTED on staggered clean exits and
+                    # must not count into hvd_collective_errors_total.
+                    eager._backend().barrier(global_process_set)
                 except Exception:
                     pass  # peers may already be gone; close anyway
                 _ctx.core.shutdown()
@@ -216,6 +252,16 @@ def shutdown():
                 _ctx.timeline.close()
             finally:
                 _ctx.timeline = None
+        # Preserve the bound port across the stop so an elastic
+        # shutdown/init cycle re-serves on it (stop_metrics_server
+        # clears it — an explicit user stop means stay stopped).
+        restart_port = (_ctx.metrics_server.port
+                        if _ctx.metrics_server is not None else None)
+        stop_metrics_server()
+        _ctx.metrics_restart_port = restart_port
+        from horovod_tpu.utils import metrics as metrics_mod
+
+        metrics_mod.stop_health_reporter()
         _ctx.initialized = False
 
 
@@ -347,6 +393,77 @@ def core_session():
 
 def _timeline():
     return _ctx.timeline
+
+
+def metrics_snapshot():
+    """JSON-able snapshot of the process-wide metrics registry: native
+    core counters (negotiation responses, cache hits, fusion), eager
+    per-collective latency/bytes histograms, elastic reset/commit
+    counters, data-pipeline throughput, and the stall/health gauges
+    (``hvd_stalled_tensors``, ``hvd_seconds_since_last_collective``).
+    Collectors (e.g. the native-counter bridge) run first, so the view
+    is fresh. See docs/metrics.md for the catalog."""
+    from horovod_tpu.utils import metrics
+
+    return metrics.snapshot()
+
+
+def start_metrics_server(port: int = 0) -> int:
+    """Serve ``GET /metrics`` (Prometheus text format 0.0.4) and
+    ``GET /metrics.json`` from this process; returns the bound port
+    (``port=0`` picks an ephemeral one). Idempotent: a second call
+    returns the already-running server's port. Set ``HVD_METRICS_PORT``
+    to have ``hvd.init()`` do this automatically (each co-located
+    worker serves on base + local_rank)."""
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    with _ctx.lock:
+        if _ctx.metrics_server is not None:
+            return _ctx.metrics_server.port
+        # metrics_only: the scrape port must not double as a writable
+        # KV store (operators open it to their Prometheus fleet).
+        server = KVStoreServer(port=port, metrics_only=True)
+        server.start()
+        _ctx.metrics_server = server
+        return server.port
+
+
+def stop_metrics_server():
+    """Stop the /metrics server started by ``start_metrics_server``
+    (idempotent). An explicit stop also cancels any pending
+    restart-after-reset (``shutdown()`` preserves it instead, so the
+    server comes back with the next ``init()``)."""
+    with _ctx.lock:
+        server, _ctx.metrics_server = _ctx.metrics_server, None
+        _ctx.metrics_restart_port = None
+    if server is not None:
+        try:
+            server.stop()
+        except Exception:
+            pass
+
+
+def _try_start_metrics_server(base_port, source: str,
+                              offset_local_rank: bool = False):
+    """Best-effort server start shared by the ``HVD_METRICS_PORT`` init
+    path, the restart-after-reset path, and ``MetricsCallback(port=)``:
+    an observability knob must never take training down, so a malformed
+    value or unbindable port logs a warning and continues. With
+    ``offset_local_rank``, co-located workers serve on base +
+    local_rank so one host's workers never collide (base 0 picks an
+    ephemeral port). Returns the bound port or None."""
+    try:
+        port = int(base_port)
+        if port != 0 and offset_local_rank and _ctx.initialized:
+            port += _ctx.topology.local_rank
+        return start_metrics_server(port)
+    except (ValueError, OverflowError, OSError) as e:
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "%s: could not start the metrics server (%s); "
+            "continuing without one", source, e)
+        return None
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False):

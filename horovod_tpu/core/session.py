@@ -13,12 +13,37 @@ import ctypes
 import itertools
 import os
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from horovod_tpu.core.build import library_path
+from horovod_tpu.utils import metrics as _metrics
+
+# Bridge of the native perf counters (core/src/perf.cc via
+# hvd_core_counters) into the process-wide metrics registry
+# (docs/metrics.md). The native side reports running totals; the
+# bridge publishes deltas so registry counters stay monotonic across
+# elastic resets (each reset starts a fresh core at zero).
+_M_CORE = {
+    "responses": _metrics.counter(
+        "hvd_core_responses_total",
+        "Negotiated responses executed by the native background loop."),
+    "cached_responses": _metrics.counter(
+        "hvd_core_cached_responses_total",
+        "Responses served from the coordinator's response cache."),
+    "fused_tensors": _metrics.counter(
+        "hvd_core_fused_tensors_total",
+        "Tensors batched into fusion-buffer executions."),
+    "allreduced_tensors": _metrics.counter(
+        "hvd_core_allreduced_tensors_total",
+        "Tensors allreduced by the native core."),
+    "allreduce_bytes": _metrics.counter(
+        "hvd_core_allreduce_bytes_total",
+        "Payload bytes allreduced by the native core."),
+}
 
 # OpType values must match core/src/common.h.
 OP_ALLREDUCE = 0
@@ -52,7 +77,8 @@ def _dtype_code(dtype) -> int:
 class _Pending:
     """One in-flight op: owns input/output buffers until completion."""
 
-    __slots__ = ("kind", "buf", "group", "index", "shape", "dtype")
+    __slots__ = ("kind", "buf", "group", "index", "shape", "dtype",
+                 "submitted_at")
 
     def __init__(self, kind, buf, group, index, shape, dtype):
         self.kind = kind
@@ -61,6 +87,9 @@ class _Pending:
         self.index = index
         self.shape = shape
         self.dtype = dtype
+        # Enqueue stamp for the hvd_stalled_tensors gauge (an op this
+        # old with no completion is negotiation-wedged or peer-dead).
+        self.submitted_at = time.monotonic()
 
 
 class _Group:
@@ -111,6 +140,28 @@ class CoreSession:
         # Keep the trampoline alive for the lib's lifetime; installed in
         # start() after hvd_core_init (the core ignores it before init).
         self._trampoline = _CALLBACK_TYPE(self._on_done)
+        # Metrics bridge state: last native totals seen, so the scrape
+        # collector publishes deltas (see _publish_metrics). The lock +
+        # closed flag serialize scrape-thread counters() calls against
+        # shutdown(), which frees the native global state.
+        self._metrics_last: Dict[str, int] = {}
+        self._metrics_lock = threading.Lock()
+        self._metrics_closed = False
+        # Gauge threshold for hvd_stalled_tensors. Lenient: malformed
+        # or non-positive values (the native inspector's "disabled"
+        # spelling, controller.cc) fall back to the 60 s default rather
+        # than failing hvd.init() or — worse — flagging every in-flight
+        # tensor as stalled under a 0-second threshold. The gauge is
+        # pure observability, so it stays useful even when native
+        # stall enforcement is off.
+        try:
+            self._stall_warn_seconds = float(
+                os.environ.get("HOROVOD_STALL_CHECK_TIME_SECONDS", "60")
+                or 60)
+        except ValueError:
+            self._stall_warn_seconds = 60.0
+        if self._stall_warn_seconds <= 0:
+            self._stall_warn_seconds = 60.0
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -170,6 +221,11 @@ class CoreSession:
             log = os.environ.get("HOROVOD_AUTOTUNE_LOG")
             lib.hvd_core_autotune_start(
                 log.encode() if log else None)
+        # Fold native counters + pending-tensor health into the metrics
+        # registry on every scrape. Keyed registration: an elastic
+        # reset's fresh session replaces the dead one's collector.
+        _metrics.register_collector("core_session",
+                                    session._publish_metrics)
         return session
 
     # --- native perf subsystem --------------------------------------------
@@ -199,7 +255,42 @@ class CoreSession:
                 "hierarchical": bool(buf[5]),
                 "categorical_samples": int(buf[6])}
 
+    def _publish_metrics(self):
+        """Scrape-time collector: native counter deltas + stall view."""
+        with self._metrics_lock:
+            if self._metrics_closed:
+                return
+            counts = self.counters()
+            for key, total in counts.items():
+                delta = total - self._metrics_last.get(key, 0)
+                if delta > 0:
+                    _M_CORE[key].inc(delta)
+                    self._metrics_last[key] = total
+            # Gauge publication stays under the closed guard too: a
+            # scrape racing shutdown() must not overwrite the final
+            # set_pending_tensors(0, 0) with stale non-zero values
+            # (nothing would ever correct them, and docs/metrics.md
+            # tells operators to page on hvd_stalled_tensors > 0).
+            now = time.monotonic()
+            with self._lock:
+                ages = [now - p.submitted_at
+                        for p in self._pending.values()]
+            _metrics.set_pending_tensors(
+                len(ages),
+                sum(1 for a in ages if a > self._stall_warn_seconds))
+
     def shutdown(self):
+        _metrics.unregister_collector("core_session")
+        try:
+            self._publish_metrics()  # final counter deltas
+        except Exception:
+            pass
+        # A scrape thread inside counters() holds _metrics_lock; taking
+        # it before the native teardown (which frees the core's global
+        # state) makes the delete strictly after any in-flight read.
+        with self._metrics_lock:
+            self._metrics_closed = True
+        _metrics.set_pending_tensors(0, 0)
         self._lib.hvd_core_shutdown()
 
     def attach_timeline(self, timeline):
@@ -417,14 +508,21 @@ class NativeBackend:
                            index=i, root_rank=root_rank, ps_id=ps_id)
         return group.future
 
-    def alltoall_async(self, array, splits, process_set) -> Future:
+    def alltoall_async(self, array, splits, process_set,
+                       name=None) -> Future:
         group = _Group(1)
         ps_id = self._ps_id(process_set)
-        import horovod_tpu.ops.eager as eager_mod
+        if name is None:
+            # Fallback for direct backend callers; the eager layer
+            # always threads its (user-supplied or auto) name through,
+            # so the negotiation key matches the timeline and metrics
+            # label (ADVICE.md round 5 — this used to auto-name the
+            # wire op 'alltoall.native' unconditionally). Per-set
+            # counting (same desync hazard as the barrier sequence
+            # numbers below).
+            import horovod_tpu.ops.eager as eager_mod
 
-        # Per-set counting (same desync hazard as the barrier sequence
-        # numbers above).
-        name = eager_mod._auto_name("alltoall.native", process_set)
+            name = eager_mod._auto_name("alltoall", process_set)
         self._s.submit(OP_ALLTOALL, name, np.asarray(array), group=group,
                        index=0, ps_id=ps_id, splits=splits)
         fut = Future()

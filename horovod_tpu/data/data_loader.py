@@ -17,9 +17,25 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Optional
 
+from horovod_tpu.utils import metrics as _metrics
 
+# Input-pipeline telemetry (docs/metrics.md): when hvd_data_wait_seconds
+# grows while collective latency stays flat, the training job is
+# input-bound, not communication-bound.
+_M_BATCHES = _metrics.counter(
+    "hvd_data_batches_total",
+    "Batches handed to the consumer by the async data loader.")
+_M_WAIT = _metrics.histogram(
+    "hvd_data_wait_seconds",
+    "Consumer wait for the next prefetched batch (0 when the producer "
+    "keeps the queue ahead of the device step).",
+    buckets=_metrics.DEFAULT_LATENCY_BUCKETS)
+_M_DEPTH = _metrics.gauge(
+    "hvd_data_queue_depth",
+    "Prefetch queue depth sampled after each batch is taken.")
 
 
 class BaseDataLoader:
@@ -68,7 +84,9 @@ class AsyncDataLoaderMixin:
 
     def __iter__(self):
         if self.async_loader_queue_size <= 0:
-            yield from super().__iter__()
+            for batch in super().__iter__():
+                _M_BATCHES.inc()
+                yield batch
             return
         self._shutdown.clear()
         self._queue = queue.Queue(maxsize=self.async_loader_queue_size)
@@ -76,11 +94,18 @@ class AsyncDataLoaderMixin:
                                         name="hvd-async-loader")
         self._worker.start()
         while True:
+            wait_start = time.monotonic()
             item = self._queue.get()
             if item is _END:
                 break
             if isinstance(item, _LoaderError):
                 raise item.error
+            # Observed only for real batches: the _END sentinel's wait
+            # is producer teardown, not input latency, and would skew
+            # the input-bound diagnosis by one sample per epoch.
+            _M_WAIT.observe(time.monotonic() - wait_start)
+            _M_DEPTH.set(self._queue.qsize())
+            _M_BATCHES.inc()
             yield item
         self._worker.join(timeout=10)
         self._worker = None

@@ -19,6 +19,15 @@ from typing import Any, Callable, Dict, List, Optional
 
 from horovod_tpu.common import basics
 from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+from horovod_tpu.utils import metrics as _metrics
+
+_M_COMMITS = _metrics.counter(
+    "hvd_elastic_commits_total",
+    "Elastic state commits (State.commit snapshots).")
+_M_HOST_UPDATES = _metrics.counter(
+    "hvd_elastic_host_updates_total",
+    "Graceful HostsUpdatedInterrupt resets triggered at commit "
+    "boundaries by a new driver-published rendezvous version.")
 
 
 def _rendezvous_endpoint():
@@ -63,6 +72,7 @@ class State:
             cb()
 
     def commit(self):
+        _M_COMMITS.inc()
         self.save()
         self.check_host_updates()
 
@@ -73,6 +83,7 @@ class State:
         version = current_rendezvous_version()
         if version is not None and version > self._known_version:
             self._known_version = version
+            _M_HOST_UPDATES.inc()
             raise HostsUpdatedInterrupt(skip_sync=False)
 
     # --- to be implemented by subclasses ---

@@ -25,6 +25,15 @@ from horovod_tpu.common.exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
 )
+from horovod_tpu.utils import metrics as _metrics
+
+_M_RESETS = _metrics.counter(
+    "hvd_elastic_resets_total",
+    "Completed elastic re-initializations (new world adopted).")
+_M_FAILURES = _metrics.counter(
+    "hvd_elastic_failures_total",
+    "HorovodInternalError recoveries in the elastic run wrapper "
+    "(rank death / coordination failure rolled back to last commit).")
 
 
 def _rendezvous():
@@ -104,6 +113,10 @@ def reinit_for_version(min_version: int):
         from horovod_tpu.tensorflow import ingraph
 
         ingraph.init_collective_runtime()
+    # Counted only once the new world is fully adopted (init + any
+    # in-graph pre-flight succeeded) — the metric's contract is
+    # completed resets, not attempts.
+    _M_RESETS.inc()
     return meta["version"]
 
 
@@ -134,6 +147,7 @@ def run(func):
             except HorovodInternalError:
                 # A rank died mid-collective: roll back to the last
                 # commit, rejoin at the next published rendezvous.
+                _M_FAILURES.inc()
                 state.restore()
                 reset_version = state._known_version + 1
             except HostsUpdatedInterrupt as e:

@@ -3,12 +3,73 @@ horovod/keras/callbacks.py:151-190)."""
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import tensorflow as tf
 from tensorflow import keras
 
 import horovod_tpu.tensorflow as hvd
+from horovod_tpu.utils import metrics as _metrics
+
+# Registered at import time so the naming-convention check in
+# tests/test_metrics.py sees the full catalog (docs/metrics.md).
+_M_KERAS_BATCHES = _metrics.counter(
+    "hvd_keras_batches_total", "Training batches completed by Keras fit.")
+_M_KERAS_EPOCHS = _metrics.counter(
+    "hvd_keras_epochs_total", "Training epochs completed by Keras fit.")
+_M_KERAS_LOSS = _metrics.gauge(
+    "hvd_keras_last_loss", "Loss of the most recent training batch.")
+_M_KERAS_EPOCH_SECONDS = _metrics.gauge(
+    "hvd_keras_epoch_seconds", "Wall duration of the last epoch.")
+
+
+class MetricsCallback(keras.callbacks.Callback):
+    """Publish Keras training progress into the horovod_tpu metrics
+    registry (docs/metrics.md), so a ``/metrics`` scrape shows batch
+    and epoch throughput next to the collective/core counters.
+
+    Args:
+        port: optionally start the ``/metrics`` HTTP server at train
+            start (``hvd.start_metrics_server``); like the
+            ``HVD_METRICS_PORT`` init path, co-located workers serve
+            on ``port + local_rank`` and a bind failure logs a warning
+            rather than aborting training. By default only the
+            registry is updated and serving is left to
+            ``HVD_METRICS_PORT`` / an explicit server.
+    """
+
+    def __init__(self, port=None):
+        super().__init__()
+        self._port = port
+        self._epoch_start = None
+
+    def on_train_begin(self, logs=None):
+        if self._port is None:
+            return
+        from horovod_tpu.common import basics
+
+        basics._try_start_metrics_server(
+            self._port, "MetricsCallback(port=%r)" % (self._port,),
+            offset_local_rank=True)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch_start = time.monotonic()
+
+    def on_train_batch_end(self, batch, logs=None):
+        _M_KERAS_BATCHES.inc()
+        if logs and "loss" in logs:
+            try:
+                _M_KERAS_LOSS.set(float(logs["loss"]))
+            except (TypeError, ValueError):
+                pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        _M_KERAS_EPOCHS.inc()
+        if self._epoch_start is not None:
+            _M_KERAS_EPOCH_SECONDS.set(
+                time.monotonic() - self._epoch_start)
 
 
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):

@@ -37,6 +37,17 @@ def _env_flag(name: str) -> bool:
     return os.environ.get(name, "") not in ("", "0")
 
 from horovod_tpu.parallel.mesh import DATA_AXIS
+from horovod_tpu.utils import metrics as _metrics
+
+# In-graph collectives execute inside the jitted program where Python
+# cannot observe per-step latency; what IS observable is each trace
+# (call-site compilation), which is when this Python body runs. A
+# retrace storm on a hot training step shows up here long before it
+# shows up in step time.
+_M_TRACES = _metrics.counter(
+    "hvd_ingraph_collective_traces_total",
+    "In-graph collective call sites traced (counted at trace time, "
+    "not per device step).", ("op",))
 
 # Reduction op identifiers (values match the reference's enum order,
 # reference: horovod/common/common.h ReduceOp usage via torch/mpi_ops.py:54-62).
@@ -113,6 +124,7 @@ def allreduce(
 
     Differentiable: gradients of psum are psum, handled natively by JAX.
     """
+    _M_TRACES.labels("allreduce").inc()
     # HOROVOD_HIERARCHICAL_ALLREDUCE (reference: operations.cc:514-551
     # toggles NCCLHierarchicalAllreduce): with a two-level (dcn, ici)
     # axis tuple, route reduce_scatter(ici)->psum(dcn)->all_gather(ici)
@@ -167,6 +179,7 @@ def grouped_allreduce(
     to a single ``psum`` gives the compiler the same license to fuse the
     transfers into one collective.
     """
+    _M_TRACES.labels("grouped_allreduce").inc()
     xs = list(xs)
     # Two-level grouped path (reference: NCCLHierarchicalAllreduce fused
     # through the 128 MB fusion buffer, nccl_operations.cc:233-440 +
@@ -210,6 +223,7 @@ def allgather(x, *, axis=DATA_AXIS, process_set=None):
     horovod/common/ops/collective_operations.h:143-179 — the eager path in
     ``horovod_tpu.ops.eager`` reproduces that).
     """
+    _M_TRACES.labels("allgather").inc()
     # HOROVOD_HIERARCHICAL_ALLGATHER (reference analog:
     # MPIHierarchicalAllgather, ops/mpi_operations.cc): two-level gather
     # for a (dcn, ici) axis tuple.
@@ -235,6 +249,7 @@ def broadcast(x, root_rank: int = 0, *, axis=DATA_AXIS, process_set=None):
     Implemented as a masked psum — adding exact zeros from non-root ranks —
     which XLA lowers to a single all-reduce on ICI; exact for all dtypes.
     """
+    _M_TRACES.labels("broadcast").inc()
     groups = _groups_for(process_set, _axis_size(axis))
     if process_set is not None and groups is not None:
         if root_rank not in process_set.ranks:
@@ -287,6 +302,7 @@ def alltoall(x, *, axis=DATA_AXIS, split_axis: int = 0, concat_axis: int = 0,
     MPI_Alltoallv). With a ``process_set``, the exchange stays inside
     the set (lowered to ``axis_index_groups``).
     """
+    _M_TRACES.labels("alltoall").inc()
     groups = _uniform_groups_for(process_set, _axis_size(axis))
     n = len(process_set.ranks) if groups is not None else _axis_size(axis)
     if x.shape[split_axis] % n:
@@ -305,6 +321,7 @@ def reducescatter(x, op: int = Sum, *, axis=DATA_AXIS, scatter_dim: int = 0,
     ``scatter_dim``; the building block of hierarchical allreduce
     (reference: ncclReduceScatter step in
     horovod/common/ops/nccl_operations.cc:233-440)."""
+    _M_TRACES.labels("reducescatter").inc()
     groups = _groups_for(process_set, _axis_size(axis))
     n = len(process_set.ranks) if groups is not None else _axis_size(axis)
     if op not in (Average, Sum):

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
@@ -32,6 +33,24 @@ from horovod_tpu.common.process_sets import ProcessSet, global_process_set
 from horovod_tpu.ops.collective_ops import (
     Adasum, Average, Max, Min, Product, Sum,
 )
+from horovod_tpu.utils import metrics as _metrics
+
+# Per-collective telemetry (docs/metrics.md): completion counts,
+# latency and payload-size distributions, labeled by op kind.
+_M_COLLECTIVES = _metrics.counter(
+    "hvd_collectives_total",
+    "Completed eager collectives on this process.", ("op",))
+_M_ERRORS = _metrics.counter(
+    "hvd_collective_errors_total",
+    "Eager collectives that completed with an error.", ("op",))
+_M_LATENCY = _metrics.histogram(
+    "hvd_collective_latency_seconds",
+    "Submit-to-completion latency of eager collectives.", ("op",),
+    buckets=_metrics.DEFAULT_LATENCY_BUCKETS)
+_M_BYTES = _metrics.histogram(
+    "hvd_collective_bytes",
+    "Input payload bytes per eager collective submission.", ("op",),
+    buckets=_metrics.DEFAULT_BYTES_BUCKETS)
 
 _handle_lock = threading.Lock()
 _handles: Dict[int, Future] = {}
@@ -119,6 +138,47 @@ def _record_timeline(name: str, category: str, fut: Future):
         tl.record_future(name, category, fut)
 
 
+def _payload_bytes(tensors) -> int:
+    """Input payload size from shape/dtype metadata only — never a
+    device->host transfer or an O(n) materialization on the submit hot
+    path; inputs without a dtype attribute (plain lists/scalars)
+    contribute 0 rather than paying a conversion just for telemetry."""
+    total = 0
+    for t in tensors:
+        dt = getattr(t, "dtype", None)
+        if dt is None:
+            continue
+        try:
+            itemsize = np.dtype(dt).itemsize
+            n = 1
+            for d in np.shape(t):
+                n *= int(d)
+            total += n * itemsize
+        except Exception:
+            pass
+    return total
+
+
+def _observe_metrics(op_label: str, tensors, fut: Future,
+                     start: float) -> None:
+    nbytes = _payload_bytes(tensors)
+
+    def _done(f: Future):
+        if f.exception() is not None:
+            _M_ERRORS.labels(op_label).inc()
+        else:
+            # Liveness is stamped on SUCCESS only (matching
+            # _observed_sync): a retry loop of failing collectives must
+            # let hvd_seconds_since_last_collective grow, or the gauge
+            # operators alert on would hide a fully degraded job.
+            _M_COLLECTIVES.labels(op_label).inc()
+            _metrics.mark_collective()
+        _M_LATENCY.labels(op_label).observe(time.monotonic() - start)
+        _M_BYTES.labels(op_label).observe(nbytes)
+
+    fut.add_done_callback(_done)
+
+
 def _to_numpy(x) -> np.ndarray:
     if isinstance(x, np.ndarray):
         return x
@@ -170,7 +230,9 @@ class LocalBackend:
         fut.set_result([_to_numpy(a) for a in arrays])
         return fut
 
-    def alltoall_async(self, array, splits, process_set) -> Future:
+    def alltoall_async(self, array, splits, process_set,
+                       name=None) -> Future:
+        del name  # size-1 identity path: nothing to negotiate
         fut = Future()
         a = _to_numpy(array)
         if splits is not None and int(np.sum(splits)) != a.shape[0]:
@@ -215,11 +277,13 @@ def allreduce_async(tensor, *, name: Optional[str] = None, op: Optional[int] = N
     basics._check_initialized()
     op = _effective_op(op, average)
     name = name or _auto_name("allreduce", process_set)
+    start = time.monotonic()
     fut = _backend().allreduce_async([tensor], [name], op, prescale_factor,
                                      postscale_factor, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
     _record_timeline(name, "allreduce", out)
+    _observe_metrics("allreduce", [tensor], out, start)
     return _register(out)
 
 
@@ -236,12 +300,14 @@ def grouped_allreduce_async(tensors: Sequence, *, name: Optional[str] = None,
     op = _effective_op(op, None)
     base = name or _auto_name("grouped_allreduce", process_set)
     names = ["%s.%d" % (base, i) for i in range(len(tensors))]
+    start = time.monotonic()
     fut = _backend().allreduce_async(list(tensors), names, op, prescale_factor,
                                      postscale_factor, process_set)
     out = Future()
     _chain(fut, out,
            lambda rs: [_like_input(r, t) for r, t in zip(rs, tensors)])
     _record_timeline(base, "allreduce", out)
+    _observe_metrics("grouped_allreduce", list(tensors), out, start)
     return _register(out)
 
 
@@ -253,10 +319,12 @@ def allgather_async(tensor, *, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
     name = name or _auto_name("allgather", process_set)
+    start = time.monotonic()
     fut = _backend().allgather_async([tensor], [name], process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
     _record_timeline(name, "allgather", out)
+    _observe_metrics("allgather", [tensor], out, start)
     return _register(out)
 
 
@@ -268,10 +336,12 @@ def broadcast_async(tensor, root_rank: int, *, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
     name = name or _auto_name("broadcast", process_set)
+    start = time.monotonic()
     fut = _backend().broadcast_async([tensor], [name], root_rank, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
     _record_timeline(name, "broadcast", out)
+    _observe_metrics("broadcast", [tensor], out, start)
     return _register(out)
 
 
@@ -282,12 +352,18 @@ def broadcast(tensor, root_rank: int, **kwargs):
 def alltoall_async(tensor, splits=None, *, name: Optional[str] = None,
                    process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
+    # The name is threaded through to the backend so the negotiation
+    # key matches the timeline (and metrics) label — the native backend
+    # previously discarded it and auto-named the wire op
+    # 'alltoall.native' (ADVICE.md round 5).
     name = name or _auto_name("alltoall", process_set)
-    fut = _backend().alltoall_async(tensor, splits, process_set)
+    start = time.monotonic()
+    fut = _backend().alltoall_async(tensor, splits, process_set, name)
     out = Future()
     _chain(fut, out,
            lambda r: (_like_input(r[0], tensor), r[1]))
     _record_timeline(name, "alltoall", out)
+    _observe_metrics("alltoall", [tensor], out, start)
     return _register(out)
 
 
@@ -306,10 +382,12 @@ def reducescatter_async(tensor, *, name: Optional[str] = None,
         raise ValueError(
             "reducescatter supports Sum/Average, got op=%r" % (op,))
     name = name or _auto_name("reducescatter", process_set)
+    start = time.monotonic()
     fut = _backend().reducescatter_async([tensor], [name], op, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
     _record_timeline(name, "reducescatter", out)
+    _observe_metrics("reducescatter", [tensor], out, start)
     return _register(out)
 
 
@@ -317,10 +395,26 @@ def reducescatter(tensor, **kwargs):
     return synchronize(reducescatter_async(tensor, **kwargs))
 
 
+def _observed_sync(op_label: str, fn):
+    """Shared instrumentation for the blocking sync ops (barrier/join):
+    count completion or error, observe latency, stamp liveness."""
+    start = time.monotonic()
+    try:
+        result = fn()
+    except Exception:
+        _M_ERRORS.labels(op_label).inc()
+        raise
+    _M_COLLECTIVES.labels(op_label).inc()
+    _M_LATENCY.labels(op_label).observe(time.monotonic() - start)
+    _metrics.mark_collective()
+    return result
+
+
 def barrier(process_set: ProcessSet = global_process_set):
     """Block until all ranks in the set reach the barrier."""
     basics._check_initialized()
-    return _backend().barrier(process_set)
+    return _observed_sync("barrier",
+                          lambda: _backend().barrier(process_set))
 
 
 def join() -> int:
@@ -330,7 +424,7 @@ def join() -> int:
     the value is stable regardless of join timing (reference:
     horovod/common/operations.cc:1714-1742, torch/mpi_ops.py:888)."""
     basics._check_initialized()
-    return _backend().join()
+    return _observed_sync("join", lambda: _backend().join())
 
 
 def _chain(src: Future, dst: Future, transform):

@@ -23,7 +23,41 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = parts[1] if len(parts) > 1 else ""
         return scope, key
 
+    def _serve_metrics(self, as_json: bool):
+        """Prometheus text (or JSON snapshot) of the process-wide
+        metrics registry (docs/metrics.md). Routed before the KV scopes
+        so 'metrics' can never collide with a store scope."""
+        try:
+            from horovod_tpu.utils import metrics
+
+            if as_json:
+                body = metrics.render_json().encode()
+                ctype = "application/json"
+            else:
+                body = metrics.render_prometheus().encode()
+                ctype = metrics.PROMETHEUS_CONTENT_TYPE
+        except Exception as e:  # a broken registry must not kill the server
+            body = ("metrics export failed: %s\n" % e).encode()
+            self.send_response(500)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/metrics":
+            self._serve_metrics(as_json=False)
+            return
+        if path == "/metrics.json":
+            self._serve_metrics(as_json=True)
+            return
         scope, key = self._split()
         store = self.server.store  # type: ignore[attr-defined]
         with self.server.lock:  # type: ignore[attr-defined]
@@ -38,7 +72,24 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(value)
 
+    def _reject_write_if_metrics_only(self) -> bool:
+        """A server advertised as a metrics scrape target must not also
+        be an unauthenticated writable KV store: on metrics-only
+        servers the write verbs are refused."""
+        if not getattr(self.server, "metrics_only", False):
+            return False
+        length = int(self.headers.get("Content-Length", 0))
+        if length:
+            self.rfile.read(length)
+        self.send_response(405)
+        self.send_header("Allow", "GET")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return True
+
     def do_PUT(self):
+        if self._reject_write_if_metrics_only():
+            return
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
@@ -52,6 +103,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        if self._reject_write_if_metrics_only():
+            return
         scope, key = self._split()
         with self.server.lock:  # type: ignore[attr-defined]
             self.server.store.get(scope, {}).pop(key, None)
@@ -66,11 +119,15 @@ class _KVHandler(BaseHTTPRequestHandler):
 class KVStoreServer:
     """In-process threaded HTTP KV store."""
 
-    def __init__(self, port: int = 0, put_callback=None):
+    def __init__(self, port: int = 0, put_callback=None,
+                 metrics_only: bool = False):
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.put_callback = put_callback  # type: ignore[attr-defined]
+        # Refuse HTTP writes: hvd.start_metrics_server() exposes this
+        # port to scrapers, which must not get a writable KV store.
+        self._httpd.metrics_only = metrics_only  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

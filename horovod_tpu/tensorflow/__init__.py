@@ -681,8 +681,10 @@ def check_num_rank_power_of_2(num_rank):
 
 def gpu_available(*_compat_args):
     """Whether TF sees any GPU (reference: tensorflow/util.py
-    gpu_available). Always False on TPU images; kept for migrated
-    call sites."""
+    gpu_available): reports TF's ACTUAL GPU visibility via
+    ``tf.config.list_physical_devices("GPU")`` — typically empty on
+    TPU images, but True on hosts that do expose GPUs to TF. Kept for
+    migrated call sites."""
     return bool(tf.config.list_physical_devices("GPU"))
 
 

@@ -9,4 +9,5 @@ from horovod_tpu.keras.callbacks import (  # noqa: F401
     LearningRateScheduleCallback,
     LearningRateWarmupCallback,
     MetricAverageCallback,
+    MetricsCallback,
 )

@@ -1,0 +1,70 @@
+"""np=2 worker: the metrics registry after REAL eager collectives.
+
+Asserts the acceptance contract of the unified metrics subsystem
+(docs/metrics.md): after allreduces through the native core,
+``hvd.metrics_snapshot()`` carries (a) bridged native core counters
+from core/src/perf.cc, (b) per-collective latency/bytes histograms,
+(c) the elastic/stall health gauges — and the Prometheus text render
+serves the same series.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.utils import metrics  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+
+    for _ in range(5):
+        out = hvd.allreduce(np.full(1024, 1.0, np.float32),
+                            name="metrics_probe", op=hvd.Sum)
+        np.testing.assert_allclose(out, 2.0)
+    gathered = hvd.allgather(np.full(4, float(r), np.float32),
+                             name="metrics_gather")
+    assert gathered.shape == (8,), gathered.shape
+
+    snap = hvd.metrics_snapshot()
+
+    # (a) native core counters bridged through CoreSession.counters().
+    assert metrics.value("hvd_core_responses_total") > 0, \
+        snap.get("hvd_core_responses_total")
+    assert metrics.value("hvd_core_allreduced_tensors_total") >= 5
+    assert metrics.value("hvd_core_allreduce_bytes_total") >= 5 * 1024 * 4
+
+    # (b) per-collective latency/bytes histograms from the eager layer.
+    lat = metrics.value("hvd_collective_latency_seconds", op="allreduce")
+    assert lat["count"] >= 5, lat
+    nbytes = metrics.value("hvd_collective_bytes", op="allreduce")
+    assert nbytes["sum"] >= 5 * 1024 * 4, nbytes
+    assert metrics.value("hvd_collectives_total", op="allgather") >= 1
+
+    # (c) health gauges: fresh completion, nothing wedged.
+    since = metrics.value("hvd_seconds_since_last_collective")
+    assert 0.0 <= since < 60.0, since
+    assert metrics.value("hvd_stalled_tensors") == 0
+    assert metrics.value("hvd_pending_tensors") == 0
+
+    # The Prometheus render serves the same series.
+    text = metrics.render_prometheus()
+    assert "# TYPE hvd_core_responses_total counter" in text
+    assert 'hvd_collective_latency_seconds_bucket{op="allreduce"' in text
+    assert "hvd_seconds_since_last_collective" in text
+
+    hvd.shutdown()
+    # After shutdown the bridge must report an idle pipeline.
+    assert metrics.value("hvd_pending_tensors") == 0
+    print("METRICS_OK rank=%d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

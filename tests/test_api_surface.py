@@ -53,6 +53,7 @@ SURFACE = {
     "horovod_tpu.tensorflow.keras.callbacks": [
         "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
         "LearningRateWarmupCallback", "BestModelCheckpoint",
+        "MetricsCallback",
     ],
     "horovod_tpu.tensorflow.keras.elastic": [
         "KerasState", "CommitStateCallback", "UpdateBatchStateCallback",

@@ -43,9 +43,16 @@ run_tier1() {
 # ~21% headroom over that worst cold run. (Final r5 suite, 43 tests,
 # consecutive cold-cache quiet-host runs: 1231.18s, 1258.37s,
 # 1346.19s — worst holds with ~25%.)
+#
+# ISSUE 3 adds the chaos matrix (tests/test_chaos.py: sigstop np=2/3,
+# kill -9, injected half-close/stall ≈ 110s measured warm) and a
+# fault-injection TSAN smoke (jax-free workers; the sanitized core is
+# built in-test BEFORE the preloaded workers launch — forking make
+# under libtsan deadlocks). Budget bumped 1800 -> 2100 to keep the
+# headroom ratio.
 run_tier2() {
-    echo "=== tier 2 (heavyweight integration) ==="
-    timeout "${HVD_CI_TIER2_BUDGET:-1800}" \
+    echo "=== tier 2 (heavyweight integration, incl. chaos suite) ==="
+    timeout "${HVD_CI_TIER2_BUDGET:-2100}" \
         python -m pytest tests/ -q -p no:cacheprovider \
         --override-ini 'addopts=' -m tier2
 }

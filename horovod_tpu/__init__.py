@@ -37,6 +37,7 @@ if _os.environ.get("HOROVOD_WORKER_PLATFORM") == "cpu":
         pass
 
 from horovod_tpu.common import (  # noqa: F401
+    HorovodAbortedError,
     HorovodInternalError,
     HostsUpdatedInterrupt,
     ProcessSet,

@@ -16,6 +16,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     stop_timeline,
 )
 from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodAbortedError,
     HorovodInternalError,
     HorovodVersionMismatchError,
     HostsUpdatedInterrupt,

@@ -15,6 +15,22 @@ class HorovodInternalError(RuntimeError):
     """
 
 
+class HorovodAbortedError(HorovodInternalError):
+    """A collective was aborted by the native core's failure detection:
+    a peer closed its connection (process death), a socket made no
+    progress within the ``HOROVOD_COMM_TIMEOUT_SEC`` deadline
+    (SIGSTOPped peer, network blackhole, half-dead VM), or the
+    connection-abort cascade failed the op after another rank's failure.
+
+    Subclasses :class:`HorovodInternalError`, so elastic training's
+    ``except HorovodInternalError`` recovery (restore last commit +
+    re-rendezvous) absorbs it unchanged; non-elastic callers get a
+    bounded, typed error instead of an infinite hang and should treat
+    the session as dead (``hvd.shutdown()`` then re-init, or exit and
+    let the launcher respawn).
+    """
+
+
 class HostsUpdatedInterrupt(Exception):
     """Raised asynchronously (at commit/step boundaries) when the elastic
     driver discovers that the set of available hosts has changed.

@@ -1,0 +1,88 @@
+"""Fault-injection shim over the native core's chaos hooks.
+
+The C++ data plane (``core/src/comm.cc``) compiles in an env-driven
+fault injector — zero-cost when unarmed — that sabotages a chosen
+rank's connections so failure-detection paths (the
+``HOROVOD_COMM_TIMEOUT_SEC`` progress deadline, the connection-abort
+cascade, elastic recovery) can be exercised deterministically without
+root, tc/netem, or kernel features. This module is the supported way
+to build those environments: the tier-2 chaos suite
+(``tests/test_chaos.py``) uses it, and operators can use it for
+game-day drills.
+
+Modes (the injector arms only on the rank matching ``HVD_FAULT_RANK``):
+
+- ``drop``: shutdown() every connection — data plane dies, process
+  survives (peers see FIN → typed ``HorovodAbortedError`` fast).
+- ``stall``: park the background thread forever — the open-but-silent
+  socket case; only the progress deadline can save the peers.
+- ``half_close``: shutdown(SHUT_WR) toward ``peer`` (or all peers) —
+  the victim keeps reading but never writes again.
+- ``delay``: sleep ``delay_ms`` before each frame — latency injection
+  for soak tests; never fails anything by itself.
+
+Triggering is frame-counted: the fault fires on the first framed send /
+duplex transfer after ``after_frames`` of them completed, so a test can
+let bootstrap and N healthy collectives through before the chaos
+starts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+MODES = ("drop", "stall", "half_close", "delay")
+
+#: Env vars the native injector reads (core/src/comm.cc ParseFaultEnv).
+FAULT_ENV_KEYS = (
+    "HVD_FAULT_RANK",
+    "HVD_FAULT_MODE",
+    "HVD_FAULT_PEER",
+    "HVD_FAULT_AFTER_FRAMES",
+    "HVD_FAULT_DELAY_MS",
+)
+
+
+def fault_env(rank: int, mode: str, *, peer: int = -1,
+              after_frames: int = 0, delay_ms: int = 0) -> Dict[str, str]:
+    """Build the env-var dict arming the injector on ``rank``.
+
+    The same dict can be exported to every rank of a job (the injector
+    self-arms only where ``HVD_FAULT_RANK`` matches), which is exactly
+    what subprocess launchers that share one env need.
+    """
+    if mode not in MODES:
+        raise ValueError("unknown fault mode %r (choose from %s)"
+                         % (mode, ", ".join(MODES)))
+    if rank < 0:
+        raise ValueError("rank must be >= 0, got %d" % rank)
+    if after_frames < 0 or delay_ms < 0:
+        raise ValueError("after_frames/delay_ms must be >= 0")
+    return {
+        "HVD_FAULT_RANK": str(rank),
+        "HVD_FAULT_MODE": mode,
+        "HVD_FAULT_PEER": str(peer),
+        "HVD_FAULT_AFTER_FRAMES": str(after_frames),
+        "HVD_FAULT_DELAY_MS": str(delay_ms),
+    }
+
+
+def clear_fault_env(env: Optional[Dict[str, str]] = None) -> None:
+    """Disarm: remove every injector variable from ``env`` (default
+    ``os.environ``). Takes effect at the next ``hvd.init()`` — the
+    native side re-parses on communicator construction."""
+    env = os.environ if env is None else env
+    for key in FAULT_ENV_KEYS:
+        env.pop(key, None)
+
+
+def is_armed(env: Optional[Dict[str, str]] = None,
+             rank: Optional[int] = None) -> bool:
+    """True when the injector would arm (for ``rank``, if given)."""
+    env = os.environ if env is None else env
+    target = env.get("HVD_FAULT_RANK", "")
+    mode = env.get("HVD_FAULT_MODE", "")
+    if target == "" or mode not in MODES:
+        return False
+    return rank is None or target == str(rank)

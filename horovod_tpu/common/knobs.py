@@ -80,6 +80,20 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HOROVOD_ELASTIC_TIMEOUT", HONORED,
          "runner/elastic_run.py re-scaling rendezvous budget "
          "(reference elastic/driver.py:81, default 600s)"),
+    Knob("HOROVOD_COMM_TIMEOUT_SEC", HONORED,
+         "core/src/comm.cc progress deadline on every blocking socket "
+         "op (default 300; 0 = legacy infinite wait)"),
+    Knob("HOROVOD_ELASTIC_MAX_FAILURES", HONORED,
+         "elastic/worker.py capped-restart failure budget "
+         "(consecutive HorovodInternalError recoveries; 0 = unlimited)"),
+    Knob("HOROVOD_ELASTIC_BACKOFF_BASE", HONORED,
+         "elastic worker+driver exponential backoff base seconds "
+         "between consecutive failure resets (default 1.0)"),
+    Knob("HOROVOD_ELASTIC_BACKOFF_MAX", HONORED,
+         "elastic worker+driver backoff ceiling seconds (default 30)"),
+    Knob("HOROVOD_ELASTIC_STABLE_SEC", HONORED,
+         "elastic/worker.py: a world surviving this long resets the "
+         "consecutive-failure budget (default 60)"),
     Knob("HOROVOD_DISABLE_GROUP_FUSION", HONORED,
          "core/src/controller.cc FuseResponses"),
     Knob("HOROVOD_DYNAMIC_PROCESS_SETS", HONORED,
@@ -135,9 +149,8 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HOROVOD_GLOO_RENDEZVOUS_PORT", ALIASED,
          "HOROVOD_RENDEZVOUS_PORT"),
     Knob("HOROVOD_GLOO_IFACE", ALIASED, "HOROVOD_IFACE"),
-    Knob("HOROVOD_GLOO_TIMEOUT_SECONDS", REJECTED,
-         "gloo transport timeout; the native TCP control plane uses the "
-         "stall inspector for liveness enforcement"),
+    Knob("HOROVOD_GLOO_TIMEOUT_SECONDS", ALIASED,
+         "HOROVOD_COMM_TIMEOUT_SEC"),
     Knob("HOROVOD_HOSTNAME", HONORED, "core/src/comm.cc advertise addr"),
     Knob("HOROVOD_RANK", HONORED, "common/basics.py topology"),
     Knob("HOROVOD_SIZE", HONORED, "common/basics.py topology"),

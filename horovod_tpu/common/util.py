@@ -2,7 +2,30 @@
 
 from __future__ import annotations
 
+import os
+import random
 from typing import List, Sequence
+
+
+def float_env(name: str, default: float) -> float:
+    """Parse a float knob; malformed or empty values keep the default
+    (an env typo must never take init or recovery down)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def failure_backoff_seconds(streak: int, base: float, cap: float) -> float:
+    """Jittered exponential backoff shared by the elastic worker
+    wrapper and the elastic driver (one documented policy,
+    docs/elastic.md): 0 for the first failure in a streak — a single
+    rank death recovers immediately — then min(base * 2**(n-2), cap)
+    scaled by uniform(0.5, 1.0) so restarting workers desynchronize.
+    ``base <= 0`` disables the wait entirely."""
+    if streak < 2 or base <= 0:
+        return 0.0
+    return min(base * 2 ** (streak - 2), cap) * random.uniform(0.5, 1.0)
 
 
 def split_list(items: Sequence, num_parts: int) -> List[list]:

@@ -43,7 +43,28 @@ _M_CORE = {
     "allreduce_bytes": _metrics.counter(
         "hvd_core_allreduce_bytes_total",
         "Payload bytes allreduced by the native core."),
+    "comm_timeouts": _metrics.counter(
+        "hvd_comm_timeouts_total",
+        "Blocking socket operations that hit the HOROVOD_COMM_TIMEOUT_SEC "
+        "progress deadline (wedged peer / network blackhole)."),
+    "aborts": _metrics.counter(
+        "hvd_aborts_total",
+        "Connection-abort cascades triggered by the native core after a "
+        "coordination or data-plane failure."),
+    "bootstrap_retries": _metrics.counter(
+        "hvd_bootstrap_retries_total",
+        "Jittered-backoff connect retries during bootstrap rendezvous and "
+        "mesh setup."),
 }
+
+# StatusType values that mean "a peer is dead or wedged and the abort
+# cascade fired" (core/src/common.h): ABORTED from a closed connection,
+# TIMED_OUT from the HOROVOD_COMM_TIMEOUT_SEC progress deadline. Both
+# surface as the typed HorovodAbortedError so callers (and elastic
+# recovery) can distinguish "restart the communicator" from a
+# programming error.
+STATUS_ABORTED = 3
+STATUS_TIMED_OUT = 6
 
 # OpType values must match core/src/common.h.
 OP_ALLREDUCE = 0
@@ -305,11 +326,16 @@ class CoreSession:
         if pending is None:
             return
         if status != 0:
-            from horovod_tpu.common.exceptions import HorovodInternalError
+            from horovod_tpu.common.exceptions import (
+                HorovodAbortedError,
+                HorovodInternalError,
+            )
 
             msg = err.decode() if err else "collective failed"
-            pending.group.complete(pending.index, None,
-                                   HorovodInternalError(msg))
+            exc_cls = (HorovodAbortedError
+                       if status in (STATUS_ABORTED, STATUS_TIMED_OUT)
+                       else HorovodInternalError)
+            pending.group.complete(pending.index, None, exc_cls(msg))
             return
         try:
             result = self._materialize(pending, out_ptr, out_bytes,
@@ -395,10 +421,10 @@ class CoreSession:
                 # Core stopped (peer exit or coordination failure): this
                 # is the restartable condition elastic wrappers catch.
                 from horovod_tpu.common.exceptions import (
-                    HorovodInternalError,
+                    HorovodAbortedError,
                 )
 
-                group.complete(index, None, HorovodInternalError(
+                group.complete(index, None, HorovodAbortedError(
                     "coordination core is shut down (%s)" % name))
             else:
                 group.complete(index, None,
@@ -420,15 +446,18 @@ class CoreSession:
 
     def counters(self) -> Dict[str, int]:
         """Core observability counters (responses, cache hits, fusion,
-        bytes)."""
-        buf = (ctypes.c_longlong * 5)()
-        self._lib.hvd_core_counters(buf, 5)
+        bytes, comm timeouts, abort cascades, bootstrap retries)."""
+        buf = (ctypes.c_longlong * 8)()
+        self._lib.hvd_core_counters(buf, 8)
         return {
             "responses": buf[0],
             "cached_responses": buf[1],
             "fused_tensors": buf[2],
             "allreduced_tensors": buf[3],
             "allreduce_bytes": buf[4],
+            "comm_timeouts": buf[5],
+            "aborts": buf[6],
+            "bootstrap_retries": buf[7],
         }
 
     def set_params(self, cycle_ms: float = -1.0, fusion_bytes: int = -1):

@@ -7,9 +7,12 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <stdlib.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <cstring>
 #include <thread>
@@ -25,12 +28,196 @@ struct FrameHeader {
 };
 constexpr uint32_t kMagic = 0x48564454;  // "HVDT"
 
+// Sanity cap on a received frame length before out->resize(h.len): a
+// corrupted header must not become an unbounded (or OOM-killing)
+// allocation. 2 GB is far beyond any control-plane payload; the CPU
+// data plane streams through RawSendRecv, which is length-checked by
+// the caller.
+constexpr uint64_t kMaxFrameLen = 1ull << 31;
+// Bootstrap endpoint strings are "host:port"; cap well above any
+// legal hostname so a corrupted length cannot drive the resize below.
+constexpr uint32_t kMaxEndpointLen = 4096;
+
 void SetSockOpts(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
+// errnos that mean "the peer or the connection is gone" rather than a
+// local programming error. Mapped to Status::Aborted so the Python
+// side raises the typed HorovodAbortedError whether the peer died with
+// a FIN (recv 0), an RST (ECONNRESET), or our own abort cascade
+// (ESHUTDOWN/EPIPE) broke the socket first.
+bool IsPeerGoneErrno(int e) {
+  return e == ECONNRESET || e == EPIPE || e == ESHUTDOWN ||
+         e == ECONNABORTED || e == ENOTCONN || e == ETIMEDOUT;
+}
+
+Status SocketError(const char* what) {
+  std::string msg = std::string(what) + " failed: " + strerror(errno);
+  return IsPeerGoneErrno(errno) ? Status::Aborted(msg) : Status::Error(msg);
+}
+
+// Close-on-scope-exit guard for the bootstrap fds: every early error
+// return used to leak rank 0's controller socket and any accepted
+// worker sockets (ISSUE 3 satellite).
+class ScopedFd {
+ public:
+  explicit ScopedFd(int fd = -1) : fd_(fd) {}
+  ~ScopedFd() { reset(); }
+  ScopedFd(const ScopedFd&) = delete;
+  ScopedFd& operator=(const ScopedFd&) = delete;
+  void reset(int fd = -1) {
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = fd;
+  }
+  int get() const { return fd_; }
+  int release() {
+    int f = fd_;
+    fd_ = -1;
+    return f;
+  }
+
+ private:
+  int fd_;
+};
+
+struct FdVecGuard {
+  std::vector<int>& fds;
+  ~FdVecGuard() {
+    for (int& f : fds)
+      if (f >= 0) {
+        ::close(f);
+        f = -1;
+      }
+  }
+};
+
+double EnvDouble(const char* name, double dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  double parsed = strtod(v, &end);
+  if (end == v) return dflt;  // malformed: keep the default
+  return parsed;
+}
+
+long long EnvLL(const char* name, long long dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  return atoll(v);
+}
+
+// Process-wide counters (accessors declared in comm.h).
+std::atomic<long long> g_comm_timeouts{0};
+std::atomic<long long> g_bootstrap_retries{0};
+
+// ------------------------------------------------------- fault injection ---
+// Env-driven chaos hooks for the tier-2 failure-detection tests
+// (tests/test_chaos.py) and manual game-days. Compiled in always;
+// zero-cost when unarmed (a single branch in Send/RawSendRecv). Armed
+// only on the rank whose number matches HVD_FAULT_RANK:
+//
+//   HVD_FAULT_MODE=drop        shutdown() every connection (hard crash
+//                              of the data plane without killing the
+//                              process)
+//   HVD_FAULT_MODE=stall       park the background thread forever (the
+//                              open-but-silent socket case: peers see
+//                              no FIN, only the deadline can save them)
+//   HVD_FAULT_MODE=half_close  shutdown(SHUT_WR) toward HVD_FAULT_PEER
+//                              (or every peer when unset)
+//   HVD_FAULT_MODE=delay       sleep HVD_FAULT_DELAY_MS before each
+//                              frame (latency injection)
+//   HVD_FAULT_AFTER_FRAMES=K   trigger after K framed sends / duplex
+//                              transfers (default 0 = first one)
+//
+// The Python shim horovod_tpu.common.fault_injection builds these env
+// dicts; docs/troubleshooting.md documents the harness.
+
+enum class FaultMode { OFF, DROP, STALL, HALF_CLOSE, DELAY };
+
+struct FaultState {
+  FaultMode mode = FaultMode::OFF;
+  int peer = -1;  // half_close target; -1 = all peers
+  long long after_frames = 0;
+  long long delay_ms = 0;
+  bool half_closed = false;  // fire half_close once
+  std::atomic<long long> frames{0};
+};
+
+FaultState g_fault;
+
+void ParseFaultEnv(int rank) {
+  // Re-parsed (and reset) on every Init so an elastic reset's fresh
+  // communicator starts with a clean frame count.
+  g_fault.mode = FaultMode::OFF;
+  g_fault.peer = -1;
+  g_fault.after_frames = 0;
+  g_fault.delay_ms = 0;
+  g_fault.half_closed = false;
+  g_fault.frames.store(0);
+  const char* fr = getenv("HVD_FAULT_RANK");
+  if (!fr || !*fr || atoi(fr) != rank) return;
+  const char* fm = getenv("HVD_FAULT_MODE");
+  if (!fm || !*fm) return;
+  if (strcmp(fm, "drop") == 0) g_fault.mode = FaultMode::DROP;
+  else if (strcmp(fm, "stall") == 0) g_fault.mode = FaultMode::STALL;
+  else if (strcmp(fm, "half_close") == 0) g_fault.mode = FaultMode::HALF_CLOSE;
+  else if (strcmp(fm, "delay") == 0) g_fault.mode = FaultMode::DELAY;
+  else {
+    HVD_LOG(LogLevel::WARN,
+            std::string("unknown HVD_FAULT_MODE '") + fm + "'; ignored");
+    return;
+  }
+  g_fault.peer = (int)EnvLL("HVD_FAULT_PEER", -1);
+  g_fault.after_frames = EnvLL("HVD_FAULT_AFTER_FRAMES", 0);
+  g_fault.delay_ms = EnvLL("HVD_FAULT_DELAY_MS", 0);
+  HVD_LOG(LogLevel::WARN,
+          std::string("fault injector ARMED: mode=") + fm +
+              " peer=" + std::to_string(g_fault.peer) + " after_frames=" +
+              std::to_string(g_fault.after_frames));
+}
+
 }  // namespace
+
+long long CommTimeoutsTotal() { return g_comm_timeouts.load(); }
+long long CommBootstrapRetriesTotal() { return g_bootstrap_retries.load(); }
+
+Status TcpComm::MaybeInjectFault(int peer) {
+  if (g_fault.mode == FaultMode::OFF) return Status::OK();
+  long long k = g_fault.frames.fetch_add(1);
+  if (k < g_fault.after_frames) return Status::OK();
+  switch (g_fault.mode) {
+    case FaultMode::DELAY:
+      if (g_fault.delay_ms > 0)
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(g_fault.delay_ms));
+      return Status::OK();
+    case FaultMode::HALF_CLOSE:
+      if (!g_fault.half_closed) {
+        g_fault.half_closed = true;
+        for (int p = 0; p < (int)fds_.size(); ++p) {
+          if (fds_[(size_t)p] < 0) continue;
+          if (g_fault.peer >= 0 && p != g_fault.peer) continue;
+          ::shutdown(fds_[(size_t)p], SHUT_WR);
+        }
+        HVD_LOG(LogLevel::WARN, "fault injector: half-closed connection(s)");
+      }
+      return Status::OK();
+    case FaultMode::DROP:
+      HVD_LOG(LogLevel::WARN, "fault injector: dropping all connections");
+      Abort();
+      return Status::Aborted("fault injector dropped connections");
+    case FaultMode::STALL:
+      HVD_LOG(LogLevel::WARN,
+              "fault injector: stalling background thread forever");
+      for (;;) std::this_thread::sleep_for(std::chrono::hours(1));
+    case FaultMode::OFF:
+      break;
+  }
+  (void)peer;
+  return Status::OK();
+}
 
 TcpComm::~TcpComm() { Close(); }
 
@@ -58,13 +245,28 @@ void TcpComm::Close() {
 Status TcpComm::SendAll(int fd, const void* data, size_t len) {
   const char* p = static_cast<const char*>(data);
   while (len > 0) {
-    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      return Status::Error(std::string("send failed: ") + strerror(errno));
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (n > 0) {
+      p += n;
+      len -= (size_t)n;
+      continue;  // progress: the deadline below restarts
     }
-    p += n;
-    len -= (size_t)n;
+    if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      return SocketError("send");
+    struct pollfd pfd{fd, POLLOUT, 0};
+    int rc = ::poll(&pfd, 1, progress_timeout_ms_);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) {
+      ++g_comm_timeouts;
+      return Status::TimedOut(
+          "send made no progress for " +
+          std::to_string(progress_timeout_sec_) +
+          "s (HOROVOD_COMM_TIMEOUT_SEC); peer wedged or network "
+          "blackholed");
+    }
   }
   return Status::OK();
 }
@@ -72,14 +274,29 @@ Status TcpComm::SendAll(int fd, const void* data, size_t len) {
 Status TcpComm::RecvAll(int fd, void* data, size_t len) {
   char* p = static_cast<char*>(data);
   while (len > 0) {
-    ssize_t n = ::recv(fd, p, len, 0);
-    if (n == 0) return Status::Aborted("peer closed connection");
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      return Status::Error(std::string("recv failed: ") + strerror(errno));
+    ssize_t n = ::recv(fd, p, len, MSG_DONTWAIT);
+    if (n > 0) {
+      p += n;
+      len -= (size_t)n;
+      continue;
     }
-    p += n;
-    len -= (size_t)n;
+    if (n == 0) return Status::Aborted("peer closed connection");
+    if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      return SocketError("recv");
+    struct pollfd pfd{fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, progress_timeout_ms_);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) {
+      ++g_comm_timeouts;
+      return Status::TimedOut(
+          "recv made no progress for " +
+          std::to_string(progress_timeout_sec_) +
+          "s (HOROVOD_COMM_TIMEOUT_SEC); peer wedged or network "
+          "blackholed");
+    }
   }
   return Status::OK();
 }
@@ -88,31 +305,116 @@ Status TcpComm::ConnectTo(const std::string& host, int port, int* fd_out,
                           double timeout_sec) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration<double>(timeout_sec);
+  // Deterministic-enough jitter seed: distinct per (rank, port) so a
+  // whole world retrying a dead controller doesn't stampede in phase.
+  unsigned seed = (unsigned)(rank_ * 2654435761u) ^ (unsigned)port ^
+                  (unsigned)::getpid();
+  long long attempt = 0;
   while (true) {
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) return Status::Error("socket() failed");
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons((uint16_t)port);
     if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-      hostent* he = gethostbyname(host.c_str());
-      if (!he) {
-        ::close(fd);
-        return Status::Error("cannot resolve host " + host);
+      // getaddrinfo, not gethostbyname: the latter is thread-unsafe
+      // (static result buffer) and this can race a resolver call on
+      // the Python side of the process.
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      int grc = getaddrinfo(host.c_str(), nullptr, &hints, &res);
+      if (grc != 0 || !res) {
+        if (res) freeaddrinfo(res);
+        return Status::Error("cannot resolve host " + host + ": " +
+                             gai_strerror(grc));
       }
-      memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof(addr.sin_addr));
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
     }
-    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
-      SetSockOpts(fd);
-      *fd_out = fd;
+    ScopedFd fd(::socket(AF_INET, SOCK_STREAM, 0));
+    if (fd.get() < 0) return Status::Error("socket() failed");
+    // Non-blocking connect bounded by poll: a blackholed SYN must not
+    // eat minutes of the bootstrap budget in one kernel-default wait.
+    int flags = fcntl(fd.get(), F_GETFL, 0);
+    fcntl(fd.get(), F_SETFL, flags | O_NONBLOCK);
+    int crc = ::connect(fd.get(), (sockaddr*)&addr, sizeof(addr));
+    bool connected = crc == 0;
+    if (!connected && errno == EINPROGRESS) {
+      struct pollfd pfd{fd.get(), POLLOUT, 0};
+      double remaining = std::chrono::duration<double>(
+                             deadline - std::chrono::steady_clock::now())
+                             .count();
+      // Per-attempt wait: bounded so the retry/backoff loop keeps
+      // cycling (fresh SYNs) instead of parking on one dead attempt.
+      int wait_ms = (int)std::min(1000.0, std::max(0.0, remaining * 1000));
+      int prc = ::poll(&pfd, 1, wait_ms);
+      if (prc > 0) {
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(fd.get(), SOL_SOCKET, SO_ERROR, &err, &elen);
+        connected = err == 0;
+      }
+    }
+    if (connected) {
+      fcntl(fd.get(), F_SETFL, flags);  // back to blocking
+      SetSockOpts(fd.get());
+      *fd_out = fd.release();
       return Status::OK();
     }
-    ::close(fd);
     if (std::chrono::steady_clock::now() > deadline) {
-      return Status::Error("connect to " + host + ":" +
-                           std::to_string(port) + " timed out");
+      // Not counted in g_comm_timeouts: that counter's documented
+      // meaning is "HOROVOD_COMM_TIMEOUT_SEC progress-deadline hits";
+      // this wait is governed by the rendezvous timeout and already
+      // observable through hvd_bootstrap_retries_total.
+      return Status::TimedOut("connect to " + host + ":" +
+                              std::to_string(port) + " timed out after " +
+                              std::to_string(timeout_sec) + "s");
     }
-    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    // Jittered exponential backoff: 20ms doubling to a 640ms ceiling,
+    // each sleep drawn from [base/2, 3*base/2) so retries desynchronize
+    // (reference analog: gloo rendezvous retry; TorchElastic backoff).
+    ++g_bootstrap_retries;
+    ++attempt;
+    long long base = 20LL << (attempt < 5 ? attempt : 5);
+    long long jittered = base / 2 + (long long)(rand_r(&seed) % (unsigned)base);
+    std::this_thread::sleep_for(std::chrono::milliseconds(jittered));
+  }
+}
+
+Status TcpComm::AcceptWithDeadline(int listen_fd, double timeout_sec,
+                                   int* fd_out, const char* phase) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_sec);
+  while (true) {
+    struct pollfd pfd{listen_fd, POLLIN, 0};
+    int wait_ms = -1;
+    if (timeout_sec > 0) {
+      double remaining = std::chrono::duration<double>(
+                             deadline - std::chrono::steady_clock::now())
+                             .count();
+      if (remaining <= 0) remaining = 0;
+      wait_ms = (int)std::min(remaining * 1000, 2147483000.0);
+    }
+    int rc = ::poll(&pfd, 1, wait_ms);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) {
+      // Setup-phase deadline (rendezvous budget), not the
+      // HOROVOD_COMM_TIMEOUT_SEC progress deadline — see ConnectTo.
+      return Status::TimedOut(std::string(phase) + " accept timed out after " +
+                              std::to_string(timeout_sec) +
+                              "s: a peer never connected");
+    }
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      return Status::Error(std::string(phase) + " accept failed: " +
+                           strerror(errno));
+    }
+    *fd_out = fd;
+    return Status::OK();
   }
 }
 
@@ -121,6 +423,17 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
   rank_ = rank;
   size_ = size;
   fds_.assign((size_t)size, -1);
+  // Progress deadline for every post-bootstrap blocking wait. Default
+  // generous (300 s — far beyond any healthy collective, small enough
+  // that a wedged peer becomes an error the same day); 0 keeps the
+  // legacy infinite wait.
+  progress_timeout_sec_ = EnvDouble("HOROVOD_COMM_TIMEOUT_SEC", 300.0);
+  if (progress_timeout_sec_ < 0) progress_timeout_sec_ = 0.0;
+  progress_timeout_ms_ =
+      progress_timeout_sec_ > 0
+          ? (int)std::min(progress_timeout_sec_ * 1000.0, 2147483000.0)
+          : -1;
+  ParseFaultEnv(rank);
   if (size == 1) return Status::OK();
 
   // Data-plane listener on an ephemeral port.
@@ -147,34 +460,90 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
   // --- bootstrap star through rank 0's controller socket ---
   std::vector<std::string> table((size_t)size);
   if (rank == 0) {
-    int boot_fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    setsockopt(boot_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    ScopedFd boot_fd(::socket(AF_INET, SOCK_STREAM, 0));
+    if (boot_fd.get() < 0) return Status::Error("controller socket failed");
+    setsockopt(boot_fd.get(), SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in baddr{};
     baddr.sin_family = AF_INET;
     baddr.sin_addr.s_addr = htonl(INADDR_ANY);
     baddr.sin_port = htons((uint16_t)controller_port);
-    if (::bind(boot_fd, (sockaddr*)&baddr, sizeof(baddr)) != 0)
+    if (::bind(boot_fd.get(), (sockaddr*)&baddr, sizeof(baddr)) != 0)
       return Status::Error("rank 0 cannot bind controller port " +
                            std::to_string(controller_port));
-    if (::listen(boot_fd, size) != 0)
+    if (::listen(boot_fd.get(), size) != 0)
       return Status::Error("controller listen failed");
     table[0] = my_ep;
     std::vector<int> boot_fds((size_t)size, -1);
-    for (int i = 1; i < size; ++i) {
-      int cfd = ::accept(boot_fd, nullptr, nullptr);
-      if (cfd < 0) return Status::Error("controller accept failed");
+    FdVecGuard boot_guard{boot_fds};
+    // One connection failing its hello is RETRYABLE, not fatal: a
+    // worker's bounded non-blocking connect can abandon an attempt the
+    // kernel completed late (accepted here, then immediately reset),
+    // and its retry arrives moments later. Only the overall rendezvous
+    // deadline fails the bootstrap. A second full hello from the same
+    // rank replaces the first (stale) connection.
+    auto boot_deadline = std::chrono::steady_clock::now() +
+                         std::chrono::duration<double>(timeout_sec);
+    int filled = 0;
+    while (filled < size - 1) {
+      double remaining = std::chrono::duration<double>(
+                             boot_deadline -
+                             std::chrono::steady_clock::now())
+                             .count();
+      if (remaining <= 0)
+        return Status::TimedOut(
+            "bootstrap timed out after " + std::to_string(timeout_sec) +
+            "s with " + std::to_string(filled) + "/" +
+            std::to_string(size - 1) + " peers connected");
+      int cfd = -1;
+      Status s = AcceptWithDeadline(boot_fd.get(), remaining, &cfd,
+                                    "bootstrap");
+      if (!s.ok()) return s;
+      ScopedFd accepted(cfd);
       SetSockOpts(cfd);
       int32_t peer_rank;
-      Status s = RecvAll(cfd, &peer_rank, sizeof(peer_rank));
-      if (!s.ok()) return s;
+      s = RecvAll(cfd, &peer_rank, sizeof(peer_rank));
+      if (!s.ok()) {
+        HVD_LOG(LogLevel::WARN,
+                "bootstrap hello failed (" + s.reason +
+                    "); dropping connection and re-listening");
+        continue;
+      }
+      // A corrupted or hostile hello must not become an OOB write into
+      // table/boot_fds (ISSUE 3 satellite) — drop it, keep listening.
+      if (peer_rank <= 0 || peer_rank >= size) {
+        HVD_LOG(LogLevel::WARN,
+                "bootstrap peer announced invalid rank " +
+                    std::to_string(peer_rank) + " (world size " +
+                    std::to_string(size) + "); dropping connection");
+        continue;
+      }
       uint32_t ep_len;
       s = RecvAll(cfd, &ep_len, sizeof(ep_len));
-      if (!s.ok()) return s;
+      if (!s.ok() || ep_len > kMaxEndpointLen) {
+        HVD_LOG(LogLevel::WARN,
+                "bootstrap endpoint read failed for rank " +
+                    std::to_string(peer_rank) + "; dropping connection");
+        continue;
+      }
       std::string ep(ep_len, 0);
       s = RecvAll(cfd, ep.data(), ep_len);
-      if (!s.ok()) return s;
+      if (!s.ok()) {
+        HVD_LOG(LogLevel::WARN,
+                "bootstrap endpoint read failed for rank " +
+                    std::to_string(peer_rank) + "; dropping connection");
+        continue;
+      }
+      if (boot_fds[(size_t)peer_rank] != -1) {
+        HVD_LOG(LogLevel::WARN,
+                "bootstrap rank " + std::to_string(peer_rank) +
+                    " reconnected; replacing the stale connection");
+        ::close(boot_fds[(size_t)peer_rank]);
+        boot_fds[(size_t)peer_rank] = -1;
+        --filled;
+      }
       table[(size_t)peer_rank] = ep;
-      boot_fds[(size_t)peer_rank] = cfd;
+      boot_fds[(size_t)peer_rank] = accepted.release();
+      ++filled;
     }
     // Broadcast the endpoint table.
     std::string blob;
@@ -189,31 +558,39 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
       if (s.ok()) s = SendAll(boot_fds[(size_t)i], blob.data(), blob.size());
       if (!s.ok()) return s;
       ::close(boot_fds[(size_t)i]);
+      boot_fds[(size_t)i] = -1;
     }
-    ::close(boot_fd);
   } else {
-    int boot_fd = -1;
-    Status s = ConnectTo(controller_addr, controller_port, &boot_fd,
+    int raw_boot = -1;
+    Status s = ConnectTo(controller_addr, controller_port, &raw_boot,
                          timeout_sec);
     if (!s.ok()) return s;
+    ScopedFd boot_fd(raw_boot);
     int32_t r32 = rank;
     uint32_t ep_len = (uint32_t)my_ep.size();
-    s = SendAll(boot_fd, &r32, sizeof(r32));
-    if (s.ok()) s = SendAll(boot_fd, &ep_len, sizeof(ep_len));
-    if (s.ok()) s = SendAll(boot_fd, my_ep.data(), my_ep.size());
+    s = SendAll(boot_fd.get(), &r32, sizeof(r32));
+    if (s.ok()) s = SendAll(boot_fd.get(), &ep_len, sizeof(ep_len));
+    if (s.ok()) s = SendAll(boot_fd.get(), my_ep.data(), my_ep.size());
     if (!s.ok()) return s;
     uint64_t blen;
-    s = RecvAll(boot_fd, &blen, sizeof(blen));
+    s = RecvAll(boot_fd.get(), &blen, sizeof(blen));
     if (!s.ok()) return s;
+    if (blen > (uint64_t)size * (kMaxEndpointLen + sizeof(uint32_t)))
+      return Status::Error("bootstrap table length " + std::to_string(blen) +
+                           " exceeds sanity cap");
     std::string blob(blen, 0);
-    s = RecvAll(boot_fd, blob.data(), blen);
+    s = RecvAll(boot_fd.get(), blob.data(), blen);
     if (!s.ok()) return s;
-    ::close(boot_fd);
     const char* p = blob.data();
+    const char* end = p + blob.size();
     for (int i = 0; i < size; ++i) {
       uint32_t n;
+      if (p + sizeof(n) > end)
+        return Status::Error("malformed bootstrap endpoint table");
       memcpy(&n, p, sizeof(n));
       p += sizeof(n);
+      if (n > kMaxEndpointLen || p + n > end)
+        return Status::Error("malformed bootstrap endpoint table");
       table[(size_t)i].assign(p, n);
       p += n;
     }
@@ -222,31 +599,69 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
   // --- full-mesh connect: i dials j for i < j; j accepts ---
   for (int j = rank + 1; j < size; ++j) {
     auto colon = table[(size_t)j].rfind(':');
+    if (colon == std::string::npos)
+      return Status::Error("malformed endpoint for rank " +
+                           std::to_string(j) + ": '" + table[(size_t)j] +
+                           "'");
     std::string host = table[(size_t)j].substr(0, colon);
-    int port = std::stoi(table[(size_t)j].substr(colon + 1));
+    // Strict port parse: a corrupted entry must fail fast as
+    // "malformed endpoint", not burn the rendezvous budget dialing
+    // port 0 (same satellite as the bounds checks above).
+    const char* port_str = table[(size_t)j].c_str() + colon + 1;
+    char* port_end = nullptr;
+    long port = strtol(port_str, &port_end, 10);
+    if (port_end == port_str || *port_end != '\0' || port <= 0 ||
+        port > 65535)
+      return Status::Error("malformed endpoint for rank " +
+                           std::to_string(j) + ": '" + table[(size_t)j] +
+                           "'");
     int fd = -1;
     Status s = ConnectTo(host, port, &fd, timeout_sec);
     if (!s.ok()) return s;
     int32_t r32 = rank;
     s = SendAll(fd, &r32, sizeof(r32));
-    if (!s.ok()) return s;
+    if (!s.ok()) {
+      ::close(fd);
+      return s;
+    }
     fds_[(size_t)j] = fd;
   }
   for (int i = 0; i < rank; ++i) {
-    int fd = ::accept(listen_fd_, nullptr, nullptr);
-    if (fd < 0) return Status::Error("mesh accept failed");
+    int fd = -1;
+    Status s = AcceptWithDeadline(listen_fd_, timeout_sec, &fd, "mesh");
+    if (!s.ok()) return s;
+    ScopedFd accepted(fd);
     SetSockOpts(fd);
     int32_t peer_rank;
-    Status s = RecvAll(fd, &peer_rank, sizeof(peer_rank));
+    s = RecvAll(fd, &peer_rank, sizeof(peer_rank));
     if (!s.ok()) return s;
-    fds_[(size_t)peer_rank] = fd;
+    // Only lower ranks dial us; anything else is corruption.
+    if (peer_rank < 0 || peer_rank >= rank)
+      return Status::Error("mesh peer announced invalid rank " +
+                           std::to_string(peer_rank) +
+                           " (accepting ranks below " +
+                           std::to_string(rank) + ")");
+    if (fds_[(size_t)peer_rank] != -1)
+      return Status::Error("mesh peer rank " + std::to_string(peer_rank) +
+                           " connected twice");
+    fds_[(size_t)peer_rank] = accepted.release();
   }
   HVD_LOG(LogLevel::DEBUG, "TCP mesh established, size=" +
-                               std::to_string(size));
+                               std::to_string(size) +
+                               (progress_timeout_sec_ > 0
+                                    ? ", comm deadline=" +
+                                          std::to_string(
+                                              progress_timeout_sec_) +
+                                          "s"
+                                    : ", comm deadline=off"));
   return Status::OK();
 }
 
 Status TcpComm::Send(int peer, const void* data, size_t len) {
+  if (g_fault.mode != FaultMode::OFF) {
+    Status fs = MaybeInjectFault(peer);
+    if (!fs.ok()) return fs;
+  }
   FrameHeader h{kMagic, (uint32_t)rank_, (uint64_t)len};
   Status s = SendAll(fds_[(size_t)peer], &h, sizeof(h));
   if (!s.ok()) return s;
@@ -258,6 +673,9 @@ Status TcpComm::Recv(int peer, std::string* out) {
   Status s = RecvAll(fds_[(size_t)peer], &h, sizeof(h));
   if (!s.ok()) return s;
   if (h.magic != kMagic) return Status::Error("bad frame magic");
+  if (h.len > kMaxFrameLen)
+    return Status::Error("frame length " + std::to_string(h.len) +
+                         " exceeds sanity cap (corrupted header?)");
   out->resize(h.len);
   return RecvAll(fds_[(size_t)peer], out->data(), h.len);
 }
@@ -276,6 +694,10 @@ Status TcpComm::RecvInto(int peer, void* buf, size_t len) {
 
 Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
                             int peer_r, void* rbuf, size_t rlen) {
+  if (g_fault.mode != FaultMode::OFF) {
+    Status fs = MaybeInjectFault(peer_s);
+    if (!fs.ok()) return fs;
+  }
   int sfd = peer_s >= 0 ? fds_[(size_t)peer_s] : -1;
   int rfd = peer_r >= 0 ? fds_[(size_t)peer_r] : -1;
   const char* sp = static_cast<const char*>(sbuf);
@@ -298,16 +720,26 @@ Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
       pfds[n].events = POLLIN;
       ++n;
     }
-    int rc = ::poll(pfds, (nfds_t)n, 60000);
+    // One deadline policy for framed and duplex transfers: the poll
+    // round is bounded by the same HOROVOD_COMM_TIMEOUT_SEC progress
+    // window (it used to hard-code 60 s here).
+    int rc = ::poll(pfds, (nfds_t)n, progress_timeout_ms_);
     if (rc < 0) {
       if (errno == EINTR) continue;
       return Status::Error(std::string("poll failed: ") + strerror(errno));
     }
-    if (rc == 0) return Status::Error("duplex transfer timed out");
+    if (rc == 0) {
+      ++g_comm_timeouts;
+      return Status::TimedOut(
+          "duplex transfer made no progress for " +
+          std::to_string(progress_timeout_sec_) +
+          "s (HOROVOD_COMM_TIMEOUT_SEC); peer wedged or network "
+          "blackholed");
+    }
     if (si >= 0 && (pfds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
       ssize_t w = ::send(sfd, sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return Status::Error(std::string("send failed: ") + strerror(errno));
+        return SocketError("send");
       if (w > 0) {
         sp += w;
         sleft -= (size_t)w;
@@ -317,7 +749,7 @@ Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
       ssize_t r = ::recv(rfd, rp, rleft, MSG_DONTWAIT);
       if (r == 0) return Status::Aborted("peer closed connection");
       if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return Status::Error(std::string("recv failed: ") + strerror(errno));
+        return SocketError("recv");
       if (r > 0) {
         rp += r;
         rleft -= (size_t)r;

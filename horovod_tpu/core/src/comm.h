@@ -19,6 +19,13 @@
 
 namespace hvd {
 
+// Process-wide comm counters, bridged into hvd_core_counters()
+// (operations.cc) and from there into the Python metrics registry
+// (hvd_comm_timeouts_total / hvd_bootstrap_retries_total,
+// docs/metrics.md). Monotonic across elastic resets.
+long long CommTimeoutsTotal();        // ops that hit the progress deadline
+long long CommBootstrapRetriesTotal();  // ConnectTo retry attempts
+
 class TcpComm {
  public:
   TcpComm() = default;
@@ -63,13 +70,27 @@ class TcpComm {
  private:
   Status ConnectTo(const std::string& host, int port, int* fd_out,
                    double timeout_sec);
+  Status AcceptWithDeadline(int listen_fd, double timeout_sec, int* fd_out,
+                            const char* phase);
+  // Every blocking wait below carries the HOROVOD_COMM_TIMEOUT_SEC
+  // *progress* deadline: the clock resets whenever bytes move, so a
+  // slow-but-alive peer never trips it, while an open-but-silent socket
+  // (SIGSTOPped peer, network blackhole, half-dead VM) surfaces as
+  // Status::TimedOut instead of an infinite hang. 0 = legacy infinite.
   Status SendAll(int fd, const void* data, size_t len);
   Status RecvAll(int fd, void* data, size_t len);
+  // Fault injector hook (HVD_FAULT_* env, comm.cc): zero-cost single
+  // branch when unarmed; called on every framed send / duplex transfer.
+  Status MaybeInjectFault(int peer);
 
   int rank_ = 0;
   int size_ = 1;
   std::vector<int> fds_;  // fds_[peer] = socket, -1 for self
   int listen_fd_ = -1;
+  // Poll timeout derived from HOROVOD_COMM_TIMEOUT_SEC at Init
+  // (-1 = infinite, the legacy behavior when the knob is 0).
+  int progress_timeout_ms_ = -1;
+  double progress_timeout_sec_ = 0.0;
 };
 
 }  // namespace hvd

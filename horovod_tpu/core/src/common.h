@@ -33,6 +33,11 @@ enum class StatusType : int {
   ABORTED = 3,
   INVALID_ARGUMENT = 4,
   IN_PROGRESS = 5,
+  // A blocking socket operation made no progress within the
+  // HOROVOD_COMM_TIMEOUT_SEC deadline (comm.cc). Mapped to
+  // HorovodAbortedError on the Python side, like ABORTED: both mean
+  // "a peer is gone or wedged; elastic recovery should take over".
+  TIMED_OUT = 6,
 };
 
 struct Status {
@@ -52,7 +57,16 @@ struct Status {
   static Status Aborted(const std::string& msg) {
     return Status{StatusType::ABORTED, msg};
   }
+  static Status TimedOut(const std::string& msg) {
+    return Status{StatusType::TIMED_OUT, msg};
+  }
   bool ok() const { return type == StatusType::OK; }
+  // Socket-level failures that mean a peer is dead, wedged, or
+  // unreachable: the background loop escalates these into the
+  // connection-abort cascade so no rank stays blocked.
+  bool is_comm_failure() const {
+    return type == StatusType::ABORTED || type == StatusType::TIMED_OUT;
+  }
 };
 
 // ---------------------------------------------------------------- dtypes ---

@@ -65,6 +65,10 @@ struct Global {
   std::atomic<long long> ctr_fused_tensors{0};
   std::atomic<long long> ctr_allreduced_tensors{0};
   std::atomic<long long> ctr_allreduce_bytes{0};
+  // Connection-abort cascades this core triggered (coordination or
+  // data-plane failure; not clean idle exits). Bridged as
+  // hvd_aborts_total.
+  std::atomic<long long> ctr_aborts{0};
 
   DoneCb callback = nullptr;
 
@@ -641,6 +645,7 @@ void BackgroundLoop() {
         // (the role NCCL's async-error abort plays in the reference,
         // nccl_operations.cc:109-122). Elastic recovery restarts the
         // whole communicator anyway.
+        g->ctr_aborts++;
         g->comm.Abort();
         for (auto* other : sets)
           other->queue.AbortAll(s);
@@ -654,6 +659,7 @@ void BackgroundLoop() {
         TlAllBegin(r, "QUEUE");
       }
       long long cycle_bytes = 0;
+      bool cascaded = false;
       for (size_t i = 0; i < responses.size(); ++i) {
         bool from_cache = i < n_cached;
         g->ctr_responses++;
@@ -695,8 +701,22 @@ void BackgroundLoop() {
         if (!es.ok()) {
           HVD_LOG(LogLevel::ERROR, "collective failed: " + es.reason);
           g->failed.store(true);
+          // A comm-level execution failure (peer closed, progress
+          // deadline) means some peer is dead or wedged mid-transfer:
+          // cascade immediately so every rank blocked in this ring step
+          // (and every queued op) fails with a typed error instead of
+          // waiting for the next negotiation round to discover it.
+          if (es.is_comm_failure()) {
+            g->ctr_aborts++;
+            g->comm.Abort();
+            for (auto* other : sets)
+              other->queue.AbortAll(es);
+            cascaded = true;
+            break;
+          }
         }
       }
+      if (cascaded) break;
       // Autotune scores coordinator-observed payload bytes per wall
       // second (reference: parameter_manager.cc Update).
       if (cycle_bytes > 0 && ps->is_coordinator(g->comm.rank())) {
@@ -982,14 +1002,17 @@ long long hvd_core_fusion_bytes() {
 }
 
 // Fills out[0..n): responses, cached_responses, fused_tensors,
-// allreduced_tensors, allreduce_bytes.
+// allreduced_tensors, allreduce_bytes, comm_timeouts, aborts,
+// bootstrap_retries. Callers pass the slot count they know about, so
+// the layout is append-only.
 void hvd_core_counters(long long* out, int n) {
   if (!g || !out) return;
-  long long vals[5] = {
+  long long vals[8] = {
       g->ctr_responses.load(), g->ctr_cached_responses.load(),
       g->ctr_fused_tensors.load(), g->ctr_allreduced_tensors.load(),
-      g->ctr_allreduce_bytes.load()};
-  for (int i = 0; i < n && i < 5; ++i) out[i] = vals[i];
+      g->ctr_allreduce_bytes.load(), CommTimeoutsTotal(),
+      g->ctr_aborts.load(), CommBootstrapRetriesTotal()};
+  for (int i = 0; i < n && i < 8; ++i) out[i] = vals[i];
 }
 
 }  // extern "C"

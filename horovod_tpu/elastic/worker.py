@@ -25,6 +25,7 @@ from horovod_tpu.common.exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
 )
+from horovod_tpu.common.util import failure_backoff_seconds, float_env
 from horovod_tpu.utils import metrics as _metrics
 
 _M_RESETS = _metrics.counter(
@@ -127,18 +128,34 @@ def run(func):
         def train(state, ...):
             ...
         train(state)
+
+    Failure budget: consecutive ``HorovodInternalError`` recoveries are
+    counted; a world that survives ``HOROVOD_ELASTIC_STABLE_SEC``
+    (default 60) before failing resets the count. From the second
+    consecutive failure on, recovery waits a jittered exponential
+    backoff (``HOROVOD_ELASTIC_BACKOFF_BASE`` doubling up to
+    ``HOROVOD_ELASTIC_BACKOFF_MAX``) so a crash-looping worker degrades
+    gracefully instead of hot-spinning through restore/reinit cycles;
+    when ``HOROVOD_ELASTIC_MAX_FAILURES`` (default 0 = unlimited) is
+    exceeded the error is re-raised so the job fails loudly.
     """
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        max_failures = int(float_env("HOROVOD_ELASTIC_MAX_FAILURES", 0))
+        backoff_base = float_env("HOROVOD_ELASTIC_BACKOFF_BASE", 1.0)
+        backoff_max = float_env("HOROVOD_ELASTIC_BACKOFF_MAX", 30.0)
+        stable_sec = float_env("HOROVOD_ELASTIC_STABLE_SEC", 60.0)
         reset_version = None
         skip_sync = False
+        consecutive_failures = 0
         while True:
             if reset_version is not None:
                 new_version = reinit_for_version(reset_version)
                 state._known_version = new_version
                 state.on_reset()
                 reset_version = None
+            entered = time.monotonic()
             try:
                 if not skip_sync:
                     state.sync()
@@ -148,6 +165,19 @@ def run(func):
                 # A rank died mid-collective: roll back to the last
                 # commit, rejoin at the next published rendezvous.
                 _M_FAILURES.inc()
+                if time.monotonic() - entered > stable_sec:
+                    consecutive_failures = 0
+                consecutive_failures += 1
+                if max_failures and consecutive_failures > max_failures:
+                    sys.stderr.write(
+                        "elastic: failure budget exhausted (%d consecutive "
+                        "recoveries, HOROVOD_ELASTIC_MAX_FAILURES=%d); "
+                        "giving up\n" % (consecutive_failures, max_failures))
+                    raise
+                delay = failure_backoff_seconds(
+                    consecutive_failures, backoff_base, backoff_max)
+                if delay > 0:
+                    time.sleep(delay)
                 state.restore()
                 reset_version = state._known_version + 1
             except HostsUpdatedInterrupt as e:

@@ -117,6 +117,11 @@ def synchronize(handle: int):
         raise ValueError("Unknown handle %r" % (handle,))
     try:
         result = fut.result()
+    except HorovodInternalError:
+        # Already typed (incl. HorovodAbortedError from the core's
+        # failure detection): re-raise as-is so callers and elastic
+        # recovery can distinguish abort/timeout from a logic error.
+        raise
     except Exception as e:
         raise HorovodInternalError(str(e)) from e
     finally:

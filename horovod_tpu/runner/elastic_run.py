@@ -25,6 +25,8 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from horovod_tpu.common.util import failure_backoff_seconds, float_env
+
 from horovod_tpu.runner.discovery import HostDiscoveryScript, HostManager
 from horovod_tpu.runner.exec_util import SlotProcess
 from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
@@ -53,6 +55,18 @@ class ElasticDriver:
             flag_timeout if flag_timeout is not None
             else int(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600")))
         self.reset_limit = args.reset_limit
+        # Failure-reset backoff: a crash-looping world (workers dying
+        # within seconds of every respawn) must degrade gracefully, not
+        # hot-spin respawn cycles. From the second consecutive
+        # failure-triggered reset on, the driver waits a jittered
+        # exponential backoff before re-rendezvousing (shared policy
+        # with the worker wrapper: common/util.failure_backoff_seconds);
+        # a quiet stretch (2x the ceiling, no failures) clears the
+        # streak.
+        self.backoff_base = float_env("HOROVOD_ELASTIC_BACKOFF_BASE", 1.0)
+        self.backoff_max = float_env("HOROVOD_ELASTIC_BACKOFF_MAX", 30.0)
+        self._failure_streak = 0
+        self._last_failure_reset = 0.0
         self.extra_env = _tuning_env(args)
         self.host_manager = HostManager(HostDiscoveryScript(
             args.discovery_script, args.slots_per_host or 1))
@@ -153,6 +167,25 @@ class ElasticDriver:
                     self.args, "prefix_output_with_timestamp", False))
         return True
 
+    def _backoff_before_failure_reset(self):
+        """Jittered exponential wait between consecutive failure resets
+        (none before the first: a single rank death re-rendezvouses
+        immediately, only a crash loop slows down)."""
+        now = time.time()
+        if (self._last_failure_reset
+                and now - self._last_failure_reset > self.backoff_max * 2):
+            self._failure_streak = 0
+        self._failure_streak += 1
+        self._last_failure_reset = now
+        delay = failure_backoff_seconds(self._failure_streak,
+                                        self.backoff_base, self.backoff_max)
+        if delay <= 0:
+            return
+        sys.stderr.write(
+            "elastic: %d consecutive failure resets; backing off %.1fs "
+            "before re-rendezvous\n" % (self._failure_streak, delay))
+        time.sleep(delay)
+
     # --- main loop ----------------------------------------------------------
 
     def run(self) -> int:
@@ -175,6 +208,7 @@ class ElasticDriver:
             while True:
                 time.sleep(self.POLL_SEC)
                 needs_reset = False
+                worker_failed = False
                 for key, proc in list(self.procs.items()):
                     rc = proc.poll()
                     if rc is None:
@@ -193,12 +227,15 @@ class ElasticDriver:
                         if self.fail_counts[key] >= self.MAX_SLOT_FAILURES:
                             self.host_manager.blacklist_slot(key)
                         needs_reset = True
+                        worker_failed = True
 
                 if not self.procs and self.done and not needs_reset:
                     return 0
                 if self.host_manager.refresh():
                     needs_reset = True
                 if needs_reset:
+                    if worker_failed:
+                        self._backoff_before_failure_reset()
                     resets += 1
                     if self.reset_limit and resets > self.reset_limit:
                         sys.stderr.write(

@@ -1,0 +1,70 @@
+"""Jax-free chaos worker for the TSAN fault-injection smoke.
+
+ThreadSanitizer instruments every memory access: importing jax under
+TSAN takes minutes on a small CI host, so this worker talks to the
+native core through ``horovod_tpu.core.session`` directly and installs
+a stub parent package to keep ``horovod_tpu/__init__`` (which pulls
+jax via the in-graph ops) out of the import graph entirely.
+
+Scenario: the fault injector half-closes the victim's connections
+after a few healthy collectives; every rank must observe the typed
+HorovodAbortedError — under TSAN, with zero race reports — instead of
+hanging. This drives the full failure path (poll deadline plumbing,
+abort cascade, status propagation) through the instrumented build.
+"""
+
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Stub parent package: submodule imports below resolve against the real
+# source tree without executing horovod_tpu/__init__.py (jax-free).
+_pkg = types.ModuleType("horovod_tpu")
+_pkg.__path__ = [os.path.join(_REPO, "horovod_tpu")]
+sys.modules["horovod_tpu"] = _pkg
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.common.exceptions import HorovodAbortedError  # noqa: E402
+from horovod_tpu.core.session import (  # noqa: E402
+    OP_ALLREDUCE,
+    CoreSession,
+    _Group,
+)
+
+
+def main():
+    assert "jax" not in sys.modules, "TSAN worker must stay jax-free"
+    topo = types.SimpleNamespace(
+        rank=int(os.environ["HOROVOD_RANK"]),
+        size=int(os.environ["HOROVOD_SIZE"]))
+    session = CoreSession.start(topo)
+
+    got_typed_error = False
+    for i in range(200):
+        group = _Group(1)
+        session.submit(OP_ALLREDUCE, "t.%d" % i,
+                       np.ones(4096, np.float32), group=group, index=0,
+                       op=1)  # Sum
+        try:
+            group.future.result(timeout=120)
+        except HorovodAbortedError as e:
+            print("OK typed error on round %d: %s" % (i, e))
+            got_typed_error = True
+            break
+        except Exception as e:
+            print("FAIL wrong exception type %s: %s"
+                  % (type(e).__name__, e))
+            return 2
+    if not got_typed_error:
+        print("FAIL injector never surfaced an error")
+        return 3
+    session.shutdown()
+    print("CHAOS_TSAN_OK rank %d" % topo.rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Worker for the tier-2 chaos matrix (tests/test_chaos.py).
+
+Exercises the ISSUE-3 acceptance guarantee: with
+``HOROVOD_COMM_TIMEOUT_SEC`` set, a wedged or dead peer surfaces on
+every surviving rank as the typed ``HorovodAbortedError`` within ~2x
+the deadline, never an infinite hang.
+
+Modes (CHAOS_MODE; the victim is rank CHAOS_VICTIM, default n-1):
+
+- ``sigstop``: the victim SIGSTOPs itself with a collective in flight —
+  sockets stay open but silent, the worst case: only the progress
+  deadline can detect it. The test SIGCONT+SIGKILLs the victim after
+  checking the survivors.
+- ``kill9``: the victim SIGKILLs itself mid-collective — peers see the
+  socket close and the abort cascade fires fast.
+- ``half_close`` / ``stall``: the native fault injector (armed by the
+  test via HVD_FAULT_* env) sabotages the victim's connections; in
+  ``half_close`` every rank (victim included) must observe the typed
+  error, in ``stall`` the victim's background thread parks forever and
+  the test kills it.
+
+Exit 0 = this rank observed the expected outcome in time.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common import basics  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodAbortedError  # noqa: E402
+
+MODE = os.environ["CHAOS_MODE"]
+WINDOW = float(os.environ.get("CHAOS_EXPECT_WINDOW", "30"))
+BIG = 4 << 20  # 16 MB fp32: rings are mid-transfer when the fault lands
+
+
+def expect_typed_error(fn):
+    """Run fn; require a HorovodAbortedError (the TYPED error, not a
+    generic internal error) within the window."""
+    t0 = time.time()
+    try:
+        fn()
+    except HorovodAbortedError as e:
+        dt = time.time() - t0
+        if dt >= WINDOW:
+            print("FAIL error arrived after %.1fs (window %.1fs): %s"
+                  % (dt, WINDOW, e))
+            return 1
+        print("OK typed error in %.1fs: %s" % (dt, e))
+        core = basics.core_session()
+        if core is not None:
+            c = core.counters()
+            print("COUNTERS timeouts=%d aborts=%d retries=%d"
+                  % (c["comm_timeouts"], c["aborts"], c["bootstrap_retries"]))
+        return 0
+    except Exception as e:  # wrong type = failed contract
+        print("FAIL wrong exception type %s: %s" % (type(e).__name__, e))
+        return 2
+    print("FAIL collectives unexpectedly kept succeeding")
+    return 3
+
+
+def doom_loop():
+    # Several rounds: the fault lands at an arbitrary point, and rounds
+    # already past the victim's freeze may still complete.
+    for i in range(8):
+        hvd.allreduce(np.ones(BIG, np.float32), name="doom.%d" % i,
+                      op=hvd.Sum)
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    victim = int(os.environ.get("CHAOS_VICTIM", str(n - 1)))
+
+    if MODE in ("sigstop", "kill9"):
+        # Healthy warm round first: the failure must hit a WORKING mesh.
+        out = hvd.allreduce(np.full(8, float(r), np.float32), name="warm",
+                            op=hvd.Sum)
+        np.testing.assert_allclose(out, sum(range(n)))
+        if r == victim:
+            # Wedge with a collective in flight (async handle never
+            # synchronized): peers are mid-negotiation/transfer.
+            hvd.allreduce_async(np.ones(BIG, np.float32), name="doom.0",
+                                op=hvd.Sum)
+            time.sleep(0.2)
+            os.kill(os.getpid(),
+                    signal.SIGSTOP if MODE == "sigstop" else signal.SIGKILL)
+            time.sleep(600)  # SIGCONT'd only to be killed by the test
+            return 4
+        return expect_typed_error(doom_loop)
+
+    if MODE in ("half_close", "stall"):
+        # The injector (HVD_FAULT_* env, armed on the victim only)
+        # triggers after K frames — everyone just drives collectives.
+        # In stall mode the victim itself never returns (its background
+        # thread is parked); the test reaps it with SIGKILL.
+        return expect_typed_error(doom_loop)
+
+    raise ValueError("unknown CHAOS_MODE %r" % MODE)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+"""Tier-2 chaos matrix: deadline-driven failure detection (ISSUE 3).
+
+Acceptance contract under test: with ``HOROVOD_COMM_TIMEOUT_SEC`` set,
+a peer that wedges (SIGSTOP — sockets open but silent), dies (kill -9),
+or sabotages its connections (native fault injector: half-close, stall)
+surfaces on every SURVIVING rank as the typed ``HorovodAbortedError``
+within ~2x the deadline — never an infinite hang. One scenario also
+runs under ThreadSanitizer to race-check the failure paths themselves.
+
+Fast tier-1 stand-ins for the pure-Python pieces live in
+tests/test_fault_tolerance.py.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.common.fault_injection import fault_env
+from tests.test_native_core import _REPO, _ensure_tsan_core, _free_port, _launch
+
+_WORKER = os.path.join(_REPO, "tests", "chaos_worker.py")
+
+pytestmark = [pytest.mark.tier2, pytest.mark.slow]
+
+DEADLINE = 3.0
+
+
+def _spawn(np_, extra_env):
+    """Async variant of test_native_core._launch: returns live Popen
+    handles so scenarios can reap survivors before cleaning up a
+    wedged victim."""
+    port = _free_port()
+    procs = []
+    for r in range(np_):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(r),
+            "HOROVOD_SIZE": str(np_),
+            "HOROVOD_LOCAL_RANK": str(r),
+            "HOROVOD_LOCAL_SIZE": str(np_),
+            "HOROVOD_CROSS_RANK": "0",
+            "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+            "HOROVOD_CYCLE_TIME": "1.0",
+            "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        })
+        env.update(extra_env)
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    return procs
+
+
+def _run_chaos(np_, mode, extra_env=None, deadline=DEADLINE, timeout=150):
+    """Run one scenario; returns (codes, outputs) keyed by rank. The
+    victim (always the last rank) may be left wedged by design
+    (sigstop/stall); it is reaped with SIGCONT+SIGKILL after the
+    survivors are collected."""
+    victim = np_ - 1
+    env = {
+        "CHAOS_MODE": mode,
+        "CHAOS_VICTIM": str(victim),
+        "CHAOS_EXPECT_WINDOW": str(2 * deadline),
+        "HOROVOD_COMM_TIMEOUT_SEC": str(deadline),
+    }
+    env.update(extra_env or {})
+    procs = _spawn(np_, env)
+    victim_hangs = mode in ("sigstop", "stall")
+    outputs, codes = {}, {}
+    hard_deadline = time.time() + timeout
+    try:
+        for r, p in enumerate(procs):
+            if r == victim and victim_hangs:
+                continue
+            out, _ = p.communicate(
+                timeout=max(5.0, hard_deadline - time.time()))
+            outputs[r], codes[r] = out, p.returncode
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    finally:
+        vp = procs[victim]
+        if vp.poll() is None:
+            try:
+                os.kill(vp.pid, signal.SIGCONT)  # a SIGSTOPped child
+            except ProcessLookupError:
+                pass
+            vp.kill()
+        if victim not in outputs:
+            try:
+                vout, _ = vp.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                vp.kill()
+                vout = ""
+            outputs[victim] = vout or ""
+            codes[victim] = vp.returncode
+    return codes, outputs
+
+
+def _assert_survivors_typed(codes, outputs, survivors):
+    for r in survivors:
+        assert codes[r] == 0, "rank %d:\n%s" % (r, outputs[r])
+        assert "OK typed error" in outputs[r], outputs[r]
+
+
+def _counter(outputs, rank, name):
+    for line in outputs[rank].splitlines():
+        if line.startswith("COUNTERS"):
+            for field in line.split()[1:]:
+                k, v = field.split("=")
+                if k == name:
+                    return int(v)
+    return 0
+
+
+@pytest.mark.parametrize("np_", [2, 3])
+def test_chaos_sigstop_typed_error(np_):
+    """A SIGSTOPped peer mid-allreduce (open-but-silent sockets: no FIN,
+    no RST) produces the typed error on every survivor within 2x the
+    deadline — the headline acceptance criterion."""
+    codes, outputs = _run_chaos(np_, "sigstop")
+    survivors = range(np_ - 1)
+    _assert_survivors_typed(codes, outputs, survivors)
+    # Detection had to come from the progress deadline: at least one
+    # survivor's poll timed out (the rest may fail via the cascade).
+    assert sum(_counter(outputs, r, "timeouts") for r in survivors) >= 1, \
+        "\n".join(outputs.values())
+
+
+def test_chaos_kill9_abort_cascade():
+    """kill -9 mid-collective: the closed socket drives the abort
+    cascade and the typed error arrives well inside the window."""
+    codes, outputs = _run_chaos(3, "kill9")
+    _assert_survivors_typed(codes, outputs, (0, 1))
+    assert codes[2] == -9, "victim should have died by SIGKILL:\n%s" \
+        % outputs[2]
+
+
+def test_chaos_half_close_injected():
+    """Native fault injector: the victim half-closes its connections
+    after 100 frames; every rank — victim included, its writes are
+    dead — observes the typed error."""
+    codes, outputs = _run_chaos(
+        2, "half_close",
+        extra_env=fault_env(1, "half_close", after_frames=100))
+    _assert_survivors_typed(codes, outputs, (0, 1))
+
+
+def test_chaos_stall_injected():
+    """Native fault injector: the victim's background thread parks
+    forever (comm-layer SIGSTOP analog); the survivor's deadline fires."""
+    codes, outputs = _run_chaos(
+        2, "stall", extra_env=fault_env(1, "stall", after_frames=100))
+    _assert_survivors_typed(codes, outputs, (0,))
+    assert _counter(outputs, 0, "timeouts") >= 1, outputs[0]
+
+
+def test_fault_injection_tsan_smoke():
+    """One injected failure under ThreadSanitizer: the abort/timeout
+    paths (poll deadline, cascade, status propagation) must be
+    race-free. The sanitized core is built BEFORE the workers launch —
+    forking make under a preloaded libtsan deadlocks — and the worker
+    is jax-free (importing jax under TSAN takes minutes)."""
+    import glob
+
+    libtsan = None
+    for pat in ("/usr/lib/x86_64-linux-gnu/libtsan.so.*",
+                "/usr/lib/gcc/x86_64-linux-gnu/*/libtsan.so"):
+        hits = sorted(glob.glob(pat))
+        if hits:
+            libtsan = hits[-1]
+            break
+    if libtsan is None:
+        pytest.skip("libtsan not available")
+    _ensure_tsan_core()
+    report_prefix = os.path.join(
+        _REPO, "horovod_tpu", "core", "build-thread", "chaos_tsan_report")
+    for old in glob.glob(report_prefix + "*"):
+        os.unlink(old)
+    env = fault_env(1, "half_close", after_frames=50)
+    env.update({
+        "HVD_CORE_SANITIZE": "thread",
+        "LD_PRELOAD": libtsan,
+        "TSAN_OPTIONS": "report_thread_leaks=0 exitcode=66 "
+                        "log_path=%s" % report_prefix,
+        "HOROVOD_COMM_TIMEOUT_SEC": "10",
+    })
+    codes, outputs = _launch(
+        2, os.path.join(_REPO, "tests", "chaos_tsan_worker.py"),
+        extra_env=env, timeout=300)
+    reports = glob.glob(report_prefix + "*")
+    blobs = "".join(open(p).read() for p in reports)
+    assert codes == [0, 0] and not reports, (
+        "TSAN reports:\n%s\nworker output:\n%s"
+        % (blobs[:4000], "\n".join(outputs)[-3000:]))
+    assert sum("CHAOS_TSAN_OK" in o for o in outputs) == 2

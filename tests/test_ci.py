@@ -108,6 +108,22 @@ TIER2_COVERAGE = {
     # variant.
     "test_process_sets_np4":
         "tests/test_native_core.py::test_native_collectives",
+    # Chaos matrix (ISSUE 3): the pure-Python contracts (typed status
+    # mapping, injector shim, elastic budget) are pinned fast in
+    # test_fault_tolerance.py; the multi-process kill/stop/half-close
+    # scenarios are the heavyweight variants.
+    "test_chaos_sigstop_typed_error":
+        "tests/test_fault_tolerance.py::"
+        "test_status_mapping_to_typed_exceptions",
+    "test_chaos_kill9_abort_cascade":
+        "tests/test_fault_tolerance.py::test_aborted_error_is_internal_error",
+    "test_chaos_half_close_injected":
+        "tests/test_fault_tolerance.py::test_fault_env_round_trip",
+    "test_chaos_stall_injected":
+        "tests/test_fault_tolerance.py::"
+        "test_status_mapping_to_typed_exceptions",
+    "test_fault_injection_tsan_smoke":
+        "tests/test_fault_tolerance.py::test_fault_env_round_trip",
 }
 
 

@@ -1,0 +1,267 @@
+"""Fast (tier-1) units for the failure-detection stack (ISSUE 3).
+
+The multi-process chaos matrix lives in tests/test_chaos.py (tier 2);
+this file pins the pure-Python contracts in seconds: the typed
+exception hierarchy and status mapping, the fault-injection shim, the
+knob registry wiring, and the elastic failure budget / backoff logic
+on both the worker and driver sides.
+"""
+
+import argparse
+
+import pytest
+
+from horovod_tpu.common import fault_injection, knobs
+from horovod_tpu.common.exceptions import (
+    HorovodAbortedError,
+    HorovodInternalError,
+)
+
+
+# --- typed exception surface -------------------------------------------------
+
+def test_aborted_error_is_internal_error():
+    """Elastic recovery catches HorovodInternalError; the typed abort
+    must ride that path unchanged while staying distinguishable."""
+    assert issubclass(HorovodAbortedError, HorovodInternalError)
+    with pytest.raises(HorovodInternalError):
+        raise HorovodAbortedError("peer wedged")
+    import horovod_tpu
+
+    assert horovod_tpu.HorovodAbortedError is HorovodAbortedError
+
+
+def _completed_exception(status, msg=b"boom"):
+    """Drive the core callback trampoline with a fake completion and
+    return the exception class the pending future resolves to."""
+    from horovod_tpu.core import session as session_mod
+
+    s = session_mod.CoreSession(None, None)
+    group = session_mod._Group(1)
+    pending = session_mod._Pending(
+        session_mod.OP_ALLREDUCE, None, group, 0, (), None)
+    with s._lock:
+        s._pending[7] = pending
+    s._on_done(7, status, msg, None, 0, None, 0)
+    return group.future.exception()
+
+
+def test_status_mapping_to_typed_exceptions():
+    """ABORTED (3) and TIMED_OUT (6) from the native core surface as
+    HorovodAbortedError; other failures stay HorovodInternalError."""
+    for status in (3, 6):
+        exc = _completed_exception(status)
+        assert type(exc) is HorovodAbortedError, (status, exc)
+    exc = _completed_exception(1)
+    assert type(exc) is HorovodInternalError, exc
+    exc = _completed_exception(2, b"precondition")
+    assert type(exc) is HorovodInternalError
+
+
+def test_synchronize_preserves_typed_exception():
+    """eager.synchronize must not re-wrap the typed abort into a plain
+    HorovodInternalError."""
+    from concurrent.futures import Future
+
+    from horovod_tpu.ops import eager
+
+    fut = Future()
+    fut.set_exception(HorovodAbortedError("peer wedged"))
+    handle = eager._register(fut)
+    with pytest.raises(HorovodAbortedError):
+        eager.synchronize(handle)
+
+
+# --- fault-injection shim ----------------------------------------------------
+
+def test_fault_env_round_trip():
+    env = fault_injection.fault_env(2, "half_close", peer=0,
+                                    after_frames=5, delay_ms=0)
+    assert env == {
+        "HVD_FAULT_RANK": "2",
+        "HVD_FAULT_MODE": "half_close",
+        "HVD_FAULT_PEER": "0",
+        "HVD_FAULT_AFTER_FRAMES": "5",
+        "HVD_FAULT_DELAY_MS": "0",
+    }
+    assert fault_injection.is_armed(env)
+    assert fault_injection.is_armed(env, rank=2)
+    assert not fault_injection.is_armed(env, rank=0)
+    fault_injection.clear_fault_env(env)
+    assert env == {}
+    assert not fault_injection.is_armed({})
+
+
+def test_fault_env_validation():
+    with pytest.raises(ValueError):
+        fault_injection.fault_env(0, "segfault")
+    with pytest.raises(ValueError):
+        fault_injection.fault_env(-1, "drop")
+    with pytest.raises(ValueError):
+        fault_injection.fault_env(0, "delay", delay_ms=-5)
+
+
+# --- knob registry -----------------------------------------------------------
+
+def test_comm_timeout_knob_registered():
+    assert knobs.REGISTRY["HOROVOD_COMM_TIMEOUT_SEC"].status == knobs.HONORED
+    # The reference's gloo transport timeout now maps onto the native
+    # deadline instead of being rejected.
+    gloo = knobs.REGISTRY["HOROVOD_GLOO_TIMEOUT_SECONDS"]
+    assert gloo.status == knobs.ALIASED
+    assert gloo.detail == "HOROVOD_COMM_TIMEOUT_SEC"
+    env = {"HOROVOD_GLOO_TIMEOUT_SECONDS": "45"}
+    knobs.apply_aliases(env)
+    assert env["HOROVOD_COMM_TIMEOUT_SEC"] == "45"
+    for name in ("HOROVOD_ELASTIC_MAX_FAILURES",
+                 "HOROVOD_ELASTIC_BACKOFF_BASE",
+                 "HOROVOD_ELASTIC_BACKOFF_MAX",
+                 "HOROVOD_ELASTIC_STABLE_SEC"):
+        assert knobs.REGISTRY[name].status == knobs.HONORED
+
+
+def test_new_counters_registered_and_cataloged():
+    import os
+    import re
+
+    import horovod_tpu.core.session  # noqa: F401  (registers counters)
+    from horovod_tpu.utils import metrics
+
+    catalog = open(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "metrics.md")).read()
+    for name in ("hvd_comm_timeouts_total", "hvd_aborts_total",
+                 "hvd_bootstrap_retries_total"):
+        assert name in metrics.REGISTRY.names(), name
+        assert re.fullmatch(r"hvd_[a-z_]+", name)
+        assert name in catalog, "docs/metrics.md is missing %s" % name
+
+
+# --- elastic failure budget (worker side) ------------------------------------
+
+class _FakeState:
+    def __init__(self):
+        self._known_version = 0
+        self.restores = 0
+        self.resets = 0
+        self.syncs = 0
+
+    def sync(self):
+        self.syncs += 1
+
+    def restore(self):
+        self.restores += 1
+
+    def on_reset(self):
+        self.resets += 1
+
+
+def test_elastic_run_failure_budget_exhausts(monkeypatch):
+    from horovod_tpu.elastic import worker
+
+    monkeypatch.setenv("HOROVOD_ELASTIC_MAX_FAILURES", "3")
+    monkeypatch.setenv("HOROVOD_ELASTIC_BACKOFF_BASE", "0")
+    versions = []
+    monkeypatch.setattr(worker, "reinit_for_version",
+                        lambda v: versions.append(v) or v)
+
+    state = _FakeState()
+
+    @worker.run
+    def train(st):
+        raise HorovodAbortedError("peer died")
+
+    with pytest.raises(HorovodAbortedError):
+        train(state)
+    # 3 recoveries (restore + reinit) happened before the 4th failure
+    # exhausted the budget and re-raised.
+    assert state.restores == 3
+    assert versions == [1, 2, 3]
+
+
+def test_elastic_run_backoff_waits_from_second_failure(monkeypatch):
+    from horovod_tpu.elastic import worker
+
+    monkeypatch.setenv("HOROVOD_ELASTIC_MAX_FAILURES", "3")
+    monkeypatch.setenv("HOROVOD_ELASTIC_BACKOFF_BASE", "2.0")
+    monkeypatch.setenv("HOROVOD_ELASTIC_BACKOFF_MAX", "3.0")
+    monkeypatch.setattr(worker, "reinit_for_version", lambda v: v)
+    sleeps = []
+    monkeypatch.setattr(worker.time, "sleep", lambda s: sleeps.append(s))
+
+    @worker.run
+    def train(st):
+        raise HorovodInternalError("boom")
+
+    with pytest.raises(HorovodInternalError):
+        train(_FakeState())
+    # First recovery is immediate; the second and third back off, with
+    # the exponential capped at HOROVOD_ELASTIC_BACKOFF_MAX and jitter
+    # drawing from [0.5, 1.0) of the delay.
+    assert len(sleeps) == 2
+    assert 1.0 <= sleeps[0] <= 2.0   # base 2.0, jittered
+    assert 1.5 <= sleeps[1] <= 3.0   # min(4.0, cap 3.0), jittered
+
+
+def test_elastic_run_success_path_untouched(monkeypatch):
+    from horovod_tpu.elastic import worker
+
+    state = _FakeState()
+
+    @worker.run
+    def train(st):
+        return "done"
+
+    assert train(state) == "done"
+    assert state.syncs == 1 and state.restores == 0
+
+
+# --- elastic failure backoff (driver side) -----------------------------------
+
+def _driver(monkeypatch, **env):
+    from horovod_tpu.runner.elastic_run import ElasticDriver
+    from horovod_tpu.runner.launch import parse_args
+
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    ns = argparse.Namespace(
+        discovery_script="./d.sh", min_np=1, max_np=None, np=None,
+        command=["true"], start_timeout=2, reset_limit=None,
+        slots_per_host=1, elastic_timeout=None)
+    defaults = parse_args(["-np", "1", "true"])
+    for key, value in vars(defaults).items():
+        if not hasattr(ns, key):
+            setattr(ns, key, value)
+    return ElasticDriver(ns)
+
+
+def test_driver_backoff_only_from_second_consecutive_failure(monkeypatch):
+    driver = _driver(monkeypatch,
+                     HOROVOD_ELASTIC_BACKOFF_BASE="2.0",
+                     HOROVOD_ELASTIC_BACKOFF_MAX="3.0")
+    sleeps = []
+    import horovod_tpu.runner.elastic_run as er
+
+    monkeypatch.setattr(er.time, "sleep", lambda s: sleeps.append(s))
+    driver._backoff_before_failure_reset()
+    assert sleeps == []  # single failure: immediate re-rendezvous
+    driver._backoff_before_failure_reset()
+    driver._backoff_before_failure_reset()
+    assert len(sleeps) == 2
+    assert 1.0 <= sleeps[0] <= 2.0
+    assert 1.5 <= sleeps[1] <= 3.0
+    # A long quiet stretch clears the streak.
+    driver._last_failure_reset -= driver.backoff_max * 2 + 1
+    driver._backoff_before_failure_reset()
+    assert len(sleeps) == 2
+
+
+def test_driver_backoff_disabled_with_zero_base(monkeypatch):
+    driver = _driver(monkeypatch, HOROVOD_ELASTIC_BACKOFF_BASE="0")
+    import horovod_tpu.runner.elastic_run as er
+
+    sleeps = []
+    monkeypatch.setattr(er.time, "sleep", lambda s: sleeps.append(s))
+    for _ in range(4):
+        driver._backoff_before_failure_reset()
+    assert sleeps == []

@@ -24,6 +24,21 @@ def _free_port() -> int:
     return port
 
 
+def _ensure_tsan_core():
+    """Build the TSAN-instrumented core BEFORE any libtsan-preloaded
+    worker launches: forking the compiler from a preloaded process
+    deadlocks silently (core/build.py refuses that combo for the same
+    reason), so the build must happen here, preload-free."""
+    env = dict(os.environ, HVD_CORE_SANITIZE="thread")
+    env.pop("LD_PRELOAD", None)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from horovod_tpu.core.build import library_path; "
+         "library_path(build_if_missing=True)"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def _launch(np_, script, extra_env=None, timeout=180):
     port = _free_port()
     procs = []
@@ -132,6 +147,7 @@ def test_native_core_under_tsan():
             break
     if libtsan is None:
         pytest.skip("libtsan not available")
+    _ensure_tsan_core()
     report_prefix = os.path.join(
         _REPO, "horovod_tpu", "core", "build-thread", "tsan_report")
     for old in glob.glob(report_prefix + "*"):

@@ -7,11 +7,25 @@
 # is the default `pytest tests/` run, tier 2 holds the heavyweight
 # integration jobs whose code paths tier 1 already covers.
 #
-# Usage: ci/run_tests.sh [tier1|tier2|all]
+# Usage: ci/run_tests.sh [analysis|tier1|tier2|all]
 set -e
 cd "$(dirname "$0")/.."
 
 TIER="${1:-all}"
+
+# Analysis lane: cross-language contract checkers + native static
+# analyzer (docs/static_analysis.md). Runs BEFORE the test lanes and
+# fails fast — a drifted knob registry or counter bridge is cheaper to
+# catch in ~5 min of analysis than in a wedged multi-process test. The
+# checkers take seconds; the budget is dominated by gcc -fanalyzer
+# (controller.cc needs call-summary mode, see core/src/Makefile).
+run_analysis() {
+    echo "=== analysis: contract checkers (tools/analysis) ==="
+    timeout 120 python -m tools.analysis
+    echo "=== analysis: native analyzer (make analyze) ==="
+    timeout "${HVD_CI_ANALYSIS_BUDGET:-900}" \
+        make -C horovod_tpu/core/src analyze
+}
 
 # Tier-1 wall budget: the r5 suite (288 tests; adds runner-selection,
 # per-binding sweep launchers, fake contracts, spark convert) measured
@@ -50,6 +64,10 @@ run_tier1() {
 # built in-test BEFORE the preloaded workers launch — forking make
 # under libtsan deadlocks). Budget bumped 1800 -> 2100 to keep the
 # headroom ratio.
+#
+# ISSUE 4 adds the ASan/UBSan smokes (tests/test_sanitizers.py, same
+# jax-free prebuild discipline): ~11s warm, ~60s cold for the two
+# instrumented core builds — absorbed by the existing headroom.
 run_tier2() {
     echo "=== tier 2 (heavyweight integration, incl. chaos suite) ==="
     timeout "${HVD_CI_TIER2_BUDGET:-2100}" \
@@ -58,8 +76,9 @@ run_tier2() {
 }
 
 case "$TIER" in
+    analysis) run_analysis ;;
     tier1) run_tier1 ;;
     tier2) run_tier2 ;;
-    all) run_tier1; run_tier2 ;;
-    *) echo "usage: $0 [tier1|tier2|all]" >&2; exit 2 ;;
+    all) run_analysis; run_tier1; run_tier2 ;;
+    *) echo "usage: $0 [analysis|tier1|tier2|all]" >&2; exit 2 ;;
 esac

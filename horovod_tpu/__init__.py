@@ -33,8 +33,8 @@ if _os.environ.get("HOROVOD_WORKER_PLATFORM") == "cpu":
         import jax as _jax
 
         _jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    except Exception:  # analysis: allow-broad-except — jax absent or
+        pass           # already initialized; the import above is optional
 
 from horovod_tpu.common import (  # noqa: F401
     HorovodAbortedError,

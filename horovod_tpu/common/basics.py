@@ -22,12 +22,15 @@ reference's Gloo env contract, reference: horovod/runner/gloo_run.py:65-76):
 from __future__ import annotations
 
 import atexit
+import logging
 import os
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 from horovod_tpu.common.exceptions import HorovodInternalError
+
+logger = logging.getLogger("horovod_tpu")
 
 
 @dataclass
@@ -242,7 +245,7 @@ def shutdown():
                     # failure is EXPECTED on staggered clean exits and
                     # must not count into hvd_collective_errors_total.
                     eager._backend().barrier(global_process_set)
-                except Exception:
+                except Exception:  # analysis: allow-broad-except
                     pass  # peers may already be gone; close anyway
                 _ctx.core.shutdown()
             finally:
@@ -439,8 +442,10 @@ def stop_metrics_server():
     if server is not None:
         try:
             server.stop()
-        except Exception:
-            pass
+        except Exception as e:
+            # Best-effort: a half-dead server must not fail the caller's
+            # teardown, but the reason is worth a breadcrumb.
+            logger.debug("metrics server stop failed: %s", e)
 
 
 def _try_start_metrics_server(base_port, source: str,
@@ -458,9 +463,7 @@ def _try_start_metrics_server(base_port, source: str,
             port += _ctx.topology.local_rank
         return start_metrics_server(port)
     except (ValueError, OverflowError, OSError) as e:
-        import logging
-
-        logging.getLogger("horovod_tpu").warning(
+        logger.warning(
             "%s: could not start the metrics server (%s); "
             "continuing without one", source, e)
         return None

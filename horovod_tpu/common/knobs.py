@@ -15,6 +15,13 @@ equivalents and ``warn_rejected()`` logs any rejected knob the user has
 set, so a reference user migrating an environment gets an explicit
 signal instead of a silently ignored variable. Both run during
 ``hvd.init()`` (common/basics.py).
+
+The registry also carries this framework's native knobs (HVD_* and the
+HOROVOD_* names with no reference analog). Completeness is machine-
+checked: the env-knob contract checker (``python -m tools.analysis``,
+docs/static_analysis.md) fails CI when any ``getenv``/``os.environ``
+read of a HOROVOD_*/HVD_* name is neither registered here nor
+explicitly allowlisted, or is missing from docs/configuration.md.
 """
 
 from __future__ import annotations
@@ -158,6 +165,56 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HOROVOD_LOCAL_SIZE", HONORED, "common/basics.py topology"),
     Knob("HOROVOD_CROSS_RANK", HONORED, "common/basics.py topology"),
     Knob("HOROVOD_CROSS_SIZE", HONORED, "common/basics.py topology"),
+    # --- framework-native knobs (no reference analog) -----------------
+    # Every entry below is enforced by the env-knob contract checker
+    # (tools/analysis/check_knobs.py): a getenv/os.environ read of an
+    # unregistered HOROVOD_*/HVD_* name anywhere in the tree fails CI.
+    Knob("HOROVOD_CONTROLLER_ADDR", HONORED,
+         "core/session.py: rank-0 coordination endpoint every rank "
+         "connects to (the hvdrun launcher exports it; manual "
+         "multi-process runs must set it)"),
+    Knob("HOROVOD_CONTROLLER_PORT", HONORED,
+         "core/session.py: coordination endpoint port (required; the "
+         "hvdrun launcher picks and exports one)"),
+    Knob("HOROVOD_RENDEZVOUS_ADDR", HONORED,
+         "elastic/state.py + elastic/worker.py: elastic rendezvous "
+         "HTTP endpoint (target of the HOROVOD_GLOO_RENDEZVOUS_ADDR "
+         "alias)"),
+    Knob("HOROVOD_RENDEZVOUS_PORT", HONORED,
+         "elastic rendezvous HTTP port (alias target of "
+         "HOROVOD_GLOO_RENDEZVOUS_PORT)"),
+    Knob("HOROVOD_IFACE", HONORED,
+         "runner/launch.py --nics export; bind-interface selection "
+         "(alias target of HOROVOD_GLOO_IFACE)"),
+    Knob("HOROVOD_TF_HOST_BRIDGE", HONORED,
+         "tensorflow/ingraph.py: opt TF out of in-graph collectives "
+         "and route through the host TCP ring"),
+    Knob("HVD_METRICS_PORT", HONORED,
+         "common/basics.py: serve GET /metrics from every worker at "
+         "init (base port + local_rank; docs/metrics.md)"),
+    Knob("HVD_METRICS_HEALTH_INTERVAL", HONORED,
+         "utils/metrics.py: stall/health gauge refresh seconds "
+         "(0 disables the reporter thread)"),
+    Knob("HVD_CORE_SANITIZE", HONORED,
+         "core/build.py: build/load a sanitizer-instrumented core "
+         "(thread|address|undefined; docs/static_analysis.md)"),
+    Knob("HVD_FLASH_BLOCK_Q", HONORED,
+         "ops/pallas_attention.py: flash-attention query tile size"),
+    Knob("HVD_FLASH_BLOCK_K", HONORED,
+         "ops/pallas_attention.py: flash-attention key/value tile "
+         "size"),
+    # Fault injector (core/src/comm.cc; armed only on the matching
+    # rank — see docs/configuration.md and common/fault_injection.py).
+    Knob("HVD_FAULT_RANK", HONORED,
+         "core/src/comm.cc: rank that self-sabotages (unset = off)"),
+    Knob("HVD_FAULT_MODE", HONORED,
+         "core/src/comm.cc: drop | stall | half_close | delay"),
+    Knob("HVD_FAULT_PEER", HONORED,
+         "core/src/comm.cc: half_close target rank (-1 = all peers)"),
+    Knob("HVD_FAULT_AFTER_FRAMES", HONORED,
+         "core/src/comm.cc: arm after this many framed sends"),
+    Knob("HVD_FAULT_DELAY_MS", HONORED,
+         "core/src/comm.cc: per-frame sleep for delay mode"),
 ]}
 
 

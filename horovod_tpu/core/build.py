@@ -56,15 +56,20 @@ def library_path(build_if_missing: bool = True) -> Optional[str]:
         return _lib_path()
     if not build_if_missing:
         return None
-    if "libtsan" in os.environ.get("LD_PRELOAD", ""):
-        # Forking the compiler from a libtsan-preloaded process
-        # deadlocks silently (TSAN's runtime does not survive the
-        # fork/exec dance here). Surfacing the rule beats a hung CI
-        # lane: build first, then launch the instrumented workers.
+    preload = os.environ.get("LD_PRELOAD", "")
+    loaded = [rt for rt in ("libtsan", "libasan", "libubsan")
+              if rt in preload]
+    if loaded:
+        # Forking the compiler from a sanitizer-preloaded process is
+        # unsafe: libtsan deadlocks outright, and the others inject
+        # their runtime into every make/g++ child. Surfacing the rule
+        # beats a hung CI lane: build first (make tsan/asan/ubsan),
+        # then launch the instrumented workers.
         raise RuntimeError(
             "refusing to build the native core under an LD_PRELOADed "
-            "libtsan (fork deadlock); pre-build it without the preload "
-            "first: make -C horovod_tpu/core/src tsan")
+            "%s; pre-build it without the preload first: "
+            "make -C horovod_tpu/core/src tsan|asan|ubsan"
+            % "/".join(loaded))
     build_dir = _build_dir()
     os.makedirs(build_dir, exist_ok=True)
     lock_path = os.path.join(build_dir, ".build.lock")

@@ -201,18 +201,28 @@ class CoreSession:
             ctypes.c_int, ctypes.c_double, ctypes.c_double, ctypes.c_int,
             ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
             ctypes.c_longlong]
+        lib.hvd_core_join.restype = ctypes.c_int
         lib.hvd_core_join.argtypes = [ctypes.c_longlong, ctypes.c_int]
+        lib.hvd_core_counters.restype = None
         lib.hvd_core_counters.argtypes = [
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvd_core_set_params.restype = None
         lib.hvd_core_set_params.argtypes = [
             ctypes.c_double, ctypes.c_longlong]
         lib.hvd_core_autotune_start.restype = ctypes.c_int
         lib.hvd_core_autotune_start.argtypes = [ctypes.c_char_p]
+        lib.hvd_core_autotune_state.restype = None
         lib.hvd_core_autotune_state.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_int]
         lib.hvd_core_timeline_start.restype = ctypes.c_int
         lib.hvd_core_timeline_start.argtypes = [ctypes.c_char_p,
                                                 ctypes.c_int]
+        lib.hvd_core_timeline_stop.restype = None
+        lib.hvd_core_timeline_stop.argtypes = []
+        lib.hvd_core_set_callback.restype = None
+        lib.hvd_core_set_callback.argtypes = [_CALLBACK_TYPE]
+        lib.hvd_core_shutdown.restype = None
+        lib.hvd_core_shutdown.argtypes = []
 
         addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
         port = int(os.environ.get("HOROVOD_CONTROLLER_PORT", "0"))
@@ -304,8 +314,8 @@ class CoreSession:
         _metrics.unregister_collector("core_session")
         try:
             self._publish_metrics()  # final counter deltas
-        except Exception:
-            pass
+        except Exception:  # analysis: allow-broad-except — a broken
+            pass           # metrics bridge must never block shutdown
         # A scrape thread inside counters() holds _metrics_lock; taking
         # it before the native teardown (which frees the core's global
         # state) makes the delete strictly after any in-flight read.

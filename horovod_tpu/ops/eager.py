@@ -159,8 +159,8 @@ def _payload_bytes(tensors) -> int:
             for d in np.shape(t):
                 n *= int(d)
             total += n * itemsize
-        except Exception:
-            pass
+        except Exception:  # analysis: allow-broad-except — exotic dtype
+            pass           # or symbolic shape: contribute 0 (see above)
     return total
 
 

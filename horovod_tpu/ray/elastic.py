@@ -176,5 +176,5 @@ class ElasticRayExecutor:
                 for w in workers:
                     try:
                         ray.kill(w)
-                    except Exception:
-                        pass
+                    except Exception:  # analysis: allow-broad-except
+                        pass  # actor already dead; cleanup is best-effort

@@ -414,7 +414,7 @@ class MetricsRegistry:
         for fn in collectors:
             try:
                 fn()
-            except Exception:
+            except Exception:  # analysis: allow-broad-except
                 # A broken bridge must never take the scrape down.
                 pass
 

@@ -13,6 +13,7 @@ parameter manager runs on the coordinator), so chain-progress asserts
 are rank-0-only and the loop runs a fixed count on every rank.
 """
 
+import ctypes
 import sys
 
 import jax
@@ -29,6 +30,10 @@ def main():
     hvd.init()
     r = hvd.rank()
     session = basics.core_session()
+    # Declared per the ctypes-signature contract (tools/analysis):
+    # every native call site states its signature explicitly.
+    session._lib.hvd_core_cache_enabled.restype = ctypes.c_int
+    session._lib.hvd_core_cache_enabled.argtypes = []
 
     # warmup(1) + GP(3) + categorical(1 tunable knob x baseline+trial =
     # 2) samples at 5 steps each = 30 coordinator steps; fixed loop on

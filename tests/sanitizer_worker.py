@@ -1,0 +1,79 @@
+"""Jax-free worker for the ASan/UBSan smoke (tests/test_sanitizers.py).
+
+Same stub-package trick as chaos_tsan_worker.py: the sanitized core is
+exercised through ``horovod_tpu.core.session`` without ever importing
+``horovod_tpu/__init__`` (which pulls jax — minutes under an
+instrumented runtime on a small CI host, and irrelevant to the native
+code under test).
+
+The scenario is a healthy-lifecycle sweep rather than a fault drill:
+allreduce (both buffer-reuse paths), allgather and alltoall (core-owned
+output buffers crossing the ctypes boundary — exactly where a
+heap-buffer-overflow would live), a barrier, then clean shutdown. ASan
+flags memory errors, UBSan flags undefined behavior; either writes a
+report file the test asserts is absent.
+"""
+
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_pkg = types.ModuleType("horovod_tpu")
+_pkg.__path__ = [os.path.join(_REPO, "horovod_tpu")]
+sys.modules["horovod_tpu"] = _pkg
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.core.session import (  # noqa: E402
+    OP_ALLGATHER,
+    OP_ALLREDUCE,
+    OP_ALLTOALL,
+    OP_BARRIER,
+    CoreSession,
+    _Group,
+)
+
+
+def _run(session, kind, name, arr, **kw):
+    group = _Group(1)
+    session.submit(kind, name, arr, group=group, index=0, **kw)
+    return group.future.result(timeout=120)[0]
+
+
+def main():
+    assert "jax" not in sys.modules, "sanitizer worker must stay jax-free"
+    topo = types.SimpleNamespace(
+        rank=int(os.environ["HOROVOD_RANK"]),
+        size=int(os.environ["HOROVOD_SIZE"]))
+    session = CoreSession.start(topo)
+    size = topo.size
+
+    for i in range(30):
+        out = _run(session, OP_ALLREDUCE, "sum.%d" % i,
+                   np.full(1024, 1.0, np.float32), op=1)
+        np.testing.assert_allclose(out, float(size))
+
+    for i in range(10):
+        out = _run(session, OP_ALLGATHER, "gather.%d" % i,
+                   np.full((3, 4), topo.rank, np.int32))
+        assert out.shape == (3 * size, 4), out.shape
+
+    for i in range(10):
+        splits = [2] * size
+        out, recv = _run(session, OP_ALLTOALL, "a2a.%d" % i,
+                         np.arange(2 * size, dtype=np.float64),
+                         splits=splits)
+        assert out.shape == (2 * size,), out.shape
+        assert list(recv) == [2] * size, recv
+
+    _run(session, OP_BARRIER, "__barrier__.san",
+         np.zeros(0, np.uint8))
+    session.shutdown()
+    print("SANITIZER_OK rank %d" % topo.rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,466 @@
+"""Tier-1 tests of the cross-language contract checkers (ISSUE 4).
+
+Each checker runs against a small fixture tree: the known-good fixture
+passes, every seeded violation fails, and the baseline suppresses
+accepted findings. The final test pins the acceptance criterion that
+the real tree is clean — `python -m tools.analysis` exits 0.
+
+Pure AST/text analysis: no jax, no subprocesses — seconds, not minutes.
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.analysis import CHECKERS, cpp, run_all
+from tools.analysis.__main__ import main as analysis_main
+from tools.analysis.common import Finding, Project, load_baseline, \
+    save_baseline
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- fixture tree -----------------------------------------------------------
+
+KNOBS_PY = '''
+from typing import NamedTuple
+HONORED = "honored"
+ALIASED = "aliased"
+class Knob(NamedTuple):
+    name: str
+    status: str
+    detail: str
+REGISTRY = {k.name: k for k in [
+    Knob("HOROVOD_GOOD_KNOB", HONORED, "core/session.py"),
+    Knob("HOROVOD_OLD_NAME", ALIASED, "HOROVOD_ALIAS_TARGET"),
+]}
+'''
+
+SESSION_PY = '''
+import ctypes
+
+_M_CORE = {"responses": 1, "bytes_total": 2}
+
+
+class CoreSession:
+    def start(self, lib):
+        lib.hvd_core_init.restype = ctypes.c_int
+        lib.hvd_core_init.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.hvd_core_counters.restype = None
+        lib.hvd_core_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvd_core_init(1, b"addr")
+        self._lib = lib
+
+    def counters(self):
+        buf = (ctypes.c_longlong * 2)()
+        self._lib.hvd_core_counters(buf, 2)
+        return {"responses": buf[0], "bytes_total": buf[1]}
+'''
+
+OPERATIONS_CC = '''
+#include <cstdlib>
+
+extern "C" {
+
+int hvd_core_init(int rank, const char* addr) {
+  (void)rank; (void)addr;
+  if (getenv("HOROVOD_GOOD_KNOB")) return 1;
+  return 0;
+}
+
+// Fills out[0..n): responses, bytes_total. Append-only layout.
+void hvd_core_counters(long long* out, int n) {
+  long long vals[2] = {1, 2};
+  for (int i = 0; i < n && i < 2; ++i) out[i] = vals[i];
+}
+
+}  // extern "C"
+'''
+
+GOOD_MODULE = '''
+import os
+
+from fixture import metrics
+
+
+def knob():
+    return os.environ.get("HOROVOD_GOOD_KNOB", "0")
+
+
+M = metrics.counter("hvd_good_total", "documented metric")
+
+
+def careful(fn):
+    try:
+        return fn()
+    except ValueError:
+        return None
+'''
+
+CONFIG_DOC = "# knobs\n`HOROVOD_GOOD_KNOB` does things.\n"
+METRICS_DOC = "# metrics\n| `hvd_good_total` | counts |\n"
+
+
+def make_tree(root):
+    files = {
+        "horovod_tpu/__init__.py": "",
+        "horovod_tpu/common/__init__.py": "",
+        "horovod_tpu/common/knobs.py": KNOBS_PY,
+        "horovod_tpu/core/__init__.py": "",
+        "horovod_tpu/core/session.py": SESSION_PY,
+        "horovod_tpu/core/src/operations.cc": OPERATIONS_CC,
+        "horovod_tpu/good.py": GOOD_MODULE,
+        "docs/configuration.md": CONFIG_DOC,
+        "docs/metrics.md": METRICS_DOC,
+    }
+    for rel, content in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content)
+    return root
+
+
+def project(root):
+    return Project(str(root), python_scan_files=(), knob_allowlist={})
+
+
+@pytest.fixture
+def tree(tmp_path):
+    return make_tree(str(tmp_path))
+
+
+# --- known-good passes ------------------------------------------------------
+
+def test_known_good_fixture_passes(tree):
+    assert run_all(project(tree)) == []
+
+
+def test_real_tree_is_clean():
+    """Acceptance criterion: the shipped tree has no findings beyond
+    the checked-in baseline (which is expected to stay empty or carry
+    a justification per entry)."""
+    rc = analysis_main(["--root", _REPO])
+    assert rc == 0
+    for fp, why in load_baseline(
+            os.path.join(_REPO, "tools", "analysis",
+                         "baseline.json")).items():
+        assert why and "TODO" not in why, (
+            "baseline entry %s lacks a justification" % fp)
+
+
+# --- seeded violations fail -------------------------------------------------
+
+def _seed(tree, rel, content):
+    path = os.path.join(tree, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(content)
+
+
+def _keys(findings, checker):
+    return [f.key for f in findings if f.checker == checker]
+
+
+def test_unregistered_knob_fails(tree):
+    _seed(tree, "horovod_tpu/rogue.py",
+          "import os\nV = os.environ.get('HOROVOD_ROGUE_KNOB')\n")
+    assert "unregistered:HOROVOD_ROGUE_KNOB" in \
+        _keys(run_all(project(tree)), "knobs")
+
+
+def test_registered_but_undocumented_knob_fails(tree):
+    _seed(tree, "horovod_tpu/common/knobs.py", KNOBS_PY.replace(
+        'Knob("HOROVOD_GOOD_KNOB", HONORED, "core/session.py"),',
+        'Knob("HOROVOD_GOOD_KNOB", HONORED, "core/session.py"),\n'
+        '    Knob("HOROVOD_HIDDEN_KNOB", HONORED, "nowhere"),'))
+    _seed(tree, "horovod_tpu/rogue.py",
+          "import os\nV = os.environ['HOROVOD_HIDDEN_KNOB']\n")
+    assert "undocumented:HOROVOD_HIDDEN_KNOB" in \
+        _keys(run_all(project(tree)), "knobs")
+
+
+def test_alias_target_counts_as_registered(tree):
+    _seed(tree, "docs/configuration.md",
+          CONFIG_DOC + "`HOROVOD_ALIAS_TARGET` too.\n")
+    _seed(tree, "horovod_tpu/aliased.py",
+          "import os\nV = os.environ.get('HOROVOD_ALIAS_TARGET')\n")
+    assert _keys(run_all(project(tree)), "knobs") == []
+
+
+def test_native_getenv_is_scanned(tree):
+    _seed(tree, "horovod_tpu/core/src/operations.cc",
+          OPERATIONS_CC.replace("HOROVOD_GOOD_KNOB",
+                                "HVD_NATIVE_ONLY_KNOB"))
+    assert "unregistered:HVD_NATIVE_ONLY_KNOB" in \
+        _keys(run_all(project(tree)), "knobs")
+
+
+def test_counter_slot_count_mismatch_fails(tree):
+    _seed(tree, "horovod_tpu/core/src/operations.cc", OPERATIONS_CC
+          .replace("long long vals[2] = {1, 2};",
+                   "long long vals[3] = {1, 2, 3};")
+          .replace("// Fills out[0..n): responses, bytes_total.",
+                   "// Fills out[0..n): responses, bytes_total, extra."))
+    keys = _keys(run_all(project(tree)), "counters")
+    assert "slot-count-mismatch" in keys
+    assert "slot-order-mismatch" in keys  # extra name vs python decode
+
+
+def test_counter_order_mismatch_fails(tree):
+    _seed(tree, "horovod_tpu/core/src/operations.cc", OPERATIONS_CC
+          .replace("responses, bytes_total", "bytes_total, responses"))
+    assert "slot-order-mismatch" in \
+        _keys(run_all(project(tree)), "counters")
+
+
+def test_counter_call_arg_mismatch_fails(tree):
+    """The literal n passed to hvd_core_counters bounds the native
+    fill; a stale value silently zeroes appended slots even when every
+    other surface agrees."""
+    _seed(tree, "horovod_tpu/core/session.py", SESSION_PY.replace(
+        "self._lib.hvd_core_counters(buf, 2)",
+        "self._lib.hvd_core_counters(buf, 1)"))
+    assert "call-arg-count" in \
+        _keys(run_all(project(tree)), "counters")
+
+
+def test_counter_bridge_missing_key_fails(tree):
+    _seed(tree, "horovod_tpu/core/session.py", SESSION_PY.replace(
+        '_M_CORE = {"responses": 1, "bytes_total": 2}',
+        '_M_CORE = {"responses": 1}'))
+    assert "bridge-missing-keys" in \
+        _keys(run_all(project(tree)), "counters")
+
+
+def test_undeclared_ctypes_signature_fails(tree):
+    _seed(tree, "horovod_tpu/raw_call.py",
+          "def go(lib):\n    return lib.hvd_core_init(1, b'x')\n")
+    keys = _keys(run_all(project(tree)), "ctypes")
+    assert "undeclared-argtypes:hvd_core_init" in keys
+    assert "undeclared-restype:hvd_core_init" in keys
+
+
+def test_ctypes_argtype_mismatch_fails(tree):
+    _seed(tree, "horovod_tpu/core/session.py", SESSION_PY.replace(
+        "[ctypes.c_int, ctypes.c_char_p]", "[ctypes.c_int, ctypes.c_int]"))
+    assert "argtypes-mismatch:hvd_core_init:1" in \
+        _keys(run_all(project(tree)), "ctypes")
+
+
+def test_ctypes_arity_mismatch_fails(tree):
+    _seed(tree, "horovod_tpu/core/session.py", SESSION_PY.replace(
+        "[ctypes.c_int, ctypes.c_char_p]", "[ctypes.c_int]"))
+    assert "argtypes-arity:hvd_core_init" in \
+        _keys(run_all(project(tree)), "ctypes")
+
+
+def test_ctypes_unknown_symbol_fails(tree):
+    _seed(tree, "horovod_tpu/raw_call.py",
+          "def go(lib):\n    lib.hvd_core_vanished.restype = None\n"
+          "    lib.hvd_core_vanished.argtypes = []\n"
+          "    lib.hvd_core_vanished()\n")
+    assert "unknown-symbol:hvd_core_vanished" in \
+        _keys(run_all(project(tree)), "ctypes")
+
+
+def test_undocumented_metric_fails(tree):
+    _seed(tree, "horovod_tpu/extra_metric.py",
+          "from fixture import metrics\n"
+          "M = metrics.counter('hvd_rogue_total', 'oops')\n")
+    assert "undocumented:hvd_rogue_total" in \
+        _keys(run_all(project(tree)), "metrics")
+
+
+def test_bare_except_fails(tree):
+    _seed(tree, "horovod_tpu/sloppy.py",
+          "def f(x):\n    try:\n        return x()\n"
+          "    except:\n        pass\n")
+    assert _keys(run_all(project(tree)), "excepts")
+
+
+def test_blind_broad_except_fails_and_tag_suppresses(tree):
+    _seed(tree, "horovod_tpu/sloppy.py",
+          "def f(x):\n    try:\n        return x()\n"
+          "    except Exception:\n        pass\n")
+    assert _keys(run_all(project(tree)), "excepts")
+    _seed(tree, "horovod_tpu/sloppy.py",
+          "def f(x):\n    try:\n        return x()\n"
+          "    except Exception:  # analysis: allow-broad-except\n"
+          "        pass\n")
+    assert _keys(run_all(project(tree)), "excepts") == []
+
+
+def test_broad_except_that_handles_is_fine(tree):
+    _seed(tree, "horovod_tpu/careful.py",
+          "import logging\ndef f(x):\n    try:\n        return x()\n"
+          "    except Exception as e:\n"
+          "        logging.warning('fallback: %s', e)\n"
+          "        return None\n")
+    assert _keys(run_all(project(tree)), "excepts") == []
+
+
+# --- baseline + CLI ---------------------------------------------------------
+
+def test_cli_exit_codes_and_baseline_suppression(tree, tmp_path):
+    _seed(tree, "horovod_tpu/rogue.py",
+          "import os\nV = os.environ.get('HOROVOD_ROGUE_KNOB')\n")
+    baseline = str(tmp_path / "baseline.json")
+    # Fixture project defaults differ from main()'s Project(root), but
+    # the rogue knob is visible to both; exit codes are the contract.
+    assert analysis_main(["--root", tree, "--baseline", baseline]) == 1
+    # Accept the finding into the baseline -> clean run.
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--update-baseline"]) == 0
+    assert analysis_main(["--root", tree, "--baseline", baseline]) == 0
+    # --no-baseline surfaces it again.
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--no-baseline"]) == 1
+
+
+def test_scoped_update_baseline_preserves_other_checkers(tree, tmp_path):
+    """--checker X --update-baseline must not delete other checkers'
+    accepted entries (and their hand-written justifications)."""
+    _seed(tree, "horovod_tpu/rogue.py",
+          "import os\nV = os.environ.get('HOROVOD_ROGUE_KNOB')\n")
+    _seed(tree, "horovod_tpu/sloppy.py",
+          "def f(x):\n    try:\n        return x()\n"
+          "    except Exception:\n        pass\n")
+    baseline = str(tmp_path / "baseline.json")
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--update-baseline"]) == 0
+    entries = load_baseline(baseline)
+    excepts_fp = [fp for fp in entries if fp.startswith("excepts::")]
+    assert excepts_fp and any(fp.startswith("knobs::") for fp in entries)
+    # Scoped re-update of only the knobs checker:
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "knobs",
+                          "--update-baseline"]) == 0
+    after = load_baseline(baseline)
+    assert set(excepts_fp) <= set(after), after
+    assert analysis_main(["--root", tree, "--baseline", baseline]) == 0
+
+
+def test_baseline_keeps_existing_justifications(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    f1 = Finding("knobs", "a.py", 3, "unregistered:X", "msg")
+    save_baseline(path, [f1])
+    entries = load_baseline(path)
+    assert "TODO" in entries[f1.fingerprint]
+    entries[f1.fingerprint] = "accepted: legacy"
+    with open(path, "w") as fh:
+        json.dump({"findings": entries}, fh)
+    f2 = Finding("metrics", "b.py", 9, "undocumented:hvd_x", "msg2")
+    save_baseline(path, [f1, f2], load_baseline(path))
+    fresh = load_baseline(path)
+    assert fresh[f1.fingerprint] == "accepted: legacy"
+    assert "TODO" in fresh[f2.fingerprint]
+
+
+def test_doc_presence_is_boundary_anchored(tree):
+    """`HOROVOD_GOOD_KNOB` must not be satisfiable by a documented
+    `HOROVOD_GOOD_KNOB_LOG` row (substring ride-along defeats the
+    staleness guarantee)."""
+    _seed(tree, "docs/configuration.md",
+          "# knobs\n`HOROVOD_GOOD_KNOB_LOG` only.\n")
+    assert "undocumented:HOROVOD_GOOD_KNOB" in \
+        _keys(run_all(project(tree)), "knobs")
+
+
+def test_excepts_fingerprint_survives_line_shifts(tree):
+    body = ("def f(x):\n    try:\n        return x()\n"
+            "    except Exception:\n        pass\n")
+    _seed(tree, "horovod_tpu/sloppy.py", body)
+    before = _keys(run_all(project(tree)), "excepts")
+    _seed(tree, "horovod_tpu/sloppy.py", "# shifted\n# down\n" + body)
+    after = _keys(run_all(project(tree)), "excepts")
+    assert before == after and len(before) == 1
+    assert before[0].startswith("broad-except:f:")
+
+
+def test_excepts_new_violation_does_not_steal_baselined_identity(tree):
+    """Content-addressed keys: adding a distinct broad-except above an
+    accepted one must produce a NEW fingerprint, not inherit the old
+    (which would let the new swallow hide under the baseline entry)."""
+    one = ("def f(x):\n    try:\n        return x()\n"
+           "    except Exception:\n        pass\n")
+    _seed(tree, "horovod_tpu/sloppy.py", one)
+    [old_key] = _keys(run_all(project(tree)), "excepts")
+    two = ("def f(x):\n"
+           "    try:\n        x.prep()\n"
+           "    except BaseException:\n        pass\n"
+           "    try:\n        return x()\n"
+           "    except Exception:\n        pass\n")
+    _seed(tree, "horovod_tpu/sloppy.py", two)
+    keys = _keys(run_all(project(tree)), "excepts")
+    assert old_key in keys and len(keys) == 2
+
+
+def test_extern_c_wrapper_call_is_not_a_prototype(tree):
+    """A statement-position call of one export inside another must not
+    register a bogus conflicting prototype (degrades the whole ctypes
+    checker to 'unparseable')."""
+    _seed(tree, "horovod_tpu/core/src/operations.cc", OPERATIONS_CC
+          .replace("}  // extern \"C\"",
+                   "int hvd_core_failed(void) { return 0; }\n"
+                   "int hvd_core_healthy(void) {\n"
+                   "  int x = hvd_core_failed();\n"
+                   "  return hvd_core_failed() + x;\n"
+                   "}\n"
+                   "}  // extern \"C\""))
+    findings = run_all(project(tree))
+    assert _keys(findings, "ctypes") == [], findings
+
+
+# --- parser unit coverage ---------------------------------------------------
+
+def test_extern_c_parser_handles_callbacks_and_comments():
+    protos = cpp.extern_c_prototypes('''
+// extern "C" in a comment { should not confuse the parser
+extern "C" {
+void hvd_set_cb(void (*cb)(long long, int, const char*)); // decl
+int hvd_go(double scale, const long long* shape, int ndim) { return 0; }
+}
+void hvd_not_exported(int x);
+''')
+    assert set(protos) == {"hvd_set_cb", "hvd_go"}
+    assert protos["hvd_set_cb"].params[0].is_callback
+    assert protos["hvd_go"].ret == "int"
+    assert [p.ctype for p in protos["hvd_go"].params] == \
+        ["double", "const long long*", "int"]
+    assert cpp.expected_argtype(protos["hvd_go"].params[1]) == \
+        "POINTER(c_longlong)"
+
+
+def test_env_read_scanner_catches_helper_wrappers():
+    hits = cpp.env_reads('''
+double t = EnvDouble("HVD_T", 1.0);
+long long k = EnvLL("HVD_K", 0);
+const char* v = getenv("HVD_V");
+// getenv("HVD_IN_COMMENT") must not count
+''')
+    assert [h[0] for h in hits] == ["HVD_T", "HVD_K", "HVD_V"]
+
+
+def test_every_checker_ran_against_fixture(tree):
+    """Guard against a checker silently dropping out of run_all."""
+    assert set(CHECKERS) == {"knobs", "counters", "ctypes", "metrics",
+                             "excepts"}
+
+
+def test_build_refuses_any_sanitizer_preload(monkeypatch, tmp_path):
+    """core/build.py must refuse to fork the compiler under ANY
+    preloaded sanitizer runtime, not just libtsan (the docs promise
+    the guard for the whole matrix)."""
+    from horovod_tpu.core import build
+
+    monkeypatch.setenv("HVD_CORE_SANITIZE", "address")
+    monkeypatch.setenv("LD_PRELOAD",
+                       "/usr/lib/x86_64-linux-gnu/libasan.so.6")
+    # Point the build at a scratch dir with no library so the guard
+    # path (not the cache path) is exercised.
+    monkeypatch.setattr(build, "_build_dir", lambda: str(tmp_path / "b"))
+    with pytest.raises(RuntimeError, match="libasan"):
+        build.library_path(build_if_missing=True)

@@ -124,6 +124,13 @@ TIER2_COVERAGE = {
         "test_status_mapping_to_typed_exceptions",
     "test_fault_injection_tsan_smoke":
         "tests/test_fault_tolerance.py::test_fault_env_round_trip",
+    # Sanitizer matrix (ISSUE 4): the contract checkers that gate the
+    # same cross-language surfaces run fast in test_analysis.py; the
+    # instrumented multi-process smokes are the heavyweight variants.
+    "test_native_core_asan_smoke":
+        "tests/test_analysis.py::test_real_tree_is_clean",
+    "test_native_core_ubsan_smoke":
+        "tests/test_analysis.py::test_real_tree_is_clean",
 }
 
 

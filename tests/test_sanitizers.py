@@ -1,0 +1,102 @@
+"""Tier-2 ASan/UBSan smoke of the native core (ISSUE 4).
+
+Completes the sanitizer matrix started by the TSAN suite
+(test_native_core.py / test_chaos.py): AddressSanitizer catches memory
+errors (heap overflow, use-after-free) and UndefinedBehaviorSanitizer
+catches UB (signed overflow, misaligned/oob access) in the collective
+lifecycle — including the core-owned output buffers that cross the
+ctypes boundary.
+
+Discipline (same as TSAN, docs/static_analysis.md): the instrumented
+core is built BEFORE any preloaded worker launches, and the workers are
+jax-free (tests/sanitizer_worker.py stub-package trick).
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_native_core import _REPO, _launch
+
+pytestmark = [pytest.mark.tier2, pytest.mark.slow]
+
+_WORKER = os.path.join(_REPO, "tests", "sanitizer_worker.py")
+
+
+def _ensure_core(mode):
+    """Build the instrumented core preload-free (the PR 3 fork-deadlock
+    rule: never fork the compiler under a preloaded sanitizer runtime)."""
+    env = dict(os.environ, HVD_CORE_SANITIZE=mode)
+    env.pop("LD_PRELOAD", None)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from horovod_tpu.core.build import library_path; "
+         "library_path(build_if_missing=True)"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _find_runtime(stem):
+    for pat in ("/usr/lib/x86_64-linux-gnu/lib%s.so.*" % stem,
+                "/usr/lib/*/lib%s.so.*" % stem,
+                "/usr/lib/gcc/x86_64-linux-gnu/*/lib%s.so" % stem):
+        hits = sorted(glob.glob(pat))
+        if hits:
+            return hits[-1]
+    return None
+
+
+def _report_prefix(mode):
+    return os.path.join(
+        _REPO, "horovod_tpu", "core", "build-" + mode, "san_report")
+
+
+def _run_smoke(mode, extra_env):
+    _ensure_core(mode)
+    prefix = _report_prefix(mode)
+    for old in glob.glob(prefix + "*"):
+        os.unlink(old)
+    env = dict(extra_env)
+    env["HVD_CORE_SANITIZE"] = mode
+    codes, outputs = _launch(2, _WORKER, extra_env=env, timeout=300)
+    reports = glob.glob(prefix + "*")
+    blobs = "".join(open(p).read() for p in reports)
+    assert codes == [0, 0] and not reports, (
+        "%s reports:\n%s\nworker output:\n%s"
+        % (mode, blobs[:4000], "\n".join(outputs)[-3000:]))
+    assert sum("SANITIZER_OK" in o for o in outputs) == 2
+
+
+def test_native_core_asan_smoke():
+    """Full collective lifecycle under AddressSanitizer: zero memory
+    errors. Leak checking stays off — the host python is uninstrumented
+    and leaks by design (interned objects), which would drown any real
+    core leak; the analyzer lane (`make analyze`) covers leak paths
+    statically instead."""
+    libasan = _find_runtime("asan")
+    if libasan is None:
+        pytest.skip("libasan not available")
+    _run_smoke("address", {
+        # The uninstrumented python binary loads the instrumented core:
+        # the ASan runtime must initialize first (same preload pattern
+        # as TSAN), and link-order verification must be relaxed.
+        "LD_PRELOAD": libasan,
+        "ASAN_OPTIONS": "detect_leaks=0 verify_asan_link_order=0 "
+                        "exitcode=66 log_path=%s"
+                        % _report_prefix("address"),
+    })
+
+
+def test_native_core_ubsan_smoke():
+    """Full collective lifecycle under UBSan: zero undefined-behavior
+    reports. libubsan is a DT_NEEDED of the instrumented core, so no
+    preload is required; halt_on_error turns any report into a nonzero
+    exit the assertion catches even if log files go astray."""
+    _run_smoke("undefined", {
+        "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1 "
+                         "exitcode=66 log_path=%s"
+                         % _report_prefix("undefined"),
+    })

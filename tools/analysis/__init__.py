@@ -1,0 +1,44 @@
+"""Cross-language static-analysis gate (docs/static_analysis.md).
+
+Five contract checkers keep the hand-maintained bridges between the
+C++ core, the ctypes layer, the knob registry, and the docs honest:
+
+  knobs     every HOROVOD_*/HVD_* env read is registered + documented
+  counters  the hvd_core_counters slot layout agrees on both sides
+  ctypes    every native call site declares a matching signature
+  metrics   every constructed hvd_* metric is in the catalog
+  excepts   no bare/blind except swallowing in horovod_tpu/
+
+Run ``python -m tools.analysis`` (CI does, before the test lanes);
+pre-existing accepted findings live in ``baseline.json``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from tools.analysis import (
+    check_counters,
+    check_ctypes,
+    check_excepts,
+    check_knobs,
+    check_metrics,
+)
+from tools.analysis.common import Finding, Project
+
+CHECKERS: Dict[str, Callable[[Project], List[Finding]]] = {
+    "knobs": check_knobs.check,
+    "counters": check_counters.check,
+    "ctypes": check_ctypes.check,
+    "metrics": check_metrics.check,
+    "excepts": check_excepts.check,
+}
+
+
+def run_all(project: Project, only=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, fn in CHECKERS.items():
+        if only and name not in only:
+            continue
+        findings += fn(project)
+    return sorted(findings)

@@ -1,0 +1,91 @@
+"""CLI driver: ``python -m tools.analysis`` from the repo root.
+
+Exit codes: 0 clean (or every finding baselined), 1 new findings,
+2 usage/config error. ``--update-baseline`` rewrites baseline.json
+with the current finding set (existing justifications are kept; new
+entries get a TODO that a reviewer must replace or fix).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.analysis import CHECKERS, run_all
+from tools.analysis.common import Project, load_baseline, save_baseline
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="Cross-language contract checkers "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("--root", default=os.getcwd(),
+                    help="repo root to analyze (default: cwd)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path (default: the checked-in "
+                         "tools/analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baselined or not")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept the current finding set as the baseline")
+    ap.add_argument("--checker", action="append", choices=sorted(CHECKERS),
+                    help="run only this checker (repeatable)")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(os.path.join(root, "horovod_tpu")):
+        print("error: %s does not look like the repo root "
+              "(no horovod_tpu/)" % root, file=sys.stderr)
+        return 2
+
+    findings = run_all(Project(root), only=args.checker)
+
+    if args.update_baseline:
+        old = load_baseline(args.baseline)
+        # A --checker-scoped update must not delete the other checkers'
+        # accepted entries (and their hand-written justifications).
+        preserved = {}
+        if args.checker:
+            preserved = {fp: j for fp, j in old.items()
+                         if fp.split("::", 1)[0] not in args.checker}
+        save_baseline(args.baseline, findings, old, extra=preserved)
+        print("baseline updated: %d finding(s) recorded in %s"
+              % (len(findings) + len(preserved), args.baseline))
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new = [f for f in findings if f.fingerprint not in baseline]
+    suppressed = len(findings) - len(new)
+    # Only entries belonging to checkers that actually ran can be
+    # called stale; a --checker-scoped run never re-checked the rest.
+    stale = sorted(
+        fp for fp in set(baseline) - {f.fingerprint for f in findings}
+        if not args.checker or fp.split("::", 1)[0] in args.checker)
+
+    for f in new:
+        print(f.render())
+    if suppressed:
+        print("(%d baselined finding(s) suppressed)" % suppressed)
+    if stale:
+        # Not an error: fixed findings should be pruned, which
+        # --update-baseline does.
+        print("note: %d stale baseline entr%s (fixed findings); run "
+              "--update-baseline to prune: %s"
+              % (len(stale), "y" if len(stale) == 1 else "ies",
+                 ", ".join(stale[:5])))
+    if new:
+        print("FAIL: %d new finding(s) across %d checker(s)"
+              % (len(new), len({f.checker for f in new})))
+        return 1
+    print("OK: %d checker(s), no new findings" % len(args.checker
+                                                     or CHECKERS))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""Counter-bridge contract: the ``hvd_core_counters`` slot layout is
+declared twice — the Python decode in ``core/session.py`` and the
+``long long vals[N]`` fill in the native export — plus a third time in
+the export's order comment. All three must agree on slot count, and the
+comment's name order must match the Python dict order (the layout is
+append-only; a silent reorder would misattribute every metric).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from tools.analysis import cpp
+from tools.analysis.common import Finding, Project
+
+EXPORT = "hvd_core_counters"
+
+
+def _python_side(project: Project):
+    """(slot_count, call_n, [keys in order], bridge_keys or None,
+    findings)."""
+    findings: List[Finding] = []
+    rel = project.session_py
+    try:
+        tree = ast.parse(project.read(rel), rel)
+    except (OSError, SyntaxError) as e:
+        return None, None, [], None, [Finding(
+            "counters", rel, 1, "unparseable",
+            "cannot parse %s: %s" % (rel, e))]
+
+    count: Optional[int] = None
+    call_n: Optional[int] = None
+    keys: List[str] = []
+    bridge_keys = None
+    for node in ast.walk(tree):
+        # _M_CORE = {...}: the metrics bridge must cover every slot.
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "_M_CORE" \
+                and isinstance(node.value, ast.Dict):
+            bridge_keys = [k.value for k in node.value.keys
+                           if isinstance(k, ast.Constant)]
+        if isinstance(node, ast.FunctionDef) and node.name == "counters":
+            for sub in ast.walk(node):
+                # (ctypes.c_longlong * N)()
+                if isinstance(sub, ast.BinOp) \
+                        and isinstance(sub.op, ast.Mult) \
+                        and isinstance(sub.right, ast.Constant) \
+                        and isinstance(sub.right.value, int) \
+                        and "c_longlong" in ast.unparse(sub.left):
+                    count = sub.right.value
+                # hvd_core_counters(buf, N): the n actually passed is
+                # what bounds the native fill at runtime — a stale
+                # literal here silently zeroes the appended slots even
+                # when every other surface agrees.
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == EXPORT \
+                        and len(sub.args) >= 2 \
+                        and isinstance(sub.args[1], ast.Constant) \
+                        and isinstance(sub.args[1].value, int):
+                    call_n = sub.args[1].value
+                if isinstance(sub, ast.Return) \
+                        and isinstance(sub.value, ast.Dict):
+                    keys = [k.value for k in sub.value.keys
+                            if isinstance(k, ast.Constant)]
+    if count is None or not keys:
+        findings.append(Finding(
+            "counters", rel, 1, "missing-python-side",
+            "could not locate the counters() buffer size and return dict "
+            "in %s" % rel))
+    return count, call_n, keys, bridge_keys, findings
+
+
+def _native_side(project: Project):
+    """(slot_count, n_init_entries, [comment names], rel, line, findings)."""
+    for rel in project.native_files():
+        text = project.read(rel)
+        if re.search(r"\bvoid\s+%s\s*\(" % EXPORT, text) is None:
+            continue
+        code = cpp.strip_comments(text, blank_strings=True)
+        m = re.search(r"\bvoid\s+%s\s*\([^)]*\)\s*\{" % EXPORT, code)
+        if not m:
+            continue
+        line = code.count("\n", 0, m.start()) + 1
+        # Body: match braces from the definition's '{'.
+        i, depth = m.end(), 1
+        while i < len(code) and depth:
+            if code[i] == "{":
+                depth += 1
+            elif code[i] == "}":
+                depth -= 1
+            i += 1
+        body = code[m.end():i]
+        findings: List[Finding] = []
+        vm = re.search(
+            r"long\s+long\s+vals\s*\[\s*(\d+)\s*\]\s*=\s*\{", body)
+        count = n_entries = None
+        if vm:
+            count = int(vm.group(1))
+            j, depth = vm.end(), 1
+            while j < len(body) and depth:
+                if body[j] == "{":
+                    depth += 1
+                elif body[j] == "}":
+                    depth -= 1
+                j += 1
+            blob = body[vm.end():j - 1].strip()
+            parts, d, start = [], 0, 0
+            for k, c in enumerate(blob):
+                if c == "(":
+                    d += 1
+                elif c == ")":
+                    d -= 1
+                elif c == "," and d == 0:
+                    parts.append(blob[start:k])
+                    start = k + 1
+            parts.append(blob[start:])
+            n_entries = len([p for p in parts if p.strip()])
+        else:
+            findings.append(Finding(
+                "counters", rel, line, "missing-vals-array",
+                "%s does not fill a 'long long vals[N] = {...}' array; "
+                "the slot-count contract cannot be checked" % EXPORT))
+        # Order comment: contiguous // lines immediately above the
+        # definition, e.g. "// Fills out[0..n): responses, ...".
+        comment_names: List[str] = []
+        lines = text.splitlines()
+        k = line - 2
+        blob = []
+        while k >= 0 and lines[k].lstrip().startswith("//"):
+            blob.insert(0, lines[k].lstrip().lstrip("/").strip())
+            k -= 1
+        cm = re.search(r"out\s*\[0\.\.n\)\s*:\s*([^.]*)", " ".join(blob))
+        if cm:
+            comment_names = re.findall(r"[a-z][a-z0-9_]*", cm.group(1))
+        else:
+            findings.append(Finding(
+                "counters", rel, line, "missing-order-comment",
+                "%s lacks the '// Fills out[0..n): <slot names>' order "
+                "comment the Python decode is checked against" % EXPORT))
+        return count, n_entries, comment_names, rel, line, findings
+    return None, None, [], None, 1, [Finding(
+        "counters", project.native_src, 1, "missing-export",
+        "no native file under %s defines %s"
+        % (project.native_src, EXPORT))]
+
+
+def check(project: Project) -> List[Finding]:
+    py_count, py_call_n, py_keys, bridge_keys, findings = \
+        _python_side(project)
+    cc_count, cc_entries, comment_names, cc_rel, cc_line, cc_findings = \
+        _native_side(project)
+    findings += cc_findings
+    if py_count is not None and py_keys \
+            and py_count != len(py_keys):
+        findings.append(Finding(
+            "counters", project.session_py, 1, "python-count-vs-keys",
+            "counters() allocates %d slots but decodes %d keys"
+            % (py_count, len(py_keys))))
+    if py_count is not None and py_call_n is not None \
+            and py_call_n != py_count:
+        findings.append(Finding(
+            "counters", project.session_py, 1, "call-arg-count",
+            "counters() allocates %d slots but passes n=%d to %s — the "
+            "native side fills only min(n, slots), so the tail decodes "
+            "as permanent zeros" % (py_count, py_call_n, EXPORT)))
+    if py_count is not None and cc_count is not None \
+            and py_count != cc_count:
+        findings.append(Finding(
+            "counters", project.session_py, 1, "slot-count-mismatch",
+            "counters() reads %d slots but %s exports %d (%s:%d)"
+            % (py_count, EXPORT, cc_count, cc_rel, cc_line)))
+    if cc_count is not None and cc_entries is not None \
+            and cc_count != cc_entries:
+        findings.append(Finding(
+            "counters", cc_rel, cc_line, "vals-entry-count",
+            "vals[%d] is initialized with %d entries"
+            % (cc_count, cc_entries)))
+    if comment_names and py_keys and comment_names != py_keys:
+        findings.append(Finding(
+            "counters", cc_rel, cc_line, "slot-order-mismatch",
+            "slot order comment %r does not match the Python decode "
+            "order %r" % (comment_names, py_keys)))
+    if bridge_keys is not None and py_keys:
+        missing = [k for k in py_keys if k not in bridge_keys]
+        if missing:
+            findings.append(Finding(
+                "counters", project.session_py, 1, "bridge-missing-keys",
+                "_M_CORE lacks metric bindings for counter slots %r "
+                "(the scrape collector would KeyError)" % missing))
+    return findings

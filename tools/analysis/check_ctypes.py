@@ -1,0 +1,117 @@
+"""ctypes-signature contract: every Python call of a native ``hvd_*``
+symbol must have ``argtypes`` and ``restype`` declared in the same
+file, and the declarations must match the ``extern "C"`` prototype
+parsed from the native sources. An undeclared signature silently relies
+on ctypes' int-everything defaults — exactly how a ``long long`` tag
+gets truncated on a 32-bit libffi path or a ``double`` scale factor
+gets read as garbage.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tools.analysis import cpp, pyast
+from tools.analysis.common import Finding, Project
+
+
+def native_prototypes(project: Project) -> Dict[str, cpp.Prototype]:
+    protos: Dict[str, cpp.Prototype] = {}
+    for rel in project.native_files():
+        try:
+            found = cpp.extern_c_prototypes(project.read(rel))
+        except ValueError as e:
+            raise ValueError("%s: %s" % (rel, e))
+        for name, proto in found.items():
+            seen = protos.get(name)
+            if seen is not None and (seen.ret != proto.ret
+                                     or seen.params != proto.params):
+                # Surfaced as a finding by check() below.
+                protos[name] = proto
+                protos["__conflict__" + name] = seen
+            else:
+                protos[name] = proto
+    return protos
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        protos = native_prototypes(project)
+    except ValueError as e:
+        return [Finding("ctypes", project.native_src, 1, "unparseable",
+                        str(e))]
+    for name in [n for n in protos if n.startswith("__conflict__")]:
+        sym = name[len("__conflict__"):]
+        findings.append(Finding(
+            "ctypes", project.native_src, protos[sym].line,
+            "conflicting-prototypes:" + sym,
+            "extern \"C\" files disagree on the signature of %s" % sym))
+
+    for rel in project.python_files():
+        try:
+            tree = project.parsed(rel)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+        use = pyast.scan_ctypes(tree)
+        for sym, line in sorted(use.calls.items()):
+            proto = protos.get(sym)
+            if proto is None:
+                findings.append(Finding(
+                    "ctypes", rel, line, "unknown-symbol:" + sym,
+                    "%s is called here but no extern \"C\" export of "
+                    "that name exists in %s" % (sym, project.native_src)))
+                continue
+            findings += _check_argtypes(rel, sym, proto, use)
+            findings += _check_restype(rel, sym, proto, use)
+    return findings
+
+
+def _check_argtypes(rel: str, sym: str, proto: cpp.Prototype,
+                    use: pyast.CtypesUse) -> List[Finding]:
+    declared = use.argtypes.get(sym)
+    if declared is None:
+        return [Finding(
+            "ctypes", rel, use.calls[sym], "undeclared-argtypes:" + sym,
+            "%s is called without declaring .argtypes (prototype: %d "
+            "parameter(s)); ctypes would coerce every argument to int"
+            % (sym, len(proto.params)))]
+    elts, line = declared
+    if elts is None:
+        return []  # computed expression: can't verify statically
+    if len(elts) != len(proto.params):
+        return [Finding(
+            "ctypes", rel, line, "argtypes-arity:" + sym,
+            "%s.argtypes declares %d entries but the native prototype "
+            "takes %d" % (sym, len(elts), len(proto.params)))]
+    out = []
+    for i, (elt, param) in enumerate(zip(elts, proto.params)):
+        want = cpp.expected_argtype(param)
+        if want is None:
+            continue  # callback or unmapped: accept any declaration
+        if elt != want:
+            out.append(Finding(
+                "ctypes", rel, line,
+                "argtypes-mismatch:%s:%d" % (sym, i),
+                "%s.argtypes[%d] is %s but the native parameter is "
+                "'%s' (expected %s)" % (sym, i, elt, param.ctype, want)))
+    return out
+
+
+def _check_restype(rel: str, sym: str, proto: cpp.Prototype,
+                   use: pyast.CtypesUse) -> List[Finding]:
+    declared = use.restype.get(sym)
+    want = cpp.expected_restype(proto.ret)
+    if declared is None:
+        return [Finding(
+            "ctypes", rel, use.calls[sym], "undeclared-restype:" + sym,
+            "%s is called without declaring .restype (native return "
+            "type '%s'); declare %s explicitly"
+            % (sym, proto.ret, want or proto.ret))]
+    value, line = declared
+    if want is not None and value != want:
+        return [Finding(
+            "ctypes", rel, line, "restype-mismatch:" + sym,
+            "%s.restype is %s but the native return type is '%s' "
+            "(expected %s)" % (sym, value, proto.ret, want))]
+    return []

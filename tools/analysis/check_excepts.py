@@ -1,0 +1,113 @@
+"""Exception-policy lint: no bare ``except:`` and no blind
+``except Exception: pass`` swallowing inside ``horovod_tpu/``. A
+swallowed exception in a distributed runtime is a hang factory — the
+op that failed never completes, and nothing logs why. Handlers that are
+intentionally broad (last-ditch cleanup on shutdown paths, "the scrape
+must never die") carry an inline ``# analysis: allow-broad-except``
+tag, which this lint honors and which doubles as reviewer-visible
+documentation of the decision.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+from typing import List
+
+from tools.analysis.common import Finding, Project
+
+ALLOW_TAG = "analysis: allow-broad-except"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _exc_names(expr) -> List[str]:
+    if expr is None:
+        return []
+    if isinstance(expr, ast.Tuple):
+        out = []
+        for e in expr.elts:
+            out += _exc_names(e)
+        return out
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if isinstance(expr, ast.Attribute):
+        return [expr.attr]
+    return []
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body does nothing with the error: only
+    pass/continue/``...``. A body that logs, re-raises, or computes a
+    fallback is a decision, not a swallow."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _tagged(lines: List[str], handler: ast.ExceptHandler) -> bool:
+    lo = max(0, handler.lineno - 2)
+    hi = min(len(lines), handler.body[-1].end_lineno or handler.lineno)
+    return any(ALLOW_TAG in ln for ln in lines[lo:hi])
+
+
+def _handlers_with_scope(tree: ast.Module):
+    """(qualname, handler) in source order. The qualname keys the
+    baseline fingerprint, so it must not shift when unrelated lines are
+    added above (line numbers are display-only)."""
+    out = []
+
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                visit(child, scope + (child.name,))
+            else:
+                if isinstance(child, ast.ExceptHandler):
+                    out.append((".".join(scope) or "<module>", child))
+                visit(child, scope)
+
+    visit(tree, ())
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in project.except_files():
+        source = project.read(rel)
+        try:
+            tree = ast.parse(source, rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        lines = source.splitlines()
+        per_key: dict = {}
+        for qualname, node in _handlers_with_scope(tree):
+            bare = node.type is None
+            broad = bool(set(_exc_names(node.type)) & _BROAD)
+            if not bare and not (broad and _swallows(node)):
+                continue
+            if _tagged(lines, node):
+                continue
+            # Content-addressed fingerprint (ast.dump is line-free): a
+            # NEW violation added elsewhere in the same scope must not
+            # inherit a baselined handler's identity. The ordinal only
+            # disambiguates byte-identical twins in one scope.
+            digest = hashlib.md5(
+                ast.dump(node).encode()).hexdigest()[:8]
+            key = (qualname, digest)
+            ordinal = per_key.get(key, 0)
+            per_key[key] = ordinal + 1
+            what = ("bare 'except:'" if bare
+                    else "broad '%s' handler that swallows the error"
+                    % ast.unparse(node.type))
+            findings.append(Finding(
+                "excepts", rel, node.lineno,
+                "broad-except:%s:%s:%d" % (qualname, digest, ordinal),
+                "%s — narrow the exception, log-and-handle, or tag the "
+                "line with '# %s' and a reason" % (what, ALLOW_TAG)))
+    return findings

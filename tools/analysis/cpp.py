@@ -1,0 +1,211 @@
+"""Light C++ parsing for the cross-language contract checkers.
+
+Deliberately not a real parser: the native core is hand-written C-ish
+C++ (no templates in the ABI surface, no macros around the exports), so
+comment/string-aware scanning plus paren matching is enough to recover
+the ``extern "C"`` prototypes and every env-var read. If the core ever
+outgrows this, swap in libclang — the checker interfaces stay the same.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+_ENV_CALL_RE = re.compile(
+    r"\b(?:getenv|EnvLL|EnvInt|EnvDouble|EnvStr)\s*\(\s*\"([A-Z0-9_]+)\"")
+
+
+def strip_comments(text: str, blank_strings: bool = False) -> str:
+    """Blank out // and /* */ comments (and optionally string literals),
+    preserving every newline so line numbers survive."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            out.append(" " * (j - i))
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append(re.sub(r"[^\n]", " ", text[i:j]))
+            i = j
+        elif c in "\"'":
+            quote = c
+            j = i + 1
+            while j < n and text[j] != quote:
+                j += 2 if text[j] == "\\" else 1
+            j = min(j + 1, n)
+            if blank_strings:
+                body = text[i + 1:j - 1] if j - i >= 2 else ""
+                # Keep the linkage marker readable: blanking the "C" in
+                # extern "C" would hide every export from the scanner.
+                keep = body if body == "C" else " " * len(body)
+                out.append(quote + keep + quote
+                           if j - i >= 2 else text[i:j])
+            else:
+                out.append(text[i:j])
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def env_reads(text: str) -> List[Tuple[str, int]]:
+    """(name, line) for every getenv/Env* read of a string literal."""
+    code = strip_comments(text)
+    hits = []
+    for m in _ENV_CALL_RE.finditer(code):
+        hits.append((m.group(1), code.count("\n", 0, m.start()) + 1))
+    return hits
+
+
+class Param(NamedTuple):
+    ctype: str       # normalized C type, e.g. "const char*"
+    is_callback: bool
+
+
+class Prototype(NamedTuple):
+    name: str
+    ret: str         # normalized C return type
+    params: List[Param]
+    line: int
+
+
+# Words that end a multi-token C type rather than naming a parameter:
+# 'long long x' strips 'x', but an unnamed 'long long' (return types are
+# always unnamed) must not lose its second 'long'.
+_TYPE_KEYWORDS = {"void", "bool", "char", "short", "int", "long", "float",
+                  "double", "signed", "unsigned", "const", "size_t"}
+
+
+def _normalize_type(raw: str) -> str:
+    """Collapse whitespace and stick '*' to the type: 'const char *x'
+    -> 'const char*'."""
+    raw = re.sub(r"\s+", " ", raw).strip()
+    # Drop the parameter name (last identifier not part of the type).
+    m = re.match(r"^(.*?[\s\*])([A-Za-z_]\w*)$", raw)
+    if m and m.group(1).strip() and m.group(2) not in _TYPE_KEYWORDS:
+        raw = m.group(1).strip()
+    raw = raw.replace(" *", "*").replace("* ", "*")
+    return raw
+
+
+def _split_params(blob: str) -> List[Param]:
+    blob = blob.strip()
+    if not blob or blob == "void":
+        return []
+    parts, depth, start = [], 0, 0
+    for i, c in enumerate(blob):
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == "," and depth == 0:
+            parts.append(blob[start:i])
+            start = i + 1
+    parts.append(blob[start:])
+    out = []
+    for p in parts:
+        if "(" in p:  # function-pointer parameter
+            out.append(Param("callback", True))
+        else:
+            out.append(Param(_normalize_type(p), False))
+    return out
+
+
+def extern_c_prototypes(text: str,
+                        name_re: str = r"hvd_\w+") -> Dict[str, Prototype]:
+    """Parse every function defined or declared inside extern "C"
+    blocks. Duplicate declarations (forward decl + definition) must
+    agree or a ValueError names the symbol."""
+    code = strip_comments(text, blank_strings=True)
+    protos: Dict[str, Prototype] = {}
+    for m in re.finditer(r'extern\s+"C"\s*\{', code):
+        # Match the block's closing brace.
+        depth, i = 1, m.end()
+        while i < len(code) and depth:
+            if code[i] == "{":
+                depth += 1
+            elif code[i] == "}":
+                depth -= 1
+            i += 1
+        block, offset = code[m.end():i - 1], m.end()
+        for fm in re.finditer(r"(?<![\w.])(" + name_re + r")\s*\(", block):
+            name = fm.group(1)
+            # Match the parameter list (may nest for fn-pointer params).
+            j, depth = fm.end(), 1
+            while j < len(block) and depth:
+                if block[j] == "(":
+                    depth += 1
+                elif block[j] == ")":
+                    depth -= 1
+                j += 1
+            params_blob = block[fm.end():j - 1]
+            # Only definitions/declarations: next token is '{' or ';'.
+            rest = block[j:].lstrip()
+            if not rest or rest[0] not in "{;":
+                continue  # a call site inside another function body
+            # Return type: tokens between the previous ';', '{', '}' and
+            # the name.
+            prev = max(block.rfind(ch, 0, fm.start()) for ch in ";{}")
+            ret = _normalize_type(block[prev + 1:fm.start()]
+                                  .replace("\n", " "))
+            # A statement-position *call* also ends in ';' — e.g.
+            # `return hvd_core_failed();` or `x = hvd_foo();` inside
+            # another export's body. Whatever precedes the name must
+            # look like a type, or this is not a declaration.
+            if not ret or not re.match(r"^[A-Za-z_][\w\s\*]*$", ret) \
+                    or re.search(r"\breturn\b", ret):
+                continue
+            line = code.count("\n", 0, offset + fm.start()) + 1
+            proto = Prototype(name, ret, _split_params(params_blob), line)
+            seen = protos.get(name)
+            if seen is not None and (seen.ret != proto.ret
+                                     or seen.params != proto.params):
+                raise ValueError(
+                    "conflicting extern \"C\" declarations for %s" % name)
+            protos[name] = proto
+    return protos
+
+
+# C type -> the ctypes expression Python must declare for it
+# (normalized: no "ctypes." prefix). Callback params map to None:
+# statically unverifiable, any declared expression is accepted.
+C_TO_CTYPES_ARG = {
+    "int": "c_int",
+    "long long": "c_longlong",
+    "double": "c_double",
+    "const char*": "c_char_p",
+    "char*": "c_char_p",
+    "void*": "c_void_p",
+    "const void*": "c_void_p",
+    "long long*": "POINTER(c_longlong)",
+    "const long long*": "POINTER(c_longlong)",
+    "double*": "POINTER(c_double)",
+    "const double*": "POINTER(c_double)",
+    "int*": "POINTER(c_int)",
+    "const int*": "POINTER(c_int)",
+}
+
+C_TO_CTYPES_RET = {
+    "void": "None",
+    "int": "c_int",
+    "long long": "c_longlong",
+    "double": "c_double",
+    "const char*": "c_char_p",
+}
+
+
+def expected_argtype(param: Param) -> Optional[str]:
+    if param.is_callback:
+        return None  # wildcard
+    return C_TO_CTYPES_ARG.get(param.ctype)
+
+
+def expected_restype(ret: str) -> Optional[str]:
+    return C_TO_CTYPES_RET.get(ret)

@@ -1,0 +1,119 @@
+"""Python AST scanning shared by the contract checkers (jax-free: the
+checkers never import the modules they inspect)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+
+def parse(source: str, filename: str = "<analysis>") -> ast.Module:
+    return ast.parse(source, filename=filename)
+
+
+def _is_env_base(expr: ast.AST) -> bool:
+    """True for expressions that plausibly denote an environment
+    mapping: anything whose dotted source mentions 'environ' or is a
+    bare name like env/child_env/worker_env."""
+    src = ast.unparse(expr)
+    if "environ" in src:
+        return True
+    return isinstance(expr, ast.Name) and (
+        src == "env" or src.endswith("_env") or src.startswith("env_"))
+
+
+def env_reads(tree: ast.Module) -> List[Tuple[str, int]]:
+    """(name, line) for every env-var *read* with a literal key:
+    ``os.getenv("X")``, ``os.environ["X"]`` (Load context),
+    ``os.environ.get("X")`` and ``env.get("X")`` on env-like dicts."""
+    hits: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                fname, base = f.attr, f.value
+            elif isinstance(f, ast.Name):
+                fname, base = f.id, None
+            else:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            key = node.args[0].value
+            if fname == "getenv":
+                hits.append((key, node.lineno))
+            elif fname == "get" and base is not None and _is_env_base(base):
+                hits.append((key, node.lineno))
+        elif isinstance(node, ast.Subscript):
+            if not isinstance(node.ctx, ast.Load):
+                continue  # env["X"] = ... constructs a child env: a write
+            if isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and _is_env_base(node.value):
+                hits.append((node.slice.value, node.lineno))
+    return hits
+
+
+def _norm_ctypes(expr: ast.AST) -> str:
+    """Unparse with the 'ctypes.' prefix dropped so 'ctypes.c_int' and
+    'c_int' compare equal."""
+    return ast.unparse(expr).replace("ctypes.", "")
+
+
+class CtypesUse:
+    """Per-file view of native-symbol usage: declared signatures and
+    call sites for every ``<obj>.hvd_*`` attribute."""
+
+    def __init__(self):
+        self.argtypes: Dict[str, Tuple[List[str], int]] = {}
+        self.restype: Dict[str, Tuple[str, int]] = {}
+        self.calls: Dict[str, int] = {}  # symbol -> first call line
+
+
+def scan_ctypes(tree: ast.Module, symbol_prefix: str = "hvd_") -> CtypesUse:
+    use = CtypesUse()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            # <obj>.hvd_x.argtypes = [...] / <obj>.hvd_x.restype = ...
+            if isinstance(t, ast.Attribute) \
+                    and t.attr in ("argtypes", "restype") \
+                    and isinstance(t.value, ast.Attribute) \
+                    and t.value.attr.startswith(symbol_prefix):
+                sym = t.value.attr
+                if t.attr == "argtypes":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        elts = [_norm_ctypes(e) for e in node.value.elts]
+                        use.argtypes[sym] = (elts, node.lineno)
+                    else:  # computed list: record as unverifiable
+                        use.argtypes[sym] = (None, node.lineno)
+                else:
+                    use.restype[sym] = (_norm_ctypes(node.value),
+                                        node.lineno)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr.startswith(symbol_prefix):
+                use.calls.setdefault(f.attr, node.lineno)
+    return use
+
+
+def metric_names(tree: ast.Module,
+                 factories=("counter", "gauge", "histogram"),
+                 prefix: str = "hvd_") -> List[Tuple[str, int]]:
+    """(name, line) for every metric constructed with a literal name:
+    ``counter("hvd_x", ...)`` / ``registry.gauge("hvd_y", ...)``."""
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else None)
+        if fname not in factories:
+            continue
+        a = node.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                and a.value.startswith(prefix):
+            hits.append((a.value, node.lineno))
+    return hits

@@ -68,11 +68,24 @@ run_tier1() {
 # ISSUE 4 adds the ASan/UBSan smokes (tests/test_sanitizers.py, same
 # jax-free prebuild discipline): ~11s warm, ~60s cold for the two
 # instrumented core builds — absorbed by the existing headroom.
+#
+# ISSUE 5 adds the elastic control-plane chaos pair
+# (tests/test_chaos_elastic.py: SIGKILL the driver with journaling ->
+# replay + checkpoint auto-resume; SIGSTOP a worker -> heartbeat
+# liveness replacement; ~150-250s combined warm). The driver-kill case
+# runs FIRST as a fail-fast smoke — a broken journal/fencing path
+# wedges jobs in production, so it is cheaper to catch before the full
+# tier burns its budget. Budget bumped 2100 -> 2400 to keep headroom.
 run_tier2() {
+    echo "=== tier 2: driver-kill chaos smoke (journal + auto-resume) ==="
+    timeout 600 python -m pytest \
+        tests/test_chaos_elastic.py::test_driver_kill9_journal_resume \
+        -q -p no:cacheprovider --override-ini 'addopts='
     echo "=== tier 2 (heavyweight integration, incl. chaos suite) ==="
-    timeout "${HVD_CI_TIER2_BUDGET:-2100}" \
+    timeout "${HVD_CI_TIER2_BUDGET:-2400}" \
         python -m pytest tests/ -q -p no:cacheprovider \
-        --override-ini 'addopts=' -m tier2
+        --override-ini 'addopts=' -m tier2 \
+        --deselect tests/test_chaos_elastic.py::test_driver_kill9_journal_resume
 }
 
 case "$TIER" in

@@ -175,6 +175,20 @@ def init(process_sets=None):
         if _ctx.topology.size > 1:
             from horovod_tpu.core import CoreSession
 
+            # Elastic runs publish controller_port 0 (= negotiated):
+            # the launcher's free_port() probes the wrong host — only
+            # the rank-0 WORKER host knows what it can bind. Rank 0
+            # picks a port there and reports it through the rendezvous
+            # KV; everyone else polls it before dialing
+            # (elastic/worker.negotiate_controller_port).
+            if (os.environ.get("HOROVOD_CONTROLLER_PORT", "0") in ("", "0")
+                    and os.environ.get("HOROVOD_ELASTIC")
+                    and os.environ.get("HOROVOD_RENDEZVOUS_ADDR")):
+                from horovod_tpu.elastic.worker import (
+                    negotiate_controller_port,
+                )
+
+                negotiate_controller_port(_ctx.topology.rank)
             _ctx.core = CoreSession.start(_ctx.topology)
         _ctx.initialized = True
         timeline_path = os.environ.get("HOROVOD_TIMELINE")

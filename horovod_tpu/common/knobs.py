@@ -100,7 +100,19 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "elastic worker+driver backoff ceiling seconds (default 30)"),
     Knob("HOROVOD_ELASTIC_STABLE_SEC", HONORED,
          "elastic/worker.py: a world surviving this long resets the "
-         "consecutive-failure budget (default 60)"),
+         "consecutive-failure budget (default 60); the driver also "
+         "decays per-slot fail counts after this quiet stretch"),
+    Knob("HOROVOD_ELASTIC_JOURNAL_DIR", HONORED,
+         "runner/elastic_run.py: fsync'd JSONL journal of membership "
+         "transitions (also hvdrun --journal-dir); a restarted driver "
+         "replays it and resumes at rendezvous version N+1"),
+    Knob("HOROVOD_WORKER_LIVENESS_SEC", HONORED,
+         "runner/elastic_run.py: replace a worker slot whose "
+         "heartbeats stop for this many seconds "
+         "(SIGTERM->SIGKILL->reset); 0 = disabled"),
+    Knob("HVD_HEARTBEAT_SEC", HONORED,
+         "elastic/worker.py: liveness heartbeat PUT interval to the "
+         "rendezvous KV (default 10; <=0 disables)"),
     Knob("HOROVOD_DISABLE_GROUP_FUSION", HONORED,
          "core/src/controller.cc FuseResponses"),
     Knob("HOROVOD_DYNAMIC_PROCESS_SETS", HONORED,

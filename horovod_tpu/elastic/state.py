@@ -15,6 +15,7 @@ import copy
 import json
 import os
 import socket
+import sys
 from typing import Any, Callable, Dict, List, Optional
 
 from horovod_tpu.common import basics
@@ -28,6 +29,24 @@ _M_HOST_UPDATES = _metrics.counter(
     "hvd_elastic_host_updates_total",
     "Graceful HostsUpdatedInterrupt resets triggered at commit "
     "boundaries by a new driver-published rendezvous version.")
+_M_CKPT_SAVES = _metrics.counter(
+    "hvd_elastic_ckpt_saves_total",
+    "Committed snapshots persisted through the attached checkpointer "
+    "(every checkpoint_interval-th State.commit).")
+_M_CKPT_RESTORES = _metrics.counter(
+    "hvd_elastic_ckpt_restores_total",
+    "Checkpoint auto-resumes applied on a cold start (first wrapper "
+    "entry of a fresh process restored a committed step).")
+_M_CKPT_ERRORS = _metrics.counter(
+    "hvd_elastic_ckpt_errors_total",
+    "Checkpoint persistence/restore attempts that failed (save errors "
+    "are logged and skipped; restore errors fall back one step).")
+
+
+def commit_count() -> int:
+    """Total ``State.commit()`` calls in this process (public accessor
+    for the heartbeat payload and diagnostics)."""
+    return int(_M_COMMITS.get())
 
 
 def _rendezvous_endpoint():
@@ -56,12 +75,26 @@ def current_rendezvous_version() -> Optional[int]:
 
 
 class State:
-    """Base elastic state (reference: common/elastic.py:26-113)."""
+    """Base elastic state (reference: common/elastic.py:26-113).
+
+    Checkpoint integration (ISSUE 5): subclasses that accept a
+    ``checkpointer=`` (``utils/checkpoint.Checkpointer`` or anything
+    duck-typing its ``save``/``restore``/``all_steps``/``latest_step``)
+    persist every ``checkpoint_interval``-th committed snapshot, and
+    ``_maybe_auto_resume`` (called once per process by the
+    ``elastic.run`` wrapper) restores the newest committed step on a
+    cold start — falling back one step when the newest restore fails.
+    """
 
     def __init__(self, **kwargs):
         self._reset_callbacks: List[Callable] = []
         self._known_version = int(os.environ.get(
             "HOROVOD_RENDEZVOUS_VERSION", "0"))
+        self._checkpointer = None
+        self._checkpoint_interval = 1
+        self._commits_since_ckpt = 0
+        self._ckpt_seq: Optional[int] = None
+        self._resume_attempted = False
 
     def register_reset_callbacks(self, callbacks):
         self._reset_callbacks.extend(callbacks)
@@ -74,7 +107,139 @@ class State:
     def commit(self):
         _M_COMMITS.inc()
         self.save()
+        # Persist BEFORE the host-update check: a commit that triggers
+        # a graceful reset must still reach durable storage.
+        self._maybe_checkpoint()
         self.check_host_updates()
+
+    # --- durable checkpoints (utils/checkpoint.py integration) ---
+
+    def _checkpoint_step(self) -> int:
+        """The committed user ``step`` attribute when it is
+        integer-like (the common training-loop pattern), else an
+        internal counter seeded past any step already on disk."""
+        step = getattr(self, "step", None)
+        if step is not None:
+            try:
+                return int(step)
+            except (TypeError, ValueError):
+                pass
+        if self._ckpt_seq is None:
+            try:
+                latest = self._checkpointer.latest_step()
+            except Exception:  # analysis: allow-broad-except — storage
+                # probe only; a fresh sequence is always a safe seed.
+                latest = None
+            self._ckpt_seq = latest if latest is not None else -1
+        self._ckpt_seq += 1
+        return self._ckpt_seq
+
+    def _checkpoint_payload(self) -> dict:
+        """Pytree handed to the checkpointer; subclasses override.
+        Must be checkpointer-compatible (orbax: arrays, scalars,
+        nested dict/list)."""
+        raise NotImplementedError
+
+    def _apply_checkpoint(self, payload: dict) -> None:
+        """Inverse of ``_checkpoint_payload``; subclasses override."""
+        raise NotImplementedError
+
+    def _checkpoint_due(self) -> bool:
+        """Whether this commit is a checkpoint commit. The decision
+        MUST agree across ranks: ``Checkpointer.save`` runs a world
+        barrier, so one rank entering it while another skips wedges
+        the job on mismatched collectives. With an integer-like
+        ``step`` the cadence keys off it (``step % interval == 0`` —
+        identical everywhere after ``sync()``, no matter when each
+        process was respawned); only the no-step fallback uses the
+        per-process commit counter, which ``sync()`` re-aligns from
+        rank 0."""
+        if self._checkpoint_interval <= 1:
+            return True
+        step = getattr(self, "step", None)
+        if step is not None:
+            try:
+                return int(step) % self._checkpoint_interval == 0
+            except (TypeError, ValueError):
+                pass
+        self._commits_since_ckpt += 1
+        if self._commits_since_ckpt < self._checkpoint_interval:
+            return False
+        self._commits_since_ckpt = 0
+        return True
+
+    def _maybe_checkpoint(self):
+        """Persist every Nth committed snapshot. A failed save is
+        counted and logged, never raised: the in-memory commit already
+        succeeded and one bad write must not take down training."""
+        if self._checkpointer is None or not self._checkpoint_due():
+            return
+        step = self._checkpoint_step()
+        try:
+            saved = self._checkpointer.save(
+                step, self._checkpoint_payload())
+        except Exception as e:  # analysis: allow-broad-except —
+            # persistence is best-effort by contract; failures surface
+            # via hvd_elastic_ckpt_errors_total and the log line.
+            _M_CKPT_ERRORS.inc()
+            sys.stderr.write(
+                "elastic: checkpoint save at step %s failed: %s\n"
+                % (step, e))
+            return
+        # Checkpointer.save returns False on ranks that did not write
+        # and when orbax skipped the step (throttled / already on
+        # disk): count persisted snapshots, not attempts. None (a
+        # duck-typed checkpointer with no return) counts as saved.
+        if saved is not False:
+            _M_CKPT_SAVES.inc()
+
+    def _maybe_auto_resume(self) -> Optional[int]:
+        """Restore the newest committed checkpoint on the FIRST
+        wrapper entry of a fresh process (the cold-rendezvous path: a
+        driver restart or full-job crash respawned every rank), with a
+        one-step fallback when the newest restore fails. Survivors
+        re-entering through an elastic reset never come back here (the
+        latch is per-process), so their in-memory state wins and
+        ``sync()`` aligns any fresh respawn with rank 0. Returns the
+        restored step, or None."""
+        if self._checkpointer is None or self._resume_attempted:
+            return None
+        self._resume_attempted = True
+        try:
+            steps = sorted(int(s) for s in self._checkpointer.all_steps())
+        except Exception as e:  # analysis: allow-broad-except — an
+            # unreadable checkpoint dir means cold-start from scratch,
+            # exactly what a missing checkpointer would do.
+            _M_CKPT_ERRORS.inc()
+            sys.stderr.write(
+                "elastic: cannot list checkpoints, starting from "
+                "scratch: %s\n" % e)
+            return None
+        # Newest first, then its predecessor: a torn/corrupt latest
+        # step (the crash landed mid-save) must not strand the job.
+        for step in reversed(steps[-2:]):
+            try:
+                payload = self._checkpointer.restore(step=step)
+                # Apply inside the same guard: a checkpoint that reads
+                # back fine but fails to APPLY (attribute schema drift,
+                # un-coercible leaves) must fall back too — an escaped
+                # exception here kills every respawned process and
+                # crash-loops the job, since the per-process latch
+                # makes each fresh respawn retry the same checkpoint.
+                self._apply_checkpoint(payload)
+            except Exception as e:  # analysis: allow-broad-except —
+                # fall back to the previous committed step by design.
+                _M_CKPT_ERRORS.inc()
+                sys.stderr.write(
+                    "elastic: restore of checkpoint step %d failed "
+                    "(%s); falling back\n" % (step, e))
+                continue
+            self._ckpt_seq = None  # re-seed past the restored step
+            _M_CKPT_RESTORES.inc()
+            sys.stderr.write(
+                "elastic: auto-resumed from checkpoint step %d\n" % step)
+            return step
+        return None
 
     def check_host_updates(self):
         """Raise HostsUpdatedInterrupt when the driver has published a new
@@ -109,9 +274,19 @@ class ObjectState(State):
     processed_indices) get handler semantics mirroring the reference's
     SamplerStateHandler (reference: torch/elastic/state.py): commit
     snapshots their state_dict, sync unions processed indices across all
-    workers then broadcasts, and load_state_dict re-shards."""
+    workers then broadcasts, and load_state_dict re-shards.
 
-    def __init__(self, **kwargs):
+    ``checkpointer=`` attaches a ``utils/checkpoint.Checkpointer`` (or
+    duck-typed equivalent): every ``checkpoint_interval``-th
+    ``commit()`` persists the committed snapshot, and on a cold start
+    the ``elastic.run`` wrapper restores the newest committed step
+    (see ``State._maybe_auto_resume``). The persisted payload is the
+    picklable-attribute snapshot; attributes must be
+    checkpointer-compatible (orbax: arrays, scalars, nested
+    dict/list)."""
+
+    def __init__(self, checkpointer=None, checkpoint_interval: int = 1,
+                 **kwargs):
         super().__init__()
         self._samplers: Dict[str, Any] = {
             k: v for k, v in kwargs.items() if _is_sampler(v)}
@@ -119,6 +294,8 @@ class ObjectState(State):
             k: v for k, v in kwargs.items() if k not in self._samplers}
         self._saved_sampler_state: Dict[str, Any] = {}
         self.__dict__.update(kwargs)
+        self._checkpointer = checkpointer
+        self._checkpoint_interval = max(1, int(checkpoint_interval))
 
     def _save_samplers(self):
         for k, s in self._samplers.items():
@@ -138,6 +315,18 @@ class ObjectState(State):
         self.__dict__.update(copy.deepcopy(self._saved_state))
         self._restore_samplers()
 
+    def _checkpoint_payload(self) -> dict:
+        return {"state": dict(self._saved_state)}
+
+    def _apply_checkpoint(self, payload: dict) -> None:
+        # Only keys this state already owns: schema drift in an old
+        # checkpoint must not graft unknown attributes onto the state.
+        restored = payload.get("state", {})
+        for k, v in restored.items():
+            if k in self._saved_state:
+                self._saved_state[k] = v
+        self.restore()
+
     def sync(self):
         if basics.size() > 1:
             from horovod_tpu.jax.functions import (
@@ -148,6 +337,15 @@ class ObjectState(State):
                                       name="elastic.ObjectState")
             self._saved_state = synced
             self.__dict__.update(copy.deepcopy(synced))
+            if self._checkpointer is not None:
+                # Align the no-step cadence counter (and the fallback
+                # step sequence) with rank 0: a respawned rank's fresh
+                # counter must not make it skip a checkpoint commit
+                # other ranks enter (Checkpointer.save barriers).
+                self._commits_since_ckpt, self._ckpt_seq = \
+                    broadcast_object(
+                        (self._commits_since_ckpt, self._ckpt_seq),
+                        root_rank=0, name="elastic.ckpt_cadence")
             for k, s in self._samplers.items():
                 # Union processed indices from every worker (each shard
                 # advanced independently), then broadcast rank 0's view so
@@ -260,3 +458,51 @@ class TorchState(ObjectState):
                 broadcast_optimizer_state(self._optimizer, root_rank=0)
         super().sync()
         self.save()
+
+    def _checkpoint_payload(self) -> dict:
+        """The inherited payload carries only the picklable-attribute
+        snapshot — persisting just that would silently drop the model
+        and optimizer weights, and an auto-resume would then restore
+        ``step`` against freshly initialized parameters. The committed
+        state dicts (nested torch tensors, int-keyed optimizer state —
+        not orbax-compatible leaf-wise) ride along as one
+        ``torch.save`` blob wrapped in a uint8 array."""
+        import io
+
+        import numpy as np
+        import torch
+
+        payload = super()._checkpoint_payload()
+        blob: Dict[str, Any] = {}
+        if self._model is not None:
+            blob["model"] = (self._saved_model
+                             if hasattr(self, "_saved_model")
+                             else self._model.state_dict())
+        if self._optimizer is not None:
+            blob["optimizer"] = (self._saved_optimizer
+                                 if hasattr(self, "_saved_optimizer")
+                                 else self._optimizer.state_dict())
+        if blob:
+            buf = io.BytesIO()
+            torch.save(blob, buf)
+            payload["torch"] = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+        return payload
+
+    def _apply_checkpoint(self, payload: dict) -> None:
+        import io
+
+        import numpy as np
+        import torch
+
+        raw = payload.get("torch")
+        if raw is not None:
+            blob = torch.load(
+                io.BytesIO(np.asarray(raw, dtype=np.uint8).tobytes()),
+                map_location="cpu", weights_only=True)
+            if self._model is not None and "model" in blob:
+                self._saved_model = blob["model"]
+            if self._optimizer is not None and "optimizer" in blob:
+                self._saved_optimizer = blob["optimizer"]
+        # Parent filters to known _saved_state keys and calls restore(),
+        # which loads the _saved_model/_saved_optimizer set above.
+        super()._apply_checkpoint(payload)

@@ -10,6 +10,23 @@ On TPU a topology change means slice re-acquisition, so recovery is
 restart-shaped: the core is shut down, the worker polls the rendezvous
 store for the next published version, adopts its new rank/size (or exits
 cleanly when its slot is gone), and re-initializes.
+
+Crash-safe control plane (ISSUE 5):
+
+- **Version fencing.** Rendezvous versions only move forward: a worker
+  that has adopted version N ignores any published meta below N, so a
+  stale driver (or a half-dead one racing its journal-replayed
+  successor) can never drag a live world backwards into split-brain.
+- **Heartbeats.** A daemon thread PUTs ``heartbeat/<slot_key>`` to the
+  rendezvous KV every ``HVD_HEARTBEAT_SEC`` so the driver can tell a
+  wedged worker (SIGSTOP, deadlocked runtime — ``proc.poll()`` still
+  None) from a healthy one and replace it within
+  ``HOROVOD_WORKER_LIVENESS_SEC``.
+- **Auto-resume.** On the first wrapper entry of a fresh process a
+  state with an attached checkpointer restores the newest committed
+  checkpoint step (``elastic/state.py``) instead of silently starting
+  from scratch — the cold-rendezvous path after a driver restart or a
+  full-job crash.
 """
 
 from __future__ import annotations
@@ -18,7 +35,9 @@ import functools
 import json
 import os
 import sys
+import threading
 import time
+from typing import Optional, Tuple
 
 from horovod_tpu.common import basics
 from horovod_tpu.common.exceptions import (
@@ -35,6 +54,10 @@ _M_FAILURES = _metrics.counter(
     "hvd_elastic_failures_total",
     "HorovodInternalError recoveries in the elastic run wrapper "
     "(rank death / coordination failure rolled back to last commit).")
+_M_HEARTBEATS = _metrics.counter(
+    "hvd_elastic_heartbeats_total",
+    "Liveness heartbeats this worker PUT to the rendezvous KV "
+    "(heartbeat/<slot_key>, every HVD_HEARTBEAT_SEC).")
 
 
 def _rendezvous():
@@ -43,9 +66,24 @@ def _rendezvous():
     return addr, port
 
 
-def _poll_meta(min_version: int, timeout: float = 300.0) -> dict:
+def _rendezvous_or_none() -> Optional[Tuple[str, int]]:
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    if not addr or not port:
+        return None
+    return addr, int(port)
+
+
+def _poll_meta(min_version: int, timeout: Optional[float] = None) -> dict:
+    """Wait for the driver to publish rendezvous meta at version >=
+    ``min_version``. Fencing: anything older is a stale driver's
+    leftover and is ignored, never adopted. The wait budget honors the
+    registered ``HOROVOD_ELASTIC_TIMEOUT`` knob (default 600 s, the
+    driver's re-scaling budget) instead of a hardcoded constant."""
     from horovod_tpu.runner.http_server import read_kv
 
+    if timeout is None:
+        timeout = float_env("HOROVOD_ELASTIC_TIMEOUT", 600.0)
     addr, port = _rendezvous()
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -60,6 +98,142 @@ def _poll_meta(min_version: int, timeout: float = 300.0) -> dict:
         time.sleep(0.5)
     raise HorovodInternalError(
         "Timed out waiting for rendezvous version >= %d" % min_version)
+
+
+def negotiate_controller_port(rank: int,
+                              timeout: Optional[float] = None) -> int:
+    """Resolve the controller port for this world when the driver
+    published 0 (= negotiated).
+
+    Fixes the controller-port race: the driver's ``free_port()`` probed
+    the *launcher* host, but the native controller binds on the rank-0
+    *worker* host — a port free on one says nothing about the other.
+    Rank 0 bind-probes a free port on its own host (the same
+    ``free_port()`` helper, now running where the controller will
+    actually bind) and reports it through the rendezvous KV under a
+    version-scoped key; every other rank polls that key before dialing.
+    Sets ``HOROVOD_CONTROLLER_PORT`` and returns the port.
+    """
+    from horovod_tpu.runner.http_server import read_kv, write_kv
+
+    addr, port = _rendezvous()
+    version = os.environ.get("HOROVOD_RENDEZVOUS_VERSION", "0")
+    key = "controller_port.%s" % version
+    if timeout is None:
+        timeout = float_env("HOROVOD_ELASTIC_TIMEOUT", 600.0)
+    if rank == 0:
+        from horovod_tpu.runner.launch import free_port
+
+        try:
+            chosen = free_port()
+        except OSError as e:
+            raise HorovodInternalError(
+                "rank 0 could not bind a controller port on this "
+                "host: %s" % e)
+        write_kv(addr, port, "control", key, str(chosen).encode())
+        os.environ["HOROVOD_CONTROLLER_PORT"] = str(chosen)
+        return chosen
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            raw = read_kv(addr, port, "control", key, timeout=5)
+        except OSError:
+            raw = None
+        if raw:
+            os.environ["HOROVOD_CONTROLLER_PORT"] = raw.decode()
+            return int(raw)
+        # Bail out fast if the world moved on while we waited (rank 0
+        # died before reporting): dying here lets the driver respawn
+        # this slot into the new version instead of burning the full
+        # timeout against a key that will never arrive.
+        try:
+            meta_raw = read_kv(addr, port, "control", "meta", timeout=5)
+        except OSError:
+            meta_raw = None
+        if meta_raw:
+            meta = json.loads(meta_raw.decode())
+            if meta.get("version", 0) > int(version):
+                raise HorovodInternalError(
+                    "rendezvous version %s superseded while waiting for "
+                    "its controller port" % version)
+        time.sleep(0.2)
+    raise HorovodInternalError(
+        "Timed out waiting for the rank-0 controller port "
+        "(rendezvous version %s)" % version)
+
+
+# --- heartbeats -------------------------------------------------------------
+
+_heartbeat_lock = threading.Lock()
+_heartbeat_thread: Optional[threading.Thread] = None
+
+
+def heartbeat_payload() -> dict:
+    """What a heartbeat carries. The driver keys liveness off its OWN
+    arrival clock; the payload is diagnostic (which process, at which
+    rendezvous version, how far it has committed)."""
+    from horovod_tpu.elastic import state as _state
+
+    return {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "version": int(os.environ.get("HOROVOD_RENDEZVOUS_VERSION", "0")),
+        "commits": _state.commit_count(),
+    }
+
+
+def send_heartbeat() -> bool:
+    """One best-effort heartbeat PUT; False when it could not be sent
+    (no elastic env, or the rendezvous store is unreachable — e.g. the
+    driver is mid-restart; never fatal)."""
+    from horovod_tpu.runner.http_server import write_kv
+
+    ep = _rendezvous_or_none()
+    slot_key = os.environ.get("HOROVOD_SLOT_KEY")
+    if ep is None or not slot_key:
+        return False
+    try:
+        write_kv(ep[0], ep[1], "heartbeat", slot_key,
+                 json.dumps(heartbeat_payload()).encode(), timeout=5)
+    except OSError:
+        return False
+    _M_HEARTBEATS.inc()
+    return True
+
+
+def start_heartbeats() -> Optional[threading.Thread]:
+    """Start the daemon heartbeat thread (idempotent). Interval is
+    ``HVD_HEARTBEAT_SEC`` (default 10 s; <= 0 disables). Returns the
+    thread, or None when heartbeating is off / not under the elastic
+    driver. The thread re-reads the interval and env each beat, so it
+    survives elastic resets without a restart."""
+    global _heartbeat_thread
+    if float_env("HVD_HEARTBEAT_SEC", 10.0) <= 0:
+        return None
+    if (_rendezvous_or_none() is None
+            or not os.environ.get("HOROVOD_SLOT_KEY")):
+        return None
+    with _heartbeat_lock:
+        if _heartbeat_thread is not None and _heartbeat_thread.is_alive():
+            return _heartbeat_thread
+
+        def _loop():
+            while True:
+                try:
+                    send_heartbeat()
+                except Exception as e:  # analysis: allow-broad-except
+                    # — heartbeating is best-effort: one garbled KV
+                    # response (HTTPException, not OSError) must not
+                    # kill this thread, or the liveness monitor would
+                    # replace a perfectly healthy worker as wedged.
+                    sys.stderr.write(
+                        "elastic: heartbeat attempt failed: %s\n" % e)
+                time.sleep(max(0.05, float_env("HVD_HEARTBEAT_SEC", 10.0)))
+
+        _heartbeat_thread = threading.Thread(
+            target=_loop, daemon=True, name="hvd-heartbeat")
+        _heartbeat_thread.start()
+        return _heartbeat_thread
 
 
 def reinit_for_version(min_version: int):
@@ -138,6 +312,12 @@ def run(func):
     gracefully instead of hot-spinning through restore/reinit cycles;
     when ``HOROVOD_ELASTIC_MAX_FAILURES`` (default 0 = unlimited) is
     exceeded the error is re-raised so the job fails loudly.
+
+    On entry the wrapper also starts the liveness heartbeat thread
+    (when running under the elastic driver) and gives a state with an
+    attached checkpointer one chance to auto-resume from its newest
+    committed checkpoint (``elastic/state.py``) — the cold-rendezvous
+    recovery path after a driver restart or full-job crash.
     """
 
     @functools.wraps(func)
@@ -146,6 +326,12 @@ def run(func):
         backoff_base = float_env("HOROVOD_ELASTIC_BACKOFF_BASE", 1.0)
         backoff_max = float_env("HOROVOD_ELASTIC_BACKOFF_MAX", 30.0)
         stable_sec = float_env("HOROVOD_ELASTIC_STABLE_SEC", 60.0)
+        start_heartbeats()
+        # Duck-typed so user State subclasses predating the
+        # checkpointer integration keep working unchanged.
+        maybe_resume = getattr(state, "_maybe_auto_resume", None)
+        if maybe_resume is not None:
+            maybe_resume()
         reset_version = None
         skip_sync = False
         consecutive_failures = 0

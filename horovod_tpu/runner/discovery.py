@@ -45,21 +45,60 @@ class HostDiscoveryScript:
 
 class HostManager:
     """Tracks the current host set and blacklisted slots
-    (reference: discovery.py HostManager + blacklist semantics)."""
+    (reference: discovery.py HostManager + blacklist semantics).
+
+    Blacklist lifecycle: a slot blacklisted by repeated failures stays
+    blacklisted while its host remains in discovery — but a host that
+    *leaves* discovery and later re-appears gets its slots forgiven
+    (the node was replaced or rebooted; holding a dead machine's sins
+    against its successor would strand capacity forever). The initial
+    population is not a re-appearance: a driver restart that replayed
+    its journal must not have the first refresh wipe the restored
+    blacklist."""
 
     def __init__(self, discovery: HostDiscoveryScript):
         self._discovery = discovery
         self.current: List[HostInfo] = []
         self.blacklist: Set[str] = set()  # blacklisted slot keys host:slot
+        self._absent: Set[str] = set()    # hosts seen before, now gone
+        self._forgiven: Set[str] = set()  # un-blacklisted, not yet drained
 
     def blacklist_slot(self, slot_key: str):
         self.blacklist.add(slot_key)
+
+    def _forgive_returning_hosts(self, found: List[HostInfo]):
+        prev = {h.hostname for h in self.current}
+        now = {h.hostname for h in found}
+        self._absent |= prev - now
+        for host in now & self._absent:
+            self._absent.discard(host)
+            cleared = {k for k in self.blacklist
+                       if k.rsplit(":", 1)[0] == host}
+            if cleared:
+                self.blacklist -= cleared
+                self._forgiven |= cleared
+                import sys
+
+                sys.stderr.write(
+                    "elastic: host %s re-appeared in discovery; "
+                    "un-blacklisting %s\n" % (host, sorted(cleared)))
+
+    def pop_forgiven(self) -> Set[str]:
+        """Drain the slots un-blacklisted since the last call. The
+        driver clears their fail history too — a forgiven slot must
+        start from a clean record, or its stale count instantly
+        re-blacklists it on the first new failure (and a journal
+        replay would re-blacklist it with no new failure at all)."""
+        forgiven, self._forgiven = self._forgiven, set()
+        return forgiven
 
     def refresh(self) -> bool:
         """Re-run discovery; True when the effective host set changed."""
         found = self._discovery.find_available_hosts()
         if not found:
             return False
+        if self.current:
+            self._forgive_returning_hosts(found)
         if [(h.hostname, h.slots) for h in found] != \
                 [(h.hostname, h.slots) for h in self.current]:
             self.current = found

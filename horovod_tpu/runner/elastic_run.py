@@ -14,6 +14,17 @@ Protocol with workers (horovod_tpu.elastic.worker):
    re-read their slot, and re-init (or exit cleanly when removed).
 3. On worker death the remaining ranks fail fast (socket cascade in the
    core), restore committed state, and wait for the next version.
+
+Crash safety (ISSUE 5): with ``--journal-dir`` (or
+``HOROVOD_ELASTIC_JOURNAL_DIR``) every membership transition is
+appended to an fsync'd JSONL journal BEFORE it is published; a
+restarted driver replays the journal and resumes at version N+1, so a
+driver crash costs one re-rendezvous instead of the job. Worker
+liveness is watched two ways: ``proc.poll()`` catches death, and the
+heartbeat monitor (workers PUT ``heartbeat/<slot_key>`` every
+``HVD_HEARTBEAT_SEC``) catches the SIGSTOP-shaped wedge — a silent
+slot is replaced after ``HOROVOD_WORKER_LIVENESS_SEC`` of no
+heartbeats (SIGTERM -> SIGKILL -> reset).
 """
 
 from __future__ import annotations
@@ -23,20 +34,40 @@ import os
 import socket
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.common.util import failure_backoff_seconds, float_env
+from horovod_tpu.utils import metrics as _metrics
 
 from horovod_tpu.runner.discovery import HostDiscoveryScript, HostManager
 from horovod_tpu.runner.exec_util import SlotProcess
 from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from horovod_tpu.runner.http_server import RendezvousServer
-from horovod_tpu.runner.launch import _tuning_env, free_port, slot_env
+from horovod_tpu.runner.journal import DriverJournal, journal_path
+from horovod_tpu.runner.launch import _tuning_env, slot_env
+
+_M_JOURNAL_REPLAYS = _metrics.counter(
+    "hvd_driver_journal_replays_total",
+    "Driver journal replays at startup (a restarted elastic driver "
+    "recovered its rendezvous state and resumed at version N+1).")
+_M_JOURNAL_RECORDS = _metrics.counter(
+    "hvd_driver_journal_records_total",
+    "Records appended to the elastic driver's fsync'd journal "
+    "(rendezvous snapshots plus worker exit/wedge events).")
+_M_WEDGED = _metrics.counter(
+    "hvd_worker_wedged_total",
+    "Worker slots the liveness monitor declared wedged (alive by "
+    "proc.poll() but silent past HOROVOD_WORKER_LIVENESS_SEC) and "
+    "replaced via SIGTERM->SIGKILL->reset.")
 
 
 class ElasticDriver:
     POLL_SEC = 0.5
     MAX_SLOT_FAILURES = 3
+    # Grace between SIGTERM and SIGKILL when replacing a wedged worker;
+    # short because a SIGSTOPped process cannot run its SIGTERM handler
+    # anyway and the liveness deadline already waited.
+    WEDGE_KILL_GRACE_SEC = 2.0
 
     def __init__(self, args):
         if not args.discovery_script:
@@ -67,15 +98,68 @@ class ElasticDriver:
         self.backoff_max = float_env("HOROVOD_ELASTIC_BACKOFF_MAX", 30.0)
         self._failure_streak = 0
         self._last_failure_reset = 0.0
+        # Per-slot failure history decays after a stable stretch
+        # (mirrors the worker wrapper's HOROVOD_ELASTIC_STABLE_SEC
+        # discipline): two ancient failures must not combine with one
+        # fresh failure days later into a blacklist.
+        self.stable_sec = float_env("HOROVOD_ELASTIC_STABLE_SEC", 60.0)
+        self._last_slot_failure: Dict[str, float] = {}
+        # Heartbeat liveness: workers PUT heartbeat/<slot_key> every
+        # HVD_HEARTBEAT_SEC; a slot silent past the liveness deadline
+        # is wedged (SIGSTOP, deadlocked runtime) and replaced. 0
+        # disables enforcement. Arrival times are stamped with the
+        # DRIVER's clock via the KV put callback, so worker clock skew
+        # cannot fake or mask a wedge.
+        self.liveness_sec = float_env("HOROVOD_WORKER_LIVENESS_SEC", 0.0)
+        self._hb_seen: Dict[str, float] = {}
         self.extra_env = _tuning_env(args)
         self.host_manager = HostManager(HostDiscoveryScript(
             args.discovery_script, args.slots_per_host or 1))
-        self.rendezvous = RendezvousServer()
+        self.rendezvous = RendezvousServer(put_callback=self._on_kv_put)
         self.version = 0
         self.procs: Dict[str, SlotProcess] = {}
         self.done: Dict[str, bool] = {}
         self.fail_counts: Dict[str, int] = {}
         self.exit_code: Optional[int] = None
+        self.journal: Optional[DriverJournal] = None
+        journal_dir = (getattr(args, "journal_dir", None)
+                       or os.environ.get("HOROVOD_ELASTIC_JOURNAL_DIR"))
+        if journal_dir:
+            self._attach_journal(journal_path(journal_dir))
+
+    # --- journal ------------------------------------------------------------
+
+    def _attach_journal(self, path: str):
+        """Replay any existing journal (driver restart) then open it
+        for appending. The replayed version seeds the counter so the
+        first reset publishes version N+1 — strictly above anything
+        the previous incarnation published."""
+        replayed = DriverJournal.replay(path, self.MAX_SLOT_FAILURES)
+        if replayed is not None and replayed.records:
+            self.version = replayed.version
+            self.done = {key: True for key in replayed.done}
+            self.fail_counts = dict(replayed.fail_counts)
+            # The journal carries no failure timestamps; restart the
+            # decay clock at replay time so recovered counts stay
+            # decayable (stable for HOROVOD_ELASTIC_STABLE_SEC from now
+            # -> forgotten) instead of immortal.
+            now = time.time()
+            self._last_slot_failure.update(
+                {key: now for key in replayed.fail_counts})
+            for key in replayed.blacklist:
+                self.host_manager.blacklist_slot(key)
+            _M_JOURNAL_REPLAYS.inc()
+            sys.stderr.write(
+                "elastic: replayed %d journal record(s) from %s; "
+                "resuming at rendezvous version %d\n"
+                % (replayed.records, path, self.version + 1))
+        self.journal = DriverJournal(path)
+
+    def _journal_append(self, record: dict):
+        if self.journal is None:
+            return
+        self.journal.append(record)
+        _M_JOURNAL_RECORDS.inc()
 
     # --- assignment ---------------------------------------------------------
 
@@ -105,7 +189,14 @@ class ElasticDriver:
 
     # --- rendezvous ---------------------------------------------------------
 
-    def _publish(self, keyed: Dict[str, SlotInfo], controller_port: int):
+    def _on_kv_put(self, scope: str, key: str, value: bytes):
+        # Liveness bookkeeping rides the rendezvous KV: stamp heartbeat
+        # arrivals with the driver's clock (worker timestamps are
+        # informational only — clock skew must not fake a wedge).
+        if scope == "heartbeat":
+            self._hb_seen[key] = time.time()
+
+    def _publish(self, keyed: Dict[str, SlotInfo]):
         self.rendezvous.clear_scope("rendezvous")
         for key, a in keyed.items():
             self.rendezvous.put("rendezvous", key,
@@ -114,17 +205,26 @@ class ElasticDriver:
         from horovod_tpu.runner.exec_util import is_local
 
         controller_addr = "127.0.0.1" if is_local(rank0_host) else rank0_host
+        # controller_port 0 = negotiated: free_port() here would probe
+        # the LAUNCHER host, but the controller binds on the rank-0
+        # WORKER host — the rank-0 worker picks a port on its own host
+        # and reports it back through control/controller_port.<version>
+        # (elastic/worker.negotiate_controller_port).
         meta = {
             "version": self.version,
             "controller_addr": controller_addr,
-            "controller_port": controller_port,
+            "controller_port": 0,
             "size": len(keyed),
         }
         self.rendezvous.put("control", "meta", json.dumps(meta).encode())
         return controller_addr
 
-    def _reset(self) -> bool:
-        """New rendezvous round. False when min_np cannot be satisfied."""
+    def _reset(self) -> Optional[bool]:
+        """New rendezvous round. False when min_np cannot be satisfied;
+        None when there is nothing left to run (every discoverable slot
+        already completed — a driver restarted from a journal whose
+        workers all finished must report success, not stall out the
+        elastic timeout and report failure)."""
         deadline = time.time() + (self.elastic_timeout if self.version
                                   else self.start_timeout)
         while True:
@@ -132,6 +232,12 @@ class ElasticDriver:
                     if k not in self.done]
             if len(keys) >= self.min_np:
                 break
+            if not keys and len(self.done) >= self.min_np:
+                sys.stderr.write(
+                    "elastic: all %d discoverable slot(s) already "
+                    "completed (journal replay); job is done\n"
+                    % len(self.done))
+                return None
             if time.time() > deadline:
                 sys.stderr.write(
                     "elastic: %d slots available, need min-np %d; giving "
@@ -140,24 +246,45 @@ class ElasticDriver:
             self.host_manager.refresh()
             time.sleep(1.0)
 
+        # Any host that re-entered discovery since the last round gets
+        # its fail history wiped BEFORE this round is journaled, so
+        # neither the live driver nor a replay re-blacklists it.
+        self._drain_forgiveness()
         keyed = self._compute_assignments(keys)
         self.version += 1
-        controller_port = free_port()
-        controller_addr = self._publish(keyed, controller_port)
+        # Journal BEFORE publish: workers must never observe a version
+        # the journal could lose to a crash (fencing depends on the
+        # recovered driver resuming strictly above anything seen).
+        self._journal_append({
+            "type": "rendezvous",
+            "version": self.version,
+            "assignments": {k: a.to_response_string()
+                            for k, a in keyed.items()},
+            "size": len(keyed),
+            "blacklist": sorted(self.host_manager.blacklist),
+            "fail_counts": dict(self.fail_counts),
+            "done": sorted(self.done),
+            "ts": time.time(),
+        })
+        controller_addr = self._publish(keyed)
 
         launcher_host = socket.gethostname()
         for key, a in keyed.items():
             if key in self.procs and self.procs[key].poll() is None:
                 continue  # live worker adopts the new version in-process
             env = slot_env(
-                a, controller_addr, controller_port,
+                a, controller_addr, 0,
                 launcher_host if a.hostname != "localhost" else "127.0.0.1",
                 self.rendezvous.port, self.extra_env,
                 platform=getattr(self.args, "platform", "cpu"))
             env["HOROVOD_SLOT_KEY"] = key
             env["HOROVOD_RENDEZVOUS_VERSION"] = str(self.version)
             env["HOROVOD_ELASTIC"] = "1"
-            slot_idx = int(key.rsplit(":", 1)[1])
+            # Fresh process: any heartbeat recorded for this slot key
+            # belongs to a previous incarnation and would instantly
+            # trip the liveness deadline during the new worker's
+            # (potentially slow) startup.
+            self._hb_seen.pop(key, None)
             self.procs[key] = SlotProcess(
                 a.rank, self.command, env, hostname=a.hostname,
                 ssh_port=getattr(self.args, "ssh_port", None),
@@ -186,6 +313,125 @@ class ElasticDriver:
             "before re-rendezvous\n" % (self._failure_streak, delay))
         time.sleep(delay)
 
+    # --- liveness / failure bookkeeping -------------------------------------
+
+    def _record_slot_failure(self, key: str):
+        self.fail_counts[key] = self.fail_counts.get(key, 0) + 1
+        self._last_slot_failure[key] = time.time()
+        if self.fail_counts[key] >= self.MAX_SLOT_FAILURES:
+            self.host_manager.blacklist_slot(key)
+
+    def _drain_forgiveness(self):
+        """Clear the fail history of slots HostManager just forgave
+        (host left and re-entered discovery) and journal it: a
+        forgiven slot with a stale count >= threshold would otherwise
+        be re-blacklisted by its first new failure — or by a journal
+        replay with no new failure at all."""
+        forgiven = self.host_manager.pop_forgiven()
+        if not forgiven:
+            return
+        for key in forgiven:
+            self.fail_counts.pop(key, None)
+            self._last_slot_failure.pop(key, None)
+        self._journal_append({"type": "forgive",
+                              "slots": sorted(forgiven),
+                              "ts": time.time()})
+
+    def _decay_fail_counts(self, now: Optional[float] = None):
+        """Forget a slot's failure history after a stable stretch
+        (HOROVOD_ELASTIC_STABLE_SEC with no new failure): ancient
+        failures must not combine with one fresh failure into a
+        blacklist. Already-blacklisted slots stay blacklisted — they
+        clear only when their host leaves and re-enters discovery
+        (HostManager)."""
+        if self.stable_sec <= 0:
+            return
+        now = time.time() if now is None else now
+        decayed = []
+        for key, last in list(self._last_slot_failure.items()):
+            if now - last <= self.stable_sec:
+                continue
+            del self._last_slot_failure[key]
+            if key in self.host_manager.blacklist:
+                continue
+            if self.fail_counts.pop(key, 0):
+                decayed.append(key)
+                sys.stderr.write(
+                    "elastic: slot %s stable for %.0fs; forgetting its "
+                    "failure history\n" % (key, self.stable_sec))
+        if decayed:
+            # Journaled so a replay forgets the same history the live
+            # driver forgot — otherwise a restart resurrects counts the
+            # decay already cleared.
+            self._journal_append({"type": "decay",
+                                  "slots": sorted(decayed),
+                                  "ts": now})
+
+    def _heartbeat_pid(self, key: str) -> Optional[int]:
+        """The worker pid a slot last reported in its heartbeat payload
+        (None when it never beat or the payload is garbled)."""
+        raw = self.rendezvous.get("heartbeat", key)
+        if raw is None:
+            return None
+        try:
+            pid = int(json.loads(raw.decode()).get("pid", 0))
+        except (ValueError, TypeError, AttributeError, UnicodeDecodeError):
+            # The KV is an open HTTP PUT endpoint: the payload may be
+            # valid JSON without being an object with a numeric pid —
+            # never let that take down the driver main loop.
+            return None
+        return pid if pid > 0 else None
+
+    def _wedged_slots(self, now: Optional[float] = None
+                      ) -> List[Tuple[str, float]]:
+        """Slots whose process is alive by ``poll()`` but whose
+        heartbeats stopped for longer than the liveness deadline.
+        Engages only after a slot's FIRST heartbeat: a worker that is
+        still importing/compiling has not started beating yet, and
+        process death is already caught by ``poll()``."""
+        if self.liveness_sec <= 0:
+            return []
+        now = time.time() if now is None else now
+        wedged = []
+        for key, proc in self.procs.items():
+            last = self._hb_seen.get(key)
+            if (last is not None and now - last > self.liveness_sec
+                    and proc.poll() is None):
+                wedged.append((key, now - last))
+        return wedged
+
+    def _replace_wedged(self) -> bool:
+        """SIGTERM -> SIGKILL any wedged slot; True when one was
+        replaced (a reset is needed)."""
+        replaced = False
+        for key, silent in self._wedged_slots():
+            _M_WEDGED.inc()
+            sys.stderr.write(
+                "elastic: worker %s wedged — no heartbeat for %.1fs "
+                "(HOROVOD_WORKER_LIVENESS_SEC=%.1f); replacing "
+                "(SIGTERM->SIGKILL)\n"
+                % (key, silent, self.liveness_sec))
+            proc = self.procs.pop(key)
+            if getattr(proc, "is_remote", False):
+                # terminate() below only kills the local ssh client's
+                # process group; the wedged process itself lives on the
+                # remote host, still holding its TPU. Kill it there by
+                # the pid its own heartbeats reported.
+                pid = self._heartbeat_pid(key)
+                if not proc.kill_remote(pid):
+                    sys.stderr.write(
+                        "elastic: could not confirm remote kill of "
+                        "wedged worker %s (pid %s) — its host may need "
+                        "manual cleanup before the slot is reusable\n"
+                        % (key, pid))
+            proc.terminate(grace_sec=self.WEDGE_KILL_GRACE_SEC)
+            self._hb_seen.pop(key, None)
+            self._record_slot_failure(key)
+            self._journal_append(
+                {"type": "wedged", "slot": key, "ts": time.time()})
+            replaced = True
+        return replaced
+
     # --- main loop ----------------------------------------------------------
 
     def run(self) -> int:
@@ -202,7 +448,10 @@ class ElasticDriver:
                     return 1
                 time.sleep(1.0)
 
-            if not self._reset():
+            first = self._reset()
+            if first is None:
+                return 0
+            if not first:
                 return 1
             resets = 0
             while True:
@@ -215,19 +464,24 @@ class ElasticDriver:
                         continue
                     proc.wait()
                     del self.procs[key]
+                    self._hb_seen.pop(key, None)
+                    self._journal_append({"type": "exit", "slot": key,
+                                          "rc": rc, "ts": time.time()})
                     if rc == 0:
                         self.done[key] = True
                     else:
-                        self.fail_counts[key] = \
-                            self.fail_counts.get(key, 0) + 1
+                        self._record_slot_failure(key)
                         sys.stderr.write(
                             "elastic: worker %s exited with code %d "
                             "(failure %d)\n"
                             % (key, rc, self.fail_counts[key]))
-                        if self.fail_counts[key] >= self.MAX_SLOT_FAILURES:
-                            self.host_manager.blacklist_slot(key)
                         needs_reset = True
                         worker_failed = True
+
+                if self._replace_wedged():
+                    needs_reset = True
+                    worker_failed = True
+                self._decay_fail_counts()
 
                 if not self.procs and self.done and not needs_reset:
                     return 0
@@ -244,14 +498,17 @@ class ElasticDriver:
                         for p in self.procs.values():
                             p.terminate()
                         return 1
-                    if not self._reset():
+                    again = self._reset()
+                    if again is not True:
                         for p in self.procs.values():
                             p.terminate()
-                        return 1
+                        return 0 if again is None else 1
         finally:
             for p in self.procs.values():
                 p.terminate()
             self.rendezvous.stop()
+            if self.journal is not None:
+                self.journal.close()
 
 
 def run_elastic(args) -> int:

@@ -112,6 +112,7 @@ class SlotProcess:
                  prefix_timestamp: bool = False):
         self.rank = rank
         self.hostname = hostname
+        self._ssh_prefix: Optional[List[str]] = None
         if is_local(hostname):
             full_cmd = command
             proc_env = dict(os.environ)
@@ -130,6 +131,7 @@ class SlotProcess:
                 shlex.quote(os.getcwd()), env_str,
                 " ".join(shlex.quote(c) for c in command))
             full_cmd = ssh_args + [hostname, remote]
+            self._ssh_prefix = list(ssh_args) + [hostname]
             proc_env = dict(os.environ)
         self.proc = subprocess.Popen(
             full_cmd, env=proc_env, stdout=subprocess.PIPE,
@@ -179,3 +181,32 @@ class SlotProcess:
 
         terminate_executor_shell_and_children(self.proc.pid,
                                               grace_s=grace_sec)
+
+    @property
+    def is_remote(self) -> bool:
+        return self._ssh_prefix is not None
+
+    def kill_remote(self, pid: Optional[int],
+                    timeout_sec: float = 15.0) -> bool:
+        """Best-effort SIGKILL of the remote worker process group by
+        pid. ``terminate()`` only reaches the LOCAL ssh client's
+        process group — a SIGSTOPped remote worker survives it and
+        keeps its TPU chip claimed (the round-1 postmortem wedge). The
+        pid comes from the worker's own heartbeat payload. SIGKILL is
+        the right signal: it is delivered even to a stopped process,
+        where SIGTERM would stay pending until a SIGCONT that never
+        comes. False when local, pid-less, or unconfirmed."""
+        if self._ssh_prefix is None or not pid:
+            return False
+        # Group kill first (the remote shell runs the worker in its own
+        # session), then the pid itself in case it never became a group
+        # leader on that host.
+        cmd = self._ssh_prefix + [
+            "kill -KILL -- -%d 2>/dev/null || kill -KILL %d" % (pid, pid)]
+        try:
+            rc = subprocess.run(
+                cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=timeout_sec).returncode
+        except (OSError, subprocess.SubprocessError):
+            return False
+        return rc == 0

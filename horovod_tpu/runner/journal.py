@@ -1,0 +1,189 @@
+"""Crash-safe driver journal for the elastic control plane.
+
+The elastic driver's rendezvous state (version counter, keyed slot
+assignments, blacklist, fail counts, done slots) was purely in-memory,
+making the driver a single point of failure: a driver crash killed the
+whole job even though every worker slot was healthy (ISSUE 5; the
+reference's ``RendezvousServer`` has the same gap — its KV store dies
+with the launcher process).
+
+``DriverJournal`` appends one JSON record per membership transition to
+an fsync'd JSONL file. A restarted driver replays the journal, adopts
+the last published rendezvous version, and resumes at version N+1 —
+strictly above anything the dead driver ever published, so workers that
+fence on a monotonically increasing ``HOROVOD_RENDEZVOUS_VERSION``
+(``elastic/worker._poll_meta``) can never be split-brained by a stale
+driver's leftovers.
+
+Record types (one JSON object per line):
+
+- ``rendezvous``: full snapshot at each published version — version,
+  keyed assignments (slot key -> wire response string), blacklist,
+  fail counts, done slots, controller address.
+- ``exit``: a worker left (rc 0 = done slot, nonzero = failure).
+- ``wedged``: the liveness monitor replaced a silent worker.
+- ``forgive``: slots un-blacklisted because their host left and
+  re-entered discovery; their fail history is wiped so replay does
+  not resurrect the blacklist from stale counts.
+- ``decay``: slots whose fail counts the stable-period decay forgot
+  (HOROVOD_ELASTIC_STABLE_SEC with no new failure); replay forgets
+  them too instead of resurrecting them.
+
+Replay is snapshot + event fold: the last ``rendezvous`` record seeds
+the state and later ``exit``/``wedged`` events update it, so the
+recovered driver sees exactly the bookkeeping the dead one had. A torn
+final line (the crash landed mid-append) is tolerated and dropped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+# Default blacklist threshold for standalone replay() calls; the
+# driver passes its own ElasticDriver.MAX_SLOT_FAILURES so the two
+# can never drift.
+MAX_SLOT_FAILURES = 3
+
+JOURNAL_FILENAME = "driver_journal.jsonl"
+
+
+@dataclass
+class ReplayState:
+    """Driver bookkeeping reconstructed from a journal."""
+
+    version: int = 0
+    done: Set[str] = field(default_factory=set)
+    fail_counts: Dict[str, int] = field(default_factory=dict)
+    blacklist: Set[str] = field(default_factory=set)
+    records: int = 0
+
+
+def journal_path(journal_dir: str) -> str:
+    return os.path.join(journal_dir, JOURNAL_FILENAME)
+
+
+class DriverJournal:
+    """Append-only fsync'd JSONL journal.
+
+    Every ``append`` is flushed AND fsync'd before returning: the
+    driver publishes a rendezvous version to workers only after the
+    journal holds it, so a post-crash replay can never resume at a
+    version some worker already saw exceeded.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._truncate_torn_tail(path)
+        self._fh = open(path, "a", encoding="utf-8")
+        # Persist the directory entry too: append() fsyncs only the
+        # file's data, but a freshly created file whose directory
+        # entry never reached disk vanishes entirely in a host crash —
+        # and a missing journal makes the restarted driver resume at
+        # version 1, below versions live workers already fenced past.
+        try:
+            dfd = os.open(parent, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # platform without directory fsync: best effort
+
+    @staticmethod
+    def _truncate_torn_tail(path: str) -> None:
+        """Drop a partial trailing line left by a crash mid-append.
+        Opening in append mode would otherwise concatenate the next
+        record onto the torn fragment, producing one unparsable merged
+        line MID-file — and since replay stops at the first bad line,
+        every record this incarnation writes would be silently lost to
+        the next replay."""
+        try:
+            with open(path, "rb+") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() == 0:
+                    return
+                fh.seek(-1, os.SEEK_END)
+                if fh.read(1) == b"\n":
+                    return
+                fh.seek(0)
+                keep = fh.read().rfind(b"\n") + 1
+                fh.truncate(keep)
+        except FileNotFoundError:
+            return
+
+    def append(self, record: dict) -> None:
+        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def replay(path: str,
+               max_failures: int = MAX_SLOT_FAILURES
+               ) -> Optional[ReplayState]:
+        """Reconstruct driver state from ``path``; None when the file
+        does not exist. A torn trailing line (crash mid-append) ends
+        the replay at the last complete record. ``max_failures`` is
+        the caller's blacklist threshold (the driver passes its
+        authoritative constant)."""
+        if not os.path.exists(path):
+            return None
+        state = ReplayState()
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail: the crash landed mid-append
+                state.records += 1
+                rtype = rec.get("type")
+                if rtype == "rendezvous":
+                    state.version = max(state.version,
+                                        int(rec.get("version", 0)))
+                    state.done = set(rec.get("done", []))
+                    state.fail_counts = {
+                        str(k): int(v)
+                        for k, v in rec.get("fail_counts", {}).items()}
+                    state.blacklist = set(rec.get("blacklist", []))
+                elif rtype == "exit":
+                    slot = rec.get("slot")
+                    if slot is None:
+                        continue
+                    if rec.get("rc", 1) == 0:
+                        state.done.add(slot)
+                    else:
+                        state.fail_counts[slot] = \
+                            state.fail_counts.get(slot, 0) + 1
+                elif rtype == "wedged":
+                    slot = rec.get("slot")
+                    if slot is not None:
+                        state.fail_counts[slot] = \
+                            state.fail_counts.get(slot, 0) + 1
+                elif rtype == "forgive":
+                    for slot in rec.get("slots", []):
+                        state.fail_counts.pop(slot, None)
+                        state.blacklist.discard(slot)
+                elif rtype == "decay":
+                    # Stable-period decay: counts are forgotten but the
+                    # blacklist is untouched (live decay never clears a
+                    # blacklisted slot's counts, so these slots are
+                    # never blacklisted ones).
+                    for slot in rec.get("slots", []):
+                        state.fail_counts.pop(slot, None)
+        for slot, count in state.fail_counts.items():
+            if count >= max_failures:
+                state.blacklist.add(slot)
+        return state

@@ -86,6 +86,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="Timeout (s) for elastic re-initialisation after "
                         "re-scaling; default 600 or "
                         "HOROVOD_ELASTIC_TIMEOUT.")
+    p.add_argument("--journal-dir", dest="journal_dir", default=None,
+                   help="Elastic: directory for the driver's fsync'd "
+                        "membership journal (or "
+                        "HOROVOD_ELASTIC_JOURNAL_DIR). A restarted "
+                        "driver replays it and resumes at the next "
+                        "rendezvous version instead of losing the job.")
     # Core tuning knobs → env (reference: config_parser.py
     # set_env_from_args; flag names match launch.py:304-475).
     p.add_argument("--fusion-threshold-mb", type=float, default=None)

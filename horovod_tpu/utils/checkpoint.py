@@ -67,11 +67,23 @@ class Checkpointer:
         proceeds (the reference's commit discipline,
         common/elastic.py:60-77)."""
         saved = False
+        err: Optional[BaseException] = None
         if basics.rank() == 0:
-            saved = self._manager.save(step, args=self._args(state),
-                                       force=force)
-            self._manager.wait_until_finished()
+            try:
+                saved = self._manager.save(step, args=self._args(state),
+                                           force=force)
+                self._manager.wait_until_finished()
+            except Exception as e:  # analysis: allow-broad-except —
+                # re-raised below; held only so the completion barrier
+                # still runs.
+                err = e
+        # Ranks 1..n-1 are already blocked in this barrier: rank 0 must
+        # reach it even when its write failed, or the world's collective
+        # sequence desynchronizes and the job wedges until the comm
+        # deadline fires.
         self._barrier()
+        if err is not None:
+            raise err
         return saved
 
     def restore(self, step: Optional[int] = None,
@@ -109,6 +121,15 @@ class Checkpointer:
 
     @staticmethod
     def _args(state):
+        import jax
+        import numpy as np
         import orbax.checkpoint as ocp
 
+        # Orbax's standard handler rejects bare numpy scalars
+        # (np.int64(3)) while accepting 0-d arrays; coerce so pytrees
+        # built from numpy arithmetic (elastic TpuState snapshots,
+        # epoch counters) round-trip instead of failing the save.
+        state = jax.tree.map(
+            lambda l: np.asarray(l) if isinstance(l, np.generic) else l,
+            state)
         return ocp.args.StandardSave(state)

@@ -5,10 +5,20 @@ Mirrors the reference's elastic test mains
 number of global steps with per-step commit, logs
 ``{rank, size, step}`` JSON lines, optionally self-terminates once at a
 scheduled step to exercise failure recovery.
+
+ISSUE-5 extensions:
+
+- ``ELASTIC_CKPT_DIR``: attach a ``utils/checkpoint.Checkpointer`` to
+  the state (``checkpoint_interval`` from ``ELASTIC_CKPT_INTERVAL``,
+  default 1) so commits persist and a cold restart auto-resumes.
+- ``ELASTIC_HANG_RANK`` / ``ELASTIC_HANG_STEP``: the given rank
+  SIGSTOPs itself once at the given step — the open-but-silent wedge
+  the driver's heartbeat liveness monitor must detect and replace.
 """
 
 import json
 import os
+import signal
 import sys
 
 import jax
@@ -26,6 +36,11 @@ FAIL_RANK = os.environ.get("ELASTIC_FAIL_RANK")
 FAIL_STEP = int(os.environ.get("ELASTIC_FAIL_STEP", "-1"))
 FAIL_MODE = os.environ.get("ELASTIC_FAIL_MODE", "once")
 FAIL_MARKER = os.path.join(LOG_DIR, "fail_marker")
+HANG_RANK = os.environ.get("ELASTIC_HANG_RANK")
+HANG_STEP = int(os.environ.get("ELASTIC_HANG_STEP", "-1"))
+HANG_MARKER = os.path.join(LOG_DIR, "hang_marker")
+CKPT_DIR = os.environ.get("ELASTIC_CKPT_DIR")
+CKPT_INTERVAL = int(os.environ.get("ELASTIC_CKPT_INTERVAL", "1"))
 # Step-anchored discovery trigger (the reference anchors its discovery
 # schedules on observed progress, not wall clock — elastic_common.py's
 # schedule technique): when rank 0 commits TRIGGER_STEP, it touches
@@ -40,21 +55,29 @@ def log(step):
                         os.environ["HOROVOD_SLOT_KEY"].replace(":", "_"))
     with open(path, "a") as f:
         f.write(json.dumps({"rank": hvd.rank(), "size": hvd.size(),
-                            "step": step}) + "\n")
+                            "step": int(step)}) + "\n")
 
 
 def main():
     import time
 
     hvd.init()
+    state_kwargs = {}
+    if CKPT_DIR:
+        from horovod_tpu.utils.checkpoint import Checkpointer
+
+        state_kwargs["checkpointer"] = Checkpointer(CKPT_DIR,
+                                                    max_to_keep=3)
+        state_kwargs["checkpoint_interval"] = CKPT_INTERVAL
     state = elastic.TpuState(
-        weights=np.zeros(4, np.float32), step=0)
+        weights=np.zeros(4, np.float32), step=0, **state_kwargs)
 
     @elastic.run
     def train(state):
-        while state.step < TOTAL_STEPS:
+        while int(state.step) < TOTAL_STEPS:
+            step = int(state.step)
             if (FAIL_RANK is not None and hvd.rank() == int(FAIL_RANK)
-                    and state.step == FAIL_STEP
+                    and step == FAIL_STEP
                     and (FAIL_MODE == "always"
                          or not os.path.exists(FAIL_MARKER))):
                 # 'once' (default): the marker suppresses repeats, so
@@ -63,12 +86,20 @@ def main():
                 # blacklist / reset-limit handling.
                 open(FAIL_MARKER, "w").close()
                 os._exit(17)
+            if (HANG_RANK is not None and hvd.rank() == int(HANG_RANK)
+                    and step == HANG_STEP
+                    and not os.path.exists(HANG_MARKER)):
+                # The SIGSTOP wedge: sockets stay open, proc.poll()
+                # stays None — only heartbeat silence can reveal it.
+                # Marker first so the respawned slot runs clean.
+                open(HANG_MARKER, "w").close()
+                os.kill(os.getpid(), signal.SIGSTOP)
             # One "training step": allreduce a step-dependent value; all
             # ranks must agree on the result.
             out = hvd.allreduce(
-                np.full(4, float(state.step), np.float32),
+                np.full(4, float(step), np.float32),
                 name="elastic.step", op=hvd.Average)
-            np.testing.assert_allclose(out, float(state.step))
+            np.testing.assert_allclose(out, float(step))
             # UNNAMED collective: auto-name sequence numbers must stay
             # aligned between elastic-reset survivors (whose counters
             # advanced in the previous world) and fresh respawns
@@ -76,10 +107,10 @@ def main():
             ones = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum)
             np.testing.assert_allclose(ones, float(hvd.size()))
             state.weights = state.weights + np.asarray(out)
-            state.step += 1
+            state.step = step + 1
             log(state.step)
             if (TRIGGER_FILE and hvd.rank() == 0
-                    and state.step >= TRIGGER_STEP
+                    and int(state.step) >= TRIGGER_STEP
                     and not os.path.exists(TRIGGER_FILE)):
                 open(TRIGGER_FILE, "w").close()
             time.sleep(0.15)

@@ -43,6 +43,32 @@ def test_checkpointer_restore_empty(tmp_path):
     ck.close()
 
 
+def test_failed_save_still_runs_completion_barrier(tmp_path, monkeypatch):
+    """A rank-0 write failure must not skip the completion barrier the
+    other ranks are already blocked in — rank 0 sailing past it would
+    desynchronize the world's collective sequence. The error surfaces
+    only after the barrier."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.utils.checkpoint import Checkpointer
+
+    basics.init()
+    ck = Checkpointer(str(tmp_path / "boom"))
+    real_manager = ck._manager
+
+    class _Boom:
+        def save(self, *a, **k):
+            raise IOError("disk full")
+
+    events = []
+    monkeypatch.setattr(ck, "_manager", _Boom())
+    monkeypatch.setattr(ck, "_barrier",
+                        lambda: events.append("barrier"))
+    with pytest.raises(IOError, match="disk full"):
+        ck.save(5, {"w": np.arange(2.0)})
+    assert events == ["barrier"]
+    real_manager.close()
+
+
 def test_checkpointer_np2(tmp_path):
     """Rank-0 write + barrier + collective restore across 2 processes."""
     import socket

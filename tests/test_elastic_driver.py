@@ -84,7 +84,9 @@ def test_host_manager_refresh_and_blacklist():
 
     assert mgr.refresh() is True  # h2 disappeared
     assert mgr.available_slot_keys() == ["h1:0"]
-    # A vanished-then-returned host does not clear the blacklist.
+    # h2 returning forgives only h2's slots; h1 never left discovery,
+    # so its blacklist entry stands (re-appearance forgiveness is per
+    # host — tests/test_elastic_resilience.py covers the full cycle).
     mgr._discovery = _FakeDiscovery(["h1:2", "h2:1"])
     assert mgr.refresh() is True
     assert "h1:1" not in mgr.available_slot_keys()

@@ -1,0 +1,814 @@
+"""Fast unit tests for the crash-safe elastic control plane (ISSUE 5).
+
+Pure-logic coverage, seconds total: driver journal append/replay,
+restart recovery bookkeeping, worker version fencing, heartbeat
+bookkeeping on both sides, controller-port negotiation, fail-count
+decay, and the checkpoint-integrated auto-resume of elastic states
+(with a stub checkpointer — no orbax, no jax workers). The end-to-end
+driver-kill / SIGSTOP scenarios live in tests/test_chaos_elastic.py
+(tier 2 + slow).
+"""
+
+import argparse
+import json
+import os
+import stat
+import time
+
+import pytest
+
+from horovod_tpu.runner.journal import DriverJournal, journal_path
+
+
+def _driver_args(tmp_path=None, **over):
+    base = dict(discovery_script="./d.sh", min_np=2, max_np=None, np=None,
+                command=["true"], start_timeout=2, reset_limit=None,
+                slots_per_host=1, elastic_timeout=None, journal_dir=None)
+    base.update(over)
+    ns = argparse.Namespace(**base)
+    from horovod_tpu.runner.launch import parse_args
+
+    defaults = parse_args(["-np", "1", "true"])
+    for key, value in vars(defaults).items():
+        if not hasattr(ns, key):
+            setattr(ns, key, value)
+    return ns
+
+
+def _driver(**over):
+    from horovod_tpu.runner.elastic_run import ElasticDriver
+
+    return ElasticDriver(_driver_args(**over))
+
+
+# --- journal ----------------------------------------------------------------
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    path = journal_path(str(tmp_path))
+    j = DriverJournal(path)
+    j.append({"type": "rendezvous", "version": 1,
+              "assignments": {"h1:0": "0,2,0,2,0,1"},
+              "blacklist": [], "fail_counts": {}, "done": []})
+    j.append({"type": "exit", "slot": "h1:1", "rc": 17})
+    j.append({"type": "rendezvous", "version": 2,
+              "assignments": {"h1:0": "0,1,0,1,0,1"},
+              "blacklist": [], "fail_counts": {"h1:1": 1}, "done": []})
+    j.append({"type": "exit", "slot": "h1:0", "rc": 0})
+    j.close()
+
+    state = DriverJournal.replay(path)
+    assert state.version == 2
+    assert state.records == 4
+    assert state.done == {"h1:0"}
+    assert state.fail_counts == {"h1:1": 1}
+    assert state.blacklist == set()
+
+
+def test_journal_replay_missing_and_torn_tail(tmp_path):
+    assert DriverJournal.replay(str(tmp_path / "nope.jsonl")) is None
+
+    path = journal_path(str(tmp_path))
+    j = DriverJournal(path)
+    j.append({"type": "rendezvous", "version": 3, "done": ["h1:0"],
+              "fail_counts": {}, "blacklist": []})
+    j.close()
+    # The crash landed mid-append: a torn trailing line is dropped.
+    with open(path, "a") as f:
+        f.write('{"type": "rendezvous", "version": 9, "do')
+    state = DriverJournal.replay(path)
+    assert state.version == 3
+    assert state.records == 1
+
+
+def test_journal_append_after_torn_tail_truncates(tmp_path):
+    """Re-attaching to a journal with a torn trailing line truncates
+    the fragment first: plain append mode would merge the next record
+    into one unparsable MID-file line, and replay (which stops at the
+    first bad line) would then silently lose every record the new
+    incarnation writes."""
+    path = journal_path(str(tmp_path))
+    j = DriverJournal(path)
+    j.append({"type": "rendezvous", "version": 3, "done": [],
+              "fail_counts": {}, "blacklist": []})
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"type": "rendezvous", "version": 9, "do')  # crash
+
+    j2 = DriverJournal(path)  # restart drops the fragment
+    j2.append({"type": "rendezvous", "version": 4, "done": [],
+               "fail_counts": {}, "blacklist": []})
+    j2.close()
+    state = DriverJournal.replay(path)
+    assert state.version == 4
+    assert state.records == 2
+
+
+def test_journal_replay_recomputes_blacklist(tmp_path):
+    """Fail events past the threshold blacklist the slot on replay,
+    exactly as the live driver would have."""
+    path = journal_path(str(tmp_path))
+    j = DriverJournal(path)
+    for _ in range(2):
+        j.append({"type": "exit", "slot": "h1:1", "rc": 17})
+    j.append({"type": "wedged", "slot": "h1:1"})
+    j.close()
+    state = DriverJournal.replay(path)
+    assert state.fail_counts == {"h1:1": 3}
+    assert state.blacklist == {"h1:1"}
+
+
+def test_journal_forgive_event_clears_replayed_history(tmp_path):
+    """A ``forgive`` record wipes the slot's fail history on replay:
+    a restarted driver must not re-blacklist a slot the dead driver
+    had forgiven (host left and re-entered discovery)."""
+    path = journal_path(str(tmp_path))
+    j = DriverJournal(path)
+    for _ in range(3):
+        j.append({"type": "exit", "slot": "h1:0", "rc": 1})
+    j.append({"type": "forgive", "slots": ["h1:0"]})
+    j.append({"type": "exit", "slot": "h1:0", "rc": 1})
+    j.close()
+    state = DriverJournal.replay(path)
+    assert state.fail_counts == {"h1:0": 1}
+    assert state.blacklist == set()
+
+
+def test_journal_replay_blacklist_threshold_parameter(tmp_path):
+    """Replay takes the caller's blacklist threshold — the driver
+    passes its authoritative MAX_SLOT_FAILURES, so tuning it cannot
+    drift from the journal's recompute."""
+    path = journal_path(str(tmp_path))
+    j = DriverJournal(path)
+    for _ in range(3):
+        j.append({"type": "exit", "slot": "h1:0", "rc": 1})
+    j.close()
+    assert DriverJournal.replay(path).blacklist == {"h1:0"}
+    assert DriverJournal.replay(path, max_failures=5).blacklist == set()
+
+
+def test_driver_restart_resumes_at_next_version(tmp_path):
+    """A restarted driver replays its journal: version counter, done
+    slots, fail counts and blacklist are all restored, and the next
+    rendezvous publishes strictly above anything the dead driver
+    published."""
+    jdir = str(tmp_path)
+    first = _driver(journal_dir=jdir)
+    first.version = 4
+    first._journal_append({
+        "type": "rendezvous", "version": 4,
+        "assignments": {"h1:0": "0,2,0,2,0,1", "h1:1": "1,2,1,2,0,1"},
+        "blacklist": ["h2:0"], "fail_counts": {"h2:0": 3},
+        "done": ["h3:0"]})
+    first._journal_append({"type": "exit", "slot": "h1:1", "rc": 9})
+    first.journal.close()
+
+    second = _driver(journal_dir=jdir)
+    assert second.version == 4          # next _reset publishes 5
+    assert second.done == {"h3:0": True}
+    assert second.fail_counts == {"h2:0": 3, "h1:1": 1}
+    assert "h2:0" in second.host_manager.blacklist
+    second.journal.close()
+
+
+def test_restarted_driver_with_all_slots_done_reports_success():
+    """A driver restarted from a journal whose workers ALL finished
+    must recognize completion (_reset -> None, run exits 0) instead of
+    stalling out the elastic timeout and reporting failure."""
+    driver = _driver()
+    driver.done = {"h1:0": True, "h1:1": True}
+    driver.host_manager.available_slot_keys = lambda: ["h1:0", "h1:1"]
+    assert driver._reset() is None
+
+    # One slot still pending: the normal wait path engages (and with
+    # nothing new discoverable, the deadline expires to False).
+    pending = _driver(start_timeout=0)
+    pending.done = {"h1:0": True}
+    pending.host_manager.available_slot_keys = lambda: ["h1:0", "h1:1"]
+    pending.host_manager.refresh = lambda: False
+    assert pending._reset() is False
+
+
+def test_driver_env_knob_enables_journal(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_ELASTIC_JOURNAL_DIR", str(tmp_path))
+    driver = _driver()
+    assert driver.journal is not None
+    driver._journal_append({"type": "exit", "slot": "h1:0", "rc": 0})
+    driver.journal.close()
+    assert os.path.exists(journal_path(str(tmp_path)))
+
+
+# --- version fencing (worker side) ------------------------------------------
+
+def _kv_env(monkeypatch, server):
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(server.port))
+
+
+def test_poll_meta_fences_stale_versions(monkeypatch):
+    """A stale driver's published version below the worker's floor is
+    never adopted; the next version at/above the floor is."""
+    from horovod_tpu.elastic.worker import _poll_meta
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    try:
+        _kv_env(monkeypatch, server)
+        server.put("control", "meta", json.dumps(
+            {"version": 3, "controller_addr": "x"}).encode())
+        with pytest.raises(HorovodInternalError):
+            _poll_meta(min_version=5, timeout=1.5)
+        server.put("control", "meta", json.dumps(
+            {"version": 5, "controller_addr": "x"}).encode())
+        assert _poll_meta(min_version=5, timeout=5)["version"] == 5
+    finally:
+        server.stop()
+
+
+def test_poll_meta_honors_elastic_timeout_knob(monkeypatch):
+    """Satellite: the hardcoded 300 s default is gone — the registered
+    HOROVOD_ELASTIC_TIMEOUT knob bounds the wait."""
+    from horovod_tpu.elastic.worker import _poll_meta
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    try:
+        _kv_env(monkeypatch, server)
+        monkeypatch.setenv("HOROVOD_ELASTIC_TIMEOUT", "1")
+        t0 = time.time()
+        with pytest.raises(HorovodInternalError):
+            _poll_meta(min_version=1)
+        assert time.time() - t0 < 10
+    finally:
+        server.stop()
+
+
+# --- controller-port negotiation --------------------------------------------
+
+def test_controller_port_negotiation(monkeypatch):
+    """Rank 0 binds a port on ITS host and reports it through the KV;
+    other ranks poll the version-scoped key (the launcher-host
+    free_port() race fix)."""
+    from horovod_tpu.elastic.worker import negotiate_controller_port
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    try:
+        _kv_env(monkeypatch, server)
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_VERSION", "7")
+        monkeypatch.setenv("HOROVOD_CONTROLLER_PORT", "0")
+        chosen = negotiate_controller_port(rank=0)
+        assert chosen > 0
+        assert os.environ["HOROVOD_CONTROLLER_PORT"] == str(chosen)
+        assert server.get("control", "controller_port.7") == \
+            str(chosen).encode()
+
+        monkeypatch.setenv("HOROVOD_CONTROLLER_PORT", "0")
+        assert negotiate_controller_port(rank=1, timeout=5) == chosen
+        assert os.environ["HOROVOD_CONTROLLER_PORT"] == str(chosen)
+    finally:
+        server.stop()
+
+
+def test_controller_port_wait_superseded(monkeypatch):
+    """A non-zero rank waiting on a version whose rank 0 died bails
+    out as soon as a NEWER version is published, instead of burning
+    the whole elastic timeout."""
+    from horovod_tpu.elastic.worker import negotiate_controller_port
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    try:
+        _kv_env(monkeypatch, server)
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_VERSION", "2")
+        server.put("control", "meta", json.dumps({"version": 3}).encode())
+        t0 = time.time()
+        with pytest.raises(HorovodInternalError, match="superseded"):
+            negotiate_controller_port(rank=1, timeout=30)
+        assert time.time() - t0 < 10
+    finally:
+        server.stop()
+
+
+# --- heartbeat bookkeeping --------------------------------------------------
+
+def test_worker_heartbeat_put_and_payload(monkeypatch):
+    from horovod_tpu.elastic import worker as ew
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    try:
+        _kv_env(monkeypatch, server)
+        monkeypatch.setenv("HOROVOD_SLOT_KEY", "localhost:1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_VERSION", "3")
+        assert ew.send_heartbeat() is True
+        raw = server.get("heartbeat", "localhost:1")
+        payload = json.loads(raw.decode())
+        assert payload["version"] == 3
+        assert payload["pid"] == os.getpid()
+        assert payload["ts"] <= time.time()
+        assert payload["commits"] >= 0
+    finally:
+        server.stop()
+
+
+def test_worker_heartbeat_best_effort_without_env(monkeypatch):
+    from horovod_tpu.elastic import worker as ew
+
+    monkeypatch.delenv("HOROVOD_RENDEZVOUS_ADDR", raising=False)
+    monkeypatch.delenv("HOROVOD_SLOT_KEY", raising=False)
+    assert ew.send_heartbeat() is False
+    assert ew.start_heartbeats() is None
+
+
+def test_heartbeat_thread_survives_exceptions(monkeypatch):
+    """A non-OSError from one heartbeat attempt (e.g. a garbled KV
+    response raising HTTPException) must not kill the daemon thread —
+    a dead heartbeat thread gets a healthy worker replaced as wedged."""
+    import http.client
+
+    from horovod_tpu.elastic import worker as ew
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    try:
+        _kv_env(monkeypatch, server)
+        monkeypatch.setenv("HOROVOD_SLOT_KEY", "localhost:9")
+        monkeypatch.setenv("HVD_HEARTBEAT_SEC", "0.05")
+        calls = {"n": 0}
+        real = ew.send_heartbeat
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise http.client.HTTPException("garbled KV response")
+            return real()
+
+        monkeypatch.setattr(ew, "send_heartbeat", flaky)
+        thread = ew.start_heartbeats()
+        assert thread is not None
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and server.get("heartbeat", "localhost:9") is None):
+            time.sleep(0.05)
+        assert thread.is_alive()
+        assert calls["n"] >= 3
+        assert server.get("heartbeat", "localhost:9") is not None
+    finally:
+        server.stop()
+
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+
+def test_driver_wedge_detection_after_first_heartbeat():
+    """A slot is wedged only when (a) its process is alive, (b) it has
+    heartbeated at least once, and (c) it has been silent past the
+    liveness deadline. A worker still importing/compiling (no beat
+    yet) is never declared wedged."""
+    driver = _driver()
+    driver.liveness_sec = 5.0
+    now = time.time()
+    driver.procs = {"h1:0": _FakeProc(), "h1:1": _FakeProc(),
+                    "h1:2": _FakeProc(), "h1:3": _FakeProc(rc=1)}
+    driver._hb_seen = {"h1:0": now - 1.0,    # fresh beat: healthy
+                       "h1:1": now - 20.0,   # silent: wedged
+                       "h1:3": now - 20.0}   # dead by poll(): not wedged
+    # h1:2 never beat: startup grace, not wedged.
+    wedged = driver._wedged_slots(now=now)
+    assert [k for k, _ in wedged] == ["h1:1"]
+    assert wedged[0][1] == pytest.approx(20.0, abs=0.1)
+
+    driver.liveness_sec = 0.0  # disabled: never wedged
+    assert driver._wedged_slots(now=now) == []
+
+
+def test_driver_heartbeat_arrival_uses_driver_clock(monkeypatch):
+    """Heartbeats arriving over HTTP are stamped with the DRIVER's
+    clock via the KV put callback — worker clock skew is irrelevant."""
+    from horovod_tpu.runner.http_server import write_kv
+
+    driver = _driver()
+    driver.rendezvous.start()
+    try:
+        before = time.time()
+        write_kv("127.0.0.1", driver.rendezvous.port, "heartbeat",
+                 "h1:0", json.dumps({"ts": 12345.0}).encode())
+        assert before <= driver._hb_seen["h1:0"] <= time.time()
+    finally:
+        driver.rendezvous.stop()
+
+
+# --- fail-count decay / un-blacklist ----------------------------------------
+
+def test_fail_counts_decay_after_stable_period():
+    driver = _driver()
+    driver.stable_sec = 60.0
+    driver._record_slot_failure("h1:0")
+    driver._record_slot_failure("h1:1")
+    assert driver.fail_counts == {"h1:0": 1, "h1:1": 1}
+
+    # Not stable yet: nothing decays.
+    driver._decay_fail_counts(now=time.time() + 30)
+    assert driver.fail_counts == {"h1:0": 1, "h1:1": 1}
+
+    # Stable stretch: both histories are forgotten.
+    driver._decay_fail_counts(now=time.time() + 61)
+    assert driver.fail_counts == {}
+    assert driver._last_slot_failure == {}
+
+    # Disabled decay keeps history forever.
+    driver._record_slot_failure("h1:0")
+    driver.stable_sec = 0.0
+    driver._decay_fail_counts(now=time.time() + 10_000)
+    assert driver.fail_counts == {"h1:0": 1}
+
+
+def test_blacklisted_slot_survives_decay():
+    """Decay forgets counts, never the blacklist — only host
+    re-appearance in discovery forgives a blacklisted slot."""
+    driver = _driver()
+    driver.stable_sec = 60.0
+    for _ in range(3):
+        driver._record_slot_failure("h1:0")
+    assert "h1:0" in driver.host_manager.blacklist
+    driver._decay_fail_counts(now=time.time() + 120)
+    assert "h1:0" in driver.host_manager.blacklist
+    assert driver.fail_counts.get("h1:0") == 3
+
+
+def test_decay_is_journaled_and_replayed(tmp_path):
+    """Live decay writes a ``decay`` record: a driver restart must not
+    resurrect failure history the dead driver had already forgotten."""
+    driver = _driver(journal_dir=str(tmp_path))
+    driver.stable_sec = 60.0
+    driver._journal_append({"type": "exit", "slot": "h1:0", "rc": 1})
+    driver._record_slot_failure("h1:0")
+    driver._decay_fail_counts(now=time.time() + 61)
+    assert driver.fail_counts == {}
+    driver.journal.close()
+    replayed = DriverJournal.replay(journal_path(str(tmp_path)))
+    assert replayed.fail_counts == {}
+
+
+def test_replayed_fail_counts_are_decayable(tmp_path):
+    """The journal carries no failure timestamps; replay seeds the
+    decay clock at restart time so recovered counts still decay after
+    a stable stretch instead of living forever."""
+    jdir = str(tmp_path)
+    first = _driver(journal_dir=jdir)
+    first._journal_append({
+        "type": "rendezvous", "version": 1, "assignments": {},
+        "blacklist": [], "fail_counts": {"h1:0": 2}, "done": []})
+    first.journal.close()
+
+    second = _driver(journal_dir=jdir)
+    assert second.fail_counts == {"h1:0": 2}
+    assert "h1:0" in second._last_slot_failure
+    second.stable_sec = 60.0
+    second._decay_fail_counts(now=time.time() + 61)
+    assert second.fail_counts == {}
+    second.journal.close()
+
+
+def test_forgiveness_clears_driver_fail_history(tmp_path):
+    """When a slot is forgiven its fail count goes too: a stale count
+    of 3 would otherwise re-blacklist the replacement node on its
+    FIRST failure, and a journal replay would re-blacklist it with no
+    new failure at all."""
+    driver = _driver(journal_dir=str(tmp_path))
+    for _ in range(3):
+        driver._record_slot_failure("h1:0")
+    assert "h1:0" in driver.host_manager.blacklist
+    # What HostManager does when host h1 leaves and re-enters.
+    driver.host_manager.blacklist.discard("h1:0")
+    driver.host_manager._forgiven.add("h1:0")
+    driver._drain_forgiveness()
+    assert "h1:0" not in driver.fail_counts
+    assert "h1:0" not in driver._last_slot_failure
+    # The replacement's first failure starts a fresh history.
+    driver._record_slot_failure("h1:0")
+    assert driver.fail_counts["h1:0"] == 1
+    assert "h1:0" not in driver.host_manager.blacklist
+    driver.journal.close()
+    replayed = DriverJournal.replay(journal_path(str(tmp_path)))
+    assert "h1:0" not in replayed.blacklist
+
+
+def test_host_reappearance_clears_its_blacklist():
+    from horovod_tpu.runner.discovery import HostManager
+
+    class _Rounds:
+        def __init__(self, *rounds):
+            self.rounds = list(rounds)
+
+        def find_available_hosts(self):
+            from horovod_tpu.runner.hosts import HostInfo
+
+            current = self.rounds[0]
+            if len(self.rounds) > 1:
+                self.rounds.pop(0)
+            return [HostInfo.from_string(h) for h in current]
+
+    mgr = HostManager(_Rounds(["h1:2", "h2:1"], ["h2:1"],
+                              ["h1:2", "h2:1"]))
+    mgr.refresh()
+    mgr.blacklist_slot("h1:1")
+    mgr.blacklist_slot("h2:0")
+    assert mgr.refresh() is True        # h1 vanished
+    assert mgr.refresh() is True        # h1 came back: forgiven
+    assert "h1:1" not in mgr.blacklist
+    assert "h2:0" in mgr.blacklist      # h2 never left: still banned
+
+
+def test_initial_population_keeps_replayed_blacklist():
+    """The first discovery refresh after a driver restart must not
+    count as a 're-appearance' and wipe the journal-restored
+    blacklist."""
+    from horovod_tpu.runner.discovery import HostManager
+
+    class _Static:
+        def find_available_hosts(self):
+            from horovod_tpu.runner.hosts import HostInfo
+
+            return [HostInfo("h1", 2)]
+
+    mgr = HostManager(_Static())
+    mgr.blacklist_slot("h1:1")          # restored from the journal
+    assert mgr.refresh() is True
+    assert "h1:1" in mgr.blacklist
+
+
+# --- checkpoint-integrated elastic state ------------------------------------
+
+class _StubCheckpointer:
+    """Duck-types utils/checkpoint.Checkpointer without orbax."""
+
+    def __init__(self):
+        self.saved = {}
+        self.fail_steps = set()
+
+    def save(self, step, payload, force=False):
+        import copy
+
+        self.saved[int(step)] = copy.deepcopy(payload)
+        return True
+
+    def restore(self, step=None, template=None):
+        if step is None:
+            step = self.latest_step()
+        if step in self.fail_steps:
+            raise IOError("simulated torn checkpoint at step %d" % step)
+        return self.saved[int(step)]
+
+    def latest_step(self):
+        return max(self.saved) if self.saved else None
+
+    def all_steps(self):
+        return sorted(self.saved)
+
+
+def _fresh_state(ck, interval=1, **kwargs):
+    from horovod_tpu.elastic.state import ObjectState
+
+    return ObjectState(checkpointer=ck, checkpoint_interval=interval,
+                       **kwargs)
+
+
+def test_commit_persists_every_nth_commit():
+    ck = _StubCheckpointer()
+    state = _fresh_state(ck, interval=3, step=0, loss=0.0)
+    for i in range(1, 10):
+        state.step = i
+        state.commit()
+    # Commits 3, 6, 9 persisted (step attribute names the orbax step).
+    assert sorted(ck.saved) == [3, 6, 9]
+    assert ck.saved[9]["state"]["step"] == 9
+
+
+def test_auto_resume_restores_latest_committed_step():
+    ck = _StubCheckpointer()
+    old = _fresh_state(ck, step=0, w=1.5)
+    old.step, old.w = 7, 99.5
+    old.commit()
+
+    fresh = _fresh_state(ck, step=0, w=0.0)
+    assert fresh._maybe_auto_resume() == 7
+    assert fresh.step == 7 and fresh.w == 99.5
+    # The latch: one attempt per process/state, survivors' in-memory
+    # progress is never rolled back by a later call.
+    fresh.step = 11
+    fresh.save()
+    assert fresh._maybe_auto_resume() is None
+    assert fresh.step == 11
+
+
+def test_auto_resume_falls_back_one_step():
+    """A torn newest checkpoint (crash mid-save) falls back to the
+    previous committed step instead of stranding the job."""
+    ck = _StubCheckpointer()
+    old = _fresh_state(ck, step=0)
+    for s in (5, 6):
+        old.step = s
+        old.commit()
+    ck.fail_steps.add(6)
+
+    fresh = _fresh_state(ck, step=0)
+    assert fresh._maybe_auto_resume() == 5
+    assert fresh.step == 5
+
+
+def test_auto_resume_without_checkpoints_is_noop():
+    ck = _StubCheckpointer()
+    fresh = _fresh_state(ck, step=3)
+    assert fresh._maybe_auto_resume() is None
+    assert fresh.step == 3
+
+    from horovod_tpu.elastic.state import ObjectState
+
+    plain = ObjectState(step=4)
+    assert plain._maybe_auto_resume() is None
+    plain.commit()  # no checkpointer: commit stays in-memory only
+
+
+def test_apply_checkpoint_ignores_unknown_keys():
+    ck = _StubCheckpointer()
+    ck.saved[3] = {"state": {"step": 3, "evil_new_attr": 1}}
+    fresh = _fresh_state(ck, step=0)
+    assert fresh._maybe_auto_resume() == 3
+    assert fresh.step == 3
+    assert not hasattr(fresh, "evil_new_attr")
+
+
+def test_checkpoint_cadence_is_step_keyed_across_respawns():
+    """Interval > 1: the cadence keys off the synced ``step``, so a
+    freshly respawned rank (commit counter reset to 0) makes the same
+    save/skip decision as survivors at every commit —
+    ``Checkpointer.save`` runs a world barrier, so divergence wedges
+    the job on mismatched collectives."""
+    ck_survivor, ck_respawn = _StubCheckpointer(), _StubCheckpointer()
+    survivor = _fresh_state(ck_survivor, interval=2, step=0)
+    for s in (1, 2, 3):
+        survivor.step = s
+        survivor.commit()
+    assert sorted(ck_survivor.saved) == [2]
+    # A rank respawned mid-run joins with a zeroed commit counter but
+    # the synced step; at step 4 both must save.
+    respawn = _fresh_state(ck_respawn, interval=2, step=0)
+    respawn.step = 4
+    survivor.step = 4
+    respawn.commit()
+    survivor.commit()
+    assert sorted(ck_survivor.saved) == [2, 4]
+    assert sorted(ck_respawn.saved) == [4]
+
+
+def test_ckpt_saves_metric_counts_only_persisted_snapshots():
+    """Checkpointer.save returns False on ranks that did not write
+    (and when orbax throttled/skipped the step) — those attempts must
+    not inflate hvd_elastic_ckpt_saves_total."""
+    from horovod_tpu.elastic import state as es
+
+    class _NoWrite(_StubCheckpointer):
+        def save(self, step, payload, force=False):
+            return False
+
+    before = es._M_CKPT_SAVES.get()
+    skipping = _fresh_state(_NoWrite(), step=0)
+    skipping.step = 1
+    skipping.commit()
+    assert es._M_CKPT_SAVES.get() == before
+    writing = _fresh_state(_StubCheckpointer(), step=0)
+    writing.step = 1
+    writing.commit()
+    assert es._M_CKPT_SAVES.get() == before + 1
+
+
+def test_failed_save_is_swallowed_and_counted():
+    class _Boom(_StubCheckpointer):
+        def save(self, step, payload, force=False):
+            raise IOError("disk full")
+
+    from horovod_tpu.elastic import state as es
+
+    before = es._M_CKPT_ERRORS.labels().get()
+    state = _fresh_state(_Boom(), step=0)
+    state.step = 1
+    state.commit()  # must not raise
+    assert es._M_CKPT_ERRORS.labels().get() == before + 1
+
+
+def test_auto_resume_falls_back_when_apply_fails():
+    """A checkpoint that reads back fine but fails to APPLY (attribute
+    schema drift between runs) must fall back one step too: an escaped
+    apply exception kills the respawned process, and the per-process
+    latch makes every later respawn retry the same checkpoint — a
+    crash loop with no way out."""
+    from horovod_tpu.elastic.state import ObjectState
+
+    class _Picky(ObjectState):
+        def _apply_checkpoint(self, payload):
+            if "poison" in payload:
+                raise ValueError("schema drift")
+            super()._apply_checkpoint(payload)
+
+    ck = _StubCheckpointer()
+    old = _Picky(checkpointer=ck, step=0)
+    for s in (5, 6):
+        old.step = s
+        old.commit()
+    ck.saved[6]["poison"] = True
+
+    fresh = _Picky(checkpointer=ck, step=0)
+    assert fresh._maybe_auto_resume() == 5
+    assert fresh.step == 5
+
+
+# --- remote wedge kill ------------------------------------------------------
+
+def test_slot_process_remote_kill_command(monkeypatch):
+    """kill_remote reaches through ssh to SIGKILL the reported pid (and
+    its group) on the worker's own host — terminate() only kills the
+    local ssh client, which a SIGSTOPped remote worker survives. Local
+    slots and missing pids are a no-op False."""
+    from horovod_tpu.runner import exec_util
+    from horovod_tpu.runner.exec_util import SlotProcess
+
+    sp = SlotProcess.__new__(SlotProcess)
+    sp._ssh_prefix = ["ssh", "-o", "StrictHostKeyChecking=no", "h7"]
+    seen = {}
+
+    def _fake_run(cmd, **kwargs):
+        seen["cmd"] = cmd
+
+        class _Done:
+            returncode = 0
+
+        return _Done()
+
+    monkeypatch.setattr(exec_util.subprocess, "run", _fake_run)
+    assert sp.is_remote
+    assert sp.kill_remote(4242) is True
+    assert seen["cmd"][:4] == sp._ssh_prefix
+    assert "kill -KILL -- -4242" in seen["cmd"][-1]
+    assert sp.kill_remote(None) is False  # never heartbeated: no pid
+
+    local = SlotProcess.__new__(SlotProcess)
+    local._ssh_prefix = None
+    assert local.is_remote is False
+    assert local.kill_remote(4242) is False
+
+
+def test_replace_wedged_kills_remote_by_heartbeat_pid():
+    """For a wedged REMOTE slot the driver must kill the worker on its
+    own host, using the pid the worker's heartbeats reported — the
+    local terminate() cannot reach it."""
+    driver = _driver()
+    driver.liveness_sec = 5.0
+    calls = {}
+
+    class _RemoteProc(_FakeProc):
+        is_remote = True
+
+        def kill_remote(self, pid, **kw):
+            calls["pid"] = pid
+            return True
+
+        def terminate(self, grace_sec=None):
+            calls["terminated"] = True
+
+    driver.procs = {"h9:0": _RemoteProc()}
+    driver._hb_seen = {"h9:0": time.time() - 60.0}
+    driver.rendezvous.start()
+    try:
+        driver.rendezvous.put("heartbeat", "h9:0",
+                              json.dumps({"pid": 31337}).encode())
+        assert driver._heartbeat_pid("h9:0") == 31337
+        driver.rendezvous.put("heartbeat", "h9:1", b"garbled{")
+        assert driver._heartbeat_pid("h9:1") is None
+        assert driver._heartbeat_pid("h9:2") is None  # never beat
+        # Valid JSON that is not an object with a numeric pid — the KV
+        # is an open PUT endpoint, this must not crash the driver loop.
+        driver.rendezvous.put("heartbeat", "h9:3", b'"ok"')
+        assert driver._heartbeat_pid("h9:3") is None
+        driver.rendezvous.put("heartbeat", "h9:4",
+                              json.dumps({"pid": [1]}).encode())
+        assert driver._heartbeat_pid("h9:4") is None
+        assert driver._replace_wedged() is True
+    finally:
+        driver.rendezvous.stop()
+    assert calls == {"pid": 31337, "terminated": True}
+    assert driver.fail_counts == {"h9:0": 1}

@@ -117,3 +117,61 @@ def test_torch_state_with_sampler_reshards():
     assert len(sampler.processed_indices) == 2
     # shuffle=False, world size 1: iteration is the identity order.
     assert first == [0, 1]
+
+
+class _StubCheckpointer:
+    """Duck-types utils/checkpoint.Checkpointer without orbax."""
+
+    def __init__(self):
+        self.saved = {}
+
+    def save(self, step, payload, force=False):
+        self.saved[int(step)] = payload
+        return True
+
+    def restore(self, step=None, template=None):
+        if step is None:
+            step = self.latest_step()
+        return self.saved[int(step)]
+
+    def latest_step(self):
+        return max(self.saved) if self.saved else None
+
+    def all_steps(self):
+        return sorted(self.saved)
+
+
+def test_checkpointer_persists_and_restores_model_and_optimizer():
+    """checkpointer= on TorchState must persist the model/optimizer
+    state dicts (as a torch.save blob in a uint8 array — orbax cannot
+    hold torch tensors leaf-wise), not just the scalar attributes:
+    otherwise an auto-resume restores ``step`` against freshly
+    initialized weights and training silently loses its progress."""
+    basics.init()
+    ck = _StubCheckpointer()
+    model = _tiny_model()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    state = TorchState(model=model, optimizer=optimizer, step=0,
+                       checkpointer=ck)
+    _train_step(model, optimizer)
+    state.step = 3
+    state.commit()
+    committed = {k: v.clone() for k, v in model.state_dict().items()}
+
+    payload = ck.saved[3]
+    assert payload["state"]["step"] == 3
+    assert payload["torch"].dtype == np.uint8  # orbax-compatible blob
+
+    # A fresh process: same architecture, diverged weights, cold
+    # optimizer. Auto-resume must bring back the committed step AND
+    # the committed parameters/momentum.
+    model2 = _tiny_model()
+    _train_step(model2, torch.optim.SGD(model2.parameters(), lr=0.5))
+    optimizer2 = torch.optim.SGD(model2.parameters(), lr=0.1, momentum=0.9)
+    fresh = TorchState(model=model2, optimizer=optimizer2, step=0,
+                       checkpointer=ck)
+    assert fresh._maybe_auto_resume() == 3
+    assert fresh.step == 3
+    for k, v in model2.state_dict().items():
+        assert torch.equal(v, committed[k])
+    assert optimizer2.state_dict()["state"]  # momentum buffers restored

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Native wire microbenchmark harness (docs/wire.md).
+
+Loopback allreduce busbw sweep over payload sizes through the native
+TCP data plane, measured with jax-free workers
+(tests/wire_bench_worker.py) — the data-plane A/B instrument this box
+needs because ``bench_scaling.py`` is broken by jax API drift and the
+host has ~2x run-to-run swings (only interleaved pre/post trials are
+trustworthy; see docs/benchmarks.md).
+
+Examples:
+
+    python bench_wire.py --np 2                      # default sweep
+    python bench_wire.py --np 4 --sizes 65536,1048576
+    python bench_wire.py --chunk-bytes 0             # serial fallback
+    python bench_wire.py --sg 0                      # pack-path fused
+    python bench_wire.py --out wire.json             # machine-readable
+
+Exit code 0 and one JSON document on stdout (and in --out when given).
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_WORKER = os.path.join(_REPO, "tests", "wire_bench_worker.py")
+
+DEFAULT_SIZES = "65536,1048576,8388608,67108864"  # 64 KB -> 64 MB
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
+              timeout=600):
+    """One np-wide sweep; returns the rank-0 JSON payload."""
+    port = _free_port()
+    procs = []
+    for r in range(np_):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(r),
+            "HOROVOD_SIZE": str(np_),
+            "HOROVOD_LOCAL_RANK": str(r),
+            "HOROVOD_LOCAL_SIZE": str(np_),
+            "HOROVOD_CROSS_RANK": "0",
+            "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+            "HOROVOD_CYCLE_TIME": "1.0",
+            "HVD_WIRE_BENCH_SIZES": sizes,
+            "HVD_WIRE_BENCH_ITERS": str(iters),
+            "HVD_WIRE_BENCH_WARMUP": str(warmup),
+            "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+            # Workers are jax-free, but scrub the TPU relay trigger
+            # anyway so nothing in the process tree claims the chip.
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        })
+        if chunk_bytes is not None:
+            env["HVD_RING_CHUNK_BYTES"] = str(chunk_bytes)
+        if sg is not None:
+            env["HVD_WIRE_SG"] = str(sg)
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(out)
+    for r, p in enumerate(procs):
+        if p.returncode != 0:
+            raise RuntimeError("wire bench rank %d failed (rc=%s):\n%s"
+                               % (r, p.returncode, outputs[r]))
+    for line in outputs[0].splitlines():
+        if line.startswith("WIRE_BENCH_JSON "):
+            return json.loads(line[len("WIRE_BENCH_JSON "):])
+    raise RuntimeError("rank 0 emitted no WIRE_BENCH_JSON line:\n%s"
+                       % outputs[0])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--np", type=int, default=2, dest="np_")
+    ap.add_argument("--sizes", default=DEFAULT_SIZES,
+                    help="comma-separated payload bytes "
+                         "(default %s)" % DEFAULT_SIZES)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--chunk-bytes", type=int, default=None,
+                    help="HVD_RING_CHUNK_BYTES for the workers "
+                         "(0 = serial fallback; default: core default)")
+    ap.add_argument("--sg", type=int, default=None, choices=(0, 1),
+                    help="HVD_WIRE_SG for the workers")
+    ap.add_argument("--timeout", type=int, default=600)
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args(argv)
+
+    payload = run_sweep(args.np_, args.sizes, args.iters, args.warmup,
+                        chunk_bytes=args.chunk_bytes, sg=args.sg,
+                        timeout=args.timeout)
+    doc = json.dumps(payload, indent=2, sort_keys=True)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

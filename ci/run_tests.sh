@@ -69,6 +69,12 @@ run_tier1() {
 # jax-free prebuild discipline): ~11s warm, ~60s cold for the two
 # instrumented core builds — absorbed by the existing headroom.
 #
+# ISSUE 6 adds the wire-bench smoke (one tiny np=2 loopback sweep
+# through bench_wire.py, ~15s warm) so a broken data-plane bench lane
+# is caught before anyone needs it for an A/B, plus the pipelined-ring
+# chaos pair and the np=4 sweep inside the tier-2 pytest run (~70s
+# combined warm) — absorbed by the existing headroom.
+#
 # ISSUE 5 adds the elastic control-plane chaos pair
 # (tests/test_chaos_elastic.py: SIGKILL the driver with journaling ->
 # replay + checkpoint auto-resume; SIGSTOP a worker -> heartbeat
@@ -77,6 +83,13 @@ run_tier1() {
 # wedges jobs in production, so it is cheaper to catch before the full
 # tier burns its budget. Budget bumped 2100 -> 2400 to keep headroom.
 run_tier2() {
+    echo "=== tier 2: wire microbenchmark smoke (bench_wire.py) ==="
+    # Smoke only: proves the jax-free bench lane runs end-to-end (two
+    # sizes, handful of iters). Real A/B numbers need interleaved
+    # pre/post trials — see docs/wire.md.
+    timeout "${HVD_CI_WIRE_BUDGET:-180}" \
+        python bench_wire.py --np 2 --sizes 65536,4194304 \
+        --iters 4 --warmup 1 > /dev/null
     echo "=== tier 2: driver-kill chaos smoke (journal + auto-resume) ==="
     timeout 600 python -m pytest \
         tests/test_chaos_elastic.py::test_driver_kill9_journal_resume \

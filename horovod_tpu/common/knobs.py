@@ -215,6 +215,18 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HVD_FLASH_BLOCK_K", HONORED,
          "ops/pallas_attention.py: flash-attention key/value tile "
          "size"),
+    # Wire path (core/src/comm.cc + collectives.cc; docs/wire.md).
+    Knob("HVD_RING_CHUNK_BYTES", HONORED,
+         "core/src/comm.cc + collectives.cc: pipelined-ring sub-chunk "
+         "size — reduce of sub-chunk k overlaps the transfer of k+1 "
+         "(default 1 MiB; 0 = serial legacy schedule)"),
+    Knob("HOROVOD_SOCKET_BUF_BYTES", HONORED,
+         "core/src/comm.cc: explicit SO_SNDBUF/SO_RCVBUF on every data-"
+         "plane socket (0/unset = kernel autotuned default)"),
+    Knob("HVD_WIRE_SG", HONORED,
+         "core/src/operations.cc: =0 restores the fusion-buffer "
+         "pack/unpack path for fused allreduces instead of the "
+         "scatter-gather ring over tensor memory"),
     # Fault injector (core/src/comm.cc; armed only on the matching
     # rank — see docs/configuration.md and common/fault_injection.py).
     Knob("HVD_FAULT_RANK", HONORED,

@@ -55,6 +55,18 @@ _M_CORE = {
         "hvd_bootstrap_retries_total",
         "Jittered-backoff connect retries during bootstrap rendezvous and "
         "mesh setup."),
+    "tx_bytes": _metrics.counter(
+        "hvd_comm_tx_bytes_total",
+        "Bytes the native TCP data plane wrote to the wire (payload + "
+        "frame headers, docs/wire.md)."),
+    "rx_bytes": _metrics.counter(
+        "hvd_comm_rx_bytes_total",
+        "Bytes the native TCP data plane read from the wire (payload + "
+        "frame headers)."),
+    "ring_subchunk_steps": _metrics.counter(
+        "hvd_ring_subchunk_steps_total",
+        "Pipelined ring sub-chunk reduction steps (HVD_RING_CHUNK_BYTES "
+        "schedule; 0 means the serial legacy path is in use)."),
 }
 
 # StatusType values that mean "a peer is dead or wedged and the abort
@@ -456,9 +468,10 @@ class CoreSession:
 
     def counters(self) -> Dict[str, int]:
         """Core observability counters (responses, cache hits, fusion,
-        bytes, comm timeouts, abort cascades, bootstrap retries)."""
-        buf = (ctypes.c_longlong * 8)()
-        self._lib.hvd_core_counters(buf, 8)
+        bytes, comm timeouts, abort cascades, bootstrap retries, wire
+        tx/rx bytes, pipelined ring sub-chunk steps)."""
+        buf = (ctypes.c_longlong * 11)()
+        self._lib.hvd_core_counters(buf, 11)
         return {
             "responses": buf[0],
             "cached_responses": buf[1],
@@ -468,6 +481,9 @@ class CoreSession:
             "comm_timeouts": buf[5],
             "aborts": buf[6],
             "bootstrap_retries": buf[7],
+            "tx_bytes": buf[8],
+            "rx_bytes": buf[9],
+            "ring_subchunk_steps": buf[10],
         }
 
     def set_params(self, cycle_ms: float = -1.0, fusion_bytes: int = -1):

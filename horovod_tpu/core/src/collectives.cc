@@ -299,8 +299,91 @@ Status AdasumAllreduce(TcpComm& comm, void* data, int64_t count,
   return Status::OK();
 }
 
+void RingPartition(int64_t count, int n, std::vector<int64_t>* counts,
+                   std::vector<int64_t>* offsets) {
+  counts->assign((size_t)n, n > 0 ? count / n : 0);
+  if (n <= 0) {
+    offsets->clear();
+    return;
+  }
+  // First (count % n) chunks get one extra element.
+  for (int i = 0; i < (int)(count % n); ++i) (*counts)[(size_t)i]++;
+  offsets->assign((size_t)n, 0);
+  for (int i = 1; i < n; ++i)
+    (*offsets)[(size_t)i] = (*offsets)[(size_t)i - 1] +
+                            (*counts)[(size_t)i - 1];
+}
+
+int64_t RingEffectiveChunk(int64_t chunk_bytes, int64_t esize) {
+  if (chunk_bytes <= 0) return 0;
+  int64_t eff = chunk_bytes - chunk_bytes % esize;
+  return eff > 0 ? eff : esize;
+}
+
+int64_t RingSubchunkCount(int64_t step_bytes, int64_t chunk_eff) {
+  if (chunk_eff <= 0 || step_bytes <= chunk_eff) return 1;
+  return (step_bytes + chunk_eff - 1) / chunk_eff;
+}
+
+namespace {
+
+// Gather the logical byte range [begin, begin + len) of a segment list
+// into an iovec list (zero-copy view over tensor memory).
+void RangeToIov(const std::vector<WireSegment>& segs, int64_t begin,
+                int64_t len, std::vector<struct iovec>* out) {
+  out->clear();
+  int64_t pos = 0;
+  for (const auto& seg : segs) {
+    if (len <= 0) break;
+    int64_t seg_end = pos + seg.bytes;
+    if (seg_end > begin) {
+      int64_t off = std::max<int64_t>(begin - pos, 0);
+      int64_t take = std::min(seg.bytes - off, len);
+      out->push_back({seg.ptr + off, (size_t)take});
+      begin += take;
+      len -= take;
+    }
+    pos = seg_end;
+  }
+}
+
+// dst(segments logical range starting at byte_begin) op= src for
+// `nbytes` bytes. Every boundary involved is element-aligned: segment
+// sizes are count*esize, ring offsets are element offsets, and the
+// pipelined sub-chunk size is aligned by RingEffectiveChunk.
+void ReduceIntoSegments(const std::vector<WireSegment>& segs,
+                        int64_t byte_begin, const char* src, int64_t nbytes,
+                        DataType dtype, ReduceOp op) {
+  size_t esize = DataTypeSize(dtype);
+  int64_t pos = 0;
+  for (const auto& seg : segs) {
+    if (nbytes <= 0) break;
+    int64_t seg_end = pos + seg.bytes;
+    if (seg_end > byte_begin) {
+      int64_t off = std::max<int64_t>(byte_begin - pos, 0);
+      int64_t take = std::min(seg.bytes - off, nbytes);
+      ReduceBuffer(seg.ptr + off, src, take / (int64_t)esize, dtype, op);
+      src += take;
+      byte_begin += take;
+      nbytes -= take;
+    }
+    pos = seg_end;
+  }
+}
+
+}  // namespace
+
 Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
                      ReduceOp op, const std::vector<int>& members) {
+  std::vector<WireSegment> segs{
+      {(char*)data, count * (int64_t)DataTypeSize(dtype)}};
+  return RingAllreduceSegments(comm, segs, count, dtype, op, members);
+}
+
+Status RingAllreduceSegments(TcpComm& comm,
+                             const std::vector<WireSegment>& segs,
+                             int64_t count, DataType dtype, ReduceOp op,
+                             const std::vector<int>& members) {
   int n = (int)members.size();
   if (n <= 1 || count == 0) return Status::OK();
   int idx = -1;
@@ -309,43 +392,65 @@ Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
   if (idx < 0) return Status::InvalidArgument("rank not in member list");
 
   size_t esize = DataTypeSize(dtype);
-  char* base = (char*)data;
-
-  // Chunk boundaries: first (count % n) chunks get one extra element.
-  std::vector<int64_t> counts((size_t)n, count / n);
-  for (int i = 0; i < (int)(count % n); ++i) counts[(size_t)i]++;
-  std::vector<int64_t> offsets((size_t)n, 0);
-  for (int i = 1; i < n; ++i)
-    offsets[(size_t)i] = offsets[(size_t)i - 1] + counts[(size_t)i - 1];
+  std::vector<int64_t> counts, offsets;
+  RingPartition(count, n, &counts, &offsets);
 
   int right = members[(size_t)((idx + 1) % n)];
   int left = members[(size_t)((idx - 1 + n) % n)];
   int64_t max_chunk = 0;
   for (auto c : counts) max_chunk = std::max(max_chunk, c);
   std::vector<char> scratch((size_t)(max_chunk * (int64_t)esize));
+  int64_t chunk_eff = RingEffectiveChunk(comm.ring_chunk_bytes(),
+                                         (int64_t)esize);
+  std::vector<struct iovec> siov, riov;
 
   // Phase 1: reduce-scatter. After step s, chunk (idx - s) has been
-  // accumulated by its current holder.
+  // accumulated by its current holder. Receives land in scratch and
+  // reduce into the owning segments; with a sub-chunk schedule the
+  // reduce of sub-chunk k runs between poll rounds while the kernel
+  // keeps streaming sub-chunk k+1 (and draining our sends).
   for (int s = 0; s < n - 1; ++s) {
     int send_c = ((idx - s) % n + n) % n;
     int recv_c = ((idx - s - 1) % n + n) % n;
-    Status st = comm.RawSendRecv(
-        right, base + offsets[(size_t)send_c] * esize,
-        (size_t)(counts[(size_t)send_c] * (int64_t)esize), left,
-        scratch.data(), (size_t)(counts[(size_t)recv_c] * (int64_t)esize));
+    int64_t send_bytes = counts[(size_t)send_c] * (int64_t)esize;
+    int64_t recv_bytes = counts[(size_t)recv_c] * (int64_t)esize;
+    int64_t recv_base = offsets[(size_t)recv_c] * (int64_t)esize;
+    RangeToIov(segs, offsets[(size_t)send_c] * (int64_t)esize, send_bytes,
+               &siov);
+    struct iovec rv{scratch.data(), (size_t)recv_bytes};
+    Status st;
+    if (RingSubchunkCount(recv_bytes, chunk_eff) > 1) {
+      st = comm.RawSendRecvV(
+          right, siov.data(), (int)siov.size(), left, &rv, 1,
+          (size_t)chunk_eff, [&](size_t b, size_t e) {
+            ReduceIntoSegments(segs, recv_base + (int64_t)b,
+                               scratch.data() + b, (int64_t)(e - b), dtype,
+                               op);
+            CountRingSubchunkStep();
+          });
+    } else {
+      // Serial fallback (HVD_RING_CHUNK_BYTES=0, or a step too small
+      // to split): transfer fully, then reduce — the legacy schedule.
+      st = comm.RawSendRecvV(right, siov.data(), (int)siov.size(), left,
+                             &rv, 1);
+      if (st.ok())
+        ReduceIntoSegments(segs, recv_base, scratch.data(), recv_bytes,
+                           dtype, op);
+    }
     if (!st.ok()) return st;
-    ReduceBuffer(base + offsets[(size_t)recv_c] * esize, scratch.data(),
-                 counts[(size_t)recv_c], dtype, op);
   }
   // Phase 2: allgather. Rank holds fully-reduced chunk (idx + 1) % n.
+  // No reduction to overlap — receives scatter straight into segment
+  // memory in one monolithic duplex step.
   for (int s = 0; s < n - 1; ++s) {
     int send_c = ((idx + 1 - s) % n + n) % n;
     int recv_c = ((idx - s) % n + n) % n;
-    Status st = comm.RawSendRecv(
-        right, base + offsets[(size_t)send_c] * esize,
-        (size_t)(counts[(size_t)send_c] * (int64_t)esize), left,
-        base + offsets[(size_t)recv_c] * esize,
-        (size_t)(counts[(size_t)recv_c] * (int64_t)esize));
+    RangeToIov(segs, offsets[(size_t)send_c] * (int64_t)esize,
+               counts[(size_t)send_c] * (int64_t)esize, &siov);
+    RangeToIov(segs, offsets[(size_t)recv_c] * (int64_t)esize,
+               counts[(size_t)recv_c] * (int64_t)esize, &riov);
+    Status st = comm.RawSendRecvV(right, siov.data(), (int)siov.size(),
+                                  left, riov.data(), (int)riov.size());
     if (!st.ok()) return st;
   }
   return Status::OK();
@@ -365,8 +470,12 @@ Status RingAllgatherv(TcpComm& comm, const void* sendbuf, void* recvbuf,
     offsets[(size_t)i] =
         offsets[(size_t)i - 1] + bytes_per_member[(size_t)i - 1];
   char* out = (char*)recvbuf;
-  memcpy(out + offsets[(size_t)idx], sendbuf,
-         (size_t)bytes_per_member[(size_t)idx]);
+  // Skip the self-copy when the caller's sendbuf already aliases its
+  // slot in recvbuf (in-place allgather): memcpy over exactly
+  // overlapping pointers is both wasted bandwidth and formally UB.
+  if ((const void*)(out + offsets[(size_t)idx]) != sendbuf)
+    memcpy(out + offsets[(size_t)idx], sendbuf,
+           (size_t)bytes_per_member[(size_t)idx]);
   if (n <= 1) return Status::OK();
 
   int right = members[(size_t)((idx + 1) % n)];

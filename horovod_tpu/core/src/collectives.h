@@ -14,10 +14,48 @@
 
 namespace hvd {
 
+// Dim-0-balanced contiguous ring partition of `count` elements over
+// `n` members: the first (count % n) chunks carry one extra element.
+// Shared by the ring collectives, the reducescatter shard math, and
+// the hvd_ring_partition test export (operations.cc).
+void RingPartition(int64_t count, int n, std::vector<int64_t>* counts,
+                   std::vector<int64_t>* offsets);
+
+// Effective pipelined sub-chunk size: `chunk_bytes` aligned down to a
+// whole number of `esize`-byte elements (minimum one element); 0 stays
+// 0 (serial fallback).
+int64_t RingEffectiveChunk(int64_t chunk_bytes, int64_t esize);
+
+// Number of sub-chunk reduction steps one ring step of `step_bytes`
+// performs under effective chunk `chunk_eff` (0, or no split needed,
+// = 1 monolithic step). Mirrors the RawSendRecvV callback cadence.
+int64_t RingSubchunkCount(int64_t step_bytes, int64_t chunk_eff);
+
+// One contiguous element-aligned span of a logical wire buffer. The
+// fused allreduce path describes its tensors as a segment list so ring
+// steps gather sends straight from (and scatter receives straight
+// into) tensor memory — no fusion-buffer pack/unpack (docs/wire.md).
+struct WireSegment {
+  char* ptr;
+  int64_t bytes;
+};
+
 // In-place ring allreduce over `members` (sorted global ranks).
 // AVERAGE is reduced as SUM; the caller applies the 1/n scale.
 Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
                      ReduceOp op, const std::vector<int>& members);
+
+// Segment-list ring allreduce: same algorithm, but the logical buffer
+// is scattered across `segs` (total `count` elements). Reduce-scatter
+// receives land in a scratch buffer and reduce into the owning
+// segments; the allgather phase scatters receives directly into
+// segment memory. When comm.ring_chunk_bytes() > 0, each ring step is
+// pipelined in sub-chunks: the reduce of sub-chunk k runs while the
+// wire moves sub-chunk k+1 (0 = serial legacy schedule).
+Status RingAllreduceSegments(TcpComm& comm,
+                             const std::vector<WireSegment>& segs,
+                             int64_t count, DataType dtype, ReduceOp op,
+                             const std::vector<int>& members);
 
 // Allgather with per-member byte counts. `sendbuf` (my part) is copied
 // into `recvbuf` at my offset; parts ordered by member index.

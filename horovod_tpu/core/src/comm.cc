@@ -3,12 +3,14 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <limits.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <stdlib.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -38,9 +40,45 @@ constexpr uint64_t kMaxFrameLen = 1ull << 31;
 // legal hostname so a corrupted length cannot drive the resize below.
 constexpr uint32_t kMaxEndpointLen = 4096;
 
+double EnvDouble(const char* name, double dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  double parsed = strtod(v, &end);
+  if (end == v) return dflt;  // malformed: keep the default
+  return parsed;
+}
+
+long long EnvLL(const char* name, long long dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  return atoll(v);
+}
+
 void SetSockOpts(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // HOROVOD_SOCKET_BUF_BYTES: explicit SO_SNDBUF/SO_RCVBUF sizing next
+  // to TCP_NODELAY (docs/wire.md). Bigger kernel buffers are what let
+  // the pipelined ring overlap reduction with the wire — the peer keeps
+  // streaming into rcvbuf while this thread reduces the previous
+  // sub-chunk. 0/unset keeps the kernel's autotuned default.
+  long long want = EnvLL("HOROVOD_SOCKET_BUF_BYTES", 0);
+  if (want > 0) {
+    int buf = (int)std::min(want, (long long)INT_MAX);
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+  }
+}
+
+// Largest iovec window per sendmsg/recvmsg call; the resumption loops
+// advance through longer lists window by window.
+int MaxIovPerCall() {
+  static const int kMax = []() {
+    long v = ::sysconf(_SC_IOV_MAX);
+    return (int)(v > 0 ? std::min(v, 1024L) : 16);
+  }();
+  return kMax;
 }
 
 // errnos that mean "the peer or the connection is gone" rather than a
@@ -93,24 +131,15 @@ struct FdVecGuard {
   }
 };
 
-double EnvDouble(const char* name, double dflt) {
-  const char* v = getenv(name);
-  if (!v || !*v) return dflt;
-  char* end = nullptr;
-  double parsed = strtod(v, &end);
-  if (end == v) return dflt;  // malformed: keep the default
-  return parsed;
-}
-
-long long EnvLL(const char* name, long long dflt) {
-  const char* v = getenv(name);
-  if (!v || !*v) return dflt;
-  return atoll(v);
-}
-
 // Process-wide counters (accessors declared in comm.h).
 std::atomic<long long> g_comm_timeouts{0};
 std::atomic<long long> g_bootstrap_retries{0};
+// Wire accounting: every byte sendmsg/recvmsg reports moved (payload +
+// frame headers), plus pipelined ring sub-chunk reduction steps.
+// Relaxed ordering: pure monotonic telemetry read by the scrape thread.
+std::atomic<long long> g_tx_bytes{0};
+std::atomic<long long> g_rx_bytes{0};
+std::atomic<long long> g_ring_subchunks{0};
 
 // ------------------------------------------------------- fault injection ---
 // Env-driven chaos hooks for the tier-2 failure-detection tests
@@ -182,6 +211,12 @@ void ParseFaultEnv(int rank) {
 
 long long CommTimeoutsTotal() { return g_comm_timeouts.load(); }
 long long CommBootstrapRetriesTotal() { return g_bootstrap_retries.load(); }
+long long CommTxBytesTotal() { return g_tx_bytes.load(); }
+long long CommRxBytesTotal() { return g_rx_bytes.load(); }
+long long RingSubchunkStepsTotal() { return g_ring_subchunks.load(); }
+void CountRingSubchunkStep() {
+  g_ring_subchunks.fetch_add(1, std::memory_order_relaxed);
+}
 
 Status TcpComm::MaybeInjectFault(int peer) {
   if (g_fault.mode == FaultMode::OFF) return Status::OK();
@@ -247,6 +282,7 @@ Status TcpComm::SendAll(int fd, const void* data, size_t len) {
   while (len > 0) {
     ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (n > 0) {
+      g_tx_bytes.fetch_add(n, std::memory_order_relaxed);
       p += n;
       len -= (size_t)n;
       continue;  // progress: the deadline below restarts
@@ -276,6 +312,7 @@ Status TcpComm::RecvAll(int fd, void* data, size_t len) {
   while (len > 0) {
     ssize_t n = ::recv(fd, p, len, MSG_DONTWAIT);
     if (n > 0) {
+      g_rx_bytes.fetch_add(n, std::memory_order_relaxed);
       p += n;
       len -= (size_t)n;
       continue;
@@ -293,6 +330,71 @@ Status TcpComm::RecvAll(int fd, void* data, size_t len) {
       ++g_comm_timeouts;
       return Status::TimedOut(
           "recv made no progress for " +
+          std::to_string(progress_timeout_sec_) +
+          "s (HOROVOD_COMM_TIMEOUT_SEC); peer wedged or network "
+          "blackholed");
+    }
+  }
+  return Status::OK();
+}
+
+namespace {
+
+// Consume `n` bytes of progress from an iovec list in place, skipping
+// exhausted (and zero-length) entries. `idx` tracks the first live
+// entry so resumed sendmsg/recvmsg calls start from it.
+void AdvanceIov(struct iovec* iov, int iovcnt, int* idx, size_t n) {
+  while (n > 0 && *idx < iovcnt) {
+    struct iovec& v = iov[*idx];
+    if (v.iov_len == 0) {
+      ++*idx;
+      continue;
+    }
+    size_t take = std::min(n, v.iov_len);
+    v.iov_base = (char*)v.iov_base + take;
+    v.iov_len -= take;
+    n -= take;
+    if (v.iov_len == 0) ++*idx;
+  }
+}
+
+// First live entry at/after idx (zero-length entries are legal in a
+// gather list and must not become a zero-byte sendmsg busy-loop).
+int SkipEmptyIov(const struct iovec* iov, int iovcnt, int idx) {
+  while (idx < iovcnt && iov[idx].iov_len == 0) ++idx;
+  return idx;
+}
+
+}  // namespace
+
+Status TcpComm::SendVecAll(int fd, struct iovec* iov, int iovcnt) {
+  size_t left = 0;
+  for (int i = 0; i < iovcnt; ++i) left += iov[i].iov_len;
+  int idx = 0;
+  while (left > 0) {
+    idx = SkipEmptyIov(iov, iovcnt, idx);
+    struct msghdr msg {};
+    msg.msg_iov = iov + idx;
+    msg.msg_iovlen = (size_t)std::min(iovcnt - idx, MaxIovPerCall());
+    ssize_t n = ::sendmsg(fd, &msg, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (n > 0) {
+      g_tx_bytes.fetch_add(n, std::memory_order_relaxed);
+      left -= (size_t)n;
+      AdvanceIov(iov, iovcnt, &idx, (size_t)n);
+      continue;  // progress: the deadline below restarts
+    }
+    if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      return SocketError("sendmsg");
+    struct pollfd pfd{fd, POLLOUT, 0};
+    int rc = ::poll(&pfd, 1, progress_timeout_ms_);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) {
+      ++g_comm_timeouts;
+      return Status::TimedOut(
+          "send made no progress for " +
           std::to_string(progress_timeout_sec_) +
           "s (HOROVOD_COMM_TIMEOUT_SEC); peer wedged or network "
           "blackholed");
@@ -433,6 +535,13 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
       progress_timeout_sec_ > 0
           ? (int)std::min(progress_timeout_sec_ * 1000.0, 2147483000.0)
           : -1;
+  // Pipelined-ring sub-chunk size (docs/wire.md). Default 1 MiB: big
+  // enough that per-chunk bookkeeping is noise, small enough that the
+  // reduce of chunk k overlaps a meaningful slice of chunk k+1's
+  // transfer. 0 (or negative/malformed) = serial legacy schedule —
+  // the fallback that saved np=8 on oversubscribed hosts.
+  ring_chunk_bytes_ = EnvLL("HVD_RING_CHUNK_BYTES", 1 << 20);
+  if (ring_chunk_bytes_ < 0) ring_chunk_bytes_ = 0;
   ParseFaultEnv(rank);
   if (size == 1) return Status::OK();
 
@@ -658,14 +767,28 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
 }
 
 Status TcpComm::Send(int peer, const void* data, size_t len) {
+  struct iovec iov{const_cast<void*>(data), len};
+  return Sendv(peer, &iov, 1);
+}
+
+Status TcpComm::Sendv(int peer, const struct iovec* iov, int iovcnt) {
+  // One frame, however many buffers it gathers: the injector's
+  // HVD_FAULT_AFTER_FRAMES counting is stable across the framed path's
+  // move from two syscalls (header SendAll + payload SendAll) to one
+  // vectored sendmsg.
   if (g_fault.mode != FaultMode::OFF) {
     Status fs = MaybeInjectFault(peer);
     if (!fs.ok()) return fs;
   }
-  FrameHeader h{kMagic, (uint32_t)rank_, (uint64_t)len};
-  Status s = SendAll(fds_[(size_t)peer], &h, sizeof(h));
-  if (!s.ok()) return s;
-  return SendAll(fds_[(size_t)peer], data, len);
+  uint64_t len = 0;
+  for (int i = 0; i < iovcnt; ++i) len += iov[i].iov_len;
+  FrameHeader h{kMagic, (uint32_t)rank_, len};
+  // Header + payload in one gather list: a single vectored call per
+  // frame (no Nagle-unfriendly header/payload split, no pack copy).
+  std::vector<struct iovec> vec((size_t)iovcnt + 1);
+  vec[0] = {&h, sizeof(h)};
+  for (int i = 0; i < iovcnt; ++i) vec[(size_t)(i + 1)] = iov[i];
+  return SendVecAll(fds_[(size_t)peer], vec.data(), iovcnt + 1);
 }
 
 Status TcpComm::Recv(int peer, std::string* out) {
@@ -694,16 +817,36 @@ Status TcpComm::RecvInto(int peer, void* buf, size_t len) {
 
 Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
                             int peer_r, void* rbuf, size_t rlen) {
+  struct iovec siov{const_cast<void*>(sbuf), slen};
+  struct iovec riov{rbuf, rlen};
+  return RawSendRecvV(peer_s, &siov, 1, peer_r, &riov, 1);
+}
+
+Status TcpComm::RawSendRecvV(int peer_s, const struct iovec* siov,
+                             int siovcnt, int peer_r,
+                             const struct iovec* riov, int riovcnt,
+                             size_t rchunk, const ChunkCallback& on_chunk) {
+  // One duplex transfer == one frame for HVD_FAULT_AFTER_FRAMES,
+  // regardless of how many iovecs it gathers/scatters or how many
+  // sub-chunk callbacks fire (chaos-test contract, docs/wire.md).
   if (g_fault.mode != FaultMode::OFF) {
     Status fs = MaybeInjectFault(peer_s);
     if (!fs.ok()) return fs;
   }
   int sfd = peer_s >= 0 ? fds_[(size_t)peer_s] : -1;
   int rfd = peer_r >= 0 ? fds_[(size_t)peer_r] : -1;
-  const char* sp = static_cast<const char*>(sbuf);
-  char* rp = static_cast<char*>(rbuf);
-  size_t sleft = sfd >= 0 ? slen : 0;
-  size_t rleft = rfd >= 0 ? rlen : 0;
+  std::vector<struct iovec> sv, rv;
+  size_t sleft = 0, rleft = 0;
+  if (sfd >= 0) {
+    sv.assign(siov, siov + siovcnt);
+    for (auto& v : sv) sleft += v.iov_len;
+  }
+  if (rfd >= 0) {
+    rv.assign(riov, riov + riovcnt);
+    for (auto& v : rv) rleft += v.iov_len;
+  }
+  int sidx = 0, ridx = 0;
+  size_t rtotal = rleft, rdone = 0, rfired = 0;
   while (sleft > 0 || rleft > 0) {
     struct pollfd pfds[2];
     int n = 0;
@@ -722,7 +865,9 @@ Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
     }
     // One deadline policy for framed and duplex transfers: the poll
     // round is bounded by the same HOROVOD_COMM_TIMEOUT_SEC progress
-    // window (it used to hard-code 60 s here).
+    // window (it used to hard-code 60 s here). Sub-chunk reduction
+    // runs between rounds on this thread; the window restarts at the
+    // next poll, so consuming a chunk can never trip the deadline.
     int rc = ::poll(pfds, (nfds_t)n, progress_timeout_ms_);
     if (rc < 0) {
       if (errno == EINTR) continue;
@@ -737,22 +882,47 @@ Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
           "blackholed");
     }
     if (si >= 0 && (pfds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t w = ::send(sfd, sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
+      sidx = SkipEmptyIov(sv.data(), (int)sv.size(), sidx);
+      struct msghdr msg {};
+      msg.msg_iov = sv.data() + sidx;
+      msg.msg_iovlen =
+          (size_t)std::min((int)sv.size() - sidx, MaxIovPerCall());
+      ssize_t w = ::sendmsg(sfd, &msg, MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return SocketError("send");
+        return SocketError("sendmsg");
       if (w > 0) {
-        sp += w;
+        g_tx_bytes.fetch_add(w, std::memory_order_relaxed);
         sleft -= (size_t)w;
+        AdvanceIov(sv.data(), (int)sv.size(), &sidx, (size_t)w);
       }
     }
     if (ri >= 0 && (pfds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t r = ::recv(rfd, rp, rleft, MSG_DONTWAIT);
+      ridx = SkipEmptyIov(rv.data(), (int)rv.size(), ridx);
+      struct msghdr msg {};
+      msg.msg_iov = rv.data() + ridx;
+      msg.msg_iovlen =
+          (size_t)std::min((int)rv.size() - ridx, MaxIovPerCall());
+      ssize_t r = ::recvmsg(rfd, &msg, MSG_DONTWAIT);
       if (r == 0) return Status::Aborted("peer closed connection");
       if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return SocketError("recv");
+        return SocketError("recvmsg");
       if (r > 0) {
-        rp += r;
+        g_rx_bytes.fetch_add(r, std::memory_order_relaxed);
         rleft -= (size_t)r;
+        rdone += (size_t)r;
+        AdvanceIov(rv.data(), (int)rv.size(), &ridx, (size_t)r);
+        if (rchunk > 0 && on_chunk) {
+          // Fire every fully-landed sub-chunk; the tail (< rchunk)
+          // fires once the whole range is in.
+          while (rdone - rfired >= rchunk) {
+            on_chunk(rfired, rfired + rchunk);
+            rfired += rchunk;
+          }
+          if (rleft == 0 && rfired < rtotal) {
+            on_chunk(rfired, rtotal);
+            rfired = rtotal;
+          }
+        }
       }
     }
   }

@@ -14,6 +14,9 @@
 
 #include "common.h"
 
+#include <sys/uio.h>
+
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -25,6 +28,13 @@ namespace hvd {
 // docs/metrics.md). Monotonic across elastic resets.
 long long CommTimeoutsTotal();        // ops that hit the progress deadline
 long long CommBootstrapRetriesTotal();  // ConnectTo retry attempts
+// Wire accounting (docs/wire.md): every payload/header byte that moved
+// through the data plane, and every pipelined ring sub-chunk reduction
+// step (collectives.cc increments via CountRingSubchunkStep).
+long long CommTxBytesTotal();
+long long CommRxBytesTotal();
+long long RingSubchunkStepsTotal();
+void CountRingSubchunkStep();
 
 class TcpComm {
  public:
@@ -43,8 +53,14 @@ class TcpComm {
   int rank() const { return rank_; }
   int size() const { return size_; }
 
-  // Framed point-to-point (blocking, background thread only).
+  // Framed point-to-point (blocking, background thread only). The
+  // header and payload go out in ONE vectored sendmsg (docs/wire.md):
+  // no second syscall per frame, and no pack copy for multi-buffer
+  // payloads (Sendv gathers straight from the caller's buffers). One
+  // Send/Sendv call == one frame for the fault injector's
+  // HVD_FAULT_AFTER_FRAMES accounting, however many iovecs it gathers.
   Status Send(int peer, const void* data, size_t len);
+  Status Sendv(int peer, const struct iovec* iov, int iovcnt);
   Status Recv(int peer, std::string* out);
   // Receive exactly `len` bytes into `buf`.
   Status RecvInto(int peer, void* buf, size_t len);
@@ -55,6 +71,30 @@ class TcpComm {
   // kernel socket buffers). Either peer may be -1 to skip that side.
   Status RawSendRecv(int peer_s, const void* sbuf, size_t slen, int peer_r,
                      void* rbuf, size_t rlen);
+
+  // Invoked as recv payload completes chunk boundaries: on_chunk(b, e)
+  // says bytes [b, e) of the receive range are fully landed and safe to
+  // consume. Runs on the calling (background) thread between poll
+  // rounds, so consuming a chunk overlaps the wire: the kernel keeps
+  // accepting inbound bytes and draining outbound ones meanwhile.
+  using ChunkCallback = std::function<void(size_t begin, size_t end)>;
+
+  // Scatter-gather duplex transfer: stream the send iovec list to
+  // `peer_s` while scattering reads from `peer_r` into the recv iovec
+  // list (sendmsg/recvmsg; partial progress resumes under the same
+  // poll/deadline machinery as RawSendRecv). With rchunk > 0, on_chunk
+  // fires after every rchunk received bytes (and once for the final
+  // partial chunk) — the pipelined ring's reduce hook. One call == one
+  // frame for HVD_FAULT_AFTER_FRAMES, regardless of iovec or sub-chunk
+  // count. Either peer may be -1 to skip that side.
+  Status RawSendRecvV(int peer_s, const struct iovec* siov, int siovcnt,
+                      int peer_r, const struct iovec* riov, int riovcnt,
+                      size_t rchunk = 0,
+                      const ChunkCallback& on_chunk = nullptr);
+
+  // Sub-chunk size (bytes) for pipelined chunked ring steps, from
+  // HVD_RING_CHUNK_BYTES at Init (0 = serial legacy path; docs/wire.md).
+  int64_t ring_chunk_bytes() const { return ring_chunk_bytes_; }
 
   // --- control-plane collectives over the star/mesh (blocking) ---
   // Gather variable-size blobs to `root` (root gets all, others send).
@@ -79,6 +119,10 @@ class TcpComm {
   // Status::TimedOut instead of an infinite hang. 0 = legacy infinite.
   Status SendAll(int fd, const void* data, size_t len);
   Status RecvAll(int fd, void* data, size_t len);
+  // Vectored SendAll: one sendmsg per poll round over the remaining
+  // iovec tail (gather I/O with partial-write resumption). Mutates the
+  // caller's iovec array to track progress.
+  Status SendVecAll(int fd, struct iovec* iov, int iovcnt);
   // Fault injector hook (HVD_FAULT_* env, comm.cc): zero-cost single
   // branch when unarmed; called on every framed send / duplex transfer.
   Status MaybeInjectFault(int peer);
@@ -91,6 +135,9 @@ class TcpComm {
   // (-1 = infinite, the legacy behavior when the knob is 0).
   int progress_timeout_ms_ = -1;
   double progress_timeout_sec_ = 0.0;
+  // HVD_RING_CHUNK_BYTES at Init; 0 disables the pipelined sub-chunk
+  // schedule (serial fallback — see docs/wire.md).
+  int64_t ring_chunk_bytes_ = 0;
 };
 
 }  // namespace hvd

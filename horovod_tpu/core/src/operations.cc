@@ -53,6 +53,10 @@ struct Global {
   int64_t fusion_bytes = 128 * 1024 * 1024;
   int cache_cap = 1024;
   std::vector<char> fusion_buffer;
+  // HVD_WIRE_SG=0 restores the fusion-buffer pack/unpack path for
+  // fused allreduces; default is the scatter-gather ring straight over
+  // tensor memory (docs/wire.md).
+  bool wire_sg = true;
   // Removals are deferred to the end of the cycle: a "__ps_remove__"
   // barrier executes while the loop still holds pointers into the set
   // table, so the erase must not happen mid-iteration.
@@ -274,6 +278,37 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
     if (st.ok()) {
       double s = avg_scale * resp.postscale;
       if (s != 1.0) ScaleBuffer(p.entry.data, p.count, resp.dtype, s);
+    }
+  } else if (g->wire_sg) {
+    // Fused scatter-gather path (docs/wire.md): describe the tensors
+    // as a segment list and ring-reduce straight over their memory —
+    // sends gather from (and allgather receives scatter into) tensor
+    // buffers via sendmsg/recvmsg, so the MEMCPY_IN/OUT_FUSION_BUFFER
+    // pack/unpack of the legacy path below never happens.
+    std::vector<std::vector<char>> absent;  // joined ranks contribute 0
+    std::vector<WireSegment> segs;
+    segs.reserve(parts.size());
+    for (auto& p : parts) {
+      char* ptr;
+      if (p.present) {
+        ptr = (char*)p.entry.data;
+      } else {
+        absent.emplace_back((size_t)(p.count * (int64_t)esize), 0);
+        ptr = absent.back().data();
+      }
+      if (resp.prescale != 1.0)
+        ScaleBuffer(ptr, p.count, resp.dtype, resp.prescale);
+      segs.push_back({ptr, p.count * (int64_t)esize});
+    }
+    TlAllBegin(resp, TlWireName(resp));
+    st = RingAllreduceSegments(g->comm, segs, total, resp.dtype,
+                               resp.reduce_op, ps.members);
+    TlAllEnd(resp);
+    if (st.ok()) {
+      double s = avg_scale * resp.postscale;
+      if (s != 1.0)
+        for (size_t i = 0; i < parts.size(); ++i)
+          ScaleBuffer(segs[i].ptr, parts[i].count, resp.dtype, s);
     }
   } else {
     // Fused path: pack into the persistent fusion buffer
@@ -767,6 +802,8 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
   if (const char* mc = getenv("HOROVOD_TIMELINE_MARK_CYCLES"))
     g->tl_mark_cycles = *mc && strcmp(mc, "0") != 0;
+  if (const char* sg = getenv("HVD_WIRE_SG"))
+    g->wire_sg = !(*sg && strcmp(sg, "0") == 0);
   if (fusion_bytes > 0) g->fusion_bytes = fusion_bytes;
   if (cache_cap >= 0) g->cache_cap = cache_cap;
 
@@ -1003,16 +1040,47 @@ long long hvd_core_fusion_bytes() {
 
 // Fills out[0..n): responses, cached_responses, fused_tensors,
 // allreduced_tensors, allreduce_bytes, comm_timeouts, aborts,
-// bootstrap_retries. Callers pass the slot count they know about, so
-// the layout is append-only.
+// bootstrap_retries, tx_bytes, rx_bytes, ring_subchunk_steps. Callers
+// pass the slot count they know about, so the layout is append-only.
 void hvd_core_counters(long long* out, int n) {
   if (!g || !out) return;
-  long long vals[8] = {
+  long long vals[11] = {
       g->ctr_responses.load(), g->ctr_cached_responses.load(),
       g->ctr_fused_tensors.load(), g->ctr_allreduced_tensors.load(),
       g->ctr_allreduce_bytes.load(), CommTimeoutsTotal(),
-      g->ctr_aborts.load(), CommBootstrapRetriesTotal()};
-  for (int i = 0; i < n && i < 8; ++i) out[i] = vals[i];
+      g->ctr_aborts.load(), CommBootstrapRetriesTotal(),
+      CommTxBytesTotal(), CommRxBytesTotal(), RingSubchunkStepsTotal()};
+  for (int i = 0; i < n && i < 11; ++i) out[i] = vals[i];
+}
+
+// --- wire-schedule test hooks (tests/test_wire.py) --------------------------
+// Pure functions over the ring math in collectives.cc, exported so the
+// chunk/offset schedule is unit-testable in-process via ctypes without
+// bootstrapping a mesh. Not part of the session API.
+
+// Fills counts[0..n) and offsets[0..n) with the dim-0-balanced ring
+// partition of `count` elements. Returns 0, or -1 on invalid args.
+int hvd_ring_partition(long long count, int n, long long* counts,
+                       long long* offsets) {
+  if (count < 0 || n <= 0 || !counts || !offsets) return -1;
+  std::vector<int64_t> c, o;
+  RingPartition((int64_t)count, n, &c, &o);
+  for (int i = 0; i < n; ++i) {
+    counts[i] = (long long)c[(size_t)i];
+    offsets[i] = (long long)o[(size_t)i];
+  }
+  return 0;
+}
+
+// Number of pipelined sub-chunk reduce steps for one ring step of
+// `step_count` elements of `esize` bytes under HVD_RING_CHUNK_BYTES =
+// `chunk_bytes` (after element alignment; 0 = serial = 1). Returns -1
+// on invalid args.
+long long hvd_ring_subchunk_count(long long step_count, long long esize,
+                                  long long chunk_bytes) {
+  if (step_count < 0 || esize <= 0) return -1;
+  int64_t eff = RingEffectiveChunk((int64_t)chunk_bytes, (int64_t)esize);
+  return (long long)RingSubchunkCount(step_count * esize, eff);
 }
 
 }  // extern "C"

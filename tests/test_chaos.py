@@ -155,6 +155,34 @@ def test_chaos_half_close_injected():
     _assert_survivors_typed(codes, outputs, (0, 1))
 
 
+def test_chaos_drop_pipelined_ring():
+    """Fault-injector compatibility with the pipelined wire path
+    (docs/wire.md): a tiny HVD_RING_CHUNK_BYTES forces many sub-chunk
+    callbacks per ring step, but HVD_FAULT_AFTER_FRAMES still counts
+    ONE frame per vectored send / duplex transfer, however many
+    sub-chunk callbacks fire inside it — the injected drop lands
+    mid-pipeline (a 16 MB doom payload at 4 KB chunks is thousands of
+    sub-chunks per ring step) and every rank, victim included, must
+    observe the typed HorovodAbortedError, never a hang."""
+    codes, outputs = _run_chaos(
+        2, "half_close",
+        extra_env=dict(fault_env(1, "drop", after_frames=100),
+                       HVD_RING_CHUNK_BYTES="4096"))
+    _assert_survivors_typed(codes, outputs, (0, 1))
+
+
+def test_chaos_stall_pipelined_ring():
+    """Same pipelined schedule, stall mode: the victim's background
+    thread parks between sub-chunks and the survivor's progress
+    deadline must fire through the chunked RawSendRecvV poll loop."""
+    codes, outputs = _run_chaos(
+        2, "stall",
+        extra_env=dict(fault_env(1, "stall", after_frames=100),
+                       HVD_RING_CHUNK_BYTES="4096"))
+    _assert_survivors_typed(codes, outputs, (0,))
+    assert _counter(outputs, 0, "timeouts") >= 1, outputs[0]
+
+
 def test_chaos_stall_injected():
     """Native fault injector: the victim's background thread parks
     forever (comm-layer SIGSTOP analog); the survivor's deadline fires."""

@@ -141,6 +141,16 @@ TIER2_COVERAGE = {
     "test_sigstop_worker_replaced_by_liveness":
         "tests/test_elastic_resilience.py::"
         "test_driver_wedge_detection_after_first_heartbeat",
+    # Wire path (ISSUE 6): chunk math and pipelined-vs-legacy equality
+    # run fast at np=2/3 in test_wire.py; the np=4 busbw sweep and the
+    # fault-injection-through-the-pipeline runs are the heavyweight
+    # variants.
+    "test_wire_bench_np4_sweep":
+        "tests/test_wire.py::test_equality_pipelined_np2",
+    "test_chaos_drop_pipelined_ring":
+        "tests/test_wire.py::test_equality_pipelined_np2",
+    "test_chaos_stall_pipelined_ring":
+        "tests/test_wire.py::test_equality_pipelined_np2",
 }
 
 

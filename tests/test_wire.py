@@ -1,0 +1,170 @@
+"""Wire-path schedule tests (docs/wire.md).
+
+Two layers, mirroring how the schedule can break:
+
+- **Chunk/offset math** (in-process, ctypes): the ring partition and
+  pipelined sub-chunk counts exported as test hooks from the native
+  core (``hvd_ring_partition`` / ``hvd_ring_subchunk_count``), probed
+  at the boundaries — ``count % n != 0``, counts smaller than the
+  world, chunk sizes that don't divide the element size.
+- **Pipelined-vs-legacy equality** (multi-process, seconds each):
+  the same collective matrix must produce identical results under the
+  pipelined chunked ring (tiny ``HVD_RING_CHUNK_BYTES`` forces many
+  sub-chunks), the serial legacy schedule (``HVD_RING_CHUNK_BYTES=0``
+  + ``HVD_WIRE_SG=0``), and at odd world sizes.
+
+The np=4 busbw sweep is the heavyweight variant (tier2 + slow; its
+schedule/equality code paths are covered by the fast runs here).
+"""
+
+import ctypes
+import json
+import os
+
+import pytest
+
+from horovod_tpu.core.build import library_path
+from tests.test_native_core import _REPO, _launch
+
+_WORKER = os.path.join(_REPO, "tests", "wire_equality_worker.py")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = ctypes.CDLL(library_path(build_if_missing=True))
+    lib.hvd_ring_partition.restype = ctypes.c_int
+    lib.hvd_ring_partition.argtypes = [
+        ctypes.c_longlong, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong)]
+    lib.hvd_ring_subchunk_count.restype = ctypes.c_longlong
+    lib.hvd_ring_subchunk_count.argtypes = [
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong]
+    return lib
+
+
+def _partition(lib, count, n):
+    counts = (ctypes.c_longlong * n)()
+    offsets = (ctypes.c_longlong * n)()
+    assert lib.hvd_ring_partition(count, n, counts, offsets) == 0
+    return list(counts), list(offsets)
+
+
+# --- chunk/offset math ------------------------------------------------------
+
+def test_partition_ragged(lib):
+    # First (count % n) chunks carry the extra element.
+    assert _partition(lib, 10, 3) == ([4, 3, 3], [0, 4, 7])
+    assert _partition(lib, 11, 3) == ([4, 4, 3], [0, 4, 8])
+
+
+def test_partition_small_world_and_zero(lib):
+    # count < n: trailing chunks are empty, offsets stay monotonic.
+    assert _partition(lib, 2, 3) == ([1, 1, 0], [0, 1, 2])
+    assert _partition(lib, 0, 4) == ([0] * 4, [0] * 4)
+    assert _partition(lib, 5, 1) == ([5], [0])
+
+
+@pytest.mark.parametrize("count", [0, 1, 3, 7, 64, 1000, 4099, 1 << 20])
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 8])
+def test_partition_invariants(lib, count, n):
+    counts, offsets = _partition(lib, count, n)
+    assert sum(counts) == count
+    assert max(counts) - min(counts) <= 1  # dim-0 balance
+    acc = 0
+    for c, o in zip(counts, offsets):
+        assert o == acc  # contiguous, in member order
+        acc += c
+
+
+def test_partition_invalid_args(lib):
+    counts = (ctypes.c_longlong * 2)()
+    assert lib.hvd_ring_partition(-1, 2, counts, counts) == -1
+    assert lib.hvd_ring_partition(4, 0, counts, counts) == -1
+
+
+def test_subchunk_counts(lib):
+    # chunk 0 = serial = one monolithic step, whatever the payload.
+    assert lib.hvd_ring_subchunk_count(1 << 20, 4, 0) == 1
+    # Fits in one chunk (boundary inclusive).
+    assert lib.hvd_ring_subchunk_count(1024, 4, 4096) == 1
+    # One element over the boundary splits.
+    assert lib.hvd_ring_subchunk_count(1025, 4, 4096) == 2
+    # Chunk is aligned DOWN to the element size (5 -> 4 for esize 4).
+    assert lib.hvd_ring_subchunk_count(10, 4, 5) == 10
+    # Chunk smaller than one element rounds up to one element.
+    assert lib.hvd_ring_subchunk_count(5, 8, 3) == 5
+    # Generic ceil-division against a Python mirror.
+    for step, esize, chunk in ((4099, 4, 64), (4099, 8, 1024),
+                               (17, 2, 16), (1, 8, 1 << 20)):
+        eff = max(esize, chunk - chunk % esize)
+        want = max(1, -(-step * esize // eff)) if step * esize > eff else 1
+        assert lib.hvd_ring_subchunk_count(step, esize, chunk) == want
+    assert lib.hvd_ring_subchunk_count(-1, 4, 64) == -1
+    assert lib.hvd_ring_subchunk_count(4, 0, 64) == -1
+
+
+# --- pipelined-vs-legacy equality (multi-process) ---------------------------
+
+def _eq_counters(outputs):
+    for out in outputs:
+        for line in out.splitlines():
+            if line.startswith("WIRE_EQ_COUNTERS "):
+                return json.loads(line[len("WIRE_EQ_COUNTERS "):])
+    raise AssertionError("no WIRE_EQ_COUNTERS line:\n" + "\n".join(outputs))
+
+
+def _run_equality(np_, extra_env):
+    codes, outputs = _launch(np_, _WORKER, extra_env=extra_env, timeout=180)
+    assert codes == [0] * np_, "\n".join(outputs)
+    assert sum("WIRE_EQ_OK" in o for o in outputs) == np_
+    return _eq_counters(outputs)
+
+
+def test_equality_pipelined_np2():
+    """Tiny chunks force many sub-chunk reduce steps; results must
+    match the locally computed expectation bit-for-bit."""
+    c = _run_equality(2, {"HVD_RING_CHUNK_BYTES": "64"})
+    assert c["ring_subchunk_steps"] > 0, c  # the pipeline engaged
+    assert c["tx_bytes"] > 0 and c["rx_bytes"] > 0, c
+
+
+def test_equality_legacy_serial_np2():
+    """HVD_RING_CHUNK_BYTES=0 + HVD_WIRE_SG=0 is the full legacy
+    schedule (monolithic ring steps, fusion-buffer pack): same matrix,
+    zero sub-chunk steps."""
+    c = _run_equality(2, {"HVD_RING_CHUNK_BYTES": "0", "HVD_WIRE_SG": "0"})
+    assert c["ring_subchunk_steps"] == 0, c
+
+
+def test_equality_pipelined_np3_odd_world():
+    """Odd world: every count in the matrix is ragged mod 3 somewhere,
+    so chunk boundaries and segment boundaries interleave."""
+    c = _run_equality(3, {"HVD_RING_CHUNK_BYTES": "128"})
+    assert c["ring_subchunk_steps"] > 0, c
+
+
+# --- heavyweight: np=4 busbw sweep (tier 2) ---------------------------------
+
+@pytest.mark.tier2
+@pytest.mark.slow
+def test_wire_bench_np4_sweep():
+    """np=4 sweep through the bench_wire harness: sane busbw numbers,
+    byte accounting engaged, and the equality matrix at the widest
+    world the fast tier skips."""
+    import bench_wire
+
+    # Explicit small chunk: at np=4 the largest per-rank ring step here
+    # is 4 MiB / 4 = 1 MiB, exactly the default HVD_RING_CHUNK_BYTES —
+    # steps that fit in one chunk run serial, so the default would
+    # never engage the pipeline this test asserts on.
+    payload = bench_wire.run_sweep(4, "65536,1048576,4194304", iters=3,
+                                   warmup=1, chunk_bytes=262144,
+                                   timeout=420)
+    assert payload["np"] == 4
+    for size, row in payload["results"].items():
+        assert row["median_sec"] > 0
+        assert row["busbw_gbps"] > 0
+    assert payload["counters"]["tx_bytes"] > 0
+    assert payload["counters"]["ring_subchunk_steps"] > 0
+    _run_equality(4, {"HVD_RING_CHUNK_BYTES": "4096"})

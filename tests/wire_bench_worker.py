@@ -1,0 +1,112 @@
+"""Jax-free native wire microbenchmark worker (docs/wire.md).
+
+Launched np-at-a-time by ``bench_wire.py`` (or the tier-2 smoke in
+tests/test_wire.py) with the usual launcher env set. Talks to the
+native core through ``horovod_tpu.core.session`` directly, with the
+stub-parent-package trick keeping jax out of the import graph — the
+point of this harness is to measure the TCP data plane without the
+jax-drift-broken ``bench_scaling.py`` path (and without jax's import
+cost skewing small runs).
+
+Sweep: allreduce (Sum, float32) over the payload sizes in
+``HVD_WIRE_BENCH_SIZES`` (comma-separated bytes), timed per iteration
+after a warmup. Rank 0 emits one ``WIRE_BENCH_JSON {...}`` line with
+per-size median seconds and ring busbw (2*(n-1)/n * bytes / sec, the
+standard allreduce bus-bandwidth convention) plus the core's wire
+counters, so harnesses can assert byte accounting and pipelining
+engagement.
+"""
+
+import json
+import os
+import sys
+import time
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Stub parent package: submodule imports below resolve against the real
+# source tree without executing horovod_tpu/__init__.py (jax-free).
+_pkg = types.ModuleType("horovod_tpu")
+_pkg.__path__ = [os.path.join(_REPO, "horovod_tpu")]
+sys.modules["horovod_tpu"] = _pkg
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.core.session import (  # noqa: E402
+    OP_ALLREDUCE,
+    CoreSession,
+    _Group,
+)
+
+DEFAULT_SIZES = "65536,1048576,8388608,67108864"  # 64 KB -> 64 MB
+
+
+def _allreduce(session, name, arr):
+    group = _Group(1)
+    session.submit(OP_ALLREDUCE, name, arr, group=group, index=0,
+                   op=1)  # Sum
+    return group.future.result(timeout=300)[0]
+
+
+def main():
+    assert "jax" not in sys.modules, "wire bench worker must stay jax-free"
+    topo = types.SimpleNamespace(
+        rank=int(os.environ["HOROVOD_RANK"]),
+        size=int(os.environ["HOROVOD_SIZE"]))
+    sizes = [int(s) for s in
+             os.environ.get("HVD_WIRE_BENCH_SIZES", DEFAULT_SIZES).split(",")
+             if s.strip()]
+    iters = int(os.environ.get("HVD_WIRE_BENCH_ITERS", "10"))
+    warmup = int(os.environ.get("HVD_WIRE_BENCH_WARMUP", "2"))
+
+    session = CoreSession.start(topo)
+    n = topo.size
+    results = {}
+    for size in sizes:
+        count = max(1, size // 4)
+        arr = np.ones(count, np.float32)
+        name = "wb.%d" % size
+        for _ in range(warmup):
+            _allreduce(session, name, arr)
+        secs = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = _allreduce(session, name, arr)
+            secs.append(time.perf_counter() - t0)
+            # Keep the correctness floor under the timer's feet: a wire
+            # path that corrupts data must never report a win.
+            assert out[0] == float(n), out[0]
+        secs.sort()
+        median = secs[len(secs) // 2]
+        bytes_moved = count * 4
+        results[str(size)] = {
+            "count": count,
+            "iters": iters,
+            "median_sec": median,
+            "min_sec": secs[0],
+            # Ring allreduce moves 2*(n-1)/n * payload per rank.
+            "busbw_gbps": (2.0 * (n - 1) / n) * bytes_moved / median / 1e9,
+            "algbw_gbps": bytes_moved / median / 1e9,
+        }
+    counters = session.counters()
+    if topo.rank == 0:
+        print("WIRE_BENCH_JSON " + json.dumps({
+            "np": n,
+            "ring_chunk_bytes": os.environ.get("HVD_RING_CHUNK_BYTES", ""),
+            "wire_sg": os.environ.get("HVD_WIRE_SG", ""),
+            "results": results,
+            # .get-tolerant so the same worker runs against a pre-wire
+            # build during interleaved A/B trials (no wire counters
+            # there).
+            "counters": {k: counters[k] for k in
+                         ("tx_bytes", "rx_bytes", "ring_subchunk_steps",
+                          "allreduce_bytes") if k in counters},
+        }))
+    session.shutdown()
+    print("WIRE_BENCH_OK rank %d" % topo.rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

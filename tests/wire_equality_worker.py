@@ -1,0 +1,125 @@
+"""Jax-free equality worker for the wire-path schedule tests.
+
+Launched np-at-a-time by tests/test_wire.py under different wire
+schedules (pipelined chunked ring, serial legacy ring, scatter-gather
+vs pack-path fused sends — HVD_RING_CHUNK_BYTES / HVD_WIRE_SG are set
+by the test): every schedule must produce bit-identical collective
+results. The matrix deliberately hits the chunk-math boundaries —
+``count % n != 0``, counts smaller than the world, counts that split
+into many sub-chunks under a tiny HVD_RING_CHUNK_BYTES — across all
+wire dtypes and the non-commutative-ish ops (min/max/product), plus a
+grouped (fused) submission so the segment-list path carries multiple
+tensors per frame.
+
+Rank 0 prints one ``WIRE_EQ_COUNTERS {...}`` line so the test can
+assert whether the pipelined schedule actually engaged (sub-chunk
+steps > 0) or stayed serial (== 0).
+"""
+
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Stub parent package: submodule imports below resolve against the real
+# source tree without executing horovod_tpu/__init__.py (jax-free).
+_pkg = types.ModuleType("horovod_tpu")
+_pkg.__path__ = [os.path.join(_REPO, "horovod_tpu")]
+sys.modules["horovod_tpu"] = _pkg
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.core.session import (  # noqa: E402
+    OP_ALLREDUCE,
+    CoreSession,
+    _Group,
+)
+
+OP_SUM, OP_MIN, OP_MAX, OP_PRODUCT = 1, 3, 4, 5
+
+# count % n boundaries for every np this worker runs at (2, 3, 4):
+# smaller than the world, one extra element, balanced, large + ragged.
+COUNTS = [1, 3, 7, 64, 1000, 4099]
+
+
+def _allreduce(session, name, arr, op=OP_SUM):
+    group = _Group(1)
+    session.submit(OP_ALLREDUCE, name, arr, group=group, index=0, op=op)
+    return group.future.result(timeout=120)[0]
+
+
+def _make(count, dtype, rank):
+    # Rank-dependent but locally recomputable for any rank.
+    base = (np.arange(count) % 7 + 1 + rank).astype(np.float64)
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        return base.astype(ml_dtypes.bfloat16)
+    return base.astype(dtype)
+
+
+def main():
+    assert "jax" not in sys.modules, "wire equality worker must stay jax-free"
+    topo = types.SimpleNamespace(
+        rank=int(os.environ["HOROVOD_RANK"]),
+        size=int(os.environ["HOROVOD_SIZE"]))
+    session = CoreSession.start(topo)
+    r, n = topo.rank, topo.size
+
+    # --- dtype x count matrix, Sum ---------------------------------------
+    for dtype in ("float32", "float64", "float16", "bfloat16",
+                  "int32", "int64", "int8", "uint8"):
+        for count in COUNTS:
+            if dtype in ("float16", "bfloat16", "int8", "uint8") \
+                    and count > 64:
+                continue  # keep low-precision sums exact and runs fast
+            mine = _make(count, dtype, r)
+            expect = sum(_make(count, dtype, k).astype(np.float64)
+                         for k in range(n))
+            out = _allreduce(session, "eq.%s.%d" % (dtype, count), mine)
+            np.testing.assert_allclose(
+                np.asarray(out).astype(np.float64), expect, rtol=1e-2
+                if dtype in ("float16", "bfloat16") else 1e-12)
+
+    # --- min / max / product on a ragged count ---------------------------
+    xi = (np.arange(4099) % 11 + 1 + r).astype(np.int32)
+    allv = np.stack([(np.arange(4099) % 11 + 1 + k) for k in range(n)])
+    np.testing.assert_array_equal(
+        _allreduce(session, "eq.min", xi, OP_MIN), allv.min(axis=0))
+    np.testing.assert_array_equal(
+        _allreduce(session, "eq.max", xi, OP_MAX), allv.max(axis=0))
+    np.testing.assert_array_equal(
+        _allreduce(session, "eq.prod", np.full(33, 2, np.int64),
+                   OP_PRODUCT), np.full(33, 2 ** n, np.int64))
+
+    # --- grouped (fused) submission: the segment-list wire path ----------
+    # Ragged sizes so segment boundaries never line up with chunk
+    # boundaries; all submitted before one cycle, so they fuse.
+    sizes = [129, 1, 2047, 513]
+    for round_ in range(3):
+        group = _Group(len(sizes))
+        arrs = [np.full(sz, float(i + 1 + r + round_), np.float32)
+                for i, sz in enumerate(sizes)]
+        for i, a in enumerate(arrs):
+            session.submit(OP_ALLREDUCE, "eq.fused.%d.%d" % (round_, i), a,
+                           group=group, index=i, op=OP_SUM)
+        outs = group.future.result(timeout=120)
+        for i, out in enumerate(outs):
+            expect = sum(float(i + 1 + k + round_) for k in range(n))
+            np.testing.assert_allclose(out, np.full(sizes[i], expect))
+
+    counters = session.counters()
+    if r == 0:
+        print("WIRE_EQ_COUNTERS " + json.dumps(
+            {k: counters[k] for k in ("tx_bytes", "rx_bytes",
+                                      "ring_subchunk_steps",
+                                      "fused_tensors")}))
+    session.shutdown()
+    print("WIRE_EQ_OK rank %d" % r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,12 @@ DEFAULT_ALLOWLIST: Dict[str, str] = {
     "HVD_CI_ANALYSIS_BUDGET": "ci/run_tests.sh lane budget",
     # Test-suite internals (set and read only by tests/).
     "HVD_FUZZ_SEED": "tests/fuzz_worker.py reproducibility seed",
+    "HVD_WIRE_BENCH_SIZES": "tests/wire_bench_worker.py payload sweep "
+                            "(set by the bench_wire.py harness)",
+    "HVD_WIRE_BENCH_ITERS": "tests/wire_bench_worker.py timed "
+                            "iterations per payload size",
+    "HVD_WIRE_BENCH_WARMUP": "tests/wire_bench_worker.py warmup "
+                             "iterations per payload size",
     "HVD_KERAS_SWEEP_TMP": "tests/keras_sweep_worker.py scratch dir",
     "HVD_TEST_CKPT_DIR": "tests/ckpt_worker.py scratch dir",
     "HVD_TL_DIR": "tests/timeline_worker.py scratch dir",

@@ -290,6 +290,36 @@ def _bench_transformer(args, platform, device_kind, long_context=False,
     }
 
 
+def _perf_config():
+    """In-graph perf knobs + tuner state, embedded in the result JSON.
+
+    The opportunistic TPU capture is the only silicon datapoint a round
+    gets; recording the exact bucket/tile configuration it measured is
+    what lets the next round prove (or falsify) an MFU delta instead of
+    comparing apples to unknown fruit (docs/mfu.md).
+    """
+    from horovod_tpu.jax.optimizer import grad_bucket_bytes
+    from horovod_tpu.ops import block_tuner
+    from horovod_tpu.utils import metrics
+
+    snap = metrics.REGISTRY.snapshot()
+
+    def _total(family):
+        fam = snap.get(family) or {}
+        return sum(v.get("value", 0) for v in fam.get("values", []))
+
+    return {
+        "grad_bucket_bytes": grad_bucket_bytes(),
+        "flash_tune_mode": block_tuner.tune_mode() or "off",
+        "flash_block_q_env": os.environ.get("HVD_FLASH_BLOCK_Q"),
+        "flash_block_k_env": os.environ.get("HVD_FLASH_BLOCK_K"),
+        "flash_tuned": block_tuner.tuned_snapshot(),
+        "hvd_grad_buckets_total": _total("hvd_grad_buckets_total"),
+        "hvd_flash_tuner_trials_total": _total(
+            "hvd_flash_tuner_trials_total"),
+    }
+
+
 def run_child(args) -> int:
     import jax
 
@@ -341,6 +371,7 @@ def run_child(args) -> int:
     headline = dict(entries[0])
     if len(entries) > 1:
         headline["entries"] = entries
+    headline["perf_config"] = _perf_config()
     print(json.dumps(headline))
     return 0
 
@@ -485,7 +516,22 @@ def main():
                    default=int(os.environ.get("HVD_BENCH_TIMEOUT", "600")),
                    help="Hard wall-clock budget for the accelerator "
                         "child process.")
+    p.add_argument("--tune-flash", action="store_true",
+                   help="Export HVD_FLASH_TUNE=1 to the benchmark "
+                        "child: flash-attention workloads autotune "
+                        "their VMEM tiles on first call and journal "
+                        "the winners (docs/mfu.md).")
+    p.add_argument("--grad-bucket-bytes", type=int, default=None,
+                   help="Export HVD_GRAD_BUCKET_BYTES to the child "
+                        "(0 = legacy single whole-pytree psum; "
+                        "default: the optimizer's 4 MiB buckets).")
     args = p.parse_args()
+    # Perf-knob flags are plain env exports so the supervised child
+    # (and its CPU fallback) inherit them without plumbing.
+    if args.tune_flash:
+        os.environ["HVD_FLASH_TUNE"] = "1"
+    if args.grad_bucket_bytes is not None:
+        os.environ["HVD_GRAD_BUCKET_BYTES"] = str(args.grad_bucket_bytes)
     # iters=0 would divide by zero; negative warmup is meaningless.
     args.iters = max(args.iters, 1)
     args.warmup = max(args.warmup, 0)

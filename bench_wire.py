@@ -15,6 +15,20 @@ Examples:
     python bench_wire.py --chunk-bytes 0             # serial fallback
     python bench_wire.py --sg 0                      # pack-path fused
     python bench_wire.py --out wire.json             # machine-readable
+    python bench_wire.py --null-ab --trials 5        # A/A slot bias
+    python bench_wire.py --ab chunk_bytes=0          # A/B with bias gate
+
+A/B discipline (docs/benchmarks.md): this box has ~2x run-to-run
+swings AND a paired-slot bias — an A/A null test (identical config in
+both slots of each trial) has measured the second slot up to 22%
+slower at >= 8 MB payloads. ``--null-ab`` measures that bias;
+``--ab KEY=VAL[,KEY=VAL]`` runs interleaved A/B trials (B applies the
+overrides) and ALWAYS runs the null test alongside, printing each
+size's delta next to the observed bias ratio and verdicting it
+``within_slot_bias`` unless the delta exceeds the null spread. A
+config that wins from the disadvantaged slot is a real win; anything
+smaller than the bias is noise, now enforced by the tool instead of a
+memory note.
 
 Exit code 0 and one JSON document on stdout (and in --out when given).
 """
@@ -94,6 +108,77 @@ def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
                        % outputs[0])
 
 
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2]
+
+
+def _busbw_by_size(payload):
+    return {size: res["busbw_gbps"]
+            for size, res in payload["results"].items()}
+
+
+def _parse_overrides(spec):
+    """``--ab chunk_bytes=0,sg=1`` -> kwargs for ``run_sweep``."""
+    allowed = {"chunk_bytes": int, "sg": int}
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit("--ab expects KEY=VAL, got %r" % part)
+        key, val = part.split("=", 1)
+        key = key.strip()
+        if key not in allowed:
+            raise SystemExit("--ab key %r not supported (use %s)"
+                             % (key, "/".join(sorted(allowed))))
+        out[key] = allowed[key](val)
+    if not out:
+        raise SystemExit("--ab needs at least one KEY=VAL override")
+    return out
+
+
+def run_paired_trials(args, b_overrides=None):
+    """Interleaved slot-paired trials: each trial runs slot A then
+    slot B back-to-back. Identical configs (``b_overrides=None``)
+    measure the box's slot bias (the A/A null test); with overrides the
+    same pairing measures the A/B delta *on top of* that bias.
+
+    Returns {size: {"ratios": [B/A busbw per trial], "median_ratio"}}.
+    """
+    base = dict(chunk_bytes=args.chunk_bytes, sg=args.sg)
+    b_cfg = dict(base)
+    if b_overrides:
+        b_cfg.update(b_overrides)
+    per_size = {}
+    for trial in range(args.trials):
+        a = run_sweep(args.np_, args.sizes, args.iters, args.warmup,
+                      timeout=args.timeout, **base)
+        b = run_sweep(args.np_, args.sizes, args.iters, args.warmup,
+                      timeout=args.timeout, **b_cfg)
+        bw_a, bw_b = _busbw_by_size(a), _busbw_by_size(b)
+        for size in bw_a:
+            if size in bw_b:
+                per_size.setdefault(size, []).append(
+                    bw_b[size] / bw_a[size])
+        print("# trial %d/%d done" % (trial + 1, args.trials),
+              file=sys.stderr)
+    return {size: {"ratios": ratios,
+                   "median_ratio": round(_median(ratios), 4)}
+            for size, ratios in per_size.items()}
+
+
+def _verdict(ab_ratio, null_ratios):
+    """Significant only when the A/B ratio clears the WHOLE observed
+    null spread (plus the null's own median bias direction): a delta
+    inside the band an identical config produced is slot bias."""
+    lo, hi = min(null_ratios), max(null_ratios)
+    if lo <= ab_ratio <= hi:
+        return "within_slot_bias"
+    return "faster" if ab_ratio > hi else "slower"
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--np", type=int, default=2, dest="np_")
@@ -109,11 +194,68 @@ def main(argv=None):
                     help="HVD_WIRE_SG for the workers")
     ap.add_argument("--timeout", type=int, default=600)
     ap.add_argument("--out", default=None, help="also write JSON here")
+    ap.add_argument("--null-ab", action="store_true",
+                    help="run the A/A slot-bias null test: --trials "
+                         "paired sweeps with IDENTICAL config in both "
+                         "slots; reports the per-size bias ratio an "
+                         "honest A/B delta must exceed")
+    ap.add_argument("--ab", default=None, metavar="KEY=VAL[,KEY=VAL]",
+                    help="interleaved A/B trials: slot B applies the "
+                         "overrides (chunk_bytes=..., sg=...). The A/A "
+                         "null test runs alongside automatically and "
+                         "gates each delta's verdict")
+    ap.add_argument("--trials", type=int, default=5,
+                    help="paired trials for --null-ab/--ab (default 5)")
     args = ap.parse_args(argv)
 
-    payload = run_sweep(args.np_, args.sizes, args.iters, args.warmup,
-                        chunk_bytes=args.chunk_bytes, sg=args.sg,
-                        timeout=args.timeout)
+    if args.ab:
+        overrides = _parse_overrides(args.ab)
+        print("# null A/A trials (slot-bias gate)...", file=sys.stderr)
+        null = run_paired_trials(args)
+        print("# A/B trials (B: %s)..." % args.ab, file=sys.stderr)
+        ab = run_paired_trials(args, overrides)
+        sizes = sorted(set(null) & set(ab), key=int)
+        payload = {
+            "mode": "ab",
+            "np": args.np_,
+            "trials": args.trials,
+            "b_overrides": overrides,
+            "per_size": {
+                s: {
+                    "ab_median_ratio": ab[s]["median_ratio"],
+                    "null_bias_median_ratio": null[s]["median_ratio"],
+                    "null_bias_spread": [round(min(null[s]["ratios"]), 4),
+                                         round(max(null[s]["ratios"]), 4)],
+                    "verdict": _verdict(ab[s]["median_ratio"],
+                                        null[s]["ratios"]),
+                } for s in sizes
+            },
+        }
+        for s in sizes:
+            row = payload["per_size"][s]
+            print("# %10s B/A %.3f | null bias %.3f (spread %.3f-%.3f)"
+                  " -> %s" % (s, row["ab_median_ratio"],
+                              row["null_bias_median_ratio"],
+                              row["null_bias_spread"][0],
+                              row["null_bias_spread"][1],
+                              row["verdict"]), file=sys.stderr)
+    elif args.null_ab:
+        payload = {
+            "mode": "null_ab",
+            "np": args.np_,
+            "trials": args.trials,
+            "per_size": run_paired_trials(args),
+        }
+        for s, row in sorted(payload["per_size"].items(), key=lambda kv:
+                             int(kv[0])):
+            print("# %10s A/A slot ratio median %.3f (trials: %s)"
+                  % (s, row["median_ratio"],
+                     " ".join("%.3f" % r for r in row["ratios"])),
+                  file=sys.stderr)
+    else:
+        payload = run_sweep(args.np_, args.sizes, args.iters, args.warmup,
+                            chunk_bytes=args.chunk_bytes, sg=args.sg,
+                            timeout=args.timeout)
     doc = json.dumps(payload, indent=2, sort_keys=True)
     print(doc)
     if args.out:

@@ -34,6 +34,16 @@ run_analysis() {
 # 720s) proved too thin. (Final r5 suite, 316 tests, cold cache:
 # 868.40s — holds.)
 run_tier1() {
+    echo "=== tier 1: MFU fast-fail (bucketing math + block-tuner cache) ==="
+    # The bucketed gradient path and the flash-block tuner cache are
+    # pure-Python contracts (docs/mfu.md) that every in-graph training
+    # run leans on; a broken bucket assignment or a corrupted winner
+    # journal should fail in seconds, before the full tier burns its
+    # wall budget. The jax-sweep acceptance test runs here too — it is
+    # the proof the tuner actually picks winners on this host.
+    timeout "${HVD_CI_MFU_BUDGET:-240}" \
+        python -m pytest tests/test_bucketing.py tests/test_block_tuner.py \
+        -q -p no:cacheprovider
     echo "=== tier 1: metrics subsystem fast-fail ==="
     # The metrics registry underpins scrape-based dashboards and the
     # /metrics route every runner HTTP server exposes; if it is broken,

@@ -14,6 +14,8 @@ import argparse
 import os
 import tempfile
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,7 +31,7 @@ def make_step(tx):
         pred = x @ params["w"] + params["b"]
         return jnp.mean((pred - y) ** 2)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         updates, opt_state = tx.update(grads, opt_state, params)

@@ -7,6 +7,7 @@ Run:  python examples/jax/jax_synthetic_benchmark.py            # 1 chip
 """
 
 import argparse
+from functools import partial
 import time
 
 import jax
@@ -51,7 +52,10 @@ def main():
         op=op, compression=compression)
     opt_state = tx.init(params)
 
-    @jax.jit
+    # Donated buffers: the weight/batch-stat/optimizer arrays are
+    # updated in place by XLA rather than copied every step, the same
+    # donation bench.py uses (docs/mfu.md).
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state):
         def loss_fn(p, bs):
             logits, updates = model.apply(

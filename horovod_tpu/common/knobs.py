@@ -215,6 +215,24 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HVD_FLASH_BLOCK_K", HONORED,
          "ops/pallas_attention.py: flash-attention key/value tile "
          "size"),
+    # In-graph MFU knobs (docs/mfu.md).
+    Knob("HVD_GRAD_BUCKET_BYTES", HONORED,
+         "jax/optimizer.py: per-dtype fused gradient-allreduce bucket "
+         "payload; several independent psums overlap with backprop "
+         "(default 4 MiB; 0 = legacy single whole-pytree psum)"),
+    Knob("HVD_FLASH_TUNE", HONORED,
+         "ops/pallas_attention.py + ops/block_tuner.py: 1 = autotune "
+         "flash-attention tiles per shape on first call and journal "
+         "winners; cache = use cached winners only; unset = off"),
+    Knob("HVD_FLASH_TUNE_CACHE", HONORED,
+         "ops/block_tuner.py: tuned-winner JSONL journal path "
+         "(default ~/.cache/horovod_tpu/flash_blocks.jsonl)"),
+    Knob("HVD_FLASH_TUNE_CANDIDATES", HONORED,
+         "ops/block_tuner.py: comma list of candidate tile sizes the "
+         "sweep crosses for block_q x block_k (default 128,256,512)"),
+    Knob("HVD_FLASH_TUNE_ITERS", HONORED,
+         "ops/block_tuner.py: timed fwd+bwd iterations per candidate "
+         "after the untimed compile/warmup call (default 3)"),
     # Wire path (core/src/comm.cc + collectives.cc; docs/wire.md).
     Knob("HVD_RING_CHUNK_BYTES", HONORED,
          "core/src/comm.cc + collectives.cc: pipelined-ring sub-chunk "

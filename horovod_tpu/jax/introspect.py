@@ -24,7 +24,8 @@ all-gather).
 
 from __future__ import annotations
 
-from typing import Dict, Sequence
+import re
+from typing import Dict, List, Sequence
 
 import jax
 
@@ -79,3 +80,95 @@ def assert_in_graph_gradient_sync(
             "auto-sharding instead of shard_map over the data axis."
             % (missing, counts))
     return counts
+
+
+def assert_bucketed_gradient_sync(
+    fn, *args,
+    min_buckets: int = 2,
+    **kwargs,
+) -> Dict[str, int]:
+    """Assert the traced ``fn`` issues at least ``min_buckets``
+    *independent* reduction collectives.
+
+    This is the overlap tripwire for the bucketed gradient path
+    (docs/mfu.md): XLA's latency-hiding scheduler can only overlap a
+    bucket's collective with remaining backprop if the buckets exist as
+    separate primitives in the program. One monolithic whole-pytree
+    ``psum`` (the ``HVD_GRAD_BUCKET_BYTES=0`` legacy path) counts as a
+    single reduction no matter how many leaves it carries, so a silent
+    regression to it fails here. The bucket count is the MAX of the
+    ``psum`` and ``reduce_scatter`` totals, not their sum: one
+    hierarchical ladder traces as reduce_scatter + psum(dcn) +
+    all_gather, and summing would let a single monolithic ladder
+    masquerade as two buckets.
+    """
+    counts = collective_counts(fn, *args, **kwargs)
+    reductions = max(counts.get("psum", 0), counts.get("reduce_scatter", 0))
+    if reductions < min_buckets:
+        raise AssertionError(
+            "expected >= %d independent bucket collectives in the "
+            "traced step, found %d (%r). Gradient sync has collapsed "
+            "back to a monolithic collective — check "
+            "HVD_GRAD_BUCKET_BYTES and the optimizer's bucket path."
+            % (min_buckets, reductions, counts))
+    return counts
+
+
+# Argument attributes XLA uses to mark a donated (aliased) input
+# buffer in lowered StableHLO text; jax >= 0.4.31 may emit
+# jax.buffer_donor for donations the compiler is free to use or drop.
+_DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+_ARG_RE = re.compile(r"%arg(\d+):")
+
+
+def donated_input_indices(fn, donate_argnums, *args, **kwargs) -> List[int]:
+    """Flattened input indices whose buffers survive lowering as donated.
+
+    Lowers ``jit(fn, donate_argnums=...)`` and scans the StableHLO for
+    the ``tf.aliasing_output`` / ``jax.buffer_donor`` argument
+    attributes. Donation requested at the Python level can be silently
+    dropped by lowering (dtype/layout mismatch with every output, or a
+    platform that refuses aliasing) — XLA then materializes a fresh
+    buffer per step and only prints a warning; this makes the drop
+    checkable. Indices are over the *flattened* argument list (a pytree
+    argument contributes one entry per leaf).
+
+    The scan is segment-based, not one regex over the attribute dict:
+    sharded args carry ``mhlo.sharding = "{...}"`` whose quoted braces
+    would defeat any brace-balanced pattern. Each entry-function
+    signature line is split at its ``%argN:`` markers and a donation
+    attribute is credited to the argument whose segment contains it.
+    """
+    lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(
+        *args, **kwargs)
+    out = set()
+    for line in lowered.as_text().splitlines():
+        # Donation attrs only ever appear on func signatures; the
+        # public @main is the jit entry point.
+        if "func.func" not in line or "@main" not in line:
+            continue
+        marks = list(_ARG_RE.finditer(line))
+        for i, m in enumerate(marks):
+            end = marks[i + 1].start() if i + 1 < len(marks) else len(line)
+            seg = line[m.end():end]
+            if any(mk in seg for mk in _DONATION_MARKERS):
+                out.add(int(m.group(1)))
+    return sorted(out)
+
+
+def assert_donation_survives_lowering(
+    fn, donate_argnums, *args,
+    min_donated: int = 1,
+    **kwargs,
+) -> List[int]:
+    """Assert at least ``min_donated`` flattened inputs stay donated
+    through lowering. Returns the donated indices for logging."""
+    donated = donated_input_indices(fn, donate_argnums, *args, **kwargs)
+    if len(donated) < min_donated:
+        raise AssertionError(
+            "buffer donation did NOT survive lowering: requested "
+            "donate_argnums=%r but only %d flattened inputs carry an "
+            "aliasing attribute (expected >= %d). XLA will materialize "
+            "fresh gradient/optimizer buffers every step."
+            % (donate_argnums, len(donated), min_donated))
+    return donated

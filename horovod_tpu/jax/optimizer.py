@@ -9,9 +9,16 @@ across the mesh's data axis before the inner optimizer sees it.
 Two execution paths (SURVEY.md §7 "eager enqueue vs XLA tracing"):
 
 - **In-graph (the TPU fast path)**: when ``update`` runs under a jit trace
-  (gradients are tracers), the whole gradient pytree goes through a single
-  ``lax.psum`` — one fused collective over ICI, the moral equivalent of the
-  reference's 128 MB fusion buffer, with the fusing done by XLA.
+  (gradients are tracers), the gradient pytree is split into per-dtype
+  fused buckets of ``HVD_GRAD_BUCKET_BYTES`` each (default 4 MiB) and one
+  ``psum`` is issued per bucket, in reverse-gradient order — several
+  *independent* collectives XLA's latency-hiding scheduler can overlap
+  with the remaining backprop, the in-graph analog of the reference's
+  fusion buffer + comm/compute overlap (docs/mfu.md).
+  ``HVD_GRAD_BUCKET_BYTES=0`` restores the legacy single whole-pytree
+  ``psum`` bit-exactly. With a two-level ``(dcn, ici)`` axis and
+  ``HOROVOD_HIERARCHICAL_ALLREDUCE=1`` each bucket rides the
+  hierarchical ladder (``parallel/hierarchical.py``).
 - **Eager**: with concrete arrays and world size > 1, each leaf is
   submitted to the native core's negotiation queue exactly like the
   reference's per-gradient async enqueue (named tensors, fused by the
@@ -25,6 +32,7 @@ locally for k steps and the collective fires on the k-th.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -37,7 +45,64 @@ from horovod_tpu.common.process_sets import global_process_set
 from horovod_tpu.jax.compression import Compression
 from horovod_tpu.ops import collective_ops as C
 from horovod_tpu.ops import eager
+from horovod_tpu.parallel import bucketing
 from horovod_tpu.parallel.mesh import DATA_AXIS
+from horovod_tpu.parallel.mesh import traced_axis_size
+from horovod_tpu.utils import metrics as _metrics
+
+# Default fused-bucket payload for the in-graph gradient allreduce.
+# Smaller than the reference's 128 MB fusion threshold on purpose: the
+# point is several independent collectives the XLA scheduler can
+# overlap with backprop, not one late monolith (docs/mfu.md).
+DEFAULT_GRAD_BUCKET_BYTES = 4 * 1024 * 1024
+
+# Counted at trace time (in-graph collectives are invisible to Python
+# per step): how many fused buckets each traced train step issues.
+_M_BUCKETS = _metrics.counter(
+    "hvd_grad_buckets_total",
+    "Fused gradient-allreduce buckets issued by the in-graph bucketed "
+    "path (counted at trace time, per dtype).", ("dtype",))
+
+
+def grad_bucket_bytes() -> int:
+    """Resolved ``HVD_GRAD_BUCKET_BYTES`` (0 = legacy single psum)."""
+    return int(os.environ.get("HVD_GRAD_BUCKET_BYTES",
+                              str(DEFAULT_GRAD_BUCKET_BYTES)))
+
+
+def _bucketed_allreduce(wires, op, *, axis, process_set, bucket_bytes,
+                        prescale_factor, postscale_factor):
+    """Per-dtype byte-capped fused allreduce of a leaf list.
+
+    Each bucket is one independent collective through
+    ``C.grouped_allreduce`` (which owns the hierarchical (dcn, ici)
+    routing and its padding), issued in reverse-gradient order —
+    backprop produces the last layers'
+    gradients first, so their buckets can start reducing while the
+    early layers are still differentiating. Bit-exact with the legacy
+    grouped psum: bucketing only re-associates *which leaves share a
+    buffer*, never the per-element cross-replica reduction.
+    """
+    sizes = [w.size * jnp.dtype(w.dtype).itemsize for w in wires]
+    keys = [jnp.dtype(w.dtype).name for w in wires]
+    buckets = bucketing.assign_buckets(sizes, keys, bucket_bytes)
+    outs = [None] * len(wires)
+    for bucket in buckets:
+        leaves = [wires[i] for i in bucket.indices]
+        flat, _ = bucketing.pack_bucket(leaves)
+        _M_BUCKETS.labels(bucket.dtype_key).inc()
+        # One single-member group per bucket: grouped_allreduce owns
+        # the flat-vs-hierarchical routing (and the hierarchical
+        # path's ici padding), so this stays in lockstep with every
+        # other collective's dispatch.
+        reduced = C.grouped_allreduce(
+            [flat], op, axis=axis, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)[0]
+        for i, out in zip(bucket.indices,
+                          bucketing.unpack_bucket(reduced, leaves)):
+            outs[i] = out
+    return outs
 
 
 def _is_tracing(grads) -> bool:
@@ -57,7 +122,7 @@ def _axis_in_scope(axis) -> bool:
     host bridge (see allreduce_gradients).
     """
     try:
-        jax.lax.axis_size(axis)
+        traced_axis_size(axis)
         return True
     except NameError:
         return False
@@ -81,7 +146,9 @@ def allreduce_gradients(
 ):
     """Allreduce a gradient pytree; dispatches in-graph vs eager.
 
-    In-graph: one psum over the whole pytree (single fused collective).
+    In-graph: per-dtype fused buckets of ``HVD_GRAD_BUCKET_BYTES`` each,
+    one psum per bucket in reverse-gradient order (0 = the legacy single
+    whole-pytree psum).
     Eager: grouped submission to the native core, names derived from tree
     paths so every rank agrees on tensor identity.
     """
@@ -94,12 +161,27 @@ def allreduce_gradients(
     ctxs = [c[1] for c in compressed]
 
     if _is_tracing(wires) and _axis_in_scope(axis):
-        outs = C.grouped_allreduce(
-            wires, op,
-            axis=axis, process_set=process_set,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor,
-        )
+        bucket_bytes = grad_bucket_bytes()
+        if (bucket_bytes > 0 and len(wires) > 1
+                and op in (C.Average, C.Sum)
+                and C._is_global_set(process_set)):
+            outs = _bucketed_allreduce(
+                wires, op, axis=axis, process_set=process_set,
+                bucket_bytes=bucket_bytes,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+        else:
+            # Legacy path (HVD_GRAD_BUCKET_BYTES=0), non-fusable ops
+            # (Min/Max/Product/Adasum), restricted process sets, and
+            # single-leaf trees: one grouped collective, bit-exact with
+            # the pre-bucketing behavior.
+            outs = C.grouped_allreduce(
+                wires, op,
+                axis=axis, process_set=process_set,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
     elif (_is_tracing(wires) and basics.is_initialized()
           and basics.size() > 1 and jax.process_count() == 1):
         # Plain jit in a MULTI-PROCESS job (one chip per process, the

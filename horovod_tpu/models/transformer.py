@@ -27,13 +27,14 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 from flax.linen import partitioning as nn_partitioning
+from horovod_tpu.parallel.mesh import traced_axis_size
 
 param_with_axes = nn.with_partitioning
 
 
 def _axis_bound(axis) -> bool:
     try:
-        jax.lax.axis_size(axis)
+        traced_axis_size(axis)
         return True
     except NameError:
         return False

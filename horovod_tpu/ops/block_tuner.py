@@ -1,0 +1,293 @@
+"""Flash-attention VMEM block-size autotuner with a journaled cache.
+
+``HVD_FLASH_BLOCK_Q/K`` existed since the kernel landed, but nothing
+searched them: every job ran the v5e-seq2048 sweep winner (256/512)
+regardless of its own (seq, head_dim, dtype, causal) shape or chip
+generation (ROADMAP open item #3). This module closes that loop:
+
+- ``best_blocks(...)``: consult a persistent cache keyed by
+  shape + device; on a miss (and when tuning is allowed) run an
+  on-first-call sweep over candidate (block_q, block_k) pairs on
+  synthetic data of the live shape, timing one fwd+bwd step each, and
+  journal the winner.
+- The cache is an append-only JSONL file written with the PR 5 driver-
+  journal discipline (O_APPEND single-line writes + fsync, readers fold
+  records last-wins and skip torn/garbage lines), so concurrent
+  workers tuning the same shape can never corrupt it — they at worst
+  both measure and the later record wins.
+
+Enable with ``HVD_FLASH_TUNE=1`` (tune on miss) or
+``HVD_FLASH_TUNE=cache`` (use cached winners only, never measure —
+for fleets where one tuning job warms the cache and serving jobs just
+read it). Explicit ``HVD_FLASH_BLOCK_Q/K`` env overrides and explicit
+``block_q=/block_k=`` arguments always win over the tuner
+(docs/mfu.md has the full precedence table and a walkthrough).
+
+SPMD caveat: winners are timing-derived, so two processes cold-tuning
+the same shape concurrently can pick DIFFERENT tiles — and divergent
+tile choices lower to divergent programs across ranks of one jitted
+step, which desyncs its collectives. Multi-host jobs must warm the
+cache first (one process, or rank 0 before the others trace) and run
+with ``HVD_FLASH_TUNE=cache``; ``=1`` is for single-process tuning
+and benches.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger("horovod_tpu")
+
+CACHE_VERSION = 1
+
+# One trial = one timed (block_q, block_k) candidate for one shape key.
+_M_TRIALS = _metrics.counter(
+    "hvd_flash_tuner_trials_total",
+    "Flash-attention block-size candidates timed by the autotuner "
+    "(one per (block_q, block_k) pair per tuned shape).")
+
+DEFAULT_CANDIDATES = (128, 256, 512)
+DEFAULT_ITERS = 3
+
+# Process-local fold of the cache file plus winners tuned this
+# process; avoids re-reading the JSONL on every traced call site.
+_mem_cache: Dict[str, Dict] = {}
+_mem_cache_path: Optional[str] = None
+
+
+def tune_mode() -> str:
+    """Resolved ``HVD_FLASH_TUNE``: '' (off), '1' (tune on miss) or
+    'cache' (cached winners only)."""
+    mode = os.environ.get("HVD_FLASH_TUNE", "").strip().lower()
+    if mode in ("", "0", "off", "false"):
+        return ""
+    if mode == "cache":
+        return "cache"
+    return "1"
+
+
+def cache_path() -> str:
+    """``HVD_FLASH_TUNE_CACHE`` or ``~/.cache/horovod_tpu/``."""
+    path = os.environ.get("HVD_FLASH_TUNE_CACHE", "")
+    if path:
+        return path
+    return os.path.join(os.path.expanduser("~"), ".cache", "horovod_tpu",
+                        "flash_blocks.jsonl")
+
+
+def shape_key(seq_q: int, seq_kv: int, head_dim: int, dtype, causal: bool,
+              device_kind: str) -> str:
+    """Cache key for one attention shape on one chip generation.
+
+    Batch and head count are deliberately absent: they scale the grid,
+    not the per-block VMEM working set the tile sizes trade off.
+    """
+    return "q%d.kv%d.d%d.%s.%s.%s" % (
+        seq_q, seq_kv, head_dim, str(dtype),
+        "causal" if causal else "full",
+        str(device_kind).replace(" ", "_"))
+
+
+def load_cache(path: Optional[str] = None) -> Dict[str, Dict]:
+    """Fold the JSONL journal into {key: winner-record}, last wins.
+
+    Torn tails and garbage lines are skipped, not fatal — the same
+    tolerance the PR 5 driver journal replay has; a cache that cannot
+    be parsed at all is just an empty cache.
+    """
+    path = path or cache_path()
+    out: Dict[str, Dict] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (isinstance(rec, dict)
+                        and rec.get("version") == CACHE_VERSION
+                        and isinstance(rec.get("key"), str)
+                        and isinstance(rec.get("block_q"), int)
+                        and isinstance(rec.get("block_k"), int)):
+                    out[rec["key"]] = rec
+    except OSError:
+        pass
+    return out
+
+
+def append_record(rec: Dict, path: Optional[str] = None) -> None:
+    """Journal one winner: O_APPEND single-line write + fsync.
+
+    POSIX appends of one small line are atomic with respect to other
+    appenders, so concurrent tuning processes interleave whole records
+    instead of corrupting each other; ``load_cache`` takes the last
+    record per key.
+    """
+    path = path or cache_path()
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    line = json.dumps(rec, sort_keys=True) + "\n"
+    # Torn-tail guard (the PR 5 attach lesson): a writer that died
+    # mid-append leaves a partial line; appending straight after it
+    # would weld this record onto the fragment and lose BOTH. Lead
+    # with a newline instead — the fragment stays its own (skipped)
+    # line and this record parses.
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            if fh.tell():
+                fh.seek(-1, os.SEEK_END)
+                if fh.read(1) != b"\n":
+                    line = "\n" + line
+    except OSError:
+        pass
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode("utf-8"))
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _cached(key: str, path: str) -> Optional[Dict]:
+    global _mem_cache, _mem_cache_path
+    if _mem_cache_path != path:
+        _mem_cache = load_cache(path)
+        _mem_cache_path = path
+    return _mem_cache.get(key)
+
+
+def candidate_pairs(seq_q: int, seq_kv: int,
+                    candidates=None) -> List[Tuple[int, int]]:
+    """(block_q, block_k) sweep grid, clamped to the sequence lengths
+    and deduplicated (a 64-long sequence turns 128/256/512 into one
+    candidate, not three)."""
+    if candidates is None:
+        raw = os.environ.get("HVD_FLASH_TUNE_CANDIDATES", "")
+        candidates = [int(c) for c in raw.split(",") if c.strip()] or \
+            list(DEFAULT_CANDIDATES)
+    qs = sorted({min(c, max(seq_q, 1)) for c in candidates})
+    ks = sorted({min(c, max(seq_kv, 1)) for c in candidates})
+    return [(bq, bk) for bq in qs for bk in ks]
+
+
+def tune(seq_q: int, seq_kv: int, head_dim: int, dtype, causal: bool,
+         *, candidates=None, iters: Optional[int] = None,
+         batch: int = 1, heads: int = 1,
+         interpret: Optional[bool] = None,
+         time_fn=None) -> Tuple[int, int]:
+    """Sweep candidate tiles for one shape; return the winning pair.
+
+    Times one jitted fwd+bwd step per candidate on synthetic inputs of
+    the live shape (compile excluded: one untimed warmup call per
+    candidate). ``time_fn(block_q, block_k) -> seconds`` is injectable
+    for unit tests. The winner is journaled to the cache.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.ops.pallas_attention import flash_attention
+
+    if iters is None:
+        iters = int(os.environ.get("HVD_FLASH_TUNE_ITERS",
+                                   str(DEFAULT_ITERS)))
+    pairs = candidate_pairs(seq_q, seq_kv, candidates)
+
+    if time_fn is None:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(batch, seq_q, heads, head_dim), dtype)
+        k = jnp.asarray(rng.randn(batch, seq_kv, heads, head_dim), dtype)
+        v = jnp.asarray(rng.randn(batch, seq_kv, heads, head_dim), dtype)
+
+        def time_fn(bq, bk):
+            def loss(q, k, v):
+                return flash_attention(
+                    q, k, v, causal=causal, block_q=bq, block_k=bk,
+                    interpret=interpret).astype(jnp.float32).sum()
+
+            step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            jax.block_until_ready(step(q, k, v))  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(max(iters, 1)):
+                out = step(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / max(iters, 1)
+
+    results = []
+    for bq, bk in pairs:
+        _M_TRIALS.inc()
+        try:
+            dt = time_fn(bq, bk)
+        except Exception as e:  # analysis: allow-broad-except — a
+            # candidate that fails to compile (VMEM overflow on a big
+            # tile) is a losing candidate, not a tuning failure.
+            logger.debug("flash tuner: bq=%d bk=%d failed: %s", bq, bk, e)
+            continue
+        results.append((dt, bq, bk))
+    if not results:
+        raise RuntimeError(
+            "flash block tuner: every candidate failed for shape "
+            "q=%d kv=%d d=%d %s" % (seq_q, seq_kv, head_dim, dtype))
+    results.sort()
+    dt, bq, bk = results[0]
+    key = shape_key(seq_q, seq_kv, head_dim, dtype, causal,
+                    _device_kind())
+    rec = {"version": CACHE_VERSION, "key": key, "block_q": bq,
+           "block_k": bk, "ms_per_step": round(dt * 1e3, 4),
+           "trials": len(results), "iters": iters}
+    append_record(rec)
+    _mem_cache[key] = rec
+    logger.info("flash tuner: %s -> block_q=%d block_k=%d (%.3f ms)",
+                key, bq, bk, dt * 1e3)
+    return bq, bk
+
+
+def _device_kind() -> str:
+    import jax
+
+    try:
+        d = jax.devices()[0]
+        return "%s-%s" % (d.platform, d.device_kind)
+    except Exception:  # analysis: allow-broad-except — no backend is
+        # a legitimate state for cache math in unit tests.
+        return "unknown"
+
+
+def best_blocks(seq_q: int, seq_kv: int, head_dim: int, dtype,
+                causal: bool, *,
+                interpret: Optional[bool] = None,
+                batch: int = 1, heads: int = 1
+                ) -> Optional[Tuple[int, int]]:
+    """Tuned (block_q, block_k) for the live shape, or None.
+
+    Cache hit wins; on a miss, ``HVD_FLASH_TUNE=1`` measures and
+    journals (on-first-call tuning — the sweep runs once per shape per
+    cache lifetime), ``HVD_FLASH_TUNE=cache`` returns None so the
+    caller keeps its defaults.
+    """
+    mode = tune_mode()
+    if not mode:
+        return None
+    path = cache_path()
+    key = shape_key(seq_q, seq_kv, head_dim, dtype, causal,
+                    _device_kind())
+    hit = _cached(key, path)
+    if hit is not None:
+        return hit["block_q"], hit["block_k"]
+    if mode == "cache":
+        return None
+    return tune(seq_q, seq_kv, head_dim, dtype, causal,
+                interpret=interpret, batch=batch, heads=heads)
+
+
+def tuned_snapshot() -> Dict[str, Dict]:
+    """Folded cache view for benchmarks/diagnostics (bench.py embeds
+    this in its JSON result so a TPU capture records which tiles ran)."""
+    return dict(load_cache())

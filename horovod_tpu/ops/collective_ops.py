@@ -37,6 +37,7 @@ def _env_flag(name: str) -> bool:
     return os.environ.get(name, "") not in ("", "0")
 
 from horovod_tpu.parallel.mesh import DATA_AXIS
+from horovod_tpu.parallel.mesh import traced_axis_size
 from horovod_tpu.utils import metrics as _metrics
 
 # In-graph collectives execute inside the jitted program where Python
@@ -96,7 +97,7 @@ def _groups_for(process_set, axis_size: int):
 
 
 def _axis_size(axis) -> int:
-    return lax.axis_size(axis)
+    return traced_axis_size(axis)
 
 
 def _apply_prescale(x, prescale_factor):

@@ -389,8 +389,11 @@ def flash_attention(q, k, v, *, causal: bool = True,
       block_q / block_k: VMEM tile sizes (clamped and made to divide the
         padded sequence length). Defaults 256/512 (best of the v5e
         sweep at seq 2048, ci/flash_block_sweep.py); overridable
-        per-job via HVD_FLASH_BLOCK_Q / HVD_FLASH_BLOCK_K for tuning
-        on other chip generations without a code change.
+        per-job via HVD_FLASH_BLOCK_Q / HVD_FLASH_BLOCK_K, or
+        autotuned per (seq, head_dim, dtype, causal) shape with
+        HVD_FLASH_TUNE=1 (ops/block_tuner.py caches winners across
+        processes; docs/mfu.md). Precedence: explicit argument >
+        HVD_FLASH_BLOCK_Q/K env > tuned cache > default.
       scale: score scaling; defaults to 1/sqrt(head_dim).
       interpret: force Pallas interpret mode (defaults to True off-TPU).
 
@@ -403,6 +406,21 @@ def flash_attention(q, k, v, *, causal: bool = True,
     d = q.shape[-1]
     if scale is None:
         scale = float(d) ** -0.5
+    if block_q is None and block_k is None and \
+            "HVD_FLASH_BLOCK_Q" not in os.environ and \
+            "HVD_FLASH_BLOCK_K" not in os.environ:
+        from horovod_tpu.ops import block_tuner
+
+        if block_tuner.tune_mode():
+            # On-first-call autotuning: the sweep (or a cache hit from
+            # an earlier process) picks the tiles for this live shape.
+            # Runs at trace time on synthetic same-shape inputs, so a
+            # jitted caller tunes exactly once per shape.
+            picked = block_tuner.best_blocks(
+                q.shape[1], k.shape[1], d, q.dtype, causal,
+                interpret=interpret)
+            if picked is not None:
+                block_q, block_k = picked
     if block_q is None:
         block_q = int(os.environ.get("HVD_FLASH_BLOCK_Q", "256"))
     if block_k is None:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.parallel.mesh import DATA_AXIS
+from horovod_tpu.parallel.mesh import traced_axis_size
 
 
 def adasum_pair(a, b, eps=1e-30):
@@ -60,7 +61,7 @@ def adasum_allreduce(x, *, axis=DATA_AXIS, process_set=None):
     if process_set is not None and getattr(process_set, "process_set_id", 0):
         from horovod_tpu.ops.collective_ops import _groups_for
 
-        groups = _groups_for(process_set, lax.axis_size(axis))
+        groups = _groups_for(process_set, traced_axis_size(axis))
     gathered = lax.all_gather(x, axis, axis_index_groups=groups)
     n = gathered.shape[0]
     return _tree_reduce([gathered[i] for i in range(n)])

@@ -20,6 +20,9 @@ from typing import Dict, Optional
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.parallel import bucketing
+from horovod_tpu.parallel.mesh import traced_axis_size
+
 ICI_AXIS = "data_ici"
 DCN_AXIS = "data_dcn"
 
@@ -36,8 +39,8 @@ def hierarchical_allreduce(x, *, average: bool = True, ici_axis=ICI_AXIS,
 
     Requires ``x.shape[scatter_dim]`` divisible by the ici axis size.
     """
-    ici = lax.axis_size(ici_axis)
-    dcn = lax.axis_size(dcn_axis)
+    ici = traced_axis_size(ici_axis)
+    dcn = traced_axis_size(dcn_axis)
     # 1. reduce-scatter across the fast axis: each chip owns 1/ici of the
     #    intra-slice sum.
     shard = lax.psum_scatter(x, ici_axis, scatter_dimension=scatter_dim,
@@ -66,26 +69,28 @@ def grouped_hierarchical_allreduce(xs, *, average: bool = True,
     buffer, and slice the results back out. XLA keeps the pack/unpack
     as on-chip reshapes, so the fused form costs one collective ladder
     per dtype instead of one per tensor.
+
+    Buffers are strictly per-dtype (``parallel.bucketing`` owns the
+    assignment, shared with the optimizer's byte-capped bucket path —
+    which feeds single-buffer groups through here, so the two fused
+    paths cannot drift on dtype handling): mixing a bf16 majority into
+    an fp32 buffer would upcast it and double its bytes on the wire.
     """
-    xs = list(xs)
-    ici = lax.axis_size(ici_axis)
+    xs = [jnp.asarray(x) for x in xs]
+    ici = traced_axis_size(ici_axis)
     out = [None] * len(xs)
-    by_dtype: Dict = {}
-    for i, x in enumerate(xs):
-        by_dtype.setdefault(jnp.asarray(x).dtype, []).append(i)
-    for dt, idxs in by_dtype.items():
-        flat = jnp.concatenate(
-            [jnp.ravel(jnp.asarray(xs[i])) for i in idxs])
-        pad = (-flat.size) % ici
-        if pad:
-            flat = jnp.pad(flat, (0, pad))
+    buckets = bucketing.assign_buckets(
+        [x.size * jnp.dtype(x.dtype).itemsize for x in xs],
+        [jnp.dtype(x.dtype).name for x in xs],
+        0, reverse=False)
+    for bucket in buckets:
+        leaves = [xs[i] for i in bucket.indices]
+        flat, _ = bucketing.pack_bucket(leaves, pad_multiple=ici)
         reduced = hierarchical_allreduce(
             flat, average=average, ici_axis=ici_axis, dcn_axis=dcn_axis)
-        offset = 0
-        for i in idxs:
-            n = xs[i].size
-            out[i] = reduced[offset:offset + n].reshape(xs[i].shape)
-            offset += n
+        for i, o in zip(bucket.indices,
+                        bucketing.unpack_bucket(reduced, leaves)):
+            out[i] = o
     return out
 
 

@@ -36,6 +36,49 @@ SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 PIPE_AXIS = "pipe"
 
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, axis_names=None):
+    """``jax.shard_map`` across the jax API drift.
+
+    Newer jax exposes ``shard_map`` at the top level (``check_vma``,
+    partial-manual via ``axis_names``); 0.4.x only has
+    ``jax.experimental.shard_map`` (``check_rep``, and the INVERSE
+    ``auto`` parameter — the axes NOT manual). Replication checking is
+    disabled on both: the framework's collectives use
+    ``axis_index_groups``, which the checkers don't support.
+    """
+    try:
+        from jax import shard_map as _sm
+
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return _sm(f, **kwargs)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        return _sm(f, **kwargs)
+
+
+def traced_axis_size(axis) -> int:
+    """Size of a bound mesh axis (or axis tuple) inside a trace.
+
+    ``lax.axis_size`` with a fallback for jax versions that predate it:
+    ``psum`` of the literal ``1`` constant-folds to the bound axis size
+    at trace time and raises the same ``NameError`` for an unbound
+    name, so every caller's in-scope probe keeps working.
+    """
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return jax.lax.psum(1, axis)
+
+
 _STANDARD_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 _lock = threading.Lock()

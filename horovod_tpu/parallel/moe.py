@@ -24,6 +24,7 @@ from jax import lax
 import flax.linen as nn
 
 from horovod_tpu.parallel.mesh import EXPERT_AXIS
+from horovod_tpu.parallel.mesh import traced_axis_size
 
 
 def top1_dispatch(router_logits, capacity: int):
@@ -72,7 +73,7 @@ def expert_parallel_moe(x, router_w, wi_local, wo_local, capacity: int,
     queues to the expert's owner, the return all_to_all brings results
     back.
     """
-    n = lax.axis_size(axis)
+    n = traced_axis_size(axis)
     e = router_w.shape[1]
     if e % n:
         raise ValueError("num experts (%d) must divide expert axis (%d)"

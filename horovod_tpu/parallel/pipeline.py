@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.parallel.mesh import PIPE_AXIS
+from horovod_tpu.parallel.mesh import traced_axis_size
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
@@ -41,7 +42,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     stage's chips (other stages see zeros — combine with a psum or read
     from the last stage, as the caller prefers).
     """
-    n = lax.axis_size(axis)
+    n = traced_axis_size(axis)
     idx = lax.axis_index(axis)
     m = microbatches.shape[0]
     steps = m + n - 1
@@ -78,6 +79,6 @@ def pipeline_loss(stage_fn: Callable, stage_params, microbatches,
     ``lax.psum(pipeline_loss(...), axis)`` (stages other than the last
     contribute zero)."""
     outs = pipeline_apply(stage_fn, stage_params, microbatches, axis=axis)
-    n = lax.axis_size(axis)
+    n = traced_axis_size(axis)
     idx = lax.axis_index(axis)
     return jnp.where(idx == n - 1, loss_fn(outs), 0.0)

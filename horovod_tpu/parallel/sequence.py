@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.parallel.mesh import SEQ_AXIS
+from horovod_tpu.parallel.mesh import traced_axis_size
 
 _NEG = -1e9
 
@@ -60,7 +61,7 @@ def ring_attention(q, k, v, *, axis=SEQ_AXIS, causal: bool = True):
     sharded in rank order along the axis. Returns the attention output
     shard (B, S_local, H, D).
     """
-    n = lax.axis_size(axis)
+    n = traced_axis_size(axis)
     idx = lax.axis_index(axis)
     b, s_local, h, d = q.shape
 
@@ -90,7 +91,7 @@ def ulysses_attention(q, k, v, *, axis=SEQ_AXIS, causal: bool = True,
                       attention_fn=None):
     """All_to_all sequence parallelism: reshard (B, S/n, H, D) ->
     (B, S, H/n, D), run dense attention locally, reshard back."""
-    n = lax.axis_size(axis)
+    n = traced_axis_size(axis)
     h = q.shape[2]
     if h % n:
         raise ValueError(

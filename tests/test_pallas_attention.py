@@ -124,6 +124,96 @@ def test_under_jit():
     assert _rel(out, ref) < 1e-5
 
 
+def test_block_q_variation_is_bit_exact():
+    """Tuned q-tiles vs default q-tiles: bit-level parity.
+
+    block_q only partitions the query rows; each row's streaming
+    (max, sum, acc) walk over kv blocks is row-independent, and a
+    causal row-block skip only elides blocks whose contribution is an
+    exact no-op (p underflows to exactly 0, alpha = exp(0) = 1). So
+    for a FIXED block_k, every block_q must produce identical bits —
+    the guarantee that lets the tuner change q-tiles without a
+    numerics review.
+    """
+    rng = np.random.RandomState(7)
+    for causal in (True, False):
+        q = jnp.asarray(rng.randn(2, 130, 2, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 130, 2, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 130, 2, 16), jnp.float32)
+        ref = flash_attention(q, k, v, causal=causal, block_q=256,
+                              block_k=64)
+        for bq in (16, 32, 64):
+            out = flash_attention(q, k, v, causal=causal, block_q=bq,
+                                  block_k=64)
+            assert np.array_equal(np.asarray(out), np.asarray(ref)), \
+                "causal=%s bq=%d" % (causal, bq)
+
+
+def test_block_k_variation_tight_tolerance():
+    """block_k changes the fp32 streaming-softmax association order, so
+    bit parity is NOT guaranteed across k-tiles — but the drift must
+    stay at rounding scale (the tuner may change block_k freely)."""
+    rng = np.random.RandomState(8)
+    q = jnp.asarray(rng.randn(1, 130, 2, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 130, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 130, 2, 16), jnp.float32)
+    ref = flash_attention(q, k, v, causal=True, block_q=64, block_k=130)
+    for bk in (16, 32, 64):
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=bk)
+        assert _rel(out, ref) < 1e-6, bk
+
+
+def test_env_block_override_matches_explicit(monkeypatch):
+    """HVD_FLASH_BLOCK_Q/K (what the tuner historically fed) must be
+    bit-identical to passing the same blocks explicitly."""
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 100, 2, 8), jnp.float32)
+    explicit = flash_attention(q, q, q, causal=True, block_q=32,
+                               block_k=64)
+    monkeypatch.setenv("HVD_FLASH_BLOCK_Q", "32")
+    monkeypatch.setenv("HVD_FLASH_BLOCK_K", "64")
+    via_env = flash_attention(q, q, q, causal=True)
+    assert np.array_equal(np.asarray(explicit), np.asarray(via_env))
+
+
+def test_tuned_cache_blocks_match_default_numerics(tmp_path, monkeypatch):
+    """A journaled tuner winner must change performance only: outputs
+    and gradients at the tuned blocks stay within rounding of the
+    default blocks (bit-level on the q-tile axis per the test above)."""
+    import json
+
+    from horovod_tpu.ops import block_tuner
+
+    path = str(tmp_path / "cache.jsonl")
+    monkeypatch.setenv("HVD_FLASH_TUNE_CACHE", path)
+    monkeypatch.setenv("HVD_FLASH_TUNE", "cache")
+    monkeypatch.delenv("HVD_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("HVD_FLASH_BLOCK_K", raising=False)
+    block_tuner._mem_cache = {}
+    block_tuner._mem_cache_path = None
+    key = block_tuner.shape_key(96, 96, 8, "float32", True,
+                                block_tuner._device_kind())
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"version": 1, "key": key, "block_q": 32,
+                             "block_k": 32}) + "\n")
+
+    rng = np.random.RandomState(10)
+    q = jnp.asarray(rng.randn(1, 96, 1, 8), jnp.float32)
+    tuned = flash_attention(q, q, q, causal=True)       # cache hit 32/32
+    default = flash_attention(q, q, q, causal=True, block_q=96,
+                              block_k=96)
+
+    def loss_tuned(q):
+        return jnp.sum(flash_attention(q, q, q, causal=True) ** 2)
+
+    def loss_default(q):
+        return jnp.sum(flash_attention(q, q, q, causal=True, block_q=96,
+                                       block_k=96) ** 2)
+
+    assert _rel(tuned, default) < 1e-6
+    assert _rel(jax.grad(loss_tuned)(q), jax.grad(loss_default)(q)) < 1e-6
+
+
 def test_transformer_flash_matches_dense():
     import dataclasses
 

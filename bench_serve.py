@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Serving QPS/latency microbenchmark harness (docs/serving.md).
+
+Spawns a REAL fleet — router + ``--np`` replica subprocesses through
+``python -m horovod_tpu.serve`` — and drives it with closed-loop
+client threads, reporting QPS and client-observed latency
+percentiles as one JSON document. The default ``identity`` model
+keeps every process jax-free, so the numbers measure the serving
+plane (HTTP front door, micro-batcher, proxy hop), not XLA.
+
+Examples:
+
+    python bench_serve.py --np 2 --duration 5        # one sweep
+    python bench_serve.py --model mnist_mlp --ckpt-dir D   # real model
+    python bench_serve.py --null-ab --trials 5       # A/A slot bias
+    python bench_serve.py --ab max_batch=1           # batching A/B
+
+A/B discipline (docs/benchmarks.md, identical to ``bench_wire.py``):
+this box has ~2x run-to-run swings AND a measured paired-slot bias,
+so ``--ab KEY=VAL[,KEY=VAL]`` (B applies the overrides as
+``HVD_SERVE_*`` env) ALWAYS runs the A/A null test alongside and
+verdicts each delta ``within_slot_bias`` unless it clears the whole
+observed null spread. Supported overrides: ``max_batch``,
+``deadline_ms``, ``min_bucket``.
+
+Exit code 0 and one JSON document on stdout (and in --out when given).
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+_AB_ENV = {"max_batch": "HVD_SERVE_MAX_BATCH",
+           "deadline_ms": "HVD_SERVE_BATCH_DEADLINE_MS",
+           "min_bucket": "HVD_SERVE_MIN_BUCKET"}
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_json(port, path, timeout=5.0):
+    from horovod_tpu.serve.server import http_get_json
+
+    return http_get_json("127.0.0.1", port, path, timeout=timeout)
+
+
+class Fleet:
+    """One router + replicas subprocess tree for a measurement slot."""
+
+    def __init__(self, args, overrides=None):
+        self.args = args
+        self.port = _free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        for key, val in (overrides or {}).items():
+            env[_AB_ENV[key]] = str(val)
+        self._tmp = tempfile.TemporaryDirectory(prefix="bench_serve_")
+        cmd = [sys.executable, "-m", "horovod_tpu.serve",
+               "--model", args.model, "--np", str(args.np_),
+               "--port", str(self.port),
+               "--journal-dir", os.path.join(self._tmp.name, "journal"),
+               "--liveness-sec", "60"]
+        if args.ckpt_dir:
+            cmd += ["--ckpt-dir", args.ckpt_dir]
+        self.proc = subprocess.Popen(
+            cmd, cwd=_REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        self._log = []
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        for line in self.proc.stdout:
+            self._log.append(line)
+
+    def wait_ready(self, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    "serve fleet died rc=%s:\n%s"
+                    % (self.proc.returncode, "".join(self._log[-40:])))
+            doc = _get_json(self.port, "/healthz")
+            if doc and len(doc.get("replicas", {})) >= self.args.np_:
+                return
+            time.sleep(0.2)
+        raise RuntimeError("serve fleet not ready in %.0fs" % timeout)
+
+    def stop(self):
+        doc = _get_json(self.port, "/healthz") or {}
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        # the CLI leaves replica children running on router death by
+        # design (crash-safety); the bench must reap them explicitly.
+        import signal as _signal
+
+        for info in doc.get("replicas", {}).values():
+            try:
+                os.kill(int(info["pid"]), _signal.SIGKILL)
+            except (OSError, TypeError, ValueError):
+                pass
+        self._tmp.cleanup()
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def run_load(port, args):
+    """Closed-loop client threads for --duration; returns the slot's
+    measurement payload."""
+    import http.client
+
+    row = [0.5] * args.row_dim
+    body = json.dumps({"inputs": [row]})
+    stop_at = time.monotonic() + args.duration
+    lock = threading.Lock()
+    latencies = []
+    failures = [0]
+
+    def client():
+        while time.monotonic() < stop_at:
+            t0 = time.monotonic()
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                conn.request("POST", "/v1/predict", body=body)
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+                conn.close()
+            except OSError:
+                ok = False
+            dt = time.monotonic() - t0
+            with lock:
+                if ok:
+                    latencies.append(dt)
+                else:
+                    failures[0] += 1
+
+    threads = [threading.Thread(target=client)
+               for _ in range(args.threads)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+    latencies.sort()
+    return {
+        "requests": len(latencies),
+        "failures": failures[0],
+        "elapsed_s": round(elapsed, 3),
+        "qps": round(len(latencies) / elapsed, 2) if elapsed else 0.0,
+        "latency_ms": {
+            "p50": round(1000 * (_percentile(latencies, 0.50) or 0), 3),
+            "p99": round(1000 * (_percentile(latencies, 0.99) or 0), 3),
+            "mean": round(1000 * (sum(latencies) / len(latencies)), 3)
+            if latencies else None,
+        },
+    }
+
+
+def run_slot(args, overrides=None):
+    fleet = Fleet(args, overrides)
+    try:
+        fleet.wait_ready(args.ready_timeout)
+        return run_load(fleet.port, args)
+    finally:
+        fleet.stop()
+
+
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2]
+
+
+def run_paired_trials(args, b_overrides=None):
+    """Interleaved slot-paired trials (bench_wire.py discipline): each
+    trial runs slot A then slot B back-to-back; identical configs
+    measure the slot bias, overrides measure the delta on top of it."""
+    ratios = []
+    per_trial = []
+    for trial in range(args.trials):
+        a = run_slot(args)
+        b = run_slot(args, b_overrides)
+        if a["qps"]:
+            ratios.append(b["qps"] / a["qps"])
+        per_trial.append({"a_qps": a["qps"], "b_qps": b["qps"]})
+        print("# trial %d/%d done (A %.1f qps, B %.1f qps)"
+              % (trial + 1, args.trials, a["qps"], b["qps"]),
+              file=sys.stderr)
+    return {"ratios": [round(r, 4) for r in ratios],
+            "median_ratio": round(_median(ratios), 4) if ratios else None,
+            "trials": per_trial}
+
+
+def _verdict(ab_ratio, null_ratios):
+    lo, hi = min(null_ratios), max(null_ratios)
+    if lo <= ab_ratio <= hi:
+        return "within_slot_bias"
+    return "faster" if ab_ratio > hi else "slower"
+
+
+def _parse_overrides(spec):
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit("--ab expects KEY=VAL, got %r" % part)
+        key, val = part.split("=", 1)
+        key = key.strip()
+        if key not in _AB_ENV:
+            raise SystemExit("--ab key %r not supported (use %s)"
+                             % (key, "/".join(sorted(_AB_ENV))))
+        out[key] = val.strip()
+    if not out:
+        raise SystemExit("--ab needs at least one KEY=VAL override")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--np", type=int, default=2, dest="np_")
+    ap.add_argument("--model", default="identity",
+                    help="identity (jax-free, default) or a registry "
+                         "model with --ckpt-dir")
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds of load per measurement slot")
+    ap.add_argument("--threads", type=int, default=4,
+                    help="closed-loop client threads")
+    ap.add_argument("--row-dim", type=int, default=16,
+                    help="input row width for the identity model")
+    ap.add_argument("--ready-timeout", type=float, default=120.0)
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    ap.add_argument("--null-ab", action="store_true",
+                    help="A/A slot-bias null test: --trials paired "
+                         "identical-config fleets")
+    ap.add_argument("--ab", default=None, metavar="KEY=VAL[,KEY=VAL]",
+                    help="interleaved A/B; slot B applies the "
+                         "overrides (%s) as env; the A/A null gates "
+                         "the verdict" % ",".join(sorted(_AB_ENV)))
+    ap.add_argument("--trials", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    base_cfg = {"np": args.np_, "model": args.model,
+                "duration_s": args.duration, "threads": args.threads}
+    if args.ab:
+        overrides = _parse_overrides(args.ab)
+        print("# null A/A trials (slot-bias gate)...", file=sys.stderr)
+        null = run_paired_trials(args)
+        print("# A/B trials (B: %s)..." % args.ab, file=sys.stderr)
+        ab = run_paired_trials(args, overrides)
+        payload = {"mode": "ab", "config": base_cfg,
+                   "b_overrides": overrides,
+                   "null": null, "ab": ab}
+        if null["ratios"] and ab["median_ratio"] is not None:
+            payload["verdict"] = _verdict(ab["median_ratio"],
+                                          null["ratios"])
+            print("# qps B/A %.3f | null bias %.3f (spread %.3f-%.3f) "
+                  "-> %s" % (ab["median_ratio"], null["median_ratio"],
+                             min(null["ratios"]), max(null["ratios"]),
+                             payload["verdict"]), file=sys.stderr)
+    elif args.null_ab:
+        null = run_paired_trials(args)
+        payload = {"mode": "null_ab", "config": base_cfg, "null": null}
+        if null["ratios"]:
+            print("# A/A slot ratio median %.3f (trials: %s)"
+                  % (null["median_ratio"],
+                     " ".join("%.3f" % r for r in null["ratios"])),
+                  file=sys.stderr)
+    else:
+        payload = {"mode": "sweep", "config": base_cfg}
+        payload.update(run_slot(args))
+    doc = json.dumps(payload, indent=2, sort_keys=True)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,7 +92,24 @@ run_tier1() {
 # runs FIRST as a fail-fast smoke — a broken journal/fencing path
 # wedges jobs in production, so it is cheaper to catch before the full
 # tier burns its budget. Budget bumped 2100 -> 2400 to keep headroom.
+# ISSUE 8 adds the serving lane: a jax-free bench_serve.py smoke (one
+# tiny identity-model fleet, proves router + replicas + micro-batcher
+# end-to-end in seconds) and the serving chaos test (real checkpoint,
+# kill -9 replica + SIGKILL router, ~35s warm) run FAIL-FAST before
+# the full tier — a broken serving plane is a user-facing outage, so
+# it is cheaper to catch before the tier burns its budget. The chaos
+# test is then deselected from the full tier run (driver-kill
+# precedent). Combined warm cost ~60s — absorbed by the existing
+# headroom.
 run_tier2() {
+    echo "=== tier 2: serving smoke (bench_serve.py, jax-free fleet) ==="
+    timeout "${HVD_CI_SERVE_BUDGET:-600}" \
+        python bench_serve.py --np 2 --duration 2 --threads 4 \
+        > /dev/null
+    echo "=== tier 2: serving chaos smoke (replica kill -9 + router SIGKILL) ==="
+    timeout "${HVD_CI_SERVE_BUDGET:-600}" python -m pytest \
+        tests/test_chaos_serve.py -q -p no:cacheprovider \
+        --override-ini 'addopts='
     echo "=== tier 2: wire microbenchmark smoke (bench_wire.py) ==="
     # Smoke only: proves the jax-free bench lane runs end-to-end (two
     # sizes, handful of iters). Real A/B numbers need interleaved
@@ -108,7 +125,8 @@ run_tier2() {
     timeout "${HVD_CI_TIER2_BUDGET:-2400}" \
         python -m pytest tests/ -q -p no:cacheprovider \
         --override-ini 'addopts=' -m tier2 \
-        --deselect tests/test_chaos_elastic.py::test_driver_kill9_journal_resume
+        --deselect tests/test_chaos_elastic.py::test_driver_kill9_journal_resume \
+        --deselect tests/test_chaos_serve.py::test_serve_chaos_replica_kill9_then_router_sigkill
 }
 
 case "$TIER" in

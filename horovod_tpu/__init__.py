@@ -128,3 +128,16 @@ def run(*args, **kwargs):
     from horovod_tpu.runner import run as _run
 
     return _run(*args, **kwargs)
+
+
+def __getattr__(name):
+    """Lazy subsystem attributes (PEP 562): ``hvd.serve`` loads the
+    inference-serving subsystem (docs/serving.md) on first touch —
+    training imports never pay for it, and the serve package itself
+    defers jax until a replica loads a real model."""
+    if name == "serve":
+        import horovod_tpu.serve as _serve
+
+        return _serve
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

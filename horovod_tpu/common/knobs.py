@@ -109,10 +109,13 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HOROVOD_WORKER_LIVENESS_SEC", HONORED,
          "runner/elastic_run.py: replace a worker slot whose "
          "heartbeats stop for this many seconds "
-         "(SIGTERM->SIGKILL->reset); 0 = disabled"),
+         "(SIGTERM->SIGKILL->reset); 0 = disabled. Also "
+         "serve/router.py: cull a serving replica silent this long "
+         "(serving default 30, re-admitted on rediscovery)"),
     Knob("HVD_HEARTBEAT_SEC", HONORED,
-         "elastic/worker.py: liveness heartbeat PUT interval to the "
-         "rendezvous KV (default 10; <=0 disables)"),
+         "elastic/worker.py + serve/replica.py: liveness heartbeat "
+         "PUT interval to the rendezvous/router KV (default 10; <=0 "
+         "disables)"),
     Knob("HOROVOD_DISABLE_GROUP_FUSION", HONORED,
          "core/src/controller.cc FuseResponses"),
     Knob("HOROVOD_DYNAMIC_PROCESS_SETS", HONORED,
@@ -245,6 +248,31 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "core/src/operations.cc: =0 restores the fusion-buffer "
          "pack/unpack path for fused allreduces instead of the "
          "scatter-gather ring over tensor memory"),
+    # Inference serving (horovod_tpu/serve/; docs/serving.md).
+    Knob("HVD_SERVE_MAX_BATCH", HONORED,
+         "serve/batching.py: micro-batch size trigger — a batch fires "
+         "as soon as this many rows are queued (default 8; also the "
+         "largest bucketed batch shape)"),
+    Knob("HVD_SERVE_BATCH_DEADLINE_MS", HONORED,
+         "serve/batching.py: micro-batch deadline trigger — a batch "
+         "fires when the oldest queued request has waited this long, "
+         "even if not full (default 5 ms; 0 = no batching delay)"),
+    Knob("HVD_SERVE_MIN_BUCKET", HONORED,
+         "serve/batching.py: smallest bucketed batch shape; buckets "
+         "double from here to HVD_SERVE_MAX_BATCH and bound XLA "
+         "recompiles (default 4 — the smallest row-bitexact bucket "
+         "for the repo models, see docs/serving.md)"),
+    Knob("HVD_SERVE_PORT", HONORED,
+         "serve/__main__.py: default router bind port for python -m "
+         "horovod_tpu.serve (default 8000; --port overrides)"),
+    Knob("HVD_SERVE_CKPT_POLL_SEC", HONORED,
+         "serve/replica.py: poll Checkpointer.latest_step() this often "
+         "and hot-swap newer committed steps into the live apply path "
+         "(default 10; <=0 disables hot reload)"),
+    Knob("HVD_SERVE_PROXY_TIMEOUT_SEC", HONORED,
+         "serve/router.py + serve/replica.py: per-forward timeout for "
+         "router->replica predict proxying and the replica's own "
+         "batched-inference wait (default 30)"),
     # Fault injector (core/src/comm.cc; armed only on the matching
     # rank — see docs/configuration.md and common/fault_injection.py).
     Knob("HVD_FAULT_RANK", HONORED,

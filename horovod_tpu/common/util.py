@@ -16,6 +16,14 @@ def float_env(name: str, default: float) -> float:
         return default
 
 
+def int_env(name: str, default: int) -> int:
+    """Integer twin of ``float_env``: same malformed-value policy."""
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 def failure_backoff_seconds(streak: int, base: float, cap: float) -> float:
     """Jittered exponential backoff shared by the elastic worker
     wrapper and the elastic driver (one documented policy,

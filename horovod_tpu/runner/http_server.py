@@ -5,13 +5,42 @@ Mirrors the reference's launcher-side KV store
 ``/scope/key`` paths, used for bootstrap rendezvous and elastic rank
 reassignment (``RendezvousServer``), and for returning run-func results
 (``KVStoreServer``).
+
+Request handling is concurrent (``ThreadingHTTPServer``: one daemon
+thread per connection), which the serving front door
+(``horovod_tpu/serve/router.py``) depends on — a slow replica proxied
+behind ``POST /v1/predict`` must not serialize an unrelated
+``GET /healthz`` or a heartbeat PUT. Two consequences the handlers
+enforce:
+
+- the store dict is only touched under ``server.lock``;
+- ``put_callback`` runs under ``server.callback_lock``, so callbacks
+  (the elastic driver's heartbeat stamping, the serve router's journal
+  appends) see one invocation at a time and need no internal locking
+  of their own.
+
+Custom endpoints mount via ``get_routes`` / ``post_routes`` (exact-path
+handlers, matched ahead of the KV scopes) instead of subclassing the
+handler — the serve router adds ``POST /v1/predict`` and
+``GET /healthz`` this way.
 """
 
 from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
+
+# A mounted route returns (status, content_type, body_bytes).
+RouteResult = Tuple[int, str, bytes]
+
+
+def json_route_result(status: int, payload: dict) -> RouteResult:
+    """The one JSON-response builder every mounted route shares."""
+    import json
+
+    return (status, "application/json",
+            (json.dumps(payload) + "\n").encode())
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -50,8 +79,37 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _run_route(self, route, *args):
+        """Invoke a mounted route with a last-resort 500 guard: an
+        exception escaping the handler would otherwise drop the
+        connection with no status line at all — the client deserves a
+        labeled failure it can react to. The unpack happens INSIDE the
+        guard so a malformed return value (None, wrong arity) gets the
+        same labeled 500 as a raise."""
+        try:
+            status, ctype, body = route(*args)
+        except Exception as e:  # analysis: allow-broad-except — any
+            # route bug maps to a 500 on THIS request; the server
+            # keeps serving.
+            status, ctype, body = (
+                500, "text/plain; charset=utf-8",
+                ("route handler failed: %s\n" % e).encode())
+        self._send_route_result((status, ctype, body))
+
+    def _send_route_result(self, result: RouteResult):
+        status, ctype, body = result
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/")
+        route = getattr(self.server, "get_routes", {}).get(path or "/")
+        if route is not None:
+            self._run_route(route)
+            return
         if path == "/metrics":
             self._serve_metrics(as_json=False)
             return
@@ -87,6 +145,18 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
         return True
 
+    def do_POST(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        route = getattr(self.server, "post_routes", {}).get(path or "/")
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        if route is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self._run_route(route, body)
+
     def do_PUT(self):
         if self._reject_write_if_metrics_only():
             return
@@ -97,7 +167,11 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.server.store.setdefault(scope, {})[key] = value  # type: ignore[attr-defined]
         callback = getattr(self.server, "put_callback", None)
         if callback:
-            callback(scope, key, value)
+            # Handler threads run concurrently; serializing the callback
+            # here means consumers (driver heartbeat stamping, serve
+            # router admission journaling) need no locking of their own.
+            with self.server.callback_lock:  # type: ignore[attr-defined]
+                callback(scope, key, value)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -125,10 +199,27 @@ class KVStoreServer:
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.put_callback = put_callback  # type: ignore[attr-defined]
+        self._httpd.callback_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.get_routes = {}  # type: ignore[attr-defined]
+        self._httpd.post_routes = {}  # type: ignore[attr-defined]
         # Refuse HTTP writes: hvd.start_metrics_server() exposes this
         # port to scrapers, which must not get a writable KV store.
         self._httpd.metrics_only = metrics_only  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    def register_get_route(self, path: str,
+                           fn: Callable[[], RouteResult]):
+        """Mount an exact-path GET handler (matched before the KV
+        scopes and the /metrics routes). ``fn() -> (status, content
+        type, body bytes)`` runs on the connection's handler thread."""
+        self._httpd.get_routes[path.rstrip("/") or "/"] = fn  # type: ignore[attr-defined]
+
+    def register_post_route(self, path: str,
+                            fn: Callable[[bytes], RouteResult]):
+        """Mount an exact-path POST handler; ``fn(request_body)`` runs
+        on the connection's handler thread, concurrently with other
+        requests — it must not assume exclusivity."""
+        self._httpd.post_routes[path.rstrip("/") or "/"] = fn  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:
@@ -142,8 +233,11 @@ class KVStoreServer:
         return self.port
 
     def stop(self):
-        self._httpd.shutdown()
-        if self._thread:
+        # shutdown() blocks until serve_forever() acknowledges — on a
+        # never-started server that loop does not exist and the call
+        # would hang forever, so only signal a loop that is running.
+        if self._thread is not None:
+            self._httpd.shutdown()
             self._thread.join(timeout=5)
         self._httpd.server_close()
 

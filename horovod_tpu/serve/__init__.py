@@ -1,0 +1,63 @@
+"""``horovod_tpu.serve`` — crash-safe, micro-batching inference serving.
+
+Takes a trained checkpoint to a load-balanced, autoscaled, observable
+HTTP inference service (docs/serving.md), reusing the elastic control
+plane's crash-safety machinery (PR 5: fsync'd journal, heartbeat
+liveness) and the metrics registry (PR 1) as the serving data plane's
+insurance and observability:
+
+- ``serve.replica``: a worker that loads the newest committed
+  checkpoint (``utils/checkpoint.Checkpointer``), jits the model's
+  ``apply_fn`` once per bucketed batch shape, and answers
+  ``POST /v1/predict``;
+- ``serve.batching``: the dynamic micro-batching queue — requests
+  accumulate until ``HVD_SERVE_MAX_BATCH`` rows or
+  ``HVD_SERVE_BATCH_DEADLINE_MS`` (whichever fires first) and are
+  padded to a small set of bucketed batch shapes so XLA recompiles are
+  bounded;
+- ``serve.router``: the front door — round-robin over live replicas
+  with one retry, membership journaled through ``runner/journal.py``
+  so a SIGKILLed router restarts into the same routing table;
+- ``serve.autoscale``: heartbeat-driven liveness — silent replicas are
+  culled after ``HOROVOD_WORKER_LIVENESS_SEC`` and re-admitted on
+  rediscovery.
+
+Entry points::
+
+    python -m horovod_tpu.serve --ckpt-dir CKPT --model mnist_mlp --np 2
+
+or the library API::
+
+    import horovod_tpu as hvd
+    server = hvd.serve.Server(ckpt_dir=..., model="mnist_mlp",
+                              num_replicas=2)
+    server.start()
+
+Import-light by design: nothing here pulls in jax/flax until a replica
+actually loads a model, so the router and the bench harness stay
+spawnable on a box where a jax import costs seconds.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "MicroBatcher": "horovod_tpu.serve.batching",
+    "bucket_sizes": "horovod_tpu.serve.batching",
+    "assert_bucket_equality": "horovod_tpu.serve.batching",
+    "Replica": "horovod_tpu.serve.replica",
+    "Router": "horovod_tpu.serve.router",
+    "ReplicaMonitor": "horovod_tpu.serve.autoscale",
+    "Server": "horovod_tpu.serve.server",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,142 @@
+"""CLI: ``python -m horovod_tpu.serve`` (docs/serving.md).
+
+Default topology — router in the foreground plus ``--np`` replica
+subprocesses::
+
+    python -m horovod_tpu.serve --ckpt-dir /ckpts --model mnist_mlp \
+        --np 2 --port 8000 --journal-dir /ckpts/serve
+
+Restart a crashed router into its journaled routing table (replicas
+keep serving through the outage and are rediscovered by heartbeat)::
+
+    python -m horovod_tpu.serve --role router --port 8000 \
+        --journal-dir /ckpts/serve
+
+Run one replica by hand (what the default topology spawns)::
+
+    python -m horovod_tpu.serve --role replica --ckpt-dir /ckpts \
+        --model mnist_mlp --router 127.0.0.1:8000 --replica-id r0
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import time
+
+
+def _exit_gracefully_on_sigterm(stop_fn):
+    """SIGTERM = operator-initiated shutdown: stop cleanly (close the
+    journal, reap replica children). SIGKILL remains the crash path
+    the journal exists for — replicas deliberately survive it."""
+
+    def handler(signum, frame):
+        stop_fn()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, handler)
+
+
+def _default_port() -> int:
+    try:
+        return int(os.environ.get("HVD_SERVE_PORT", 8000))
+    except ValueError:
+        return 8000
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serve",
+        description="Crash-safe micro-batching inference serving "
+                    "(docs/serving.md)")
+    ap.add_argument("--role", choices=("serve", "router", "replica"),
+                    default="serve",
+                    help="serve = router + --np replica subprocesses "
+                         "(default); router = front door only (the "
+                         "crash-restart path); replica = one worker")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="Checkpointer directory holding the committed "
+                         "steps to serve")
+    ap.add_argument("--model", default="mnist_mlp",
+                    help="registered model name (or 'identity' for the "
+                         "jax-free passthrough the bench uses)")
+    ap.add_argument("--np", type=int, default=1, dest="np_",
+                    help="replica worker subprocesses to spawn")
+    ap.add_argument("--port", type=int, default=None,
+                    help="router bind port (default HVD_SERVE_PORT or "
+                         "8000; replicas default to an ephemeral port)")
+    ap.add_argument("--journal-dir", default=None,
+                    help="serve journal directory (default: "
+                         "<ckpt-dir>/serve_journal when --ckpt-dir is "
+                         "given); the router's crash-safe routing table")
+    ap.add_argument("--liveness-sec", type=float, default=None,
+                    help="cull replicas silent this long (default "
+                         "HOROVOD_WORKER_LIVENESS_SEC or 30)")
+    # replica-role flags
+    ap.add_argument("--router", default=None,
+                    help="[replica] router addr:port to register with")
+    ap.add_argument("--replica-id", default="r0",
+                    help="[replica] stable replica identity")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    if args.role == "replica":
+        from horovod_tpu.serve import replica as _replica
+
+        if args.port is None:
+            args.port = 0
+        return _replica.main(args)
+
+    if args.port is None:
+        args.port = _default_port()
+    if args.journal_dir is None and args.ckpt_dir:
+        args.journal_dir = os.path.join(args.ckpt_dir, "serve_journal")
+
+    if args.role == "router":
+        from horovod_tpu.serve.router import Router
+
+        router = Router(port=args.port, journal_dir=args.journal_dir,
+                        liveness_sec=args.liveness_sec)
+        port = router.start()
+        _exit_gracefully_on_sigterm(router.stop)
+        print("SERVE_ROUTER_READY port=%d pid=%d replayed=%d"
+              % (port, os.getpid(), router._replayed), flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            router.stop()
+        return 0
+
+    from horovod_tpu.serve.server import Server
+
+    server = Server(ckpt_dir=args.ckpt_dir, model=args.model,
+                    num_replicas=args.np_, port=args.port,
+                    journal_dir=args.journal_dir,
+                    liveness_sec=args.liveness_sec)
+    port = server.start()
+    _exit_gracefully_on_sigterm(server.stop)
+    print("SERVE_ROUTER_READY port=%d pid=%d replicas=%d"
+          % (port, os.getpid(), args.np_), flush=True)
+    try:
+        server.wait_ready()
+        print("SERVE_FLEET_READY port=%d" % port, flush=True)
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    except Exception:
+        # Startup failure (replica crashed on load, ready timeout):
+        # reap the already-spawned replica children before dying —
+        # leaving them serving is the contract for a router CRASH
+        # (SIGKILL), not for a failed launch.
+        server.stop()
+        raise
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

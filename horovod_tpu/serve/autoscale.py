@@ -1,0 +1,104 @@
+"""Replica pool autoscaling: heartbeat liveness + SLO gauges.
+
+The elastic driver's liveness discipline (PR 5), re-aimed at serving:
+replicas PUT ``heartbeat/<replica_id>`` every ``HVD_HEARTBEAT_SEC``;
+the monitor culls any replica silent past
+``HOROVOD_WORKER_LIVENESS_SEC`` (journaled, so the cull survives a
+router restart) and the router re-admits it the moment beats reappear
+— scale-down on failure, scale-back-up on rediscovery, no operator in
+the loop.
+
+The monitor also owns the windowed SLO gauges: ``hvd_serve_qps``
+(completed predicts per second over the last window) and
+``hvd_serve_replicas_live``. Latency p50/p99 derive from the
+``hvd_serve_latency_seconds`` histogram in every export
+(docs/metrics.md#histogram-quantiles).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from horovod_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger("horovod_tpu")
+
+_G_REPLICAS = _metrics.gauge(
+    "hvd_serve_replicas_live",
+    "Replicas currently in the serving router's rotation.")
+_C_CULLED = _metrics.counter(
+    "hvd_serve_culled_total",
+    "Replicas removed from rotation after heartbeat silence exceeded "
+    "HOROVOD_WORKER_LIVENESS_SEC.")
+
+
+class ReplicaMonitor:
+    """Background liveness + SLO-gauge thread for one ``Router``.
+
+    The tick interval tracks the liveness deadline (a quarter of it,
+    bounded to [0.2s, 5s]) so a wedged replica is culled within one
+    deadline plus one tick — comfortably inside the 2x-liveness
+    detection bound the chaos test asserts.
+    """
+
+    def __init__(self, router, interval: float = None):
+        self.router = router
+        if interval is None:
+            live = router.liveness_sec
+            interval = min(5.0, max(0.2, live / 4.0)) if live > 0 else 1.0
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_requests = 0
+        self._last_ts = None
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="hvd-serve-monitor")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def tick(self):
+        """One monitoring pass (exposed for tests): cull the silent,
+        refresh the gauges."""
+        router = self.router
+        if router.liveness_sec > 0:
+            for rid in list(router.replicas()):
+                age = router.heartbeat_age(rid)
+                if age is not None and age > router.liveness_sec:
+                    logger.warning(
+                        "serve: replica %s wedged — no heartbeat for "
+                        "%.1fs (> HOROVOD_WORKER_LIVENESS_SEC=%.1fs); "
+                        "culling from rotation", rid, age,
+                        router.liveness_sec)
+                    router.cull(rid, reason="no heartbeat %.1fs" % age)
+                    _C_CULLED.inc()
+        _G_REPLICAS.set(len(router.replicas()))
+        now = time.monotonic()
+        done = router.requests_done()
+        if self._last_ts is not None and now > self._last_ts:
+            from horovod_tpu.serve.router import _G_QPS
+
+            _G_QPS.set((done - self._last_requests)
+                       / (now - self._last_ts))
+        self._last_requests = done
+        self._last_ts = now
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception as e:  # analysis: allow-broad-except — a
+                # transient bookkeeping error must not kill liveness
+                # monitoring for the rest of the serving job.
+                logger.warning("serve: monitor tick failed: %s", e)

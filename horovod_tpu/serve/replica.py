@@ -1,0 +1,388 @@
+"""Replica worker: checkpoint -> jitted apply_fn -> HTTP predict shard.
+
+One replica process serves one shard of the replica pool (the serving
+analog of a training process-set member): it loads the newest
+*committed* checkpoint through ``utils/checkpoint.Checkpointer`` (the
+same orbax commit discipline training used, so a replica can never
+load a half-written step), jits the model's ``apply_fn`` once per
+bucketed batch shape, and answers ``POST /v1/predict`` behind the
+micro-batching queue (``serve/batching.py``).
+
+Crash-safety wiring (PR 5 machinery, reused):
+
+- the replica PUTs ``heartbeat/<replica_id>`` to the router's KV every
+  ``HVD_HEARTBEAT_SEC`` (the exact discipline elastic workers use);
+  the heartbeat payload carries the replica's endpoint, so a restarted
+  router — or one that culled this replica during a stall — re-admits
+  it from the next beat alone;
+- registration/heartbeat failures are logged and retried forever: the
+  router being down (mid-restart) must not kill a healthy replica.
+
+Checkpoint hot-reload: every ``HVD_SERVE_CKPT_POLL_SEC`` the replica
+polls ``Checkpointer.latest_step()`` and atomically swaps in a newer
+committed step — a training job can keep publishing checkpoints into
+the directory a live serving fleet reads from.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.common.util import float_env, int_env
+from horovod_tpu.runner.http_server import (
+    KVStoreServer,
+    json_route_result,
+    write_kv,
+)
+from horovod_tpu.serve import batching
+from horovod_tpu.utils import metrics as _metrics
+
+logger = logging.getLogger("horovod_tpu")
+
+_C_RELOADS = _metrics.counter(
+    "hvd_serve_ckpt_reloads_total",
+    "Newer committed checkpoint steps a serving replica hot-swapped in.")
+# The serving replica rides the PR 5 heartbeat discipline wholesale,
+# including its counter family (same KV scope, same cadence knob).
+_C_HEARTBEATS = _metrics.counter(
+    "hvd_elastic_heartbeats_total",
+    "Liveness heartbeats this worker PUT to the rendezvous KV "
+    "(heartbeat/<slot_key>, every HVD_HEARTBEAT_SEC).")
+
+# Model registry: name -> (builder, sample input shape). The builder
+# returns a flax module; ``identity`` is the numpy passthrough the
+# bench harness uses to measure the serving plane without jax.
+_MODELS: Dict[str, Optional[Tuple[Callable[[], Any], Tuple[int, ...]]]] = {
+    "identity": None,
+}
+
+
+def _register_jax_models():
+    from horovod_tpu.models import MnistCNN, MnistMLP
+
+    _MODELS.setdefault("mnist_mlp", (MnistMLP, (28, 28)))
+    _MODELS.setdefault("mnist_cnn", (MnistCNN, (28, 28, 1)))
+
+
+def model_names():
+    return sorted(set(_MODELS) | {"mnist_mlp", "mnist_cnn"})
+
+
+class Replica:
+    """One serving shard: load -> self-check -> serve.
+
+    Library use::
+
+        r = Replica(ckpt_dir=..., model="mnist_mlp",
+                    router="127.0.0.1:8000", replica_id="r0")
+        r.start()          # loads, self-checks, serves, heartbeats
+        ...
+        r.stop()
+
+    A custom model plugs in with ``apply_fn`` (params, padded batch ->
+    batch of outputs) plus ``sample_shape``; the registry covers the
+    repo models.
+    """
+
+    def __init__(self, model: str = "mnist_mlp",
+                 ckpt_dir: Optional[str] = None,
+                 router: Optional[str] = None,
+                 replica_id: str = "r0",
+                 port: int = 0,
+                 advertise_addr: Optional[str] = None,
+                 apply_fn: Optional[Callable] = None,
+                 sample_shape: Optional[Tuple[int, ...]] = None,
+                 max_batch: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 min_bucket: Optional[int] = None):
+        self.model = model
+        self.ckpt_dir = ckpt_dir
+        self.replica_id = replica_id
+        self.router = router
+        self._requested_port = port
+        self.advertise_addr = advertise_addr or os.environ.get(
+            "HOROVOD_HOSTNAME") or "127.0.0.1"
+        self._user_apply = apply_fn
+        self.sample_shape = sample_shape
+        self._batcher_cfg = dict(max_batch=max_batch,
+                                 deadline_ms=deadline_ms,
+                                 min_bucket=min_bucket)
+        self.step: Optional[int] = None
+        self._apply_lock = threading.Lock()
+        self._apply: Optional[Callable[[np.ndarray], np.ndarray]] = None
+        self._ckpt = None
+        self._batcher: Optional[batching.MicroBatcher] = None
+        self._server: Optional[KVStoreServer] = None
+        self._stop = threading.Event()
+        self._threads = []
+
+    # --- model loading ------------------------------------------------------
+
+    def _build_apply(self, params) -> Callable[[np.ndarray], np.ndarray]:
+        import jax
+
+        module = self._module
+        fn = jax.jit(lambda p, x: module.apply(p, x, train=False))
+
+        def run(x: np.ndarray) -> np.ndarray:
+            return np.asarray(fn(params, x))
+
+        return run
+
+    def load(self):
+        """Restore the newest committed step and build the bucketed,
+        self-checked apply path. Identity model skips jax entirely."""
+        if self.model == "identity":
+            # Numpy passthrough, any row shape: the bench harness's
+            # jax-free stand-in for measuring the serving plane.
+            self._apply = lambda x: x
+            self.step = -1
+            self._start_batcher()
+            return
+        _register_jax_models()
+        if self._user_apply is not None:
+            if self.sample_shape is None:
+                raise ValueError("apply_fn needs sample_shape")
+            self._module = None
+        else:
+            if self.model not in _MODELS or _MODELS[self.model] is None:
+                raise ValueError("unknown model %r (have: %s)"
+                                 % (self.model, ", ".join(model_names())))
+            builder, shape = _MODELS[self.model]
+            self._module = builder()
+            if self.sample_shape is None:
+                self.sample_shape = shape
+        if self.ckpt_dir is None:
+            raise ValueError("model %r needs --ckpt-dir" % self.model)
+        from horovod_tpu.utils.checkpoint import Checkpointer
+
+        self._ckpt = Checkpointer(self.ckpt_dir)
+        self._restore_step(None)
+        self._start_batcher()
+
+    def _restore_step(self, step: Optional[int]):
+        if step is None:
+            # Resolve the step BEFORE restoring and pass it explicitly:
+            # a checkpoint committed between restore() and a later
+            # latest_step() query would mislabel self.step above the
+            # params actually loaded, and the hot-reload poll
+            # (latest > self.step) would then skip that step forever.
+            step = self._ckpt.latest_step()
+        restored = self._ckpt.restore(step=step)
+        params = restored.get("params", restored) \
+            if isinstance(restored, dict) else restored
+        if self._user_apply is not None:
+            user_fn = self._user_apply
+            apply = lambda x: np.asarray(user_fn(params, x))  # noqa: E731
+        else:
+            apply = self._build_apply(params)
+        loaded = step
+        # The bucket bit-exactness tripwire (docs/serving.md): every
+        # bucket shape must produce row-stable results BEFORE this
+        # replica admits traffic on them. Also doubles as the compile
+        # warmup — after this, no request ever waits on XLA.
+        buckets = batching.bucket_sizes(
+            self._batcher_cfg["max_batch"]
+            or int_env("HVD_SERVE_MAX_BATCH", 8),
+            self._batcher_cfg["min_bucket"]
+            or int_env("HVD_SERVE_MIN_BUCKET", 4))
+        batching.assert_bucket_equality(
+            apply, buckets,
+            np.zeros(self.sample_shape, np.float32) + 0.5)
+        with self._apply_lock:
+            self._apply = apply
+            self.step = loaded
+
+    def _run_batch(self, rows: np.ndarray) -> np.ndarray:
+        with self._apply_lock:
+            apply = self._apply
+        return apply(rows)
+
+    def _start_batcher(self):
+        self._batcher = batching.MicroBatcher(
+            self._run_batch, name=self.replica_id, **self._batcher_cfg)
+
+    # --- HTTP surface -------------------------------------------------------
+
+    _json = staticmethod(json_route_result)
+
+    def _handle_predict(self, body: bytes):
+        try:
+            doc = json.loads(body.decode() or "{}")
+            inputs = np.asarray(doc["inputs"], dtype=np.float32)
+        except (ValueError, KeyError, TypeError) as e:
+            return self._json(400, {"error": "bad request: %s" % e})
+        if self.sample_shape is not None:
+            if inputs.shape == tuple(self.sample_shape):
+                inputs = inputs[None]  # single row without batch dim
+            elif inputs.shape[1:] != tuple(self.sample_shape):
+                return self._json(400, {
+                    "error": "inputs shape %r does not match model "
+                             "sample shape %r"
+                             % (list(inputs.shape),
+                                list(self.sample_shape))})
+        elif inputs.ndim == 1:
+            inputs = inputs[None]
+        try:
+            fut = self._batcher.submit(inputs)
+            out = fut.result(timeout=float_env(
+                "HVD_SERVE_PROXY_TIMEOUT_SEC", 30.0))
+        except ValueError as e:
+            return self._json(400, {"error": str(e)})
+        except Exception as e:  # analysis: allow-broad-except — any
+            # batch failure maps to a 500 on THIS request; the server
+            # and batcher keep running.
+            return self._json(500, {"error": "inference failed: %s" % e})
+        return self._json(200, {
+            "outputs": out.tolist(),
+            "rows": int(inputs.shape[0]),
+            "model": self.model,
+            "step": self.step,
+            "replica": self.replica_id,
+        })
+
+    def _handle_healthz(self):
+        return self._json(200, {
+            "ok": self._apply is not None,
+            "role": "replica",
+            "replica": self.replica_id,
+            "model": self.model,
+            "step": self.step,
+            "pid": os.getpid(),
+            "port": self.port,
+        })
+
+    # --- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.port if self._server is not None else None
+
+    def endpoint_payload(self) -> dict:
+        """What registration and every heartbeat carry: enough for a
+        router (fresh or journal-replayed) to route to this replica."""
+        return {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "addr": self.advertise_addr,
+            "port": self.port,
+            "model": self.model,
+            "step": self.step,
+        }
+
+    def _router_endpoint(self) -> Optional[Tuple[str, int]]:
+        if not self.router:
+            return None
+        addr, _, port = self.router.rpartition(":")
+        return addr, int(port)
+
+    def register(self) -> bool:
+        """One best-effort registration PUT (replica/<id>); False when
+        the router is unreachable (it may be mid-restart — the
+        heartbeat loop keeps trying forever)."""
+        ep = self._router_endpoint()
+        if ep is None:
+            return False
+        try:
+            write_kv(ep[0], ep[1], "replica", self.replica_id,
+                     json.dumps(self.endpoint_payload()).encode(),
+                     timeout=5)
+            return True
+        except OSError:
+            return False
+
+    def _heartbeat_loop(self):
+        ep = self._router_endpoint()
+        while not self._stop.is_set():
+            try:
+                write_kv(ep[0], ep[1], "heartbeat", self.replica_id,
+                         json.dumps(self.endpoint_payload()).encode(),
+                         timeout=5)
+                _C_HEARTBEATS.inc()
+            except Exception as e:  # analysis: allow-broad-except —
+                # the elastic heartbeat discipline: a down/garbled
+                # router must never kill a healthy replica's beat loop.
+                logger.debug("serve replica heartbeat failed: %s", e)
+            self._stop.wait(max(0.05, float_env("HVD_HEARTBEAT_SEC", 10.0)))
+
+    def _ckpt_poll_loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(max(0.05, float_env(
+                "HVD_SERVE_CKPT_POLL_SEC", 10.0)))
+            if self._stop.is_set():
+                return
+            try:
+                latest = self._ckpt.latest_step()
+                if latest is not None and (self.step is None
+                                           or latest > self.step):
+                    self._restore_step(latest)
+                    _C_RELOADS.inc()
+                    logger.info("serve replica %s hot-reloaded step %s",
+                                self.replica_id, latest)
+            except Exception as e:  # analysis: allow-broad-except — a
+                # half-written or GC'd step must not kill the poller;
+                # the currently loaded step keeps serving.
+                logger.warning("serve replica %s checkpoint poll "
+                               "failed: %s", self.replica_id, e)
+
+    def start(self):
+        """Load the model, bind the HTTP server, start heartbeats and
+        the checkpoint poller. Returns the bound port."""
+        self.load()
+        self._server = KVStoreServer(port=self._requested_port)
+        self._server.register_post_route("/v1/predict",
+                                         self._handle_predict)
+        self._server.register_get_route("/healthz", self._handle_healthz)
+        self._server.start()
+        self.register()
+        if (self._router_endpoint() is not None
+                and float_env("HVD_HEARTBEAT_SEC", 10.0) > 0):
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                                 name="hvd-serve-heartbeat")
+            t.start()
+            self._threads.append(t)
+        if (self._ckpt is not None
+                and float_env("HVD_SERVE_CKPT_POLL_SEC", 10.0) > 0):
+            t = threading.Thread(target=self._ckpt_poll_loop, daemon=True,
+                                 name="hvd-serve-ckpt-poll")
+            t.start()
+            self._threads.append(t)
+        return self.port
+
+    def stop(self):
+        self._stop.set()
+        if self._batcher is not None:
+            self._batcher.stop()
+        if self._server is not None:
+            self._server.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def serve_forever(self):
+        """Block until killed (the ``--role replica`` CLI path)."""
+        try:
+            while not self._stop.wait(3600):
+                pass
+        except KeyboardInterrupt:
+            self.stop()
+
+
+def main(args) -> int:
+    logging.basicConfig(level=logging.INFO)
+    replica = Replica(model=args.model, ckpt_dir=args.ckpt_dir,
+                      router=args.router, replica_id=args.replica_id,
+                      port=args.port)
+    port = replica.start()
+    sys.stdout.write("SERVE_REPLICA_READY %s port=%d pid=%d\n"
+                     % (args.replica_id, port, os.getpid()))
+    sys.stdout.flush()
+    replica.serve_forever()
+    return 0

@@ -1,0 +1,340 @@
+"""Serving front door: journaled round-robin routing over replicas.
+
+The router is the one address clients know. It owns:
+
+- ``POST /v1/predict``: forwarded to a live replica, round-robin; a
+  failed forward (connect refused, timeout, non-200) is retried ONCE
+  against a different replica before the client sees a 502;
+- ``GET /healthz``: routing-table view (live replicas, heartbeat ages);
+- ``GET /metrics`` / ``/metrics.json``: the process-wide registry
+  (free — the router rides ``runner/http_server.KVStoreServer``);
+- the replica KV: replicas PUT ``replica/<id>`` (registration) and
+  ``heartbeat/<id>`` (liveness) exactly like elastic workers do.
+
+Crash-safety (the PR 5 journal pattern, reused verbatim): every
+membership transition (admit, cull) is appended to an fsync'd JSONL
+journal (``runner/journal.DriverJournal`` — same torn-tail-tolerant
+attach/replay) BEFORE it takes effect, so a SIGKILLed router restarts
+into the same routing table. Replayed replicas get a fresh liveness
+clock; the ones that died with the old router are culled after
+``HOROVOD_WORKER_LIVENESS_SEC`` of silence, while live ones keep
+heartbeating and never notice the restart.
+
+Re-admission: heartbeat payloads carry the replica's endpoint, so a
+culled (or never-journaled) replica is re-admitted from its next beat
+alone — no re-registration round-trip needed.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from horovod_tpu.common.util import float_env
+from horovod_tpu.runner.http_server import (
+    KVStoreServer,
+    json_route_result,
+)
+from horovod_tpu.runner.journal import DriverJournal
+from horovod_tpu.utils import metrics as _metrics
+
+SERVE_JOURNAL_FILENAME = "serve_journal.jsonl"
+
+_C_REQUESTS = _metrics.counter(
+    "hvd_serve_requests_total",
+    "Predict requests the serving router answered, by outcome "
+    "(ok / error).", labelnames=("outcome",))
+_C_RETRIES = _metrics.counter(
+    "hvd_serve_retries_total",
+    "Predict forwards retried against another replica after the first "
+    "choice failed.")
+_H_LATENCY = _metrics.histogram(
+    "hvd_serve_latency_seconds",
+    "End-to-end predict latency through the router (queueing, "
+    "micro-batching and inference included).")
+_G_QPS = _metrics.gauge(
+    "hvd_serve_qps",
+    "Predict requests per second over the autoscaler's last "
+    "monitoring window.")
+
+
+def serve_journal_path(journal_dir: str) -> str:
+    return os.path.join(journal_dir, SERVE_JOURNAL_FILENAME)
+
+
+def replay_routing(path: str) -> Dict[str, dict]:
+    """Fold a serve journal into the routing table it described:
+    ``replica`` records admit (last endpoint wins), ``cull`` records
+    remove. Unknown record types are skipped (forward compatibility);
+    a torn trailing line ends the replay (the DriverJournal attach
+    truncates it before this incarnation appends)."""
+    table: Dict[str, dict] = {}
+    if not os.path.exists(path):
+        return table
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break  # torn tail
+            rtype = rec.get("type")
+            rid = rec.get("id")
+            if rid is None:
+                continue
+            if rtype == "replica":
+                table[rid] = {k: rec.get(k)
+                              for k in ("addr", "port", "pid", "model")}
+            elif rtype == "cull":
+                table.pop(rid, None)
+    return table
+
+
+class Router:
+    """Journaled, heartbeat-monitored round-robin router."""
+
+    def __init__(self, port: int = 0,
+                 journal_dir: Optional[str] = None,
+                 liveness_sec: Optional[float] = None,
+                 monitor: bool = True):
+        from horovod_tpu.serve.autoscale import ReplicaMonitor
+
+        if liveness_sec is None:
+            liveness_sec = float_env("HOROVOD_WORKER_LIVENESS_SEC", 30.0)
+        self.liveness_sec = float(liveness_sec)
+        self._lock = threading.RLock()
+        self._table: Dict[str, dict] = {}
+        self._order: List[str] = []
+        self._rr = 0
+        self._hb_seen: Dict[str, float] = {}
+        # Replicas THIS incarnation has heard from (registration or
+        # heartbeat). Journal-replayed entries stay unconfirmed until
+        # their first live beat — readiness checks must not count a
+        # possibly-dead replayed entry as serving capacity.
+        self._confirmed: Set[str] = set()
+        self._requests_done = 0
+        self._journal: Optional[DriverJournal] = None
+        self._replayed = 0
+        if journal_dir:
+            path = serve_journal_path(journal_dir)
+            replayed = replay_routing(path)
+            # Attach AFTER replay: attach truncates a torn tail, then
+            # appends this incarnation's records to the same file.
+            self._journal = DriverJournal(path)
+            now = time.monotonic()
+            for rid, info in replayed.items():
+                self._table[rid] = info
+                self._order.append(rid)
+                # Fresh liveness clock: a replica that died with the
+                # old router is culled liveness_sec from NOW; a live
+                # one re-beats long before that.
+                self._hb_seen[rid] = now
+            self._replayed = len(replayed)
+        self._kv = KVStoreServer(port=port, put_callback=self._on_put)
+        self._kv.register_post_route("/v1/predict", self._handle_predict)
+        self._kv.register_get_route("/healthz", self._handle_healthz)
+        self._monitor = ReplicaMonitor(self) if monitor else None
+
+    # --- membership ---------------------------------------------------------
+
+    def _on_put(self, scope: str, key: str, value: bytes):
+        """KV write callback (serialized by the server's callback
+        lock): replica registrations and heartbeats feed the routing
+        table and the liveness clock."""
+        if scope == "heartbeat":
+            try:
+                info = json.loads(value.decode())
+            except ValueError:
+                info = None
+            with self._lock:
+                known = key in self._table
+                if known:
+                    self._hb_seen[key] = time.monotonic()
+                    self._confirmed.add(key)
+            if info is None or not (info.get("addr") and info.get("port")):
+                # No usable endpoint: a known replica's beat already
+                # stamped above; an unknown key is dropped without
+                # bookkeeping — the KV is an open PUT endpoint (the
+                # PR 5 hazard), and stamping arbitrary keys into
+                # _hb_seen would grow it unboundedly since cull only
+                # ever pops admitted keys.
+                return
+            # admit() is a no-op for an unchanged endpoint; for an
+            # unknown key it is the re-admission path (rediscovery of
+            # a culled replica), and for a KNOWN key whose beat
+            # carries a NEW endpoint it journals the move — a replica
+            # respawned on a fresh port while the router was down
+            # would otherwise be routed to its dead old port forever,
+            # kept "live" by the very beats that name the right one.
+            self.admit(key, info)
+            with self._lock:
+                if key in self._table:
+                    self._confirmed.add(key)
+        elif scope == "replica":
+            try:
+                info = json.loads(value.decode())
+            except ValueError:
+                return
+            self.admit(key, info)
+            with self._lock:
+                self._confirmed.add(key)
+
+    def admit(self, replica_id: str, info: dict):
+        """Add (or update) a replica; journaled before it takes effect
+        so a router restart cannot forget a member it already routed
+        to."""
+        entry = {k: info.get(k) for k in ("addr", "port", "pid", "model")}
+        with self._lock:
+            known = self._table.get(replica_id)
+            if known == entry:
+                self._hb_seen.setdefault(replica_id, time.monotonic())
+                return
+            if self._journal is not None:
+                rec = dict(entry)
+                rec.update({"type": "replica", "id": replica_id,
+                            "ts": time.time()})
+                self._journal.append(rec)
+            self._table[replica_id] = entry
+            if replica_id not in self._order:
+                self._order.append(replica_id)
+            self._hb_seen.setdefault(replica_id, time.monotonic())
+
+    def cull(self, replica_id: str, reason: str = "silent"):
+        """Remove a replica from rotation (journaled first)."""
+        with self._lock:
+            if replica_id not in self._table:
+                return
+            if self._journal is not None:
+                self._journal.append({"type": "cull", "id": replica_id,
+                                      "reason": reason,
+                                      "ts": time.time()})
+            self._table.pop(replica_id, None)
+            if replica_id in self._order:
+                self._order.remove(replica_id)
+            self._hb_seen.pop(replica_id, None)
+            self._confirmed.discard(replica_id)
+
+    def replicas(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._table.items()}
+
+    def heartbeat_age(self, replica_id: str) -> Optional[float]:
+        with self._lock:
+            last = self._hb_seen.get(replica_id)
+        return None if last is None else time.monotonic() - last
+
+    def _pick(self, exclude: Set[str]) -> Optional[Tuple[str, dict]]:
+        with self._lock:
+            candidates = [rid for rid in self._order if rid not in exclude]
+            if not candidates:
+                return None
+            rid = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            return rid, dict(self._table[rid])
+
+    # --- predict proxy ------------------------------------------------------
+
+    @staticmethod
+    def _forward(info: dict, body: bytes,
+                 timeout: float) -> Tuple[int, bytes]:
+        conn = http.client.HTTPConnection(info["addr"], int(info["port"]),
+                                          timeout=timeout)
+        try:
+            conn.request("POST", "/v1/predict", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    _json = staticmethod(json_route_result)
+
+    def _handle_predict(self, body: bytes):
+        t0 = time.monotonic()
+        timeout = float_env("HVD_SERVE_PROXY_TIMEOUT_SEC", 30.0)
+        tried: Set[str] = set()
+        last_err = "no live replicas"
+        for attempt in range(2):
+            picked = self._pick(tried)
+            if picked is None:
+                break
+            rid, info = picked
+            tried.add(rid)
+            if attempt == 1:
+                _C_RETRIES.inc()
+            try:
+                status, payload = self._forward(info, body, timeout)
+            except (OSError, http.client.HTTPException) as e:
+                # HTTPException covers the half-dead cases OSError
+                # misses: a replica killed AFTER sending headers but
+                # mid-body raises IncompleteRead/BadStatusLine — that
+                # forward failed just as hard and earns the same
+                # retry-once-then-502 treatment.
+                last_err = "replica %s unreachable: %s" % (rid, e)
+                continue
+            if status >= 500:
+                last_err = "replica %s returned %d" % (rid, status)
+                continue
+            # 2xx and client errors (4xx) both end the retry loop: a
+            # malformed request fails identically everywhere.
+            _H_LATENCY.observe(time.monotonic() - t0)
+            with self._lock:
+                self._requests_done += 1
+            _C_REQUESTS.labels(
+                outcome="ok" if status < 400 else "error").inc()
+            return (status, "application/json", payload)
+        _H_LATENCY.observe(time.monotonic() - t0)
+        _C_REQUESTS.labels(outcome="error").inc()
+        return self._json(502, {"error": last_err, "tried": sorted(tried)})
+
+    def _handle_healthz(self):
+        with self._lock:
+            table = {k: dict(v) for k, v in self._table.items()}
+            confirmed = set(self._confirmed)
+        for rid, info in table.items():
+            age = self.heartbeat_age(rid)
+            info["heartbeat_age_sec"] = None if age is None \
+                else round(age, 3)
+            info["confirmed"] = rid in confirmed
+        return self._json(200, {
+            "ok": bool(table),
+            "role": "router",
+            "replicas": table,
+            "replayed": self._replayed,
+            "liveness_sec": self.liveness_sec,
+            "pid": os.getpid(),
+            "port": self.port,
+        })
+
+    # --- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._kv.port
+
+    @property
+    def kv(self) -> KVStoreServer:
+        return self._kv
+
+    def requests_done(self) -> int:
+        with self._lock:
+            return self._requests_done
+
+    def start(self) -> int:
+        port = self._kv.start()
+        if self._monitor is not None:
+            self._monitor.start()
+        return port
+
+    def stop(self):
+        if self._monitor is not None:
+            self._monitor.stop()
+        self._kv.stop()
+        if self._journal is not None:
+            self._journal.close()

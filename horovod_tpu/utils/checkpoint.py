@@ -23,6 +23,18 @@ from typing import Any, Optional
 from horovod_tpu.common import basics
 
 
+def _rank() -> int:
+    """Rank 0 outside an initialized world: a standalone process (a
+    serving replica, a post-training export script) is its own
+    single-member world and must not be forced through ``hvd.init()``
+    just to read a committed checkpoint."""
+    return basics.rank() if basics.is_initialized() else 0
+
+
+def _size() -> int:
+    return basics.size() if basics.is_initialized() else 1
+
+
 class Checkpointer:
     """Rank-coordinated orbax checkpointing.
 
@@ -33,6 +45,10 @@ class Checkpointer:
         ...
         restored = ckpt.restore()          # latest committed step
         restored = ckpt.restore(step=500)  # specific step
+
+    Works uninitialized too (rank 0 of a world of 1): serving replicas
+    (``horovod_tpu/serve/replica.py``) restore without bootstrapping
+    the training control plane.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
@@ -40,13 +56,13 @@ class Checkpointer:
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(directory)
-        if basics.rank() == 0:
+        if _rank() == 0:
             os.makedirs(self._dir, exist_ok=True)
         self._barrier()
         opt_kwargs = dict(max_to_keep=max_to_keep,
                           save_interval_steps=save_interval_steps,
                           create=True)
-        if basics.size() > 1:
+        if _size() > 1:
             # Multi-process coordination happens through the hvd
             # control plane (the barrier below), not through
             # jax.distributed — orbax must not assume the latter.
@@ -56,7 +72,7 @@ class Checkpointer:
             self._dir, options=ocp.CheckpointManagerOptions(**opt_kwargs))
 
     def _barrier(self):
-        if basics.size() > 1 and basics.is_initialized():
+        if basics.is_initialized() and basics.size() > 1:
             from horovod_tpu.ops import eager
 
             eager.barrier()
@@ -68,7 +84,7 @@ class Checkpointer:
         common/elastic.py:60-77)."""
         saved = False
         err: Optional[BaseException] = None
-        if basics.rank() == 0:
+        if _rank() == 0:
             try:
                 saved = self._manager.save(step, args=self._args(state),
                                            force=force)

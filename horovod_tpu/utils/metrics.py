@@ -143,6 +143,39 @@ class _GaugeValue:
             return self._value
 
 
+def quantile_from_buckets(bounds: Sequence[float],
+                          counts: Sequence[int],
+                          q: float) -> Optional[float]:
+    """Derive the ``q``-quantile (0 < q <= 1) from per-bucket counts
+    (``counts[i]`` observations in ``(bounds[i-1], bounds[i]]``, with
+    ``counts[-1]`` the +Inf overflow slot), Prometheus
+    ``histogram_quantile`` semantics:
+
+    - linear interpolation inside the bucket the quantile lands in
+      (the first finite bucket interpolates from 0 — our ladders are
+      positive-valued latencies/bytes/sizes);
+    - a quantile landing in the +Inf slot reports the highest finite
+      bound (the honest answer "at least this much");
+    - ``None`` when the histogram is empty — there is no p99 of
+      nothing, and exporting 0 would fake a perfect SLO.
+    """
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0
+    lower = 0.0
+    for bound, n in zip(bounds, counts):
+        prev = cum
+        cum += n
+        if cum >= rank:
+            if n == 0:
+                return bound
+            return lower + (bound - lower) * (rank - prev) / n
+        lower = bound
+    return float(bounds[-1])  # +Inf slot
+
+
 class _HistogramValue:
     """Bounded-bucket distribution (one labelset of a Histogram)."""
 
@@ -164,7 +197,10 @@ class _HistogramValue:
             self._sum += value
 
     def get(self) -> Dict[str, object]:
-        """Cumulative bucket counts keyed by formatted upper bound."""
+        """Cumulative bucket counts keyed by formatted upper bound,
+        plus derived p50/p99 (docs/metrics.md#histogram-quantiles) so
+        the JSON exporter is SLO-readable without a Prometheus server
+        doing the ``histogram_quantile`` math."""
         with self._lock:
             counts = list(self._counts)
             total_sum = self._sum
@@ -175,7 +211,9 @@ class _HistogramValue:
             cumulative[_fmt_bound(bound)] = running
         running += counts[-1]
         cumulative["+Inf"] = running
-        return {"count": running, "sum": total_sum, "buckets": cumulative}
+        return {"count": running, "sum": total_sum, "buckets": cumulative,
+                "p50": quantile_from_buckets(self._bounds, counts, 0.50),
+                "p99": quantile_from_buckets(self._bounds, counts, 0.99)}
 
 
 class Metric:

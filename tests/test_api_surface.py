@@ -90,21 +90,36 @@ def test_binding_surface(mod):
 def test_predicate_values():
     """TPU-mapped truth values: no CUDA/MPI machinery, the native TCP
     control plane is the Gloo equivalent."""
+    import time
+
     import horovod_tpu.torch as hvd
 
-    assert hvd.tpu_built() is True
-    # check_extension first: on a fresh checkout it performs the lazy
-    # core build that gloo_built() then reports on. The reference's
-    # 4-arg call shape must work verbatim.
-    hvd.check_extension("horovod.torch", "HOROVOD_WITH_PYTORCH",
-                        __file__, "mpi_lib_v2")
-    assert hvd.gloo_built() is True        # core sources + toolchain
-    assert hvd.mpi_built() is False
-    assert hvd.cuda_built() is False
-    assert hvd.ccl_built() is False
-    assert hvd.ddl_built() is False
-    assert hvd.mpi_threads_supported() is False
-    assert hvd.nccl_built() == 0
+    # Known tier-1 load flake (memory file): check_extension's lazy
+    # core build can lose the compile race under the full 870 s verify
+    # load on this 2-core box while passing in isolation. Deflake:
+    # bounded in-test retry with a breather between attempts; a real
+    # predicate regression fails all three identically.
+    last = None
+    for attempt in range(3):
+        try:
+            assert hvd.tpu_built() is True
+            # check_extension first: on a fresh checkout it performs
+            # the lazy core build that gloo_built() then reports on.
+            # The reference's 4-arg call shape must work verbatim.
+            hvd.check_extension("horovod.torch", "HOROVOD_WITH_PYTORCH",
+                                __file__, "mpi_lib_v2")
+            assert hvd.gloo_built() is True    # core sources + toolchain
+            assert hvd.mpi_built() is False
+            assert hvd.cuda_built() is False
+            assert hvd.ccl_built() is False
+            assert hvd.ddl_built() is False
+            assert hvd.mpi_threads_supported() is False
+            assert hvd.nccl_built() == 0
+            return
+        except (AssertionError, OSError, RuntimeError) as e:
+            last = e
+            time.sleep(2 * (attempt + 1))
+    raise AssertionError("predicate values failed 3 attempts: %s" % last)
 
 
 def test_tf_execution_time_ops():

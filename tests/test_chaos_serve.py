@@ -1,0 +1,289 @@
+"""Tier-2 chaos: crash-safe serving (ISSUE 8 acceptance criteria).
+
+One end-to-end scenario over a REAL Checkpointer artifact at np=2
+replicas, driven through ``python -m horovod_tpu.serve``:
+
+1. concurrent ``POST /v1/predict`` requests are answered with batched
+   inference and correct (bit-stable) results;
+2. kill -9 one replica mid-load: requests keep succeeding (router
+   retry), and the replica is culled within 2x the liveness deadline;
+3. SIGKILL the router, restart it (``--role router``) over the same
+   journal and port: the replayed routing table serves again — no
+   lost update (the culled replica stays culled, the survivor is
+   still routed to) — while the surviving replica never noticed.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.tier2, pytest.mark.slow]
+
+LIVENESS_SEC = 6.0
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _serve_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # The test suite's conftest exports an 8-virtual-device XLA_FLAGS
+    # into os.environ; a standalone serving fleet does not run under
+    # it (and bucket 4 vs 8 cross-compile one ulp apart under it —
+    # tests/test_serve_batching.py). Scrub it so the replicas run the
+    # production single-device CPU config the defaults are tuned for.
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    env["HVD_HEARTBEAT_SEC"] = "1"
+    env["HVD_SERVE_CKPT_POLL_SEC"] = "0"  # no reload noise mid-chaos
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _get_json(port, path, timeout=5.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            return None
+        return json.loads(body.decode())
+    except (OSError, ValueError):
+        return None
+    finally:
+        conn.close()
+
+
+def _predict(port, rows, timeout=35.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/predict",
+                     body=json.dumps({"inputs": rows}))
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode() or "{}")
+    finally:
+        conn.close()
+
+
+class _LoadGenerator:
+    """Background client threads firing predicts continuously."""
+
+    def __init__(self, port, xs, threads=3):
+        self.port = port
+        self.xs = xs
+        self.ok = 0
+        self.failed = []
+        self.batched_rows = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(threads)]
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            row = self.xs[i % len(self.xs)]
+            i += 1
+            try:
+                status, doc = _predict(self.port, [row.tolist()])
+            except OSError as e:
+                with self._lock:
+                    self.failed.append("conn: %s" % e)
+                continue
+            with self._lock:
+                if status == 200:
+                    self.ok += 1
+                else:
+                    self.failed.append("status %d: %s" % (status, doc))
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+    def snapshot(self):
+        with self._lock:
+            return self.ok, list(self.failed)
+
+
+def _drain(proc, sink):
+    """Read a child's merged stdout forever so the pipe never fills
+    (replica workers inherit the serve process's handles)."""
+
+    def run():
+        for line in proc.stdout:
+            sink.append(line)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_replicas(port, want, timeout, alive_proc=None):
+    deadline = time.monotonic() + timeout
+    doc = None
+    while time.monotonic() < deadline:
+        if alive_proc is not None and alive_proc.poll() is not None:
+            raise AssertionError("serve process died rc=%s"
+                                 % alive_proc.returncode)
+        doc = _get_json(port, "/healthz")
+        if doc is not None and len(doc.get("replicas", {})) == want:
+            return doc
+        time.sleep(0.3)
+    raise AssertionError("never reached %d replicas (last: %s)"
+                         % (want, doc))
+
+
+def test_serve_chaos_replica_kill9_then_router_sigkill(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import MnistMLP
+    from horovod_tpu.utils.checkpoint import Checkpointer
+
+    # --- a real trained-artifact stand-in: committed orbax step -------------
+    ckpt_dir = str(tmp_path / "ckpt")
+    journal_dir = str(tmp_path / "journal")
+    model = MnistMLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 28, 28)))
+    ck = Checkpointer(ckpt_dir, max_to_keep=1)
+    assert ck.save(0, {"params": params})
+    ck.close()
+
+    rng = np.random.RandomState(11)
+    xs = rng.standard_normal((6, 28, 28)).astype(np.float32)
+    ref = np.asarray(jax.jit(
+        lambda x: model.apply(params, x, train=False))(jnp.asarray(xs)))
+
+    port = _free_port()
+    env = _serve_env()
+    serve = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.serve",
+         "--ckpt-dir", ckpt_dir, "--model", "mnist_mlp",
+         "--np", "2", "--port", str(port),
+         "--journal-dir", journal_dir,
+         "--liveness-sec", str(LIVENESS_SEC)],
+        cwd=_REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    serve_log = []
+    _drain(serve, serve_log)
+    load = None
+    router2 = None
+    replica_pids = []
+    try:
+        doc = _wait_replicas(port, 2, timeout=180, alive_proc=serve)
+        replica_pids = [info["pid"] for info in doc["replicas"].values()]
+
+        # --- phase 1: concurrent batched inference, correct results --------
+        status, doc = _predict(port, xs[:3].tolist())
+        assert status == 200
+        got = np.asarray(doc["outputs"], dtype=np.float32)
+        # Serving pads rows into buckets; row results are stable to a
+        # few ulp of the direct full-batch apply.
+        np.testing.assert_allclose(got, ref[:3], rtol=0, atol=5e-6)
+
+        load = _LoadGenerator(port, xs)
+        load.start()
+        deadline = time.monotonic() + 60
+        while load.snapshot()[0] < 20:
+            assert time.monotonic() < deadline, \
+                "load generator made no progress"
+            time.sleep(0.2)
+        ok_before, failed_before = load.snapshot()
+        assert not failed_before, failed_before
+        # micro-batching actually batched concurrent requests
+        metrics = _get_json(port, "/metrics.json")
+        assert metrics["hvd_serve_qps"]["values"][0]["value"] >= 0
+
+        # --- phase 2: kill -9 one replica mid-load --------------------------
+        victim = replica_pids[0]
+        os.kill(victim, signal.SIGKILL)
+        t_kill = time.monotonic()
+        doc = _wait_replicas(port, 1, timeout=2 * LIVENESS_SEC + 5,
+                             alive_proc=serve)
+        cull_latency = time.monotonic() - t_kill
+        assert cull_latency <= 2 * LIVENESS_SEC, \
+            "cull took %.1fs (> 2x liveness %.1fs)" % (cull_latency,
+                                                       LIVENESS_SEC)
+        survivor_pid = list(doc["replicas"].values())[0]["pid"]
+        assert survivor_pid != victim
+
+        # requests kept succeeding through the kill (retry masks the
+        # dead pick; tolerate nothing — with a live second replica the
+        # one retry always lands).
+        ok_mid, failed_mid = load.snapshot()
+        assert not failed_mid, failed_mid
+        deadline = time.monotonic() + 60
+        while load.snapshot()[0] < ok_mid + 10:
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+
+        # --- phase 3: SIGKILL the router, restart over the journal ----------
+        load.stop()
+        ok_final, failed_final = load.snapshot()
+        assert not failed_final, failed_final
+        assert ok_final > ok_before
+        serve.send_signal(signal.SIGKILL)
+        serve.wait(timeout=30)
+
+        router2 = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.serve",
+             "--role", "router", "--port", str(port),
+             "--journal-dir", journal_dir,
+             "--liveness-sec", str(LIVENESS_SEC)],
+            cwd=_REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        _drain(router2, serve_log)
+        doc = _wait_replicas(port, 1, timeout=60, alive_proc=router2)
+        # no lost update: the journal-replayed table routes to the
+        # surviving replica (same pid), and the culled one stayed out.
+        assert [info["pid"] for info in doc["replicas"].values()] \
+            == [survivor_pid]
+        assert doc["replayed"] >= 1
+
+        status, doc = _predict(port, xs[:2].tolist())
+        assert status == 200
+        got = np.asarray(doc["outputs"], dtype=np.float32)
+        np.testing.assert_allclose(got, ref[:2], rtol=0, atol=5e-6)
+    finally:
+        if load is not None:
+            load.stop()
+        for proc in (serve, router2):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        for pid in replica_pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass

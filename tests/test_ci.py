@@ -141,6 +141,13 @@ TIER2_COVERAGE = {
     "test_sigstop_worker_replaced_by_liveness":
         "tests/test_elastic_resilience.py::"
         "test_driver_wedge_detection_after_first_heartbeat",
+    # Serving (ISSUE 8): journal replay, retry-once routing, cull and
+    # re-admission all run fast and jax-free in test_serve_router.py;
+    # the real-checkpoint np=2 fleet with replica kill -9 + router
+    # SIGKILL is the heavyweight variant.
+    "test_serve_chaos_replica_kill9_then_router_sigkill":
+        "tests/test_serve_router.py::"
+        "test_round_robin_spreads_and_journal_survives_restart",
     # Wire path (ISSUE 6): chunk math and pipelined-vs-legacy equality
     # run fast at np=2/3 in test_wire.py; the np=4 busbw sweep and the
     # fault-injection-through-the-pipeline runs are the heavyweight

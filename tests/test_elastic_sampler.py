@@ -184,11 +184,27 @@ def test_record_batch_after_reset_uses_new_shard(monkeypatch):
 
 
 def test_sampler_sync_multiproc():
+    # Known tier-1 load flake (memory file): under the full 870 s
+    # verify this np=2 launch occasionally times out / loses a worker
+    # on the oversubscribed 2-core box while passing in isolation.
+    # Deflake: widened subprocess deadline + one bounded retry so
+    # stash-A/B comparisons stop tripping on scheduler noise; a real
+    # sampler-sync bug still fails both attempts.
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
-         sys.executable, os.path.join(_REPO, "tests", "sampler_worker.py")],
-        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert proc.stdout.count("SAMPLER_OK") == 2
+    last = None
+    for attempt in range(2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+                 sys.executable,
+                 os.path.join(_REPO, "tests", "sampler_worker.py")],
+                cwd=_REPO, env=env, capture_output=True, text=True,
+                timeout=600)
+        except subprocess.TimeoutExpired as e:
+            last = "timeout: %s" % e
+            continue
+        if proc.returncode == 0 and proc.stdout.count("SAMPLER_OK") == 2:
+            return
+        last = "rc=%s\n%s%s" % (proc.returncode, proc.stdout, proc.stderr)
+    raise AssertionError("sampler sync failed twice: %s" % last)

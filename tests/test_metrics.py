@@ -322,3 +322,56 @@ def test_native_counter_bridge_np2():
     for r, (c, out) in enumerate(zip(codes, outputs)):
         assert c == 0, "rank %d failed:\n%s" % (r, out)
     assert sum("METRICS_OK" in o for o in outputs) == 2
+
+
+# --- histogram quantiles (docs/metrics.md#histogram-quantiles) --------------
+
+
+def test_quantile_from_buckets_semantics():
+    from horovod_tpu.utils.metrics import quantile_from_buckets
+
+    bounds = (1.0, 2.0, 4.0)
+    # counts: 2 in (0,1], 2 in (1,2], 0 in (2,4], 0 overflow
+    counts = [2, 2, 0, 0]
+    # p50 rank = 2 lands exactly at the first bucket's cumulative edge:
+    # interpolate inside (0, 1].
+    assert quantile_from_buckets(bounds, counts, 0.50) == 1.0
+    # p75 rank = 3: halfway through the (1, 2] bucket.
+    assert quantile_from_buckets(bounds, counts, 0.75) == 1.5
+    # empty histogram has no quantiles (not 0 — that would fake a
+    # perfect SLO)
+    assert quantile_from_buckets(bounds, [0, 0, 0, 0], 0.99) is None
+    # quantile in the +Inf overflow slot reports the highest finite
+    # bound ("at least this much")
+    assert quantile_from_buckets(bounds, [0, 0, 0, 5], 0.50) == 4.0
+    # all mass in the first bucket interpolates from 0
+    assert quantile_from_buckets(bounds, [4, 0, 0, 0], 0.50) == 0.5
+
+
+def test_histogram_exports_carry_p50_p99():
+    import json as _json
+
+    from horovod_tpu.utils import metrics
+
+    h = metrics.REGISTRY.histogram(
+        "hvd_ts_quant_seconds", "quantile test fixture",
+        buckets=(0.01, 0.1, 1.0, 10.0))
+    try:
+        state = h.get()
+        assert state["p50"] is None and state["p99"] is None
+        for v in [0.05] * 98 + [5.0, 5.0]:
+            h.observe(v)
+        state = h.get()
+        assert 0.01 < state["p50"] <= 0.1
+        assert 1.0 < state["p99"] <= 10.0
+        # the derived quantiles ride every JSON export unchanged
+        snap = metrics.snapshot()["hvd_ts_quant_seconds"]["values"][0]
+        assert snap["p50"] == state["p50"]
+        doc = _json.loads(metrics.render_json())
+        assert doc["hvd_ts_quant_seconds"]["values"][0]["p99"] \
+            == state["p99"]
+        # ...but never the Prometheus text format (histograms have no
+        # quantile lines in the exposition spec)
+        assert "p50" not in metrics.render_prometheus()
+    finally:
+        metrics.REGISTRY.unregister("hvd_ts_quant_seconds")

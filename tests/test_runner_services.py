@@ -165,3 +165,130 @@ def test_mpi_env_rank_fallback():
                           cwd=os.path.dirname(os.path.dirname(
                               os.path.abspath(__file__))))
     assert "ENV_OK" in proc.stdout, proc.stderr
+
+
+# --- threaded KV/HTTP server (the serving front door's foundation) ----------
+
+
+def _http_get(port, path, timeout=10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_kv_server_concurrent_slow_gets():
+    """Two slow GETs must overlap, not serialize: the serve router
+    proxies slow replica inference behind one route while health and
+    heartbeat traffic rides others — a single-threaded server would
+    stack them. Regression for the ThreadingHTTPServer + per-route
+    handler contract in runner/http_server.py.
+
+    Overlap is detected INSIDE the handler (both requests observed
+    concurrently in-flight) rather than by wall-clock margins — this
+    box's tier-1 load makes timing thresholds a flake factory (see the
+    deflaked tests in this PR)."""
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    barrier = threading.Barrier(2)
+    both_inside = threading.Event()
+
+    def slow_route():
+        # The barrier only passes when BOTH requests are inside their
+        # handlers at the same time; a serialized server leaves each
+        # handler waiting alone until the timeout breaks the barrier.
+        try:
+            barrier.wait(timeout=5)
+            both_inside.set()
+        except threading.BrokenBarrierError:
+            pass
+        return (200, "text/plain", b"ok")
+
+    server = KVStoreServer(port=0)
+    server.register_get_route("/slow", slow_route)
+    port = server.start()
+    try:
+        results = []
+
+        def hit():
+            results.append(_http_get(port, "/slow", timeout=30))
+
+        threads = [threading.Thread(target=hit) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 2
+        assert all(status == 200 and body == b"ok"
+                   for status, body in results)
+        assert both_inside.is_set(), \
+            "the two GETs were never in the handler simultaneously — " \
+            "request handling serialized"
+    finally:
+        server.stop()
+
+
+def test_kv_put_callbacks_are_serialized():
+    """put_callback runs under the server's callback lock: concurrent
+    PUTs must never overlap inside the callback (the elastic driver's
+    heartbeat stamping and the serve router's journal appends rely on
+    it)."""
+    from horovod_tpu.runner.http_server import KVStoreServer, write_kv
+
+    inside = []
+    overlaps = []
+
+    def cb(scope, key, value):
+        if inside:
+            overlaps.append(key)
+        inside.append(key)
+        time.sleep(0.05)
+        inside.pop()
+
+    server = KVStoreServer(port=0, put_callback=cb)
+    port = server.start()
+    try:
+        threads = [
+            threading.Thread(
+                target=write_kv,
+                args=("127.0.0.1", port, "s", "k%d" % i, b"v"))
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert not overlaps, "callback overlapped for keys %r" % overlaps
+    finally:
+        server.stop()
+
+
+def test_kv_post_route_and_404():
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    server = KVStoreServer(port=0)
+    server.register_post_route(
+        "/echo", lambda body: (200, "application/octet-stream",
+                               body[::-1]))
+    port = server.start()
+    try:
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/echo", body=b"abc")
+        resp = conn.getresponse()
+        assert (resp.status, resp.read()) == (200, b"cba")
+        conn.close()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/nosuch", body=b"x")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        conn.close()
+    finally:
+        server.stop()

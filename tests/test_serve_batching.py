@@ -1,0 +1,296 @@
+"""Serving micro-batcher: triggers, bucketing, bit-exactness, metrics.
+
+Tier-1 fast units for docs/serving.md's hot path. The jax-backed
+bit-exactness cases ride the shared compile cache (tiny MLP programs)
+and stay in the seconds range.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serve.batching import (
+    MicroBatcher,
+    assert_bucket_equality,
+    bucket_sizes,
+    pad_to_bucket,
+    pick_bucket,
+)
+from horovod_tpu.utils import metrics as _metrics
+
+
+# --- bucket math ------------------------------------------------------------
+
+
+def test_bucket_sizes_double_to_max():
+    assert bucket_sizes(8, 4) == [4, 8]
+    assert bucket_sizes(16, 2) == [2, 4, 8, 16]
+    # a non-power-of-two max is still the last bucket
+    assert bucket_sizes(12, 4) == [4, 8, 12]
+    # min clamped into [1, max]
+    assert bucket_sizes(2, 8) == [2]
+    assert bucket_sizes(1, 0) == [1]
+
+
+def test_pick_bucket_smallest_fit():
+    buckets = [4, 8, 12]
+    assert pick_bucket(1, buckets) == 4
+    assert pick_bucket(4, buckets) == 4
+    assert pick_bucket(5, buckets) == 8
+    assert pick_bucket(12, buckets) == 12
+    with pytest.raises(ValueError):
+        pick_bucket(13, buckets)
+
+
+def test_pad_to_bucket_zero_pads():
+    rows = np.ones((3, 2), np.float32)
+    padded = pad_to_bucket(rows, 8)
+    assert padded.shape == (8, 2)
+    assert np.array_equal(padded[:3], rows)
+    assert not padded[3:].any()
+    assert pad_to_bucket(rows, 3) is rows
+
+
+# --- triggers ---------------------------------------------------------------
+
+
+def test_size_trigger_fires_before_deadline():
+    shapes = []
+    mb = MicroBatcher(lambda x: (shapes.append(x.shape), x)[1],
+                      max_batch=4, deadline_ms=30000, min_bucket=2)
+    try:
+        t0 = time.monotonic()
+        futs = [mb.submit(np.full((1, 3), i, np.float32))
+                for i in range(4)]
+        outs = [f.result(timeout=10) for f in futs]
+        assert time.monotonic() - t0 < 5, "size trigger waited on deadline"
+        assert shapes == [(4, 3)]
+        for i, out in enumerate(outs):
+            assert np.array_equal(out, np.full((1, 3), i, np.float32))
+    finally:
+        mb.stop()
+
+
+def test_deadline_trigger_fires_partial_batch():
+    shapes = []
+    mb = MicroBatcher(lambda x: (shapes.append(x.shape), x)[1],
+                      max_batch=64, deadline_ms=50, min_bucket=2)
+    try:
+        fut = mb.submit(np.ones((1, 3), np.float32))
+        out = fut.result(timeout=10)
+        assert out.shape == (1, 3)
+        assert shapes == [(2, 3)], "1 row should pad to the min bucket"
+    finally:
+        mb.stop()
+
+
+def test_zero_deadline_means_no_batching_delay():
+    mb = MicroBatcher(lambda x: x, max_batch=64, deadline_ms=0,
+                      min_bucket=1)
+    try:
+        t0 = time.monotonic()
+        assert mb.submit(np.ones((1, 2), np.float32)).result(
+            timeout=10).shape == (1, 2)
+        assert time.monotonic() - t0 < 2
+    finally:
+        mb.stop()
+
+
+def test_requests_are_never_split_across_batches():
+    shapes = []
+    mb = MicroBatcher(lambda x: (shapes.append(x.shape), x)[1],
+                      max_batch=4, deadline_ms=50, min_bucket=4)
+    try:
+        a = mb.submit(np.ones((3, 2), np.float32))
+        b = mb.submit(np.ones((3, 2), np.float32))
+        a.result(timeout=10)
+        b.result(timeout=10)
+        # 3+3 > max 4: two batches of one whole request each.
+        assert shapes == [(4, 2), (4, 2)]
+    finally:
+        mb.stop()
+
+
+# --- recompile bound --------------------------------------------------------
+
+
+def test_shape_bucketing_bounds_recompiles():
+    """Whatever request-size mix traffic brings, the executed batch
+    shapes stay within the configured bucket set — the proxy for 'XLA
+    compiles at most len(buckets) programs'."""
+    seen = set()
+    mb = MicroBatcher(lambda x: (seen.add(x.shape[0]), x)[1],
+                      max_batch=8, deadline_ms=5, min_bucket=4)
+    try:
+        futs = []
+        for n in (1, 2, 3, 5, 7, 8, 4, 6, 1, 8):
+            futs.append(mb.submit(np.ones((n, 2), np.float32)))
+        for f in futs:
+            f.result(timeout=10)
+        assert seen <= {4, 8}, seen
+    finally:
+        mb.stop()
+
+
+# --- error paths ------------------------------------------------------------
+
+
+def test_oversize_request_rejected_at_submit():
+    mb = MicroBatcher(lambda x: x, max_batch=4, deadline_ms=5,
+                      min_bucket=4)
+    try:
+        with pytest.raises(ValueError, match="HVD_SERVE_MAX_BATCH"):
+            mb.submit(np.ones((5, 2), np.float32))
+    finally:
+        mb.stop()
+
+
+def test_run_batch_exception_propagates_to_futures_only():
+    calls = []
+
+    def run(x):
+        calls.append(x.shape[0])
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return x
+
+    mb = MicroBatcher(run, max_batch=2, deadline_ms=5, min_bucket=2)
+    try:
+        bad = mb.submit(np.ones((2, 2), np.float32))
+        with pytest.raises(RuntimeError, match="boom"):
+            bad.result(timeout=10)
+        # the batcher thread survived and keeps serving
+        ok = mb.submit(np.ones((2, 2), np.float32))
+        assert ok.result(timeout=10).shape == (2, 2)
+    finally:
+        mb.stop()
+
+
+def test_stop_fails_pending_and_rejects_new():
+    mb = MicroBatcher(lambda x: x, max_batch=64, deadline_ms=60000,
+                      min_bucket=4)
+    fut = mb.submit(np.ones((1, 2), np.float32))
+    mb.stop()
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=10)
+    with pytest.raises(RuntimeError):
+        mb.submit(np.ones((1, 2), np.float32))
+
+
+# --- metrics ----------------------------------------------------------------
+
+
+def test_queue_depth_and_batch_size_metrics():
+    gate = threading.Event()
+
+    def run(x):
+        gate.wait(timeout=10)
+        return x
+
+    before = _metrics.value("hvd_serve_batches_total") or 0
+    mb = MicroBatcher(run, max_batch=2, deadline_ms=5, min_bucket=2)
+    try:
+        f1 = mb.submit(np.ones((2, 2), np.float32))  # occupies run_batch
+        f1_taken = time.monotonic()
+        while _metrics.value("hvd_serve_queue_depth"):
+            if time.monotonic() - f1_taken > 10:
+                raise AssertionError("first batch never drained")
+            time.sleep(0.01)
+        f2 = mb.submit(np.ones((2, 2), np.float32))  # queued behind it
+        assert _metrics.value("hvd_serve_queue_depth") == 2
+        gate.set()
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+        deadline = time.monotonic() + 10
+        while _metrics.value("hvd_serve_queue_depth"):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert (_metrics.value("hvd_serve_batches_total") or 0) \
+            >= before + 2
+        hist = _metrics.value("hvd_serve_batch_size")
+        assert hist["count"] >= 2
+    finally:
+        mb.stop()
+
+
+# --- bit-exactness (the PR 7 bucket discipline, jax-backed) -----------------
+
+
+@pytest.fixture(scope="module")
+def mlp_apply():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import MnistMLP
+
+    model = MnistMLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 28, 28)))
+    fn = jax.jit(lambda x: model.apply(params, x, train=False))
+    return lambda x: np.asarray(fn(x))
+
+
+def test_batched_vs_unbatched_bit_equality(mlp_apply):
+    """A request's answer must not depend on its co-batched rows: the
+    same row served alone (deadline trigger, zero-padded) and served
+    in a full batch of strangers (size trigger) is bitwise identical.
+    Single-bucket configuration so the test pins row independence —
+    the invariant that holds on every backend config — separately from
+    cross-bucket stability (probed below, backend-dependent: the
+    test suite's 8-virtual-device XLA_FLAGS compiles bucket 4 one ulp
+    apart from bucket 8, while a standalone replica's backend does
+    not)."""
+    rng = np.random.RandomState(7)
+    xs = rng.standard_normal((8, 28, 28)).astype(np.float32)
+
+    mb = MicroBatcher(mlp_apply, max_batch=8, deadline_ms=5, min_bucket=8)
+    try:
+        alone = mb.submit(xs[:1]).result(timeout=60)
+        batched = [mb.submit(xs[i:i + 1]) for i in range(8)]
+        outs = [f.result(timeout=60) for f in batched]
+    finally:
+        mb.stop()
+    assert np.array_equal(alone[0], outs[0][0]), \
+        "same row differs between lone (padded) and full-batch serving"
+    # and the whole batch agrees with a direct bucket-8 apply
+    direct = mlp_apply(xs)
+    for i in range(8):
+        assert np.array_equal(outs[i][0], direct[i])
+
+
+def test_bucket_equality_assertion_passes_stable_buckets(mlp_apply):
+    # [8, 16] compile row-stable both standalone and under the test
+    # suite's 8-virtual-device backend (unlike [4, 8], which only
+    # agree standalone — see the tripwire below).
+    assert_bucket_equality(mlp_apply, [8, 16],
+                           np.zeros((28, 28), np.float32) + 0.5)
+
+
+def test_bucket_equality_tripwire_catches_unstable_bucket(mlp_apply):
+    """Bucket 1 compiles the MLP to a one-ulp-different program on
+    this backend — exactly what the startup self-check exists to
+    catch. If this ever starts passing, the default HVD_SERVE_MIN_BUCKET
+    can drop; what it must never do is pass silently wrong."""
+    try:
+        assert_bucket_equality(mlp_apply, [1, 8],
+                               np.zeros((28, 28), np.float32) + 0.5)
+    except AssertionError as e:
+        assert "HVD_SERVE_MIN_BUCKET" in str(e)
+    else:
+        pytest.skip("backend compiled bucket 1 row-stable here; "
+                    "tripwire not exercisable")
+
+
+def test_bucket_equality_catches_row_crosstalk():
+    """A batch-coupled model (softmax over the batch axis) must trip
+    the check even under zero padding — the pseudo-random co-rows are
+    what expose it."""
+
+    def coupled(x):
+        flat = x.reshape(x.shape[0], -1)
+        return flat / (1e-6 + np.abs(flat).sum(axis=0, keepdims=True))
+
+    with pytest.raises(AssertionError):
+        assert_bucket_equality(coupled, [4, 8],
+                               np.ones((3,), np.float32))

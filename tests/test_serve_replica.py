@@ -1,0 +1,127 @@
+"""Serving replica: real Checkpointer restore, startup self-check,
+checkpoint hot-reload, model registry. Tier-1 (seconds: tiny pytrees,
+shared compile cache; the multi-process fleet lives in
+tests/test_chaos_serve.py).
+
+Note on buckets: the suite's 8-virtual-device XLA_FLAGS makes bucket 4
+compile one ulp apart from bucket 8 (tests/test_serve_batching.py pins
+it), so in-process replicas here run a single bucket (min_bucket =
+max_batch = 8) — the configuration the startup self-check accepts
+under this backend.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serve.replica import Replica
+
+
+def _post(port, doc, timeout=15.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/predict", body=json.dumps(doc))
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode() or "{}")
+    finally:
+        conn.close()
+
+
+def test_unknown_model_and_missing_ckpt_dir_fail_loudly():
+    with pytest.raises(ValueError, match="unknown model"):
+        Replica(model="no_such_model").load()
+    with pytest.raises(ValueError, match="ckpt-dir"):
+        Replica(model="mnist_mlp").load()
+
+
+def test_mnist_mlp_replica_serves_restored_checkpoint(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import MnistMLP
+    from horovod_tpu.utils.checkpoint import Checkpointer
+
+    model = MnistMLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 28, 28)))
+    ck = Checkpointer(str(tmp_path), max_to_keep=1)
+    assert ck.save(0, {"params": params})
+    ck.close()
+
+    replica = Replica(model="mnist_mlp", ckpt_dir=str(tmp_path),
+                      replica_id="r0", max_batch=8, min_bucket=8)
+    try:
+        replica.start()
+        assert replica.step == 0
+        rng = np.random.RandomState(3)
+        xs = rng.standard_normal((2, 28, 28)).astype(np.float32)
+        status, doc = _post(replica.port, {"inputs": xs.tolist()})
+        assert status == 200
+        assert doc["model"] == "mnist_mlp" and doc["step"] == 0
+        got = np.asarray(doc["outputs"], dtype=np.float32)
+        # Reference through the same bucket shape (the serve path pads
+        # to 8): bitwise-equal by the bucket discipline.
+        fn = jax.jit(lambda x: model.apply(params, x, train=False))
+        padded = np.zeros((8, 28, 28), np.float32)
+        padded[:2] = xs
+        want = np.asarray(fn(padded))[:2]
+        assert np.array_equal(got, want)
+    finally:
+        replica.stop()
+
+
+def test_checkpoint_hot_reload_swaps_newer_committed_step(
+        tmp_path, monkeypatch):
+    from horovod_tpu.utils.checkpoint import Checkpointer
+
+    monkeypatch.setenv("HVD_SERVE_CKPT_POLL_SEC", "0.2")
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    assert ck.save(0, {"params": {"scale": np.float32(2.0)}})
+
+    def apply_fn(params, x):
+        return x * params["scale"]
+
+    replica = Replica(ckpt_dir=str(tmp_path), replica_id="r0",
+                      apply_fn=apply_fn, sample_shape=(2,),
+                      max_batch=4, min_bucket=4, deadline_ms=1)
+    try:
+        replica.start()
+        status, doc = _post(replica.port, {"inputs": [[1.0, 1.0]]})
+        assert status == 200 and doc["outputs"] == [[2.0, 2.0]]
+        assert doc["step"] == 0
+        # training publishes a newer committed step into the same dir
+        assert ck.save(1, {"params": {"scale": np.float32(5.0)}})
+        deadline = time.monotonic() + 30
+        while True:
+            status, doc = _post(replica.port, {"inputs": [[1.0, 1.0]]})
+            assert status == 200
+            if doc["outputs"] == [[5.0, 5.0]]:
+                assert doc["step"] == 1
+                break
+            assert time.monotonic() < deadline, \
+                "hot reload never landed (still %r)" % (doc,)
+            time.sleep(0.2)
+    finally:
+        replica.stop()
+        ck.close()
+
+
+def test_replica_startup_self_check_blocks_coupled_model(tmp_path):
+    """A model whose rows couple across the batch axis must be refused
+    at startup — before it can serve load-dependent answers."""
+    from horovod_tpu.utils.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path), max_to_keep=1)
+    assert ck.save(0, {"params": {"bias": np.float32(1.0)}})
+    ck.close()
+
+    def coupled(params, x):
+        return x + x.sum(axis=0, keepdims=True) + params["bias"]
+
+    replica = Replica(ckpt_dir=str(tmp_path), apply_fn=coupled,
+                      sample_shape=(2,), max_batch=4, min_bucket=4)
+    with pytest.raises(AssertionError, match="bit-exactness"):
+        replica.load()

@@ -1,0 +1,411 @@
+"""Serving router: journaled membership, round-robin + retry,
+heartbeat liveness, re-admission, healthz. All jax-free tier-1 units
+(fake replicas are plain KVStoreServer routes)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner.http_server import KVStoreServer, write_kv
+from horovod_tpu.runner.journal import DriverJournal
+from horovod_tpu.serve.autoscale import ReplicaMonitor
+from horovod_tpu.serve.router import (
+    Router,
+    replay_routing,
+    serve_journal_path,
+)
+from horovod_tpu.utils import metrics as _metrics
+
+
+def _post(port, path, doc, timeout=10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(doc))
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode() or "{}")
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode() or "{}")
+    finally:
+        conn.close()
+
+
+class _FakeReplica:
+    """A KVStoreServer answering /v1/predict with its own tag."""
+
+    def __init__(self, tag, fail=False):
+        self.tag = tag
+        self.fail = fail
+        self.hits = 0
+        self._server = KVStoreServer(port=0)
+        self._server.register_post_route("/v1/predict", self._predict)
+        self.port = self._server.start()
+
+    def _predict(self, body):
+        self.hits += 1
+        if self.fail:
+            return (500, "application/json",
+                    json.dumps({"error": "injected"}).encode())
+        return (200, "application/json",
+                json.dumps({"replica": self.tag}).encode())
+
+    def info(self):
+        return {"addr": "127.0.0.1", "port": self.port,
+                "pid": os.getpid(), "model": "fake"}
+
+    def stop(self):
+        self._server.stop()
+
+
+# --- journal replay ---------------------------------------------------------
+
+
+def _write_journal(path, records):
+    j = DriverJournal(path)
+    for rec in records:
+        j.append(rec)
+    j.close()
+
+
+def test_replay_routing_folds_admits_and_culls(tmp_path):
+    path = serve_journal_path(str(tmp_path))
+    _write_journal(path, [
+        {"type": "replica", "id": "r0", "addr": "h0", "port": 1,
+         "pid": 10, "model": "m"},
+        {"type": "replica", "id": "r1", "addr": "h1", "port": 2,
+         "pid": 11, "model": "m"},
+        {"type": "cull", "id": "r0", "reason": "silent"},
+        {"type": "replica", "id": "r0", "addr": "h0", "port": 3,
+         "pid": 12, "model": "m"},  # re-admitted on a new port
+        {"type": "unknown_future_record", "id": "rX"},
+    ])
+    table = replay_routing(path)
+    assert set(table) == {"r0", "r1"}
+    assert table["r0"]["port"] == 3  # last endpoint wins
+
+
+def test_replay_routing_tolerates_torn_tail(tmp_path):
+    path = serve_journal_path(str(tmp_path))
+    _write_journal(path, [
+        {"type": "replica", "id": "r0", "addr": "h", "port": 1,
+         "pid": 1, "model": "m"},
+    ])
+    with open(path, "a") as fh:
+        fh.write('{"type": "cull", "id": "r0", "rea')  # crash mid-append
+    assert set(replay_routing(path)) == {"r0"}
+    # and a router attaching over the torn tail keeps a usable journal
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.admit("r1", {"addr": "h", "port": 2, "pid": 2, "model": "m"})
+    router.stop()
+    table = replay_routing(path)
+    assert set(table) == {"r0", "r1"}
+
+
+def test_replay_routing_missing_file(tmp_path):
+    assert replay_routing(serve_journal_path(str(tmp_path))) == {}
+
+
+# --- routing behavior -------------------------------------------------------
+
+
+def test_round_robin_spreads_and_journal_survives_restart(tmp_path):
+    a, b = _FakeReplica("A"), _FakeReplica("B")
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    port = router.start()
+    try:
+        router.admit("rA", a.info())
+        router.admit("rB", b.info())
+        tags = []
+        for _ in range(6):
+            status, doc = _post(port, "/v1/predict", {"inputs": [[1.0]]})
+            assert status == 200
+            tags.append(doc["replica"])
+        assert tags.count("A") == 3 and tags.count("B") == 3
+    finally:
+        router.stop()
+    # SIGKILL-equivalent: a brand-new router over the same journal
+    # restarts into the same routing table and serves immediately.
+    router2 = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    port2 = router2.start()
+    try:
+        assert set(router2.replicas()) == {"rA", "rB"}
+        assert router2._replayed == 2
+        status, doc = _post(port2, "/v1/predict", {"inputs": [[1.0]]})
+        assert status == 200 and doc["replica"] in ("A", "B")
+    finally:
+        router2.stop()
+        a.stop()
+        b.stop()
+
+
+def test_failed_replica_retried_once_against_another():
+    bad, good = _FakeReplica("bad", fail=True), _FakeReplica("good")
+    retries_before = _metrics.value("hvd_serve_retries_total") or 0
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        router.admit("bad", bad.info())
+        router.admit("good", good.info())
+        for _ in range(4):
+            status, doc = _post(port, "/v1/predict", {"inputs": [[1.0]]})
+            assert status == 200
+            assert doc["replica"] == "good"
+        assert bad.hits >= 1  # it was genuinely tried first sometimes
+        assert (_metrics.value("hvd_serve_retries_total") or 0) \
+            > retries_before
+    finally:
+        router.stop()
+        bad.stop()
+        good.stop()
+
+
+def test_unreachable_replica_retried_and_502_when_all_dead():
+    dead = _FakeReplica("dead")
+    dead.stop()  # port is now closed: connect refused
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        status, doc = _post(port, "/v1/predict", {"inputs": [[1.0]]})
+        assert status == 502
+        assert "no live replicas" in doc["error"]
+        router.admit("dead", dead.info())
+        status, doc = _post(port, "/v1/predict", {"inputs": [[1.0]]})
+        assert status == 502
+        assert "dead" in doc["error"]
+    finally:
+        router.stop()
+
+
+def test_client_errors_are_not_retried():
+    class _Bad400(_FakeReplica):
+        def _predict(self, body):
+            self.hits += 1
+            return (400, "application/json",
+                    json.dumps({"error": "bad shape"}).encode())
+
+    rep = _Bad400("B400")
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        router.admit("b", rep.info())
+        status, doc = _post(port, "/v1/predict", {"inputs": "garbage"})
+        assert status == 400
+        assert rep.hits == 1, "4xx must not burn the retry"
+    finally:
+        router.stop()
+        rep.stop()
+
+
+# --- membership: registration, heartbeats, cull, re-admission ---------------
+
+
+def test_registration_and_heartbeat_readmission_via_kv():
+    rep = _FakeReplica("A")
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        # registration PUT (what Replica.register() sends)
+        write_kv("127.0.0.1", port, "replica", "rA",
+                 json.dumps(rep.info()).encode())
+        assert set(router.replicas()) == {"rA"}
+        # cull, then a heartbeat carrying the endpoint re-admits
+        router.cull("rA", reason="test")
+        assert router.replicas() == {}
+        payload = dict(rep.info(), ts=time.time())
+        write_kv("127.0.0.1", port, "heartbeat", "rA",
+                 json.dumps(payload).encode())
+        assert set(router.replicas()) == {"rA"}
+        assert router.heartbeat_age("rA") is not None
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_monitor_culls_silent_replica_and_journal_remembers(tmp_path):
+    culled_before = _metrics.value("hvd_serve_culled_total") or 0
+    router = Router(port=0, journal_dir=str(tmp_path),
+                    liveness_sec=0.2, monitor=False)
+    router.start()
+    monitor = ReplicaMonitor(router, interval=3600)  # tick by hand
+    try:
+        router.admit("rA", {"addr": "h", "port": 1, "pid": 1,
+                            "model": "m"})
+        monitor.tick()
+        assert set(router.replicas()) == {"rA"}  # fresh clock: kept
+        router._hb_seen["rA"] = time.monotonic() - 1.0  # silent 1s
+        monitor.tick()
+        assert router.replicas() == {}
+        assert (_metrics.value("hvd_serve_culled_total") or 0) \
+            > culled_before
+    finally:
+        router.stop()
+    assert replay_routing(serve_journal_path(str(tmp_path))) == {}
+
+
+def test_monitor_updates_qps_and_replica_gauges():
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    rep = _FakeReplica("A")
+    monitor = ReplicaMonitor(router, interval=3600)
+    try:
+        router.admit("rA", rep.info())
+        monitor.tick()
+        assert _metrics.value("hvd_serve_replicas_live") == 1
+        t0 = time.monotonic()
+        for _ in range(5):
+            _post(port, "/v1/predict", {"inputs": [[1.0]]})
+        monitor.tick()
+        qps = _metrics.value("hvd_serve_qps")
+        elapsed = time.monotonic() - t0
+        assert qps > 0
+        assert qps <= 5 / max(elapsed, 1e-3) * 1.5 + 1
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_healthz_reports_table_and_heartbeat_ages():
+    router = Router(port=0, liveness_sec=12.5, monitor=False)
+    port = router.start()
+    try:
+        status, doc = _get(port, "/healthz")
+        assert status == 200
+        assert doc["ok"] is False and doc["replicas"] == {}
+        router.admit("rA", {"addr": "h", "port": 1, "pid": 7,
+                            "model": "m"})
+        status, doc = _get(port, "/healthz")
+        assert doc["ok"] is True
+        assert doc["replicas"]["rA"]["pid"] == 7
+        assert doc["replicas"]["rA"]["heartbeat_age_sec"] >= 0
+        assert doc["liveness_sec"] == 12.5
+        assert doc["role"] == "router"
+    finally:
+        router.stop()
+
+
+# --- end-to-end in-process with a real (identity) replica -------------------
+
+
+def test_identity_replica_end_to_end_roundtrip():
+    from horovod_tpu.serve.replica import Replica
+
+    router = Router(port=0, liveness_sec=30, monitor=False)
+    port = router.start()
+    replica = Replica(model="identity", router="127.0.0.1:%d" % port,
+                      replica_id="r0")
+    try:
+        replica.start()
+        deadline = time.monotonic() + 10
+        while not router.replicas():
+            assert time.monotonic() < deadline, "registration never landed"
+            time.sleep(0.05)
+        status, doc = _post(port, "/v1/predict",
+                            {"inputs": [[1.0, 2.0, 3.0, 4.0]]})
+        assert status == 200
+        assert doc["outputs"] == [[1.0, 2.0, 3.0, 4.0]]
+        assert doc["replica"] == "r0"
+        # requests metrics moved
+        assert (_metrics.value("hvd_serve_requests_total", outcome="ok")
+                or 0) >= 1
+        hist = _metrics.value("hvd_serve_latency_seconds")
+        assert hist["count"] >= 1 and hist["p50"] is not None
+    finally:
+        replica.stop()
+        router.stop()
+
+
+def test_replica_rejects_bad_shapes_and_payloads():
+    from horovod_tpu.serve.replica import Replica
+
+    replica = Replica(model="identity", replica_id="r0",
+                      sample_shape=(3,))
+    try:
+        replica.start()
+        port = replica.port
+        status, doc = _post(port, "/v1/predict", {"inputs": [[1.0, 2.0]]})
+        assert status == 400 and "shape" in doc["error"]
+        status, doc = _post(port, "/v1/predict", {"wrong_key": 1})
+        assert status == 400
+        # single row without batch dim is accepted and wrapped
+        status, doc = _post(port, "/v1/predict",
+                            {"inputs": [1.0, 2.0, 3.0]})
+        assert status == 200 and doc["rows"] == 1
+        status, doc = _get(port, "/healthz")
+        assert status == 200 and doc["role"] == "replica"
+    finally:
+        replica.stop()
+
+
+def test_heartbeat_with_new_endpoint_updates_known_replica(tmp_path):
+    """A replica respawned on a new port while the router was down
+    re-registers through its BEAT: known keys must adopt a changed
+    endpoint (journaled), not be pinned to the dead old port by the
+    very beats that name the right one."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    port = router.start()
+    try:
+        router.admit("rA", {"addr": "127.0.0.1", "port": 1111,
+                            "pid": 1, "model": "m"})
+        payload = {"ts": time.time(), "pid": 2, "addr": "127.0.0.1",
+                   "port": 2222, "model": "m"}
+        write_kv("127.0.0.1", port, "heartbeat", "rA",
+                 json.dumps(payload).encode())
+        assert router.replicas()["rA"]["port"] == 2222
+    finally:
+        router.stop()
+    assert replay_routing(
+        serve_journal_path(str(tmp_path)))["rA"]["port"] == 2222
+
+
+def test_replayed_replicas_unconfirmed_until_first_beat(tmp_path):
+    """Journal-replayed entries may be dead: healthz flags them
+    unconfirmed until this incarnation hears a live beat, so readiness
+    checks (Server.wait_ready) never count ghosts as capacity."""
+    path = serve_journal_path(str(tmp_path))
+    _write_journal(path, [
+        {"type": "replica", "id": "r0", "addr": "127.0.0.1",
+         "port": 1111, "pid": 1, "model": "m"},
+    ])
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    port = router.start()
+    try:
+        status, doc = _get(port, "/healthz")
+        assert doc["replicas"]["r0"]["confirmed"] is False
+        write_kv("127.0.0.1", port, "heartbeat", "r0",
+                 json.dumps({"ts": time.time(), "pid": 1,
+                             "addr": "127.0.0.1", "port": 1111,
+                             "model": "m"}).encode())
+        status, doc = _get(port, "/healthz")
+        assert doc["replicas"]["r0"]["confirmed"] is True
+    finally:
+        router.stop()
+
+
+def test_garbage_heartbeat_keys_leave_no_bookkeeping():
+    """The router KV is an open PUT endpoint (the PR 5 hazard):
+    endpoint-less beats for unknown keys must not grow _hb_seen or the
+    table."""
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        for i in range(5):
+            write_kv("127.0.0.1", port, "heartbeat", "ghost%d" % i,
+                     b"not json at all")
+        assert router.replicas() == {}
+        assert router._hb_seen == {}
+    finally:
+        router.stop()

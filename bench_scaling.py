@@ -71,7 +71,9 @@ def mesh_child() -> int:
 
         data_spec = jax.sharding.PartitionSpec(DATA_AXIS)
         repl = jax.sharding.PartitionSpec()
-        return jax.jit(jax.shard_map(
+        from horovod_tpu.parallel.mesh import shard_map_compat
+
+        return jax.jit(shard_map_compat(
             step, mesh=mesh,
             in_specs=(repl, repl, data_spec, data_spec),
             out_specs=(repl, repl, repl), check_vma=False)), tx
@@ -148,7 +150,9 @@ def busbw_child() -> int:
     x = jnp.ones((n, elems), jnp.float32)
     spec = jax.sharding.PartitionSpec("data")
 
-    step = jax.jit(jax.shard_map(
+    from horovod_tpu.parallel.mesh import shard_map_compat
+
+    step = jax.jit(shard_map_compat(
         lambda v: jax.lax.psum(v, "data"), mesh=mesh,
         in_specs=spec, out_specs=spec))
     step(x).block_until_ready()

@@ -20,7 +20,20 @@ TIER="${1:-all}"
 # checkers take seconds; the budget is dominated by gcc -fanalyzer
 # (controller.cc needs call-summary mode, see core/src/Makefile).
 run_analysis() {
-    echo "=== analysis: contract checkers (tools/analysis) ==="
+    echo "=== analysis: per-checker smoke (tools/analysis --checker) ==="
+    # One scoped run per checker BEFORE the combined run: a checker
+    # that crashes (rather than finds) then fails with its own name in
+    # the log. Each run is a fresh process, so the tree is re-parsed
+    # per checker (~4 s each, ~40 s for the loop — noise next to the
+    # fanalyzer budget below); run_all also names a crashing checker,
+    # this loop just guarantees the attribution shows up as the LAST
+    # lane header even if the combined run is skipped or wrapped.
+    for checker in knobs counters ctypes metrics excepts \
+                   locks journal jaxcompat testtier; do
+        echo "--- checker: $checker"
+        timeout 60 python -m tools.analysis --checker "$checker"
+    done
+    echo "=== analysis: contract checkers (tools/analysis, all) ==="
     timeout 120 python -m tools.analysis
     echo "=== analysis: native analyzer (make analyze) ==="
     timeout "${HVD_CI_ANALYSIS_BUDGET:-900}" \

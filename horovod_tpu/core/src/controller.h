@@ -39,8 +39,8 @@ class TensorQueue {
 
  private:
   std::mutex mu_;
-  std::unordered_map<std::string, TensorTableEntry> table_;
-  std::deque<Request> queue_;
+  std::unordered_map<std::string, TensorTableEntry> table_;  // GUARDED_BY(mu_)
+  std::deque<Request> queue_;  // GUARDED_BY(mu_)
 };
 
 // --------------------------------------------------------- response cache ---

@@ -41,7 +41,7 @@ struct Global {
   std::unique_ptr<Controller> controller;
 
   std::mutex ps_mutex;
-  std::map<int, std::unique_ptr<ProcessSetState>> process_sets;
+  std::map<int, std::unique_ptr<ProcessSetState>> process_sets;  // GUARDED_BY(ps_mutex)
 
   std::atomic<bool> shut_down{false};
   std::atomic<bool> failed{false};
@@ -60,7 +60,7 @@ struct Global {
   // Removals are deferred to the end of the cycle: a "__ps_remove__"
   // barrier executes while the loop still holds pointers into the set
   // table, so the erase must not happen mid-iteration.
-  std::vector<int> pending_removals;
+  std::vector<int> pending_removals;  // GUARDED_BY(ps_mutex)
 
   // Observability counters (reference analog: timeline + autotune
   // byte scoring, horovod/common/parameter_manager.cc).
@@ -81,28 +81,28 @@ struct Global {
   // after the loop is already running) and the manager's non-atomic
   // sample state.
   std::mutex autotune_mutex;
-  std::unique_ptr<ParameterManager> autotune;
+  std::unique_ptr<ParameterManager> autotune;  // GUARDED_BY(autotune_mutex)
   std::mutex timeline_mutex;
-  std::unique_ptr<TimelineWriter> timeline;
-  // Tensors currently inside a NEGOTIATE_* span (guarded by
-  // timeline_mutex; mirrors the reference's per-tensor TimelineState).
-  std::set<std::string> tl_negotiating;
+  std::unique_ptr<TimelineWriter> timeline;  // GUARDED_BY(timeline_mutex)
+  // Tensors currently inside a NEGOTIATE_* span (mirrors the
+  // reference's per-tensor TimelineState).
+  std::set<std::string> tl_negotiating;  // GUARDED_BY(timeline_mutex)
   // Open top-level/activity span count per tensor in THIS timeline
-  // session (guarded by timeline_mutex).
-  std::map<std::string, int> tl_open_spans;
+  // session.
+  std::map<std::string, int> tl_open_spans;  // GUARDED_BY(timeline_mutex)
   // HOROVOD_TIMELINE_MARK_CYCLES: stamp each background cycle on the
   // loop row (reference: timeline.cc MarkStartedCycle/WriteMarker).
-  bool tl_mark_cycles = false;
+  bool tl_mark_cycles = false;  // GUARDED_BY(timeline_mutex)
   Clock::time_point t_origin = Clock::now();
 
   std::mutex init_mutex;
   std::condition_variable init_cv;
-  bool init_done = false;
-  Status init_status;
+  bool init_done = false;  // GUARDED_BY(init_mutex)
+  Status init_status;  // GUARDED_BY(init_mutex)
 
   // Join callbacks per process set (tag ids).
   std::mutex join_mutex;
-  std::map<int, long long> join_tags;
+  std::map<int, long long> join_tags;  // GUARDED_BY(join_mutex)
 };
 
 Global* g = nullptr;
@@ -582,6 +582,8 @@ Status PerformOperation(ProcessSetState& ps, const Response& resp,
 // ------------------------------------------------- process set management ---
 
 void CreateProcessSetLocked(int ps_id, const std::vector<int>& ranks) {
+  // analysis: holds-lock(ps_mutex) — the Locked suffix is the
+  // contract: every caller acquires g->ps_mutex first.
   if (g->process_sets.count(ps_id)) return;
   auto ps = std::make_unique<ProcessSetState>();
   ps->id = ps_id;
@@ -800,8 +802,12 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
   g->rank = rank;
   g->size = size;
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
-  if (const char* mc = getenv("HOROVOD_TIMELINE_MARK_CYCLES"))
+  if (const char* mc = getenv("HOROVOD_TIMELINE_MARK_CYCLES")) {
+    // No other thread can hold g yet, but the discipline (and the
+    // locks checker) is uniform: tl_mark_cycles moves under its mutex.
+    std::lock_guard<std::mutex> lk(g->timeline_mutex);
     g->tl_mark_cycles = *mc && strcmp(mc, "0") != 0;
+  }
   if (const char* sg = getenv("HVD_WIRE_SG"))
     g->wire_sg = !(*sg && strcmp(sg, "0") == 0);
   if (fusion_bytes > 0) g->fusion_bytes = fusion_bytes;

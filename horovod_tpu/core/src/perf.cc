@@ -275,6 +275,8 @@ void TimelineWriter::Event(const std::string& name,
 }
 
 int TimelineWriter::TidLocked(const std::string& tensor) {
+  // analysis: holds-lock(mu_) — the Locked suffix is the contract:
+  // every caller (Begin/End/Instant) acquires mu_ first.
   auto it = tids_.find(tensor);
   if (it != tids_.end()) return it->second;
   int tid = next_tid_++;

@@ -174,10 +174,12 @@ class TimelineWriter {
   std::FILE* f_;
   std::mutex mu_;
   std::condition_variable cv_;
-  std::deque<Rec> q_;
-  std::unordered_map<std::string, int> tids_;
-  int next_tid_ = 1;  // 0 = the background-loop row
-  bool stop_ = false;
+  std::deque<Rec> q_;  // GUARDED_BY(mu_)
+  std::unordered_map<std::string, int> tids_;  // GUARDED_BY(mu_)
+  int next_tid_ = 1;  // GUARDED_BY(mu_); 0 = the loop row
+  bool stop_ = false;  // GUARDED_BY(mu_)
+  // first_ is writer-thread-only state (no annotation): Loop() reads
+  // and writes it in its unlock window while fprintf'ing.
   bool first_ = true;
   std::thread thread_;
 };

@@ -37,21 +37,29 @@ EXPERT_AXIS = "expert"
 PIPE_AXIS = "pipe"
 
 
-def shard_map_compat(f, *, mesh, in_specs, out_specs, axis_names=None):
+def shard_map_compat(f, *, mesh, in_specs, out_specs, axis_names=None,
+                     check_vma=False):
     """``jax.shard_map`` across the jax API drift.
 
     Newer jax exposes ``shard_map`` at the top level (``check_vma``,
     partial-manual via ``axis_names``); 0.4.x only has
     ``jax.experimental.shard_map`` (``check_rep``, and the INVERSE
-    ``auto`` parameter — the axes NOT manual). Replication checking is
-    disabled on both: the framework's collectives use
-    ``axis_index_groups``, which the checkers don't support.
+    ``auto`` parameter — the axes NOT manual). Replication checking
+    defaults off on both: the framework's collectives use
+    ``axis_index_groups``, which the checkers don't support — but a
+    caller shard-mapping plain jax code can opt back in with
+    ``check_vma=True`` (mapped to ``check_rep`` on 0.4.x).
+
+    This is the ONE sanctioned spelling of shard_map outside this
+    module: the jaxcompat checker (docs/static_analysis.md#jax-compat)
+    flags every direct ``jax.shard_map`` / ``jax.experimental``
+    import elsewhere.
     """
     try:
         from jax import shard_map as _sm
 
         kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_vma=False)
+                      check_vma=check_vma)
         if axis_names is not None:
             kwargs["axis_names"] = set(axis_names)
         return _sm(f, **kwargs)
@@ -59,7 +67,7 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs, axis_names=None):
         from jax.experimental.shard_map import shard_map as _sm
 
         kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_rep=False)
+                      check_rep=check_vma)
         if axis_names is not None:
             kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
         return _sm(f, **kwargs)

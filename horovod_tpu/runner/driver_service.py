@@ -78,14 +78,16 @@ class HorovodRunDriverService(BasicService):
 
     def wait_for_initial_registration(self, timeout_s: float = 120.0):
         deadline = time.time() + timeout_s
+        registered = 0
         while time.time() < deadline:
             with self._lock:
-                if len(self._task_addresses) == self._num_hosts:
-                    return
+                registered = len(self._task_addresses)
+            if registered == self._num_hosts:
+                return
             time.sleep(0.1)
         raise TimeoutError(
             "only %d/%d hosts registered with the driver"
-            % (len(self._task_addresses), self._num_hosts))
+            % (registered, self._num_hosts))
 
     def task_addresses_for_driver(self) -> Dict[int, Dict]:
         with self._lock:

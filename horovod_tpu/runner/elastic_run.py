@@ -33,6 +33,7 @@ import json
 import os
 import socket
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -111,7 +112,18 @@ class ElasticDriver:
         # DRIVER's clock via the KV put callback, so worker clock skew
         # cannot fake or mask a wedge.
         self.liveness_sec = float_env("HOROVOD_WORKER_LIVENESS_SEC", 0.0)
+        # _hb_seen is shared between the KV server's callback thread
+        # (stamping arrivals) and the driver main loop (wedge checks,
+        # respawn clears): every touch goes through _hb_lock. _hb_fence
+        # maps slot key -> minimum rendezvous version whose beats count;
+        # it is bumped when a slot is respawned so an in-flight beat
+        # from the killed incarnation cannot resurrect the entry the
+        # respawn just cleared (which would start the liveness clock
+        # against the OLD process and wedge-cull a slow-starting new
+        # worker that never got its first-beat grace).
+        self._hb_lock = threading.Lock()
         self._hb_seen: Dict[str, float] = {}
+        self._hb_fence: Dict[str, int] = {}
         self.extra_env = _tuning_env(args)
         self.host_manager = HostManager(HostDiscoveryScript(
             args.discovery_script, args.slots_per_host or 1))
@@ -193,8 +205,38 @@ class ElasticDriver:
         # Liveness bookkeeping rides the rendezvous KV: stamp heartbeat
         # arrivals with the driver's clock (worker timestamps are
         # informational only — clock skew must not fake a wedge).
-        if scope == "heartbeat":
+        if scope != "heartbeat":
+            return
+        # Incarnation fence: a beat whose payload names a rendezvous
+        # version BELOW the slot's respawn fence is an in-flight
+        # straggler from the incarnation we just killed — dropping it
+        # preserves the new worker's first-beat grace. Payloads that do
+        # not parse keep the PR 5 contract (arrival alone proves
+        # liveness; the open KV may carry garbage) and still stamp.
+        version = None
+        try:
+            version = int(json.loads(value.decode()).get("version"))
+        except (ValueError, TypeError, AttributeError,
+                UnicodeDecodeError):
+            pass
+        with self._hb_lock:
+            fence = self._hb_fence.get(key, 0)
+            if version is not None and version < fence:
+                return
             self._hb_seen[key] = time.time()
+
+    def _hb_clear(self, key: str, fence: Optional[int] = None):
+        """Forget a slot's heartbeat bookkeeping (exit, wedge-replace,
+        respawn); with ``fence``, additionally require future beats to
+        name at least that rendezvous version."""
+        with self._hb_lock:
+            self._hb_seen.pop(key, None)
+            if fence is not None:
+                self._hb_fence[key] = fence
+
+    def _hb_last(self, key: str) -> Optional[float]:
+        with self._hb_lock:
+            return self._hb_seen.get(key)
 
     def _publish(self, keyed: Dict[str, SlotInfo]):
         self.rendezvous.clear_scope("rendezvous")
@@ -283,8 +325,10 @@ class ElasticDriver:
             # Fresh process: any heartbeat recorded for this slot key
             # belongs to a previous incarnation and would instantly
             # trip the liveness deadline during the new worker's
-            # (potentially slow) startup.
-            self._hb_seen.pop(key, None)
+            # (potentially slow) startup. The fence keeps in-flight
+            # stragglers from the old incarnation (version < current)
+            # from re-stamping what this clear just removed.
+            self._hb_clear(key, fence=self.version)
             self.procs[key] = SlotProcess(
                 a.rank, self.command, env, hostname=a.hostname,
                 ssh_port=getattr(self.args, "ssh_port", None),
@@ -394,7 +438,7 @@ class ElasticDriver:
         now = time.time() if now is None else now
         wedged = []
         for key, proc in self.procs.items():
-            last = self._hb_seen.get(key)
+            last = self._hb_last(key)
             if (last is not None and now - last > self.liveness_sec
                     and proc.poll() is None):
                 wedged.append((key, now - last))
@@ -425,7 +469,7 @@ class ElasticDriver:
                         "manual cleanup before the slot is reusable\n"
                         % (key, pid))
             proc.terminate(grace_sec=self.WEDGE_KILL_GRACE_SEC)
-            self._hb_seen.pop(key, None)
+            self._hb_clear(key)
             self._record_slot_failure(key)
             self._journal_append(
                 {"type": "wedged", "slot": key, "ts": time.time()})
@@ -464,7 +508,7 @@ class ElasticDriver:
                         continue
                     proc.wait()
                     del self.procs[key]
-                    self._hb_seen.pop(key, None)
+                    self._hb_clear(key)
                     self._journal_append({"type": "exit", "slot": key,
                                           "rc": rc, "ts": time.time()})
                     if rc == 0:

@@ -265,7 +265,13 @@ class MicroBatcher:
             n = rows.shape[0]
             try:
                 bucket = pick_bucket(n, self.buckets)
-                out = np.asarray(self.run_batch(pad_to_bucket(rows, bucket)))
+                # asanyarray, not asarray: run_batch may return an
+                # ndarray subclass carrying per-batch metadata (the
+                # replica tags outputs with the checkpoint step that
+                # produced them); the per-request slices below preserve
+                # the subclass, so the metadata reaches each future.
+                out = np.asanyarray(
+                    self.run_batch(pad_to_bucket(rows, bucket)))
                 if out.shape[0] != bucket:
                     raise RuntimeError(
                         "run_batch returned %d rows for a bucket of %d"

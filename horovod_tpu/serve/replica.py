@@ -57,6 +57,19 @@ _C_HEARTBEATS = _metrics.counter(
     "Liveness heartbeats this worker PUT to the rendezvous KV "
     "(heartbeat/<slot_key>, every HVD_HEARTBEAT_SEC).")
 
+class _SteppedOutput(np.ndarray):
+    """Batch output tagged with the checkpoint step that produced it.
+    ``__array_finalize__`` propagates the tag through the batcher's
+    per-request slices, so every future's result knows its true step
+    even when a hot reload lands mid-flight."""
+
+    step = None
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self.step = getattr(obj, "step", None)
+
+
 # Model registry: name -> (builder, sample input shape). The builder
 # returns a flax module; ``identity`` is the numpy passthrough the
 # bench harness uses to measure the serving plane without jax.
@@ -143,8 +156,9 @@ class Replica:
         if self.model == "identity":
             # Numpy passthrough, any row shape: the bench harness's
             # jax-free stand-in for measuring the serving plane.
-            self._apply = lambda x: x
-            self.step = -1
+            with self._apply_lock:
+                self._apply = lambda x: x
+                self.step = -1
             self._start_batcher()
             return
         _register_jax_models()
@@ -201,10 +215,23 @@ class Replica:
             self._apply = apply
             self.step = loaded
 
-    def _run_batch(self, rows: np.ndarray) -> np.ndarray:
+    def _loaded_state(self) -> Tuple[Optional[Callable], Optional[int]]:
+        """Atomic (apply, step) snapshot: the hot-reload poller swaps
+        the pair under the lock, so readers that look at both must
+        take it too, or a reload landing between the two reads sees a
+        torn pair."""
         with self._apply_lock:
-            apply = self._apply
-        return apply(rows)
+            return self._apply, self.step
+
+    def _run_batch(self, rows: np.ndarray) -> np.ndarray:
+        apply, step = self._loaded_state()
+        out = np.asarray(apply(rows)).view(_SteppedOutput)
+        # The step rides WITH the outputs it produced: a hot reload
+        # landing between this batch and the response serialization
+        # must not relabel step-N outputs as step N+1 (the batcher's
+        # per-request slices preserve the subclass + attribute).
+        out.step = step
+        return out
 
     def _start_batcher(self):
         self._batcher = batching.MicroBatcher(
@@ -241,21 +268,29 @@ class Replica:
             # batch failure maps to a 500 on THIS request; the server
             # and batcher keep running.
             return self._json(500, {"error": "inference failed: %s" % e})
+        # Prefer the step tag the batch itself carried (_SteppedOutput):
+        # it names the checkpoint that actually computed these rows. The
+        # locked snapshot is only the fallback for apply fns routed
+        # around _run_batch.
+        step = getattr(out, "step", None)
+        if step is None:
+            _, step = self._loaded_state()
         return self._json(200, {
             "outputs": out.tolist(),
             "rows": int(inputs.shape[0]),
             "model": self.model,
-            "step": self.step,
+            "step": step,
             "replica": self.replica_id,
         })
 
     def _handle_healthz(self):
+        apply, step = self._loaded_state()
         return self._json(200, {
-            "ok": self._apply is not None,
+            "ok": apply is not None,
             "role": "replica",
             "replica": self.replica_id,
             "model": self.model,
-            "step": self.step,
+            "step": step,
             "pid": os.getpid(),
             "port": self.port,
         })
@@ -269,13 +304,14 @@ class Replica:
     def endpoint_payload(self) -> dict:
         """What registration and every heartbeat carry: enough for a
         router (fresh or journal-replayed) to route to this replica."""
+        _, step = self._loaded_state()
         return {
             "ts": time.time(),
             "pid": os.getpid(),
             "addr": self.advertise_addr,
             "port": self.port,
             "model": self.model,
-            "step": self.step,
+            "step": step,
         }
 
     def _router_endpoint(self) -> Optional[Tuple[str, int]]:
@@ -321,8 +357,9 @@ class Replica:
                 return
             try:
                 latest = self._ckpt.latest_step()
-                if latest is not None and (self.step is None
-                                           or latest > self.step):
+                _, step = self._loaded_state()
+                if latest is not None and (step is None
+                                           or latest > step):
                     self._restore_step(latest)
                     _C_RELOADS.inc()
                     logger.info("serve replica %s hot-reloaded step %s",

@@ -336,5 +336,10 @@ class Router:
         if self._monitor is not None:
             self._monitor.stop()
         self._kv.stop()
-        if self._journal is not None:
-            self._journal.close()
+        # Detach under the lock: a KV callback mid-flight when stop()
+        # was called must observe either a usable journal or None —
+        # never append to a closed file handle.
+        with self._lock:
+            journal, self._journal = self._journal, None
+        if journal is not None:
+            journal.close()

@@ -53,6 +53,8 @@ class Timeline:
     _FLUSH_SECONDS = 1.0
 
     def _flush_locked(self):
+        # analysis: holds-lock(_lock) — the _locked suffix is the
+        # contract: every caller takes self._lock before calling.
         if self._buf:
             self._f.write("".join(self._buf))
             self._buf.clear()

@@ -1,4 +1,6 @@
-"""Tier-1 tests of the cross-language contract checkers (ISSUE 4).
+"""Tier-1 tests of the cross-language contract checkers (ISSUE 4; the
+second-generation locks/journal/jaxcompat/testtier checkers are
+ISSUE 9).
 
 Each checker runs against a small fixture tree: the known-good fixture
 passes, every seeded violation fails, and the baseline suppresses
@@ -447,7 +449,8 @@ const char* v = getenv("HVD_V");
 def test_every_checker_ran_against_fixture(tree):
     """Guard against a checker silently dropping out of run_all."""
     assert set(CHECKERS) == {"knobs", "counters", "ctypes", "metrics",
-                             "excepts"}
+                             "excepts", "locks", "journal", "jaxcompat",
+                             "testtier"}
 
 
 def test_build_refuses_any_sanitizer_preload(monkeypatch, tmp_path):
@@ -464,3 +467,463 @@ def test_build_refuses_any_sanitizer_preload(monkeypatch, tmp_path):
     monkeypatch.setattr(build, "_build_dir", lambda: str(tmp_path / "b"))
     with pytest.raises(RuntimeError, match="libasan"):
         build.library_path(build_if_missing=True)
+
+
+# ====================== second-generation checkers (ISSUE 9) ================
+# locks / journal / jaxcompat / testtier: same fixture-tree discipline —
+# known-good passes, each seeded violation fails, tags suppress, the
+# real tree stays clean (test_real_tree_is_clean above already runs all
+# nine checkers).
+
+# --- locks: python ----------------------------------------------------------
+
+LOCKED_CLASS_OK = '''
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self.name = "t"  # never written under the lock: unguarded
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+
+    def get(self, k):
+        with self._lock:
+            return self._rows.get(k)
+
+    def label(self):
+        return self.name
+'''
+
+
+def test_locks_known_good_locked_class_passes(tree):
+    _seed(tree, "horovod_tpu/table.py", LOCKED_CLASS_OK)
+    assert _keys(run_all(project(tree)), "locks") == []
+
+
+def test_locks_unguarded_read_fails(tree):
+    _seed(tree, "horovod_tpu/table.py", LOCKED_CLASS_OK.replace(
+        "        with self._lock:\n            return self._rows.get(k)",
+        "        return self._rows.get(k)"))
+    assert "unguarded:Table.get:_rows" in \
+        _keys(run_all(project(tree)), "locks")
+
+
+def test_locks_unguarded_mutator_call_fails(tree):
+    """self._rows.pop(...) outside the lock is a WRITE of _rows."""
+    _seed(tree, "horovod_tpu/table.py", LOCKED_CLASS_OK + '''
+    def evict(self, k):
+        self._rows.pop(k, None)
+''')
+    assert "unguarded:Table.evict:_rows" in \
+        _keys(run_all(project(tree)), "locks")
+
+
+def test_locks_holds_lock_tag_suppresses(tree):
+    _seed(tree, "horovod_tpu/table.py", LOCKED_CLASS_OK + '''
+    def get_locked(self, k):
+        # analysis: holds-lock(_lock) -- callers hold self._lock
+        return self._rows.get(k)
+''')
+    assert _keys(run_all(project(tree)), "locks") == []
+
+
+def test_locks_init_writes_are_exempt(tree):
+    """__init__ populates guarded attributes before the object escapes
+    to other threads: LOCKED_CLASS_OK relies on it (already clean), and
+    the exemption must not leak to other methods (covered above)."""
+    _seed(tree, "horovod_tpu/table.py", LOCKED_CLASS_OK.replace(
+        "        self._rows = {}",
+        "        self._rows = {}\n        self._rows['seed'] = 1"))
+    assert _keys(run_all(project(tree)), "locks") == []
+
+
+def test_locks_closure_does_not_inherit_the_lock(tree):
+    """A closure defined under `with self._lock:` outlives the scope
+    (callbacks, thread targets) — its accesses are NOT lock-covered."""
+    _seed(tree, "horovod_tpu/table.py", LOCKED_CLASS_OK + '''
+    def deferred(self):
+        with self._lock:
+            def cb():
+                return self._rows.copy()
+        return cb
+''')
+    assert "unguarded:Table.deferred:_rows" in \
+        _keys(run_all(project(tree)), "locks")
+
+
+def test_locks_borrowed_lock_via_with_counts(tree):
+    """An attribute used as `with self._mu:` is a lock even when the
+    lock object is passed in (the metrics value classes share their
+    family's RLock that way)."""
+    _seed(tree, "horovod_tpu/borrowed.py", '''
+class Child:
+    def __init__(self, mu):
+        self._mu = mu
+        self._n = 0
+
+    def inc(self):
+        with self._mu:
+            self._n += 1
+
+    def peek(self):
+        return self._n
+''')
+    assert "unguarded:Child.peek:_n" in \
+        _keys(run_all(project(tree)), "locks")
+
+
+# --- locks: C++ GUARDED_BY --------------------------------------------------
+
+GUARDED_CC = '''
+#include <mutex>
+
+struct State {
+  std::mutex mu_;
+  int hits_ = 0;  // GUARDED_BY(mu_)
+};
+
+State st;
+
+void Bump() {
+  std::lock_guard<std::mutex> lk(st.mu_);
+  st.hits_ += 1;
+}
+'''
+
+
+def test_locks_guarded_by_locked_use_passes(tree):
+    _seed(tree, "horovod_tpu/core/src/state.cc", GUARDED_CC)
+    assert _keys(run_all(project(tree)), "locks") == []
+
+
+def test_locks_guarded_by_unlocked_use_fails(tree):
+    _seed(tree, "horovod_tpu/core/src/state.cc", GUARDED_CC + '''
+int Peek() { return st.hits_; }
+''')
+    keys = _keys(run_all(project(tree)), "locks")
+    assert "unguarded-native:hits_:0" in keys
+
+
+def test_locks_guarded_by_holds_lock_comment_suppresses(tree):
+    _seed(tree, "horovod_tpu/core/src/state.cc", GUARDED_CC + '''
+int PeekLocked() {
+  // analysis: holds-lock(mu_) -- callers hold mu_
+  return st.hits_;
+}
+''')
+    assert _keys(run_all(project(tree)), "locks") == []
+
+
+def test_locks_guarded_by_lock_scope_ends_at_brace(tree):
+    """The acquisition guards only until its enclosing brace closes."""
+    _seed(tree, "horovod_tpu/core/src/state.cc", GUARDED_CC + '''
+int Mixed() {
+  {
+    std::lock_guard<std::mutex> lk(st.mu_);
+    st.hits_ += 1;
+  }
+  return st.hits_;  // outside the guard scope
+}
+''')
+    keys = _keys(run_all(project(tree)), "locks")
+    assert keys == ["unguarded-native:hits_:0"], keys
+
+
+def test_guarded_by_parser_units():
+    from tools.analysis.check_locks import guarded_fields, scan_cpp_uses
+
+    text = '''
+struct S {
+  std::mutex mu_;
+  std::map<int, int> table_;  // GUARDED_BY(mu_)
+  int plain_;
+  // GUARDED_BY(ghost_) in prose only: no declaration, no entry
+};
+void F(S& s) {
+  std::unique_lock<std::mutex> lk(s.mu_);
+  s.table_[1] = 2;
+}
+void G(S& s) { s.table_.clear(); }
+'''
+    fields = guarded_fields(text)
+    assert set(fields) == {"table_"}
+    assert fields["table_"][0] == "mu_"
+    uses = scan_cpp_uses(text, fields)
+    # The F use is guarded; only G's is reported.
+    assert len(uses) == 1 and uses[0][0] == "table_"
+    # Comment/string occurrences never count as uses.
+    assert scan_cpp_uses('// table_ in a comment\n"table_ in a string"',
+                         fields) == []
+
+
+# --- journal ----------------------------------------------------------------
+
+def test_journal_direct_append_fails(tree):
+    _seed(tree, "horovod_tpu/sidecar.py", '''
+import json
+
+
+def persist(path, rec):
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec) + "\\n")
+''')
+    assert any(k.startswith("direct-append:open")
+               for k in _keys(run_all(project(tree)), "journal"))
+
+
+def test_journal_os_open_append_fails(tree):
+    _seed(tree, "horovod_tpu/sidecar.py", '''
+import os
+
+
+def persist(path, line):
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+    os.write(fd, line)
+    os.close(fd)
+''')
+    assert any(k.startswith("direct-append:os.open")
+               for k in _keys(run_all(project(tree)), "journal"))
+
+
+def test_journal_allowed_files_and_tag_are_exempt(tree):
+    body = '''
+def persist(path, line):
+    with open(path, "a") as fh:  # analysis: allow-append -- test log
+        fh.write(line)
+'''
+    _seed(tree, "horovod_tpu/sidecar.py", body)
+    assert _keys(run_all(project(tree)), "journal") == []
+    # The journal primitives themselves may append (that is their job).
+    _seed(tree, "horovod_tpu/runner/journal.py",
+          "def attach(path):\n    return open(path, 'a')\n")
+    _seed(tree, "horovod_tpu/ops/block_tuner.py",
+          "import os\n\n\ndef rec(path):\n"
+          "    return os.open(path, os.O_APPEND)\n")
+    assert _keys(run_all(project(tree)), "journal") == []
+
+
+# --- jaxcompat --------------------------------------------------------------
+
+def test_jaxcompat_shard_map_import_fails(tree):
+    _seed(tree, "horovod_tpu/rogue_sm.py", "from jax import shard_map\n")
+    assert "import-shard_map:0" in \
+        _keys(run_all(project(tree)), "jaxcompat")
+
+
+def test_jaxcompat_try_except_import_dance_still_fails(tree):
+    """The try/except dance is exactly what shard_map_compat exists to
+    centralize — doing it inline is still a finding."""
+    _seed(tree, "horovod_tpu/rogue_sm.py",
+          "try:\n    from jax import shard_map\n"
+          "except ImportError:\n"
+          "    from jax.experimental.shard_map import shard_map\n")
+    keys = _keys(run_all(project(tree)), "jaxcompat")
+    assert "import-shard_map:0" in keys
+    assert "import-experimental-shard_map:0" in keys
+
+
+def test_jaxcompat_attribute_uses_fail(tree):
+    _seed(tree, "horovod_tpu/rogue_sm.py", '''
+import jax
+from jax import lax
+
+
+def f(fn, mesh, spec):
+    sized = lax.axis_size("data")
+    jax.set_mesh(mesh)
+    return jax.shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec), sized
+''')
+    keys = _keys(run_all(project(tree)), "jaxcompat")
+    assert "attr-jax.shard_map:0" in keys
+    assert "attr-jax.set_mesh:0" in keys
+    assert "attr-lax.axis_size:0" in keys
+
+
+def test_jaxcompat_bare_psum_axis_sizing_fails(tree):
+    _seed(tree, "horovod_tpu/rogue_sm.py",
+          "from jax import lax\n\n\ndef n(axis):\n"
+          "    return lax.psum(1, axis)\n")
+    assert "psum-axis-sizing:0" in \
+        _keys(run_all(project(tree)), "jaxcompat")
+
+
+def test_jaxcompat_mesh_shim_file_is_allowed(tree):
+    _seed(tree, "horovod_tpu/parallel/mesh.py", '''
+from jax import lax
+
+
+def traced_axis_size(axis):
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return lax.psum(1, axis)
+
+
+def shard_map_compat(f, **kw):
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, **kw)
+''')
+    assert _keys(run_all(project(tree)), "jaxcompat") == []
+
+
+def test_jaxcompat_getattr_probe_is_not_a_finding(tree):
+    _seed(tree, "horovod_tpu/probe.py",
+          "import jax\n\nHAS_SM = hasattr(jax, 'shard_map')\n"
+          "SET_MESH = getattr(jax, 'set_mesh', None)\n")
+    assert _keys(run_all(project(tree)), "jaxcompat") == []
+
+
+# --- testtier ---------------------------------------------------------------
+
+TIER_OK_TEST = '''
+import time
+
+import pytest
+
+
+@pytest.mark.tier2
+@pytest.mark.slow
+def test_heavy_fleet(launcher):
+    launcher(8, timeout=600)
+    time.sleep(6)
+
+
+def test_light():
+    time.sleep(0.1)
+'''
+
+
+def test_testtier_marked_and_light_tests_pass(tree):
+    _seed(tree, "tests/test_fixture_tiers.py", TIER_OK_TEST)
+    assert _keys(run_all(project(tree)), "testtier") == []
+
+
+def test_testtier_sleep_budget_fails(tree):
+    _seed(tree, "tests/test_fixture_tiers.py",
+          "import time\n\n\ndef test_sleepy():\n"
+          "    time.sleep(3)\n    time.sleep(3)\n")
+    assert "needs-tier2-slow:test_sleepy" in \
+        _keys(run_all(project(tree)), "testtier")
+
+
+def test_testtier_timeout_budget_fails(tree):
+    _seed(tree, "tests/test_fixture_tiers.py",
+          "def test_budgeted(run):\n    run(timeout=420)\n")
+    assert "needs-tier2-slow:test_budgeted" in \
+        _keys(run_all(project(tree)), "testtier")
+
+
+def test_testtier_fleet_evidence_fails(tree):
+    _seed(tree, "tests/test_fixture_tiers.py",
+          "def test_fleet(subprocess, sys):\n"
+          "    subprocess.run([sys.executable, '-m', 'x', '-np', '8'])\n")
+    assert "needs-tier2-slow:test_fleet" in \
+        _keys(run_all(project(tree)), "testtier")
+
+
+def test_testtier_half_marked_fails_and_pair_rule(tree):
+    _seed(tree, "tests/test_fixture_tiers.py", TIER_OK_TEST.replace(
+        "@pytest.mark.tier2\n@pytest.mark.slow\n", "@pytest.mark.tier2\n"))
+    assert "needs-tier2-slow:test_heavy_fleet" in \
+        _keys(run_all(project(tree)), "testtier")
+    # slow without tier2 is inconsistent regardless of triggers.
+    _seed(tree, "tests/test_fixture_tiers.py",
+          "import pytest\n\n\n@pytest.mark.slow\ndef test_dangling():\n"
+          "    pass\n")
+    assert "slow-without-tier2:test_dangling" in \
+        _keys(run_all(project(tree)), "testtier")
+
+
+def test_testtier_module_pytestmark_honored(tree):
+    _seed(tree, "tests/test_fixture_tiers.py",
+          "import pytest\n\npytestmark = [pytest.mark.tier2, "
+          "pytest.mark.slow]\n\n\ndef test_heavy(run):\n"
+          "    run(timeout=999)\n")
+    assert _keys(run_all(project(tree)), "testtier") == []
+
+
+def test_testtier_tier1_ok_tag_suppresses(tree):
+    _seed(tree, "tests/test_fixture_tiers.py",
+          "def test_ceiling(run):\n"
+          "    # analysis: tier1-ok(runs in seconds; big ceiling is "
+          "flake insurance)\n"
+          "    run(timeout=600)\n")
+    assert _keys(run_all(project(tree)), "testtier") == []
+
+
+def test_new_checker_findings_are_baselinable(tree, tmp_path):
+    """The fingerprint/baseline machinery covers the new checkers the
+    same way: accept, clean, resurface with --no-baseline."""
+    _seed(tree, "horovod_tpu/rogue_sm.py", "from jax import shard_map\n")
+    baseline = str(tmp_path / "baseline.json")
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "jaxcompat"]) == 1
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "jaxcompat",
+                          "--update-baseline"]) == 0
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "jaxcompat"]) == 0
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "jaxcompat", "--no-baseline"]) == 1
+
+
+def test_locks_guarded_by_skip_is_per_file(tree):
+    """Review fix: the declaration-line skip must be per-file — an
+    unguarded use in file B sharing a line NUMBER with file A's
+    annotated declaration was silently suppressed."""
+    _seed(tree, "horovod_tpu/core/src/state.h", '''#include <mutex>
+struct State {
+  std::mutex mu_;
+  int hits_ = 0;  // GUARDED_BY(mu_)
+};
+extern State st;
+''')
+    # The unguarded use sits on line 4 — the same line number as the
+    # annotated declaration in state.h.
+    _seed(tree, "horovod_tpu/core/src/peek.cc", '''#include "state.h"
+int Peek() {
+  // line 3
+  return st.hits_;
+}
+''')
+    keys = _keys(run_all(project(tree)), "locks")
+    assert "unguarded-native:hits_:0" in keys
+
+
+def test_journal_pathlib_open_append_fails(tree):
+    """Review fix: method-style opens take mode FIRST — Path(p).open("a")
+    must be flagged; a lone filename positional that merely contains an
+    'a' must not."""
+    _seed(tree, "horovod_tpu/sidecar.py", '''
+import pathlib
+
+
+def persist(path, line):
+    with pathlib.Path(path).open("a") as fh:
+        fh.write(line)
+''')
+    assert any(k.startswith("direct-append:open")
+               for k in _keys(run_all(project(tree)), "journal"))
+    _seed(tree, "horovod_tpu/sidecar.py",
+          "import codecs\n\n\ndef load():\n"
+          "    return codecs.open('data.txt')\n")
+    assert _keys(run_all(project(tree)), "journal") == []
+
+
+def test_crashing_checker_dies_with_its_name(tree, monkeypatch):
+    from tools import analysis as pkg
+
+    def boom(project):
+        raise ValueError("kaput")
+
+    monkeypatch.setitem(pkg.CHECKERS, "locks", boom)
+    with pytest.raises(RuntimeError, match="checker 'locks' crashed"):
+        run_all(project(tree))

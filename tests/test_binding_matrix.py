@@ -20,6 +20,7 @@ def test_torch_binding_matrix():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_error_matrix():
     """Third wave: the remaining coordinator error classes (op-type,
     broadcast/allgather shape, alltoall splits, duplicate-name)
@@ -32,6 +33,7 @@ def test_error_matrix():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_tf_binding_matrix():
     # Host-bridge mode must be chosen before TF's eager context exists,
     # so it rides the environment into the workers.

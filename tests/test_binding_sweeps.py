@@ -53,6 +53,7 @@ def test_mxnet_sweep():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_tf_sweep():
     # Default (in-graph) mode on purpose: the sweep's narrow-dtype
     # cells prove the dtype-gated fallback routing from the TF
@@ -63,6 +64,7 @@ def test_tf_sweep():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_tf_sweep2_host_bridge():
     # Third wave rides the host-bridged eager plane on purpose: it is
     # the plane with joined-rank accounting (the join cell) and the
@@ -75,6 +77,7 @@ def test_tf_sweep2_host_bridge():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_keras_sweep():
     with tempfile.TemporaryDirectory() as tmp:
         proc = _launch("keras_sweep_worker.py",

@@ -12,7 +12,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+# shard_map via the repo compat shim: this box's jax 0.4.x has no
+# top-level jax.shard_map (the jaxcompat checker enforces this).
+from horovod_tpu.parallel.mesh import shard_map_compat as shard_map
 
 import horovod_tpu as hvd
 from horovod_tpu.ops import collective_ops as C
@@ -193,9 +195,18 @@ def test_allreduce_differentiable(mesh8):
         return jax.grad(loss)(s)
 
     out = _per_rank(mesh8, per_rank, x)
-    # d/dx_r sum((mean x)^2) summed across replicas... each rank's grad of its
-    # own loss: 2*mean/8 per element per replica contribution = 2*1/8.
-    np.testing.assert_allclose(np.asarray(out), np.tile(0.25, (8, 2)), rtol=1e-6)
+    # The gradient of a psum-coupled loss depends on the jax version's
+    # shard_map transpose rule. Newer jax (top-level shard_map, with
+    # replication checking) uses the efficient psum transpose: each
+    # rank sees the partial of its OWN loss, 2*mean/8 = 0.25. On 0.4.x
+    # transpose(psum) = psum, so every rank gets the total derivative
+    # of the GLOBAL summed loss: 8 * 2*mean/8 = 2*mean = 2.0. Both are
+    # internally consistent autodiff semantics; pin whichever this jax
+    # implements (probed, not imported — the jaxcompat checker bans
+    # direct shard_map imports here).
+    expected = 0.25 if hasattr(jax, "shard_map") else 2.0
+    np.testing.assert_allclose(np.asarray(out), np.tile(expected, (8, 2)),
+                               rtol=1e-6)
 
 
 def test_mesh_factory():

@@ -137,6 +137,7 @@ def test_elastic_world_shrink(tmp_path):
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_blacklist_persistent_failure(tmp_path):
     """A slot that keeps dying at the same step gets blacklisted after
     MAX_SLOT_FAILURES; the job completes on the remaining slots
@@ -158,6 +159,7 @@ def test_elastic_blacklist_persistent_failure(tmp_path):
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_reset_limit_exceeded(tmp_path):
     """--reset-limit bounds recovery attempts: a persistently failing
     world exhausts it and the job fails loudly instead of cycling

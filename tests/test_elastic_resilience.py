@@ -812,3 +812,64 @@ def test_replace_wedged_kills_remote_by_heartbeat_pid():
         driver.rendezvous.stop()
     assert calls == {"pid": 31337, "terminated": True}
     assert driver.fail_counts == {"h9:0": 1}
+
+
+# --- heartbeat bookkeeping locking + incarnation fence (ISSUE 9) ------------
+
+def test_driver_heartbeat_fence_drops_stale_incarnation_beats():
+    """Regression (locks sweep): a beat in flight from a killed worker
+    used to re-stamp the _hb_seen entry the respawn had just cleared —
+    starting the liveness clock against the OLD process and wedge-
+    culling a slow-starting replacement before its first-beat grace.
+    Respawn now fences the slot at the current rendezvous version and
+    beats naming an older version are dropped."""
+    driver = _driver()
+    driver.version = 3
+    driver._hb_seen["h1:0"] = time.time() - 99.0
+    driver._hb_clear("h1:0", fence=driver.version)
+    assert driver._hb_last("h1:0") is None
+
+    # Straggler from the killed incarnation (version 2): dropped.
+    driver._on_kv_put("heartbeat", "h1:0",
+                      json.dumps({"version": 2, "pid": 11}).encode())
+    assert driver._hb_last("h1:0") is None
+
+    # The replacement's own beat (current version): stamped.
+    driver._on_kv_put("heartbeat", "h1:0",
+                      json.dumps({"version": 3, "pid": 12}).encode())
+    assert driver._hb_last("h1:0") is not None
+
+
+def test_driver_heartbeat_unparsable_payload_still_stamps():
+    """Arrival alone proves liveness when the payload does not parse
+    (the KV is an open PUT endpoint — the PR 5 contract): the fence
+    only drops beats that AFFIRMATIVELY name a pre-respawn version."""
+    driver = _driver()
+    driver._hb_clear("h2:0", fence=5)
+    driver._on_kv_put("heartbeat", "h2:0", b"\xffnot json")
+    assert driver._hb_last("h2:0") is not None
+
+
+def test_driver_heartbeat_bookkeeping_goes_through_the_lock():
+    """_hb_seen is shared between the KV server's callback thread and
+    the driver main loop; the locks checker enforces the discipline
+    statically, this pins it dynamically on all three accessors."""
+    driver = _driver()
+    real = driver._hb_lock
+    acquired = {"n": 0}
+
+    class Recording:
+        def __enter__(self):
+            acquired["n"] += 1
+            return real.__enter__()
+
+        def __exit__(self, *exc):
+            return real.__exit__(*exc)
+
+    driver._hb_lock = Recording()
+    driver._on_kv_put("heartbeat", "h1:0", b"{}")
+    assert acquired["n"] == 1
+    assert driver._hb_last("h1:0") is not None
+    assert acquired["n"] == 2
+    driver._hb_clear("h1:0")
+    assert acquired["n"] == 3

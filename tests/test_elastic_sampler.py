@@ -184,6 +184,7 @@ def test_record_batch_after_reset_uses_new_shard(monkeypatch):
 
 
 def test_sampler_sync_multiproc():
+    # analysis: tier1-ok(runs ~20s; the 600s ceiling is flake insurance)
     # Known tier-1 load flake (memory file): under the full 870 s
     # verify this np=2 launch occasionally times out / loses a worker
     # on the oversubscribed 2-core box while passing in isolation.

@@ -37,6 +37,7 @@ def test_pytorch_mnist_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_keras_mnist_example():
     proc = _run_example("examples/keras/keras_mnist.py", 2,
                         ["--epochs", "1", "--batch-size", "64"],
@@ -74,6 +75,7 @@ def test_adasum_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_pytorch_imagenet_resnet50_example(tmp_path):
     proc = _run_example(
         "examples/pytorch/pytorch_imagenet_resnet50.py", 2,
@@ -88,6 +90,7 @@ def test_pytorch_imagenet_resnet50_example(tmp_path):
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_pytorch_example():
     """Static np=2 run of the elastic torch example (the world-change
     path is covered by tests/test_elastic.py; this proves the example's
@@ -100,6 +103,7 @@ def test_elastic_pytorch_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_tensorflow2_example():
     proc = _run_example(
         "examples/elastic/tensorflow2/tensorflow2_mnist_elastic.py", 2,
@@ -109,6 +113,7 @@ def test_elastic_tensorflow2_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_keras_mnist_advanced_example():
     """Advanced keras recipe (augmentation layers + warmup + staircase
     + gradient aggregation) through the keras-native binding."""
@@ -121,6 +126,7 @@ def test_keras_mnist_advanced_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_keras_imagenet_resnet50_example():
     proc = _run_example(
         "examples/keras/keras_imagenet_resnet50.py", 2,
@@ -160,6 +166,7 @@ def test_jax_checkpoint_resume_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_tensorflow2_mnist_example():
     """Custom-loop family: DistributedGradientTape + post-first-step
     broadcast (reference: tensorflow2_mnist.py)."""
@@ -234,6 +241,7 @@ def test_pytorch_lightning_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_pytorch_synthetic_benchmark():
     """Elastic x perf crossover, torch flavor (reference:
     examples/elastic/pytorch/pytorch_synthetic_benchmark_elastic.py)."""
@@ -249,6 +257,7 @@ def test_elastic_pytorch_synthetic_benchmark():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_tensorflow2_synthetic_benchmark():
     """Elastic x perf crossover, TF2 flavor (reference:
     examples/elastic/tensorflow2/
@@ -265,6 +274,7 @@ def test_elastic_tensorflow2_synthetic_benchmark():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_keras_spark_rossmann_example(tmp_path):
     """The feature-engineering estimator recipe (reference:
     examples/spark/keras/keras_spark_rossmann_estimator.py): one-hot
@@ -312,6 +322,7 @@ def test_ray_tensorflow2_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_pytorch_imagenet_example(tmp_path):
     """Elastic x full-recipe crossover (reference:
     examples/elastic/pytorch/pytorch_imagenet_resnet50_elastic.py):
@@ -331,6 +342,7 @@ def test_elastic_pytorch_imagenet_example(tmp_path):
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_elastic_keras_mnist_example():
     """Keras fit x elastic state callbacks (reference:
     examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py)."""
@@ -344,6 +356,7 @@ def test_elastic_keras_mnist_example():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_tensorflow2_keras_synthetic_benchmark_example():
     """fit-loop perf benchmark (reference:
     examples/tensorflow2/tensorflow2_keras_synthetic_benchmark.py)."""

@@ -21,7 +21,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+# shard_map via the repo compat shim: this box's jax 0.4.x has no
+# top-level jax.shard_map (the jaxcompat checker enforces this).
+from horovod_tpu.parallel.mesh import shard_map_compat as shard_map
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu.jax as hvd_jax

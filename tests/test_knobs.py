@@ -78,6 +78,7 @@ def test_hierarchical_allreduce_knob_in_graph(monkeypatch):
     import jax.numpy as jnp
 
     from horovod_tpu.ops import collective_ops as C
+    from horovod_tpu.parallel.mesh import shard_map_compat
 
     if jax.device_count() < 4:
         pytest.skip("needs >=4 virtual devices")
@@ -91,10 +92,10 @@ def test_hierarchical_allreduce_knob_in_graph(monkeypatch):
         return C.allreduce(x, C.Sum, axis=("dcn", "ici"))
 
     spec = jax.sharding.PartitionSpec(("dcn", "ici"))
-    flat = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=spec,
-                                 out_specs=spec))(x)
+    flat = jax.jit(shard_map_compat(step, mesh=mesh, in_specs=spec,
+                                    out_specs=spec))(x)
     monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
-    hier = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=spec,
-                                 out_specs=spec))(x)
+    hier = jax.jit(shard_map_compat(step, mesh=mesh, in_specs=spec,
+                                    out_specs=spec))(x)
     np.testing.assert_allclose(np.asarray(flat), np.asarray(hier),
                                rtol=1e-6)

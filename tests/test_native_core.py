@@ -107,6 +107,7 @@ def test_cache_eviction_under_tiny_capacity():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_native_collectives_np8():
     """np=8 native control+data plane (VERDICT r2 #8): the same
     rank-generic matrix as np=2/3, at the widest world this host
@@ -170,6 +171,7 @@ def test_native_core_under_tsan():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_process_sets_np4():
     """Concurrent disjoint process sets at np=4 (reference:
     test_process_sets_static.py discipline)."""

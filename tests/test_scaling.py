@@ -100,6 +100,7 @@ def test_collective_overhead_is_bounded():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_scaling_harness_runs_fresh(tmp_path):
     out_path = tmp_path / "SCALING.json"
     subprocess.run(

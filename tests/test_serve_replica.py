@@ -125,3 +125,130 @@ def test_replica_startup_self_check_blocks_coupled_model(tmp_path):
                       sample_shape=(2,), max_batch=4, min_bucket=4)
     with pytest.raises(AssertionError, match="bit-exactness"):
         replica.load()
+
+
+# --- (apply, step) snapshot atomicity (ISSUE 9 locks sweep) -----------------
+
+class _RecordingLock:
+    """Context-manager wrapper counting acquisitions of a real lock."""
+
+    def __init__(self, real):
+        self.real = real
+        self.acquired = 0
+
+    def __enter__(self):
+        self.acquired += 1
+        return self.real.__enter__()
+
+    def __exit__(self, *exc):
+        return self.real.__exit__(*exc)
+
+
+def test_replica_readers_snapshot_apply_and_step_under_the_lock():
+    """Regression (locks checker finding): the hot-reload poller swaps
+    (_apply, step) under _apply_lock, but endpoint_payload / healthz /
+    predict used to read them bare — a reload landing between the two
+    reads served outputs from the new step labeled with the old one.
+    Every reader now goes through the locked _loaded_state snapshot."""
+    replica = Replica(model="identity")
+    rec = _RecordingLock(replica._apply_lock)
+    replica._apply_lock = rec
+
+    payload = replica.endpoint_payload()
+    assert payload["step"] is None  # not loaded yet
+    assert rec.acquired == 1
+
+    replica._handle_healthz()
+    assert rec.acquired == 2
+
+    apply, step = replica._loaded_state()
+    assert (apply, step) == (None, None)
+    assert rec.acquired == 3
+
+
+def test_replica_hot_reload_never_serves_a_torn_apply_step_pair():
+    """Concurrent hot-reloads vs readers: the step a reader reports
+    must always match the apply function it observed (each swapped-in
+    apply encodes its own step)."""
+    import threading
+
+    replica = Replica(model="identity")
+
+    def make_apply(step):
+        return lambda x: step
+
+    with replica._apply_lock:
+        replica._apply = make_apply(0)
+        replica.step = 0
+
+    stop = threading.Event()
+
+    def reloader():
+        step = 0
+        while not stop.is_set():
+            step += 1
+            with replica._apply_lock:
+                replica._apply = make_apply(step)
+                replica.step = step
+
+    t = threading.Thread(target=reloader, daemon=True)
+    t.start()
+    try:
+        for _ in range(2000):
+            apply, step = replica._loaded_state()
+            assert apply(None) == step, "torn (apply, step) pair"
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_predict_step_label_matches_the_apply_that_ran():
+    """Review fix: the response's step must name the checkpoint that
+    COMPUTED the outputs, not whatever was loaded at serialization
+    time — a hot reload landing between the batch run and the 200
+    response must not relabel step-N outputs as step N+1. The step now
+    rides on the batch output itself (_SteppedOutput)."""
+    import threading
+
+    replica = Replica(model="identity")
+    replica.load()
+    try:
+        def stepped(k):
+            return lambda x: np.full_like(np.asarray(x), float(k))
+
+        with replica._apply_lock:
+            replica._apply = stepped(7)
+            replica.step = 7
+        status, _, payload = replica._handle_predict(
+            json.dumps({"inputs": [[1.0, 2.0]]}).encode())
+        doc = json.loads(payload.decode())
+        assert status == 200
+        assert doc["outputs"][0][0] == 7.0 and doc["step"] == 7
+
+        # Race it: a reloader flips (apply, step) while predicts run;
+        # the reported step must always match the value the outputs
+        # carry (each apply writes its own step into every row).
+        stop = threading.Event()
+
+        def reloader():
+            k = 8
+            while not stop.is_set():
+                with replica._apply_lock:
+                    replica._apply = stepped(k)
+                    replica.step = k
+                k += 1
+
+        t = threading.Thread(target=reloader, daemon=True)
+        t.start()
+        try:
+            for _ in range(100):
+                status, _, payload = replica._handle_predict(
+                    json.dumps({"inputs": [[0.0, 0.0]]}).encode())
+                doc = json.loads(payload.decode())
+                assert status == 200
+                assert doc["step"] == doc["outputs"][0][0], doc
+        finally:
+            stop.set()
+            t.join(timeout=5)
+    finally:
+        replica.stop()

@@ -230,6 +230,7 @@ def test_halving_schedule_properties():
 
 
 @pytest.mark.tier2
+@pytest.mark.slow
 def test_tf_ingraph_process_sets_np4():
     """np=4: process-set collectives on per-set TF group keys + 2-round
     recursive-halving reduce-scatter with exact (n-1)/n traffic

@@ -1,13 +1,20 @@
 """Cross-language static-analysis gate (docs/static_analysis.md).
 
-Five contract checkers keep the hand-maintained bridges between the
-C++ core, the ctypes layer, the knob registry, and the docs honest:
+Nine contract checkers keep the hand-maintained bridges between the
+C++ core, the ctypes layer, the knob registry, the docs, and the
+concurrency/persistence disciplines honest:
 
   knobs     every HOROVOD_*/HVD_* env read is registered + documented
   counters  the hvd_core_counters slot layout agrees on both sides
   ctypes    every native call site declares a matching signature
   metrics   every constructed hvd_* metric is in the catalog
   excepts   no bare/blind except swallowing in horovod_tpu/
+  locks     guarded attributes accessed under their lock (py + C++
+            GUARDED_BY)
+  journal   no ad-hoc append-mode persistence outside the journal
+            primitives
+  jaxcompat drift-prone jax APIs only behind parallel/mesh.py shims
+  testtier  minutes-long tests carry BOTH tier2 and slow markers
 
 Run ``python -m tools.analysis`` (CI does, before the test lanes);
 pre-existing accepted findings live in ``baseline.json``.
@@ -21,8 +28,12 @@ from tools.analysis import (
     check_counters,
     check_ctypes,
     check_excepts,
+    check_jaxcompat,
+    check_journal,
     check_knobs,
+    check_locks,
     check_metrics,
+    check_testtier,
 )
 from tools.analysis.common import Finding, Project
 
@@ -32,6 +43,10 @@ CHECKERS: Dict[str, Callable[[Project], List[Finding]]] = {
     "ctypes": check_ctypes.check,
     "metrics": check_metrics.check,
     "excepts": check_excepts.check,
+    "locks": check_locks.check,
+    "journal": check_journal.check,
+    "jaxcompat": check_jaxcompat.check,
+    "testtier": check_testtier.check,
 }
 
 
@@ -40,5 +55,11 @@ def run_all(project: Project, only=None) -> List[Finding]:
     for name, fn in CHECKERS.items():
         if only and name not in only:
             continue
-        findings += fn(project)
+        try:
+            findings += fn(project)
+        except Exception as e:
+            # A crashing checker (bug in the checker, not a finding)
+            # must die with its NAME attached, not an anonymous
+            # traceback out of this loop.
+            raise RuntimeError("checker %r crashed: %s" % (name, e)) from e
     return sorted(findings)

@@ -1,0 +1,121 @@
+"""jax-compat lint: drift-prone jax APIs stay behind the mesh shims.
+
+The repeated tax of jax 0.4.x drift (``jax.shard_map`` vs
+``jax.experimental.shard_map``, missing ``lax.axis_size``, missing
+``jax.set_mesh``) was retired by ``parallel/mesh.py``'s
+``shard_map_compat`` / ``traced_axis_size`` shims (PR 7) — but only in
+the files that were migrated. Everything else kept collecting errors
+on this container's jax. This checker pins the discipline: direct use
+of a drift-prone API anywhere outside ``parallel/mesh.py`` (the one
+place allowed to probe the live jax) is a finding. Flagged patterns:
+
+- ``from jax import shard_map`` / ``jax.shard_map`` — even inside a
+  try/except import dance: the dance is what ``shard_map_compat``
+  exists to centralize;
+- ``from jax.experimental.shard_map import ...`` — removed in newer
+  jax, the other side of the same drift;
+- ``lax.axis_size`` / ``jax.lax.axis_size`` — absent on 0.4.x; use
+  ``traced_axis_size``;
+- ``jax.set_mesh`` / ``from jax import set_mesh`` — absent on 0.4.x
+  (``Mesh`` is its own context manager there);
+- ``psum(<literal 1>, axis)`` — bare psum-derived axis sizing; that is
+  ``traced_axis_size``'s fallback, not call-site code.
+
+``getattr(jax, "set_mesh", None)``-style feature probes pass the AST
+scan untouched, which is exactly the point: probing is a deliberate
+compat decision, a bare attribute access is an assumption.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.analysis.common import Finding, Project
+
+_SHIM_HINT = ("use horovod_tpu.parallel.mesh.%s "
+              "(docs/static_analysis.md#jax-compat)")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else base + "." + node.attr
+    return None
+
+
+def _scan(tree: ast.Module) -> List[Tuple[str, str, int]]:
+    """(key, message, line) per drift-prone use."""
+    hits: List[Tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            names = {a.name for a in node.names}
+            if mod == "jax" and "shard_map" in names:
+                hits.append((
+                    "import-shard_map",
+                    "'from jax import shard_map' does not exist on "
+                    "jax 0.4.x — " + _SHIM_HINT % "shard_map_compat",
+                    node.lineno))
+            if mod == "jax" and "set_mesh" in names:
+                hits.append((
+                    "import-set_mesh",
+                    "'from jax import set_mesh' is newer-jax only — "
+                    "probe with getattr and fall back to the Mesh "
+                    "context manager (see __graft_entry__)",
+                    node.lineno))
+            if mod.startswith("jax.experimental.shard_map"):
+                hits.append((
+                    "import-experimental-shard_map",
+                    "'jax.experimental.shard_map' is removed in newer "
+                    "jax — " + _SHIM_HINT % "shard_map_compat",
+                    node.lineno))
+        elif isinstance(node, ast.Attribute):
+            dotted = _dotted(node)
+            if dotted in ("jax.shard_map",):
+                hits.append((
+                    "attr-jax.shard_map",
+                    "'jax.shard_map' does not exist on jax 0.4.x — "
+                    + _SHIM_HINT % "shard_map_compat", node.lineno))
+            elif dotted in ("jax.set_mesh",):
+                hits.append((
+                    "attr-jax.set_mesh",
+                    "'jax.set_mesh' is newer-jax only — probe with "
+                    "getattr and fall back to the Mesh context manager",
+                    node.lineno))
+            elif dotted is not None and dotted.endswith("lax.axis_size"):
+                hits.append((
+                    "attr-lax.axis_size",
+                    "'lax.axis_size' is absent on jax 0.4.x — "
+                    + _SHIM_HINT % "traced_axis_size", node.lineno))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname == "psum" and len(node.args) >= 2 \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == 1:
+                hits.append((
+                    "psum-axis-sizing",
+                    "bare 'psum(1, axis)' axis sizing — "
+                    + _SHIM_HINT % "traced_axis_size", node.lineno))
+    return hits
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in project.jax_files():
+        try:
+            tree = project.parsed(rel)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        per_key: dict = {}
+        for key, message, line in _scan(tree):
+            ordinal = per_key.get(key, 0)
+            per_key[key] = ordinal + 1
+            findings.append(Finding(
+                "jaxcompat", rel, line,
+                "%s:%d" % (key, ordinal), message))
+    return findings

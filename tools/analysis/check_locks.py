@@ -1,0 +1,409 @@
+"""Lock-discipline lint: guarded attributes stay under their lock.
+
+PRs 5-8 grew a threaded surface (heartbeat daemons, the serve router,
+KV ``put_callback`` consumers, the metrics registry) whose locking
+rules lived only in review comments. This checker makes them a gate:
+
+Python (``horovod_tpu/``): in any class that owns a
+``threading.Lock/RLock/Condition`` attribute, every attribute that is
+*written* somewhere under ``with self.<lock>:`` is **guarded** by that
+lock. Any read or write of a guarded attribute outside a ``with``
+scope of one of its guarding locks is a finding, except:
+
+- inside ``__init__`` (the object has not escaped to other threads);
+- inside a method carrying ``# analysis: holds-lock(<lock>)`` — the
+  documented "caller holds the lock" contract (the tag doubles as the
+  reviewer-visible justification).
+
+Accesses inside nested functions/lambdas are deliberately treated as
+NOT holding any enclosing ``with`` lock: closures outlive the scope
+that created them (callbacks, thread targets), which is exactly how
+guarded state leaks out from under its lock.
+
+C++ (``core/src``): opt-in via field annotations. A field declared with
+a trailing ``// GUARDED_BY(<mutex>)`` comment must only be touched in
+a scope where a ``std::lock_guard``/``std::unique_lock`` naming that
+mutex is live (brace-scope tracking over comment/string-stripped
+text), or past a ``// analysis: holds-lock(<mutex>)`` comment in the
+same scope. Field identifiers are matched by name across core/src, so
+annotated fields need class-unique names (the ``name_`` convention
+already provides that).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis import cpp
+from tools.analysis.common import Finding, Project
+
+HOLDS_TAG_RE = re.compile(r"analysis:\s*holds-lock\(([^)]*)\)")
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# Method calls that mutate their receiver in place: a call like
+# ``self._table.pop(k)`` is a WRITE of ``_table``.
+_MUTATORS = {
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "sort", "update",
+}
+
+
+def _lock_call(expr: ast.AST) -> bool:
+    """True when ``expr`` contains a threading.Lock/RLock/Condition()
+    construction (covers ``threading.RLock()``, a bare imported
+    ``RLock()``, and conditional forms like ``x if x else Lock()``)."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in _LOCK_FACTORIES:
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _Access:
+    __slots__ = ("attr", "write", "locks", "line")
+
+    def __init__(self, attr: str, write: bool, locks: Set[str], line: int):
+        self.attr = attr
+        self.write = write
+        self.locks = locks
+        self.line = line
+
+
+def _method_tags(lines: Sequence[str], fn: ast.AST) -> Set[str]:
+    """Lock names named by ``# analysis: holds-lock(...)`` tags within
+    the method's line range (decorator line through body end)."""
+    lo = max(0, fn.lineno - 1)
+    hi = min(len(lines), fn.body[-1].end_lineno or fn.lineno)
+    out: Set[str] = set()
+    for ln in lines[lo:hi]:
+        m = HOLDS_TAG_RE.search(ln)
+        if m:
+            out |= {p.strip() for p in m.group(1).split(",") if p.strip()}
+    return out
+
+
+def _collect_accesses(fn, lock_attrs: Set[str]) -> List[_Access]:
+    """Every ``self.<attr>`` touch in ``fn`` with the set of owned
+    locks held at that point. Nested function bodies reset the held
+    set (closures escape the scope that created them)."""
+    out: List[_Access] = []
+
+    def record(attr: Optional[str], write: bool, locks: Set[str],
+               line: int):
+        if attr is not None and attr not in lock_attrs:
+            out.append(_Access(attr, write, set(locks), line))
+
+    def visit(node: ast.AST, locks: Set[str]):
+        if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+            acquired = set(locks)
+            for item in node.items:
+                name = _self_attr(item.context_expr)
+                if name in lock_attrs:
+                    acquired.add(name)
+                else:
+                    visit(item.context_expr, locks)
+            for stmt in node.body:
+                visit(stmt, acquired)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for stmt in body:
+                visit(stmt, set())  # closures: no inherited lock
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                _visit_target(t, locks)
+            visit(node.value, locks)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            _visit_target(node.target, locks)
+            if getattr(node, "value", None) is not None:
+                visit(node.value, locks)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                _visit_target(t, locks)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                attr = _self_attr(f.value)
+                if attr is not None:
+                    record(attr, True, locks, node.lineno)
+                else:
+                    visit(f.value, locks)
+            else:
+                visit(f, locks)
+            for a in node.args:
+                visit(a, locks)
+            for kw in node.keywords:
+                visit(kw.value, locks)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                record(attr, not isinstance(node.ctx, ast.Load),
+                       locks, node.lineno)
+                return
+        for child in ast.iter_child_nodes(node):
+            visit(child, locks)
+
+    def _visit_target(t: ast.AST, locks: Set[str]):
+        attr = _self_attr(t)
+        if attr is not None:
+            record(attr, True, locks, t.lineno)
+            return
+        if isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr is not None:
+                record(attr, True, locks, t.lineno)
+                visit(t.slice, locks)
+                return
+        visit(t, locks)
+
+    for stmt in fn.body:
+        visit(stmt, set())
+    return out
+
+
+def _check_class(rel: str, lines: Sequence[str], cls: ast.ClassDef,
+                 qual: str) -> List[Finding]:
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    lock_attrs: Set[str] = set()
+    for fn in methods:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None and _lock_call(node.value):
+                        lock_attrs.add(attr)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                # ``with self._lock:`` (no ``as`` binding) marks the
+                # attribute as a lock even when the lock object is
+                # passed in rather than constructed here (the metrics
+                # value classes share their family's lock that way).
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and item.optional_vars is None:
+                        lock_attrs.add(attr)
+    if not lock_attrs:
+        return []
+
+    per_method: List[Tuple[ast.AST, List[_Access], Set[str]]] = []
+    guards: Dict[str, Set[str]] = {}
+    for fn in methods:
+        accesses = _collect_accesses(fn, lock_attrs)
+        tags = _method_tags(lines, fn)
+        per_method.append((fn, accesses, tags))
+        for acc in accesses:
+            if acc.write and acc.locks:
+                guards.setdefault(acc.attr, set()).update(acc.locks)
+
+    findings: List[Finding] = []
+    for fn, accesses, tags in per_method:
+        if fn.name == "__init__":
+            continue
+        seen: Set[str] = set()
+        for acc in accesses:
+            guarding = guards.get(acc.attr)
+            if not guarding:
+                continue
+            if acc.locks & guarding or tags & guarding:
+                continue
+            if acc.attr in seen:
+                continue
+            seen.add(acc.attr)
+            findings.append(Finding(
+                "locks", rel, acc.line,
+                "unguarded:%s.%s:%s" % (qual, fn.name, acc.attr),
+                "%s of '%s.%s' (guarded by %s) outside the lock in "
+                "%s() — take the lock, or tag the method with "
+                "'# analysis: holds-lock(%s)' and a reason"
+                % ("write" if acc.write else "read", qual, acc.attr,
+                   "/".join(sorted(guarding)), fn.name,
+                   ", ".join(sorted(guarding)))))
+    return findings
+
+
+def _python_findings(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in project.lock_files():
+        try:
+            tree = project.parsed(rel)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        lines = project.read(rel).splitlines()
+
+        def visit(node, scope):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = ".".join(scope + (child.name,))
+                    findings.extend(
+                        _check_class(rel, lines, child, qual))
+                    visit(child, scope + (child.name,))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    visit(child, scope + (child.name,))
+                else:
+                    visit(child, scope)
+
+        visit(tree, ())
+    return findings
+
+
+# --- C++ GUARDED_BY ----------------------------------------------------------
+
+GUARDED_BY_RE = re.compile(r"//\s*GUARDED_BY\(\s*(\w+)\s*\)")
+_LOCK_ACQ_RE = re.compile(
+    r"\b(?:std::)?(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"(?:<[^>]*>)?\s+\w+\s*[({]([^;]*?)[)}]")
+
+
+def guarded_fields(text: str) -> Dict[str, Tuple[str, int]]:
+    """field name -> (mutex, line) for every declaration carrying a
+    trailing ``// GUARDED_BY(<mutex>)`` comment."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        m = GUARDED_BY_RE.search(line)
+        if m is None:
+            continue
+        decl = line[:m.start()]
+        dm = re.search(r"(\w+)\s*(?:\[[^\]]*\])?\s*(?:=[^;]*)?;\s*$", decl)
+        if dm:
+            out[dm.group(1)] = (m.group(1), lineno)
+    return out
+
+
+def scan_cpp_uses(text: str, fields: Dict[str, Tuple[str, int]],
+                  anno_lines: Optional[Set[int]] = None
+                  ) -> List[Tuple[str, str, int]]:
+    """(field, mutex, line) for every use of an annotated field outside
+    a live lock scope of its mutex. Brace-scope tracking: an acquisition
+    guards until its enclosing brace closes; a ``holds-lock`` comment
+    guards the rest of its scope the same way. ``anno_lines`` is the
+    set of THIS text's own annotated-declaration lines (skipped as
+    uses); the default derives it from ``fields``, which is only
+    correct when ``fields`` came from this same text — cross-file
+    callers must pass their per-file set, or a use that happens to
+    share a line number with another file's declaration is silently
+    skipped."""
+    if not fields:
+        return []
+    # Tags are comments, so collect their offsets before stripping.
+    tag_marks: List[Tuple[int, str]] = []  # (offset, mutex)
+    for m in HOLDS_TAG_RE.finditer(text):
+        for name in m.group(1).split(","):
+            if name.strip():
+                tag_marks.append((m.start(), name.strip()))
+    if anno_lines is None:
+        anno_lines = {line for _, (_, line) in fields.items()}
+    code = cpp.strip_comments(text, blank_strings=True)
+
+    acquisitions: List[Tuple[int, Set[str]]] = []  # (offset, mutex names)
+    for m in _LOCK_ACQ_RE.finditer(code):
+        names = set(re.findall(r"\w+", m.group(1)))
+        acquisitions.append((m.start(), names))
+    for off, name in tag_marks:
+        acquisitions.append((off, {name}))
+    acquisitions.sort()
+
+    field_re = re.compile(
+        r"\b(" + "|".join(re.escape(f) for f in sorted(fields)) + r")\b")
+    uses = [(m.start(), m.group(1)) for m in field_re.finditer(code)]
+    if not uses:
+        return []
+
+    # Walk the text once, maintaining a stack of (depth) -> held mutexes.
+    events = sorted(
+        [(off, "acq", names) for off, names in acquisitions]
+        + [(off, "use", f) for off, f in uses])
+    depth = 0
+    held: List[Tuple[int, Set[str]]] = []  # (depth at acquisition, names)
+    out: List[Tuple[str, str, int]] = []
+    ei = 0
+    for i, c in enumerate(code):
+        while ei < len(events) and events[ei][0] == i:
+            off, kind, payload = events[ei]
+            ei += 1
+            if kind == "acq":
+                held.append((depth, payload))
+            else:
+                field = payload
+                mutex = fields[field][0]
+                line = code.count("\n", 0, off) + 1
+                if line in anno_lines:
+                    continue  # the annotated declaration itself
+                if not any(mutex in names for _, names in held):
+                    out.append((field, mutex, line))
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            # An acquisition guards until its enclosing brace closes.
+            held = [(d, n) for d, n in held if d <= depth]
+    # Flush any trailing events (EOF without trailing brace movement).
+    while ei < len(events):
+        off, kind, payload = events[ei]
+        ei += 1
+        if kind == "use":
+            field = payload
+            mutex = fields[field][0]
+            line = code.count("\n", 0, off) + 1
+            if line not in anno_lines and \
+                    not any(mutex in names for _, names in held):
+                out.append((field, mutex, line))
+    return out
+
+
+def _native_findings(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    texts: Dict[str, str] = {}
+    fields: Dict[str, Tuple[str, int]] = {}
+    per_file_anno: Dict[str, Set[int]] = {}
+    for rel in project.native_files():
+        try:
+            texts[rel] = project.read(rel)
+        except (OSError, UnicodeDecodeError):
+            continue
+        own = guarded_fields(texts[rel])
+        fields.update(own)
+        # Declaration-line skips are strictly per-file: another file's
+        # annotation at the same line number must not mask a use here.
+        per_file_anno[rel] = {line for _, line in own.values()}
+    if not fields:
+        return findings
+    for rel, text in sorted(texts.items()):
+        per_key: Dict[str, int] = {}
+        for field, mutex, line in scan_cpp_uses(
+                text, fields, anno_lines=per_file_anno.get(rel, set())):
+            ordinal = per_key.get(field, 0)
+            per_key[field] = ordinal + 1
+            findings.append(Finding(
+                "locks", rel, line,
+                "unguarded-native:%s:%d" % (field, ordinal),
+                "use of '%s' (GUARDED_BY(%s)) outside a lock_guard/"
+                "unique_lock scope of %s — acquire the mutex, or mark "
+                "the scope with '// analysis: holds-lock(%s)' and a "
+                "reason" % (field, mutex, mutex, mutex)))
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    return _python_findings(project) + _native_findings(project)

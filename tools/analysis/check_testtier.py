@@ -1,0 +1,186 @@
+"""Test-tier lint: minutes-long tests carry tier2 AND slow.
+
+The ROADMAP tier-1 verify runs ``-m 'not slow'`` against a hard 870 s
+wall, which OVERRIDES pytest.ini's ``-m "not tier2"`` addopts — so a
+tier2 test without ``slow`` still burns the verify budget (the PR 3
+lesson, re-learned every time a chaos-scale test ships half-marked).
+This checker turns the rule into a gate. A test function must carry
+BOTH ``@pytest.mark.tier2`` and ``@pytest.mark.slow`` (decorator,
+class decorator, or module ``pytestmark``) when its body shows
+minutes-scale budget evidence:
+
+- cumulative literal ``time.sleep(...)`` seconds >= 5;
+- a literal ``timeout=`` of 360 s or more (tier-1 subprocess ceilings
+  in this tree are 120-300 s of flake insurance; a 6-minute budget is
+  a declaration of a minutes-long run);
+- a subprocess fleet: a literal ``np``/``np_`` >= 4, a launcher called
+  with a first positional int >= 4, or ``"-np", "<n>=4"`` argv pairs.
+
+Marker consistency is enforced on its own: ``slow`` without ``tier2``
+is a finding regardless of triggers (a slow-only test silently drops
+out of BOTH CI tiers' selections).
+
+A triggered test that is genuinely fast tags itself with
+``# analysis: tier1-ok(<reason>)`` in the function body — e.g. a big
+ceiling that exists purely as flake insurance.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from tools.analysis.common import Finding, Project
+
+TIER1_OK_RE = re.compile(r"analysis:\s*tier1-ok\(([^)]*)\)")
+
+SLEEP_BUDGET_SEC = 5.0
+TIMEOUT_BUDGET_SEC = 360.0
+FLEET_NP = 4
+
+
+def _marks(decorators) -> Set[str]:
+    """Marker names from @pytest.mark.X decorators (call or bare)."""
+    out: Set[str] = set()
+    for dec in decorators:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = []
+        while isinstance(node, ast.Attribute):
+            dotted.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            dotted.append(node.id)
+        dotted = list(reversed(dotted))
+        if len(dotted) >= 3 and dotted[0] == "pytest" \
+                and dotted[1] == "mark":
+            out.add(dotted[2])
+    return out
+
+
+def _module_marks(tree: ast.Module) -> Set[str]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "pytestmark"
+                for t in node.targets):
+            vals = node.value.elts \
+                if isinstance(node.value, (ast.List, ast.Tuple)) \
+                else [node.value]
+            return _marks(vals)
+    return set()
+
+
+def _num(node) -> Optional[float]:
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(node.value, bool):
+        return float(node.value)
+    return None
+
+
+def _triggers(fn) -> List[str]:
+    """Budget evidence in one test function's body."""
+    sleep_total = 0.0
+    reasons: List[str] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if fname == "sleep" and node.args:
+            v = _num(node.args[0])
+            if v is not None:
+                sleep_total += v
+        for kw in node.keywords:
+            if kw.arg == "timeout":
+                v = _num(kw.value)
+                if v is not None and v >= TIMEOUT_BUDGET_SEC:
+                    reasons.append("timeout=%g" % v)
+            if kw.arg in ("np", "np_"):
+                v = _num(kw.value)
+                if v is not None and v >= FLEET_NP:
+                    reasons.append("np=%d fleet" % int(v))
+        if fname is not None and "launch" in fname.lower() and node.args:
+            v = _num(node.args[0])
+            if v is not None and v >= FLEET_NP:
+                reasons.append("np=%d fleet" % int(v))
+        args = node.args
+        for i, a in enumerate(args[:-1]):
+            if isinstance(a, ast.Constant) and a.value == "-np":
+                n = args[i + 1]
+                if isinstance(n, ast.Constant):
+                    try:
+                        if int(n.value) >= FLEET_NP:
+                            reasons.append("-np %s fleet" % n.value)
+                    except (TypeError, ValueError):
+                        pass
+        # argv built as a list literal: ["-np", "8", ...]
+        for lst in [a for a in args if isinstance(a, (ast.List, ast.Tuple))]:
+            elts = lst.elts
+            for i, a in enumerate(elts[:-1]):
+                if isinstance(a, ast.Constant) and a.value == "-np" \
+                        and isinstance(elts[i + 1], ast.Constant):
+                    try:
+                        if int(elts[i + 1].value) >= FLEET_NP:
+                            reasons.append("-np %s fleet"
+                                           % elts[i + 1].value)
+                    except (TypeError, ValueError):
+                        pass
+    if sleep_total >= SLEEP_BUDGET_SEC:
+        reasons.insert(0, "sleeps %gs cumulative" % sleep_total)
+    return reasons
+
+
+def _tagged(lines, fn) -> bool:
+    lo = max(0, fn.lineno - 1)
+    hi = min(len(lines), fn.body[-1].end_lineno or fn.lineno)
+    return any(TIER1_OK_RE.search(ln) for ln in lines[lo:hi])
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in project.test_files():
+        try:
+            tree = project.parsed(rel)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        lines = project.read(rel).splitlines()
+        module_marks = _module_marks(tree)
+
+        def visit(node, inherited: Set[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, inherited | _marks(child.decorator_list))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    if not child.name.startswith("test_"):
+                        continue
+                    marks = inherited | _marks(child.decorator_list)
+                    if "slow" in marks and "tier2" not in marks:
+                        findings.append(Finding(
+                            "testtier", rel, child.lineno,
+                            "slow-without-tier2:%s" % child.name,
+                            "%s is marked slow but not tier2 — a "
+                            "slow-only test drops out of both CI "
+                            "tiers' selections; mark it tier2 too"
+                            % child.name))
+                    if "tier2" in marks and "slow" in marks:
+                        continue
+                    if _tagged(lines, child):
+                        continue
+                    reasons = _triggers(child)
+                    if reasons:
+                        findings.append(Finding(
+                            "testtier", rel, child.lineno,
+                            "needs-tier2-slow:%s" % child.name,
+                            "%s shows minutes-scale budget evidence "
+                            "(%s) but lacks %s — add BOTH "
+                            "@pytest.mark.tier2 and @pytest.mark.slow "
+                            "(the 870s verify-wall rule), or tag the "
+                            "body with '# analysis: tier1-ok(<reason>)'"
+                            % (child.name, "; ".join(sorted(set(reasons))),
+                               " and ".join(sorted(
+                                   {"tier2", "slow"} - marks)))))
+
+        visit(tree, module_marks)
+    return findings

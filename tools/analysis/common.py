@@ -56,6 +56,15 @@ class Project:
                      "bin/hvdrun"),
                  except_scan_dirs: Sequence[str] = ("horovod_tpu",),
                  metric_scan_dirs: Sequence[str] = ("horovod_tpu",),
+                 lock_scan_dirs: Sequence[str] = ("horovod_tpu",),
+                 journal_scan_dirs: Sequence[str] = ("horovod_tpu",),
+                 journal_allowed_files: Sequence[str] = (
+                     "horovod_tpu/runner/journal.py",
+                     "horovod_tpu/ops/block_tuner.py"),
+                 jax_allowed_files: Sequence[str] = (
+                     "horovod_tpu/parallel/mesh.py",),
+                 jax_scan_files: Sequence[str] = ("__graft_entry__.py",),
+                 test_scan_dirs: Sequence[str] = ("tests",),
                  knob_allowlist: Optional[Dict[str, str]] = None):
         self.root = os.path.abspath(root)
         self.knobs_py = knobs_py
@@ -67,6 +76,12 @@ class Project:
         self.python_scan_files = tuple(python_scan_files)
         self.except_scan_dirs = tuple(except_scan_dirs)
         self.metric_scan_dirs = tuple(metric_scan_dirs)
+        self.lock_scan_dirs = tuple(lock_scan_dirs)
+        self.journal_scan_dirs = tuple(journal_scan_dirs)
+        self.journal_allowed_files = tuple(journal_allowed_files)
+        self.jax_allowed_files = tuple(jax_allowed_files)
+        self.jax_scan_files = tuple(jax_scan_files)
+        self.test_scan_dirs = tuple(test_scan_dirs)
         self.knob_allowlist = knob_allowlist
         self._ast_cache: Dict[str, object] = {}
 
@@ -121,6 +136,25 @@ class Project:
 
     def native_files(self) -> List[str]:
         return self._walk([self.native_src], (".cc", ".h"))
+
+    def lock_files(self) -> List[str]:
+        return self._walk(self.lock_scan_dirs, (".py",))
+
+    def journal_files(self) -> List[str]:
+        return [rel for rel in self._walk(self.journal_scan_dirs, (".py",))
+                if rel not in self.journal_allowed_files]
+
+    def jax_files(self) -> List[str]:
+        files = self.python_files()
+        for rel in self.jax_scan_files:
+            if self.exists(rel):
+                files.append(rel)
+        return sorted({rel for rel in files
+                       if rel not in self.jax_allowed_files})
+
+    def test_files(self) -> List[str]:
+        return [rel for rel in self._walk(self.test_scan_dirs, (".py",))
+                if os.path.basename(rel).startswith("test_")]
 
 
 # --- baseline ---------------------------------------------------------------

@@ -308,6 +308,9 @@ def _perf_config():
         fam = snap.get(family) or {}
         return sum(v.get("value", 0) for v in fam.get("values", []))
 
+    from horovod_tpu.utils import online_tuner
+
+    tuner = online_tuner.online_tuner()
     return {
         "grad_bucket_bytes": grad_bucket_bytes(),
         "flash_tune_mode": block_tuner.tune_mode() or "off",
@@ -317,6 +320,15 @@ def _perf_config():
         "hvd_grad_buckets_total": _total("hvd_grad_buckets_total"),
         "hvd_flash_tuner_trials_total": _total(
             "hvd_flash_tuner_trials_total"),
+        # Online-tuner movement (docs/autotune.md): final knob state +
+        # the full decision trajectory, so a capture records what the
+        # tuner did, not just where it ended.
+        "tune": {
+            "mode": online_tuner.tune_mode() or "off",
+            "state": tuner.state() if tuner is not None else None,
+            "trajectory": tuner.trajectory() if tuner is not None
+            else None,
+        },
     }
 
 
@@ -336,6 +348,13 @@ def run_child(args) -> int:
     import horovod_tpu as hvd
 
     hvd.init()
+
+    # HVD_TUNE (the --tune flag exports it): run the online tuner for
+    # the duration of the benchmark; _perf_config embeds its decision
+    # trajectory in the result JSON.
+    from horovod_tpu.utils.online_tuner import start_online_tuner
+
+    start_online_tuner(role="training")
 
     # Parent always resolves --workloads; the fallback covers a direct
     # --child invocation (debugging).
@@ -525,6 +544,13 @@ def main():
                    help="Export HVD_GRAD_BUCKET_BYTES to the child "
                         "(0 = legacy single whole-pytree psum; "
                         "default: the optimizer's 4 MiB buckets).")
+    p.add_argument("--tune", action="store_true",
+                   help="Export HVD_TUNE=1 to the benchmark child: the "
+                        "online tuner (docs/autotune.md) runs during "
+                        "the benchmark and its decision trajectory is "
+                        "embedded in the result JSON "
+                        "(perf_config.tune) so BENCH_* captures record "
+                        "tuned-vs-default movement.")
     args = p.parse_args()
     # Perf-knob flags are plain env exports so the supervised child
     # (and its CPU fallback) inherit them without plumbing.
@@ -532,6 +558,11 @@ def main():
         os.environ["HVD_FLASH_TUNE"] = "1"
     if args.grad_bucket_bytes is not None:
         os.environ["HVD_GRAD_BUCKET_BYTES"] = str(args.grad_bucket_bytes)
+    if args.tune:
+        os.environ.setdefault("HVD_TUNE", "1")
+        # Bench runs are short; a 30 s window would never complete a
+        # round. Users can still override explicitly.
+        os.environ.setdefault("HVD_TUNE_WINDOW_SEC", "5")
     # iters=0 would divide by zero; negative warmup is meaningless.
     args.iters = max(args.iters, 1)
     args.warmup = max(args.warmup, 0)

@@ -69,6 +69,17 @@ class Fleet:
         for key, val in (overrides or {}).items():
             env[_AB_ENV[key]] = str(val)
         self._tmp = tempfile.TemporaryDirectory(prefix="bench_serve_")
+        self.tune_dir = None
+        if getattr(args, "tune", False):
+            # Replicas online-tune their micro-batch triggers during
+            # the load (docs/autotune.md); their decision journals
+            # land here and tune_trajectories() folds them into the
+            # result JSON before the fleet is reaped.
+            self.tune_dir = os.path.join(self._tmp.name, "tune")
+            env["HVD_TUNE"] = "1"
+            env.setdefault("HVD_TUNE_WINDOW_SEC", str(max(
+                1.0, args.duration / 8.0)))
+            env["HVD_TUNE_JOURNAL_DIR"] = self.tune_dir
         cmd = [sys.executable, "-m", "horovod_tpu.serve",
                "--model", args.model, "--np", str(args.np_),
                "--port", str(self.port),
@@ -98,6 +109,25 @@ class Fleet:
                 return
             time.sleep(0.2)
         raise RuntimeError("serve fleet not ready in %.0fs" % timeout)
+
+    def tune_trajectories(self):
+        """Fold the replicas' tuner journals (read-only) into
+        {journal_name: [records...]}; None when --tune is off."""
+        if self.tune_dir is None or not os.path.isdir(self.tune_dir):
+            return None
+        out = {}
+        for fn in sorted(os.listdir(self.tune_dir)):
+            if not fn.endswith(".jsonl"):
+                continue
+            recs = []
+            with open(os.path.join(self.tune_dir, fn)) as fh:
+                for line in fh:
+                    try:
+                        recs.append(json.loads(line))
+                    except ValueError:
+                        break  # torn tail
+            out[fn] = recs
+        return out
 
     def stop(self):
         doc = _get_json(self.port, "/healthz") or {}
@@ -186,7 +216,11 @@ def run_slot(args, overrides=None):
     fleet = Fleet(args, overrides)
     try:
         fleet.wait_ready(args.ready_timeout)
-        return run_load(fleet.port, args)
+        result = run_load(fleet.port, args)
+        tune = fleet.tune_trajectories()
+        if tune is not None:
+            result["tune"] = tune
+        return result
     finally:
         fleet.stop()
 
@@ -265,6 +299,12 @@ def main(argv=None):
                          "overrides (%s) as env; the A/A null gates "
                          "the verdict" % ",".join(sorted(_AB_ENV)))
     ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--tune", action="store_true",
+                    help="replicas run the online tuner "
+                         "(HVD_TUNE=1) over their micro-batch "
+                         "triggers during the load; the decision "
+                         "trajectory is embedded in the result JSON "
+                         "(docs/autotune.md)")
     args = ap.parse_args(argv)
 
     base_cfg = {"np": args.np_, "model": args.model,

@@ -55,7 +55,7 @@ def _free_port():
 
 
 def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
-              timeout=600):
+              sockbuf=None, timeout=600):
     """One np-wide sweep; returns the rank-0 JSON payload."""
     port = _free_port()
     procs = []
@@ -85,6 +85,8 @@ def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
             env["HVD_RING_CHUNK_BYTES"] = str(chunk_bytes)
         if sg is not None:
             env["HVD_WIRE_SG"] = str(sg)
+        if sockbuf is not None:
+            env["HOROVOD_SOCKET_BUF_BYTES"] = str(sockbuf)
         procs.append(subprocess.Popen(
             [sys.executable, _WORKER], env=env, cwd=_REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -119,8 +121,10 @@ def _busbw_by_size(payload):
 
 
 def _parse_overrides(spec):
-    """``--ab chunk_bytes=0,sg=1`` -> kwargs for ``run_sweep``."""
-    allowed = {"chunk_bytes": int, "sg": int}
+    """``--ab chunk_bytes=0,sg=1,sockbuf=...`` -> ``run_sweep``
+    kwargs (sockbuf = HOROVOD_SOCKET_BUF_BYTES, the online tuner's
+    other wire knob — docs/autotune.md)."""
+    allowed = {"chunk_bytes": int, "sg": int, "sockbuf": int}
     out = {}
     for part in spec.split(","):
         part = part.strip()
@@ -201,9 +205,10 @@ def main(argv=None):
                          "honest A/B delta must exceed")
     ap.add_argument("--ab", default=None, metavar="KEY=VAL[,KEY=VAL]",
                     help="interleaved A/B trials: slot B applies the "
-                         "overrides (chunk_bytes=..., sg=...). The A/A "
-                         "null test runs alongside automatically and "
-                         "gates each delta's verdict")
+                         "overrides (chunk_bytes=..., sg=..., "
+                         "sockbuf=...). The A/A null test runs "
+                         "alongside automatically and gates each "
+                         "delta's verdict")
     ap.add_argument("--trials", type=int, default=5,
                     help="paired trials for --null-ab/--ab (default 5)")
     args = ap.parse_args(argv)

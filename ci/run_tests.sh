@@ -47,6 +47,18 @@ run_analysis() {
 # 720s) proved too thin. (Final r5 suite, 316 tests, cold cache:
 # 868.40s — holds.)
 run_tier1() {
+    echo "=== tier 1: autotune fast-fail (online tuner loop + guardrail) ==="
+    # The online tuner (docs/autotune.md) mutates live knobs on every
+    # training/serving job that sets HVD_TUNE; a broken guardrail
+    # would let a regressing move stick, and a broken journal replay
+    # would re-search from cold on every restart. The whole lane is
+    # fake-clock units — seconds, no fleets. The guardrail-revert case
+    # runs FIRST by name so a regression there is attributed before
+    # the rest of the lane runs.
+    timeout "${HVD_CI_TUNE_BUDGET:-240}" \
+        python -m pytest \
+        "tests/test_online_tuner.py::test_guardrail_reverts_injected_regression" \
+        tests/test_online_tuner.py -q -p no:cacheprovider
     echo "=== tier 1: MFU fast-fail (bucketing math + block-tuner cache) ==="
     # The bucketed gradient path and the flash-block tuner cache are
     # pure-Python contracts (docs/mfu.md) that every in-graph training

@@ -273,6 +273,27 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "serve/router.py + serve/replica.py: per-forward timeout for "
          "router->replica predict proxying and the replica's own "
          "batched-inference wait (default 30)"),
+    # Online tuner (utils/online_tuner.py; docs/autotune.md).
+    Knob("HVD_TUNE", HONORED,
+         "utils/online_tuner.py: 1 = search the tunable-knob schema "
+         "online (journal + A/B guardrail); cache = replay the "
+         "journaled tuned state only, never search; 0/unset = off"),
+    Knob("HVD_TUNE_WINDOW_SEC", HONORED,
+         "utils/online_tuner.py: observation-window length in seconds "
+         "for each objective measurement (default 30)"),
+    Knob("HVD_TUNE_GUARD_PCT", HONORED,
+         "utils/online_tuner.py: guardrail floor — a post-apply window "
+         "regressing more than max(this %% of baseline, 2x the "
+         "baseline sub-window noise) auto-reverts the move "
+         "(default 5)"),
+    Knob("HVD_TUNE_JOURNAL_DIR", HONORED,
+         "utils/online_tuner.py: directory of the fsync'd JSONL "
+         "decision journal (runner/journal.py primitives); a restarted "
+         "job replays it to its tuned state instead of re-searching"),
+    Knob("HVD_TUNE_FREEZE", HONORED,
+         "utils/online_tuner.py: comma list of schema knob names "
+         "(common/knobs.py TUNABLE) pinned at their current value — "
+         "excluded from the search without disabling the tuner"),
     # Fault injector (core/src/comm.cc; armed only on the matching
     # rank — see docs/configuration.md and common/fault_injection.py).
     Knob("HVD_FAULT_RANK", HONORED,
@@ -286,6 +307,103 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HVD_FAULT_DELAY_MS", HONORED,
          "core/src/comm.cc: per-frame sleep for delay mode"),
 ]}
+
+
+# --- tunable-knob schema (the online tuner's search surface) -----------------
+#
+# Declarative contract between the performance-relevant knob surface
+# and utils/online_tuner.py (docs/autotune.md): bounds, proposal
+# granularity, and HOW a value reaches the running system. Three apply
+# paths exist:
+#
+# - "native":  pushed into the live core through CoreSession
+#              (set_params / set_wire_params) — takes effect within a
+#              cycle, no restart, no retrace;
+# - "env":     written to os.environ and read at next use — takes
+#              effect at the next trace/connect/construction that
+#              consults the knob;
+# - "setter":  a callable the owning subsystem registers with the
+#              tuner (e.g. MicroBatcher.set_tunables for the serving
+#              micro-batch knobs).
+#
+# ``live_safe=False`` marks knobs whose LIVE per-rank mutation can
+# lower rank-divergent XLA programs (trace-time reads: divergent
+# gradient-bucket layouts or flash tiles desync the collective
+# sequence across ranks). The tuner only searches them when the
+# process is alone in its world; they are still declared here so the
+# schema is the single inventory of the tunable surface.
+
+
+class TunableKnob(NamedTuple):
+    name: str         # schema name (journal records, HVD_TUNE_FREEZE)
+    lo: float         # search box, inclusive
+    hi: float
+    step: float       # proposal granularity: values snap to lo + k*step
+    apply_path: str   # "native" | "env" | "setter"
+    env: Optional[str]  # backing env knob (mirrored on apply when set)
+    default: float    # the no-tuner value (docs/configuration.md)
+    live_safe: bool   # safe to mutate per-rank mid-run (see above)
+    detail: str
+
+
+TUNABLE: Dict[str, TunableKnob] = {t.name: t for t in [
+    TunableKnob("fusion_threshold_mb", 0.0, 64.0, 1.0, "native",
+                "HOROVOD_FUSION_THRESHOLD", 128.0, True,
+                "eager fusion-buffer threshold (MB; the env knob is "
+                "bytes); staged through the coordinator broadcast so "
+                "layouts stay rank-identical (core/session.set_params)"),
+    TunableKnob("cycle_time_ms", 1.0, 100.0, 0.5, "native",
+                "HOROVOD_CYCLE_TIME", 1.0, True,
+                "background negotiation-loop cadence "
+                "(core/session.set_params; applies locally)"),
+    TunableKnob("ring_chunk_bytes", 0.0, float(16 << 20),
+                float(64 << 10), "native", "HVD_RING_CHUNK_BYTES",
+                float(1 << 20), True,
+                "pipelined-ring sub-chunk size; atomic, read per ring "
+                "step (core/session.set_wire_params; 0 = serial "
+                "schedule). Local reduce scheduling only — divergence "
+                "across ranks cannot desync the wire protocol"),
+    TunableKnob("socket_buf_bytes", 0.0, float(16 << 20),
+                float(64 << 10), "native", "HOROVOD_SOCKET_BUF_BYTES",
+                0.0, True,
+                "SO_SNDBUF/SO_RCVBUF on data-plane sockets; resizes "
+                "live fds + pins an override for future connects "
+                "(core/session.set_wire_params; 0 = kernel default "
+                "for future sockets only)"),
+    TunableKnob("grad_bucket_bytes", 0.0, float(64 << 20),
+                float(1 << 20), "env", "HVD_GRAD_BUCKET_BYTES",
+                float(4 << 20), False,
+                "in-graph gradient-bucket payload; read at TRACE time "
+                "— per-rank divergence lowers divergent psum sequences "
+                "(docs/mfu.md), so live search is single-process only"),
+    TunableKnob("flash_block_q", 128.0, 512.0, 128.0, "env",
+                "HVD_FLASH_BLOCK_Q", 256.0, False,
+                "flash-attention query tile; trace-time read, same "
+                "rank-divergence hazard as grad_bucket_bytes (the "
+                "shape-keyed sweep in ops/block_tuner.py is the "
+                "preferred tuner for this one)"),
+    TunableKnob("flash_block_k", 128.0, 512.0, 128.0, "env",
+                "HVD_FLASH_BLOCK_K", 512.0, False,
+                "flash-attention key/value tile; see flash_block_q"),
+    TunableKnob("serve_max_batch", 1.0, 64.0, 1.0, "setter",
+                "HVD_SERVE_MAX_BATCH", 8.0, True,
+                "serving micro-batch size trigger; tuned DOWN from the "
+                "configured maximum only (buckets above it were never "
+                "compiled) via MicroBatcher.set_tunables"),
+    TunableKnob("serve_deadline_ms", 0.0, 50.0, 1.0, "setter",
+                "HVD_SERVE_BATCH_DEADLINE_MS", 5.0, True,
+                "serving micro-batch deadline trigger "
+                "(MicroBatcher.set_tunables)"),
+]}
+
+
+def tunable_snap(knob: TunableKnob, value: float) -> float:
+    """Clamp ``value`` into the knob's box and snap it to the step
+    grid — every applied value is reproducible from (lo, step, k)."""
+    value = min(max(float(value), knob.lo), knob.hi)
+    if knob.step > 0:
+        value = knob.lo + round((value - knob.lo) / knob.step) * knob.step
+    return min(max(value, knob.lo), knob.hi)
 
 
 def apply_aliases(env: Optional[Dict[str, str]] = None) -> None:

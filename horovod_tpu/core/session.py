@@ -221,6 +221,9 @@ class CoreSession:
         lib.hvd_core_set_params.restype = None
         lib.hvd_core_set_params.argtypes = [
             ctypes.c_double, ctypes.c_longlong]
+        lib.hvd_core_set_wire_params.restype = None
+        lib.hvd_core_set_wire_params.argtypes = [
+            ctypes.c_longlong, ctypes.c_longlong]
         lib.hvd_core_autotune_start.restype = ctypes.c_int
         lib.hvd_core_autotune_start.argtypes = [ctypes.c_char_p]
         lib.hvd_core_autotune_state.restype = None
@@ -488,6 +491,18 @@ class CoreSession:
 
     def set_params(self, cycle_ms: float = -1.0, fusion_bytes: int = -1):
         self._lib.hvd_core_set_params(cycle_ms, fusion_bytes)
+
+    def set_wire_params(self, ring_chunk_bytes: int = -1,
+                        socket_buf_bytes: int = -1):
+        """Retune the data-plane wire knobs on the LIVE core: the ring
+        sub-chunk size applies from the next ring step (atomic, read
+        per op) and the socket-buffer size resizes every live peer
+        socket and pins an override for future connects. -1 leaves a
+        knob unchanged (0 is meaningful for both — serial ring
+        schedule / kernel-autotuned buffers). The online tuner
+        (utils/online_tuner.py) is the intended caller."""
+        self._lib.hvd_core_set_wire_params(int(ring_chunk_bytes),
+                                           int(socket_buf_bytes))
 
     def add_process_set(self, ps_id: int, ranks: Sequence[int]):
         """Collective: all ranks must call in the same order."""

@@ -55,6 +55,20 @@ long long EnvLL(const char* name, long long dflt) {
   return atoll(v);
 }
 
+// Online-tuner override for HOROVOD_SOCKET_BUF_BYTES
+// (hvd_core_set_wire_params): -1 = defer to the env knob; >= 0 wins
+// over it, for live fds (set_socket_buf_bytes walks them) and for
+// every socket connected later (elastic re-bootstrap).
+std::atomic<long long> g_sockbuf_override{-1};
+
+void ApplySockBuf(int fd, long long want) {
+  if (want > 0) {
+    int buf = (int)std::min(want, (long long)INT_MAX);
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+  }
+}
+
 void SetSockOpts(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -63,12 +77,8 @@ void SetSockOpts(int fd) {
   // the pipelined ring overlap reduction with the wire — the peer keeps
   // streaming into rcvbuf while this thread reduces the previous
   // sub-chunk. 0/unset keeps the kernel's autotuned default.
-  long long want = EnvLL("HOROVOD_SOCKET_BUF_BYTES", 0);
-  if (want > 0) {
-    int buf = (int)std::min(want, (long long)INT_MAX);
-    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
-    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
-  }
+  long long over = g_sockbuf_override.load();
+  ApplySockBuf(fd, over >= 0 ? over : EnvLL("HOROVOD_SOCKET_BUF_BYTES", 0));
 }
 
 // Largest iovec window per sendmsg/recvmsg call; the resumption loops
@@ -274,6 +284,22 @@ void TcpComm::Close() {
   if (listen_fd_ >= 0) {
     ::close(listen_fd_);
     listen_fd_ = -1;
+  }
+}
+
+void TcpComm::set_socket_buf_bytes(long long v) {
+  if (v < 0) return;
+  g_sockbuf_override.store(v);
+  // Resize live peer sockets too (setsockopt is fd-level thread-safe;
+  // the background loop may be mid-send on one — the kernel applies
+  // the new buffer size to subsequent queueing). fds_ is sized at Init
+  // and entries only flip to -1 at Close, so walking it off-thread is
+  // safe. v == 0 cannot restore "kernel autotuned" on a live fd, so it
+  // only resets the override for future sockets.
+  if (v > 0) {
+    for (auto fd : fds_) {
+      if (fd >= 0) ApplySockBuf(fd, v);
+    }
   }
 }
 
@@ -540,8 +566,7 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
   // reduce of chunk k overlaps a meaningful slice of chunk k+1's
   // transfer. 0 (or negative/malformed) = serial legacy schedule —
   // the fallback that saved np=8 on oversubscribed hosts.
-  ring_chunk_bytes_ = EnvLL("HVD_RING_CHUNK_BYTES", 1 << 20);
-  if (ring_chunk_bytes_ < 0) ring_chunk_bytes_ = 0;
+  set_ring_chunk_bytes(EnvLL("HVD_RING_CHUNK_BYTES", 1 << 20));
   ParseFaultEnv(rank);
   if (size == 1) return Status::OK();
 

@@ -16,6 +16,7 @@
 
 #include <sys/uio.h>
 
+#include <atomic>
 #include <functional>
 #include <string>
 #include <vector>
@@ -94,7 +95,18 @@ class TcpComm {
 
   // Sub-chunk size (bytes) for pipelined chunked ring steps, from
   // HVD_RING_CHUNK_BYTES at Init (0 = serial legacy path; docs/wire.md).
-  int64_t ring_chunk_bytes() const { return ring_chunk_bytes_; }
+  // Atomic: the online tuner (utils/online_tuner.py via
+  // hvd_core_set_wire_params) retunes it from a Python thread while the
+  // background loop reads it per ring step.
+  int64_t ring_chunk_bytes() const { return ring_chunk_bytes_.load(); }
+  void set_ring_chunk_bytes(int64_t v) {
+    ring_chunk_bytes_.store(v < 0 ? 0 : v);
+  }
+  // Resize SO_SNDBUF/SO_RCVBUF on every live peer socket and pin the
+  // override for sockets connected later (elastic re-bootstrap). 0
+  // hands buffer sizing back to the kernel for FUTURE sockets only —
+  // an explicit setsockopt cannot be un-done on a live fd.
+  void set_socket_buf_bytes(long long v);
 
   // --- control-plane collectives over the star/mesh (blocking) ---
   // Gather variable-size blobs to `root` (root gets all, others send).
@@ -135,9 +147,10 @@ class TcpComm {
   // (-1 = infinite, the legacy behavior when the knob is 0).
   int progress_timeout_ms_ = -1;
   double progress_timeout_sec_ = 0.0;
-  // HVD_RING_CHUNK_BYTES at Init; 0 disables the pipelined sub-chunk
-  // schedule (serial fallback — see docs/wire.md).
-  int64_t ring_chunk_bytes_ = 0;
+  // HVD_RING_CHUNK_BYTES at Init (retunable, see set_ring_chunk_bytes);
+  // 0 disables the pipelined sub-chunk schedule (serial fallback — see
+  // docs/wire.md).
+  std::atomic<int64_t> ring_chunk_bytes_{0};
 };
 
 }  // namespace hvd

@@ -795,9 +795,24 @@ using namespace hvd;
 
 extern "C" {
 
+// Serializes the online tuner's off-thread wire-param applies against
+// the core lifecycle. The tuner thread (utils/online_tuner.py) calls
+// hvd_core_set_wire_params while an elastic reset may be tearing the
+// core down (`delete g`) or re-Initing it (fds_.assign reallocates the
+// vector set_socket_buf_bytes walks) on the main thread — without the
+// mutex that is a use-after-free. Only this API pays the lock: it is
+// the one entry point designed to be called from a non-owner thread
+// for the core's whole lifetime.
+static std::mutex g_wire_params_mutex;
+
 int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
                   double cycle_ms, long long fusion_bytes, int cache_cap) {
   if (g) return -1;
+  // Exclude a concurrent tuner-thread hvd_core_set_wire_params while
+  // g is half-built and comm.Init reallocates fds_ (elastic re-init
+  // races the tuner thread that survived the previous world).
+  // Released once the comm is fully bootstrapped.
+  std::unique_lock<std::mutex> wire_lk(g_wire_params_mutex);
   g = new Global();
   g->rank = rank;
   g->size = size;
@@ -821,6 +836,7 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
     g = nullptr;
     return -2;
   }
+  wire_lk.unlock();  // comm fully bootstrapped: fds_ is stable now
   g->controller = std::make_unique<Controller>(g->comm, g->fusion_bytes);
   {
     TimelineHooks hooks;
@@ -842,6 +858,12 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
 void hvd_core_timeline_stop();  // defined below; used during shutdown
 
 void hvd_core_shutdown() {
+  // Excludes a concurrent hvd_core_set_wire_params (tuner thread):
+  // Close() recycles fds another thread could be setsockopt-ing and
+  // the delete frees the comm it dereferences. set_wire_params never
+  // blocks on the background thread, so holding the mutex across the
+  // join cannot deadlock.
+  std::lock_guard<std::mutex> lk(g_wire_params_mutex);
   if (!g) return;
   hvd_core_timeline_stop();
   g->shut_down.store(true);
@@ -948,6 +970,19 @@ int hvd_core_join(long long tag, int ps_id) {
 int hvd_core_rank() { return g ? g->rank : -1; }
 int hvd_core_size() { return g ? g->size : -1; }
 int hvd_core_failed() { return g && g->failed.load() ? 1 : 0; }
+
+// Online-tuner wire knobs (utils/online_tuner.py, docs/autotune.md):
+// ring sub-chunk size takes effect on the next ring step (atomic,
+// read per op), socket buffers resize live fds and pin an override
+// for sockets connected later. -1 = leave that knob unchanged (0 is
+// meaningful for both: serial ring schedule / kernel-autotuned bufs).
+void hvd_core_set_wire_params(long long ring_chunk_bytes,
+                              long long socket_buf_bytes) {
+  std::lock_guard<std::mutex> lk(g_wire_params_mutex);
+  if (!g) return;
+  if (ring_chunk_bytes >= 0) g->comm.set_ring_chunk_bytes(ring_chunk_bytes);
+  if (socket_buf_bytes >= 0) g->comm.set_socket_buf_bytes(socket_buf_bytes);
+}
 
 void hvd_core_set_params(double cycle_ms, long long fusion_bytes) {
   if (!g) return;

@@ -327,6 +327,14 @@ def run(func):
         backoff_max = float_env("HOROVOD_ELASTIC_BACKOFF_MAX", 30.0)
         stable_sec = float_env("HOROVOD_ELASTIC_STABLE_SEC", 60.0)
         start_heartbeats()
+        # HVD_TUNE: online knob search over the wire/negotiation
+        # surface, journaled per rank — a respawned worker replays to
+        # its tuned state (docs/autotune.md). Native applies go through
+        # the live CoreSession; the env mirror makes every reinit
+        # bootstrap with the tuned values too.
+        from horovod_tpu.utils.online_tuner import start_online_tuner
+
+        start_online_tuner(role="training")
         # Duck-typed so user State subclasses predating the
         # checkpointer integration keep working unchanged.
         maybe_resume = getattr(state, "_maybe_auto_resume", None)

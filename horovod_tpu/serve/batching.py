@@ -174,6 +174,11 @@ class MicroBatcher:
             min_bucket = int_env("HVD_SERVE_MIN_BUCKET", 4)
         self.run_batch = run_batch
         self.max_batch = int(max_batch)
+        # The configured maximum is a hard ceiling: buckets (and the
+        # compiled programs behind them) are sized from it once, and
+        # submit() rejects against it, so the online tuner can only
+        # move the FIRE trigger below it (set_tunables).
+        self.hard_max_batch = self.max_batch
         self.deadline_s = max(0.0, float(deadline_ms) / 1000.0)
         self.buckets = bucket_sizes(self.max_batch, int(min_bucket))
         self._cond = threading.Condition()
@@ -191,10 +196,15 @@ class MicroBatcher:
         if rows.ndim < 1 or rows.shape[0] < 1:
             raise ValueError("submit expects a (n, ...) batch of rows, "
                              "got shape %r" % (rows.shape,))
-        if rows.shape[0] > self.max_batch:
+        if rows.shape[0] > self.hard_max_batch:
+            # Rejection is against the CONFIGURED ceiling, not the
+            # tuned fire trigger: the online tuner lowering max_batch
+            # must never start bouncing requests that were legal when
+            # the client sized them.
             raise ValueError(
                 "request of %d rows exceeds HVD_SERVE_MAX_BATCH=%d; "
-                "split it client-side" % (rows.shape[0], self.max_batch))
+                "split it client-side"
+                % (rows.shape[0], self.hard_max_batch))
         req = _Request(rows)
         with self._cond:
             if self._stopped:
@@ -204,6 +214,24 @@ class MicroBatcher:
             _G_QUEUE_DEPTH.set(self._pending_rows)
             self._cond.notify_all()
         return req.future
+
+    def set_tunables(self, max_batch: Optional[float] = None,
+                     deadline_ms: Optional[float] = None):
+        """Online-tuner apply path (utils/online_tuner.py, schema
+        knobs ``serve_max_batch``/``serve_deadline_ms``): retune the
+        batch FIRE triggers live. ``max_batch`` is clamped to
+        [1, hard_max_batch] — buckets above the configured ceiling
+        were never compiled, so the tuner can only move the trigger
+        down; ``deadline_ms`` clamps at 0. Wakes the batcher thread so
+        a shorter deadline takes effect on the batch currently
+        accumulating, not just the next one."""
+        with self._cond:
+            if max_batch is not None:
+                self.max_batch = min(max(int(max_batch), 1),
+                                     self.hard_max_batch)
+            if deadline_ms is not None:
+                self.deadline_s = max(0.0, float(deadline_ms) / 1000.0)
+            self._cond.notify_all()
 
     def stop(self):
         """Drain nothing further: fail queued requests and stop the
@@ -243,8 +271,14 @@ class MicroBatcher:
                     return []
             batch: List[_Request] = []
             n = 0
-            while self._pending and \
-                    n + self._pending[0].rows.shape[0] <= self.max_batch:
+            # Always drain at least one request: a tuned-down
+            # max_batch may sit below an already-queued (hard-max-
+            # legal) request's row count, and skipping it forever
+            # would wedge the queue.
+            while self._pending and (
+                    not batch
+                    or n + self._pending[0].rows.shape[0]
+                    <= self.max_batch):
                 req = self._pending.popleft()
                 n += req.rows.shape[0]
                 self._pending_rows -= req.rows.shape[0]

@@ -370,10 +370,30 @@ class Replica:
                 logger.warning("serve replica %s checkpoint poll "
                                "failed: %s", self.replica_id, e)
 
+    def _start_tuner(self):
+        """HVD_TUNE: online-tune the micro-batch fire triggers for
+        THIS replica (objective: rows served/sec through its own
+        batcher — replica-local, unlike the router's request
+        counter), decisions
+        journaled per replica id so a respawned replica replays to its
+        tuned batcher instead of re-searching (docs/autotune.md)."""
+        from horovod_tpu.utils import online_tuner
+
+        batcher = self._batcher
+        online_tuner.start_online_tuner(
+            role="serve", name="replica.%s" % self.replica_id,
+            setters={
+                "serve_max_batch":
+                    lambda v: batcher.set_tunables(max_batch=v),
+                "serve_deadline_ms":
+                    lambda v: batcher.set_tunables(deadline_ms=v),
+            })
+
     def start(self):
         """Load the model, bind the HTTP server, start heartbeats and
         the checkpoint poller. Returns the bound port."""
         self.load()
+        self._start_tuner()
         self._server = KVStoreServer(port=self._requested_port)
         self._server.register_post_route("/v1/predict",
                                          self._handle_predict)
@@ -396,6 +416,9 @@ class Replica:
 
     def stop(self):
         self._stop.set()
+        from horovod_tpu.utils import online_tuner
+
+        online_tuner.stop_online_tuner()
         if self._batcher is not None:
             self._batcher.stop()
         if self._server is not None:

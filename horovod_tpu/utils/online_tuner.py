@@ -1,0 +1,738 @@
+"""Online, metrics-driven, journaled knob tuner with a regression
+guardrail (Autotune 2.0, ROADMAP open item #5; docs/autotune.md).
+
+The reference's L3 parameter autotuner (perf.cc: Bayesian search over
+fusion threshold x cycle time) freezes its winner once and only governs
+the eager/host path. Meanwhile the runtime grew a much larger
+performance-relevant knob surface — ring sub-chunk size, socket
+buffers, gradient buckets, serving micro-batch size/deadline — that
+nothing searched at runtime. This module closes that loop:
+
+- **Schema.** ``common/knobs.TUNABLE`` declares every tunable knob:
+  bounds, step granularity, and apply path (native ``set_params`` /
+  ``set_wire_params`` through the live core, env-read-at-next-use, or
+  a callable setter the owning subsystem registers).
+- **Objective.** Measured from the process-wide metrics registry
+  (``utils/metrics.py``): a monotone "goodness" counter (wire
+  bytes moved, serving requests answered) sampled over fixed-length
+  observation windows; the window's rate is the score.
+- **Search.** The existing ``BayesianOptimizer`` (utils/autotune.py)
+  proposes joint moves over the non-frozen knobs, snapped to each
+  knob's step grid.
+- **Guardrail** — the part the reference never had. Every applied move
+  must survive an A/B window: the post-apply rate may not fall below
+  the pre-apply rate by more than a noise band estimated from the
+  pre-apply window's sub-window variance (the ``bench_wire --null-ab``
+  slot-bias discipline, now in-process). A regressing move is
+  auto-reverted and recorded as a loss — the optimizer learns the
+  region is bad, and the job never runs more than one guard window on
+  a bad configuration.
+- **Journal.** Every propose/apply/accept/revert/freeze decision goes
+  through ``runner/journal.DriverJournal`` (fsync'd append, torn-tail
+  tolerant — there is deliberately no third append-fsync
+  implementation in the tree; the ``journal`` contract checker
+  enforces it). A restarted (elastic or serve) process replays the
+  journal and resumes at its tuned state instead of re-searching from
+  cold; a journal written by a different tuner version or knob schema
+  is fenced off and ignored.
+
+Enable with ``HVD_TUNE=1`` (search online), ``HVD_TUNE=cache`` (replay
+the journaled tuned state, never search), ``0``/unset = off. The
+elastic run wrapper and the serving replica start the tuner thread
+automatically; ``start_online_tuner()`` is the library entry point.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from horovod_tpu.common.knobs import TUNABLE, TunableKnob, tunable_snap
+from horovod_tpu.runner.journal import DriverJournal
+from horovod_tpu.utils import metrics as _metrics
+from horovod_tpu.utils.autotune import BayesianOptimizer
+
+logger = logging.getLogger("horovod_tpu")
+
+# Bumped when the journal record semantics change; a journal stamped
+# with a different version is fenced off at replay (re-searching beats
+# replaying a state whose meaning drifted).
+TUNER_VERSION = 1
+
+# Sampling constants mirroring the reference's parameter_manager.cc
+# shape: enough samples for the GP to localize a 2-4 dim box, then
+# freeze so a long job stops paying measurement noise.
+DEFAULT_MAX_SAMPLES = 20
+DEFAULT_SUBWINDOWS = 4
+
+_M_WINDOWS = _metrics.counter(
+    "hvd_tune_windows_total",
+    "Observation windows the online tuner measured (baseline and "
+    "guard windows both count; docs/autotune.md).")
+_M_MOVES = _metrics.counter(
+    "hvd_tune_moves_total",
+    "Knob moves the online tuner applied, by guardrail outcome "
+    "(accept = kept, revert = regressed past the noise band and was "
+    "rolled back).", ("outcome",))
+_M_REPLAYS = _metrics.counter(
+    "hvd_tune_replays_total",
+    "Journal replays that restored a tuned state into a restarted "
+    "process (elastic reset / serve respawn) instead of a cold "
+    "re-search.")
+_G_OBJECTIVE = _metrics.gauge(
+    "hvd_tune_objective",
+    "Last baseline objective rate the online tuner measured "
+    "(units/sec of the configured objective counter).")
+_G_FROZEN = _metrics.gauge(
+    "hvd_tune_frozen",
+    "1 once the online tuner froze its best point (search done), else "
+    "0.")
+
+
+def tune_mode() -> str:
+    """Resolved ``HVD_TUNE``: '' (off), '1' (search online) or
+    'cache' (replay journaled state only)."""
+    mode = os.environ.get("HVD_TUNE", "").strip().lower()
+    if mode in ("", "0", "off", "false"):
+        return ""
+    if mode == "cache":
+        return "cache"
+    return "1"
+
+
+def frozen_knob_names() -> List[str]:
+    """``HVD_TUNE_FREEZE`` as a set of schema names (unknown names are
+    logged and ignored rather than failing the job)."""
+    raw = os.environ.get("HVD_TUNE_FREEZE", "")
+    out = []
+    for name in raw.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        if name not in TUNABLE:
+            logger.warning("HVD_TUNE_FREEZE names unknown knob %r "
+                           "(schema: %s)", name, ", ".join(sorted(TUNABLE)))
+            continue
+        out.append(name)
+    return out
+
+
+# --- objectives --------------------------------------------------------------
+
+
+def wire_bytes_total() -> float:
+    """Training objective source: cumulative data-plane bytes moved
+    (native tx+rx counters bridged into the registry; collectors run
+    on every read, so this is fresh)."""
+    total = 0.0
+    for fam in ("hvd_comm_tx_bytes_total", "hvd_comm_rx_bytes_total"):
+        v = _metrics.value(fam)
+        if v is not None:
+            total += float(v)
+    return total
+
+
+def serve_rows_total() -> float:
+    """Serving objective source: cumulative rows served through THIS
+    replica's micro-batcher (the hvd_serve_batch_size histogram's sum
+    — observed once per batch with that batch's row count, so the sum
+    is a monotone rows-served counter). Deliberately NOT
+    hvd_serve_requests_total: that counter lives in the ROUTER
+    process; in a replica it is permanently zero and the tuner would
+    idle forever."""
+    v = _metrics.value("hvd_serve_batch_size")
+    if isinstance(v, dict):
+        return float(v.get("sum") or 0.0)
+    return 0.0
+
+
+# --- knob application --------------------------------------------------------
+
+
+class KnobBinding:
+    """One schema knob wired to its apply path. ``setter`` overrides
+    the schema path (the serve batcher registers one); otherwise
+    "native" routes through the live CoreSession and "env" (and every
+    native knob too, as a mirror) writes the backing env var so an
+    elastic re-bootstrap reconstructs the tuned state."""
+
+    def __init__(self, knob: TunableKnob,
+                 setter: Optional[Callable[[float], None]] = None):
+        self.knob = knob
+        self._setter = setter
+
+    @property
+    def name(self) -> str:
+        return self.knob.name
+
+    def current(self) -> float:
+        """Best-effort current value: env mirror, else schema default."""
+        if self.knob.env and self.knob.env in os.environ:
+            try:
+                raw = float(os.environ[self.knob.env])
+            except ValueError:
+                return self.knob.default
+            if self.knob.name == "fusion_threshold_mb":
+                return raw / (1024.0 * 1024.0)
+            return raw
+        return self.knob.default
+
+    def apply(self, value: float) -> float:
+        """Snap ``value`` to the knob's grid, push it through the apply
+        path, mirror it to the env knob; returns the snapped value."""
+        value = tunable_snap(self.knob, value)
+        if self._setter is not None:
+            self._setter(value)
+        elif self.knob.apply_path == "native":
+            self._apply_native(value)
+        # env mirror (and the whole story for "env" knobs): next
+        # use/trace/bootstrap reads the tuned value.
+        if self.knob.env:
+            if self.knob.name == "fusion_threshold_mb":
+                # The box's 0 MB endpoint means "unfused"; <=0 is "no
+                # update" downstream, so spell it as a 1-byte threshold
+                # (same convention as utils/autotune._apply).
+                os.environ[self.knob.env] = str(
+                    max(int(value * 1024 * 1024), 1))
+            elif float(value) == int(value):
+                os.environ[self.knob.env] = str(int(value))
+            else:
+                os.environ[self.knob.env] = repr(float(value))
+        return value
+
+    def _apply_native(self, value: float):
+        from horovod_tpu.common import basics
+
+        sess = basics.core_session()
+        if sess is None:
+            return  # single-process world: the env mirror is the apply
+        if self.knob.name == "fusion_threshold_mb":
+            sess.set_params(-1.0, max(int(value * 1024 * 1024), 1))
+        elif self.knob.name == "cycle_time_ms":
+            sess.set_params(float(value), -1)
+        elif self.knob.name == "ring_chunk_bytes":
+            sess.set_wire_params(ring_chunk_bytes=int(value))
+        elif self.knob.name == "socket_buf_bytes":
+            sess.set_wire_params(socket_buf_bytes=int(value))
+        else:
+            raise ValueError("no native apply for knob %r" % self.knob.name)
+
+
+def schema_fence(knobs: Sequence[TunableKnob]) -> str:
+    """Stable hash of the searched schema (names + boxes + steps): a
+    journal written against a different schema replays as garbage
+    coordinates, so it is fenced off instead."""
+    blob = "|".join("%s:%g:%g:%g" % (k.name, k.lo, k.hi, k.step)
+                    for k in sorted(knobs, key=lambda k: k.name))
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+# --- journal replay ----------------------------------------------------------
+
+
+class TuneReplay:
+    """Folded journal state: the values to adopt, the round-counting
+    ``samples``, every ``measured`` (x, y) point (baselines included —
+    the freeze pool), and whether the search had frozen."""
+
+    def __init__(self):
+        self.values: Optional[Dict[str, float]] = None
+        self.samples: List[Tuple[Dict[str, float], float]] = []
+        self.measured: List[Tuple[Dict[str, float], float]] = []
+        self.frozen = False
+        self.records = 0
+
+
+def replay_journal(path: str, fence: str) -> Optional[TuneReplay]:
+    """Fold a tuner journal. Version fencing: only records following a
+    ``tune_meta`` whose (tuner_version, fence) matches count; a
+    mismatched meta resets the fold, so a journal from an older tuner
+    or a different knob schema yields None (cold start) instead of
+    poisoning the new search. Torn tails end the fold at the last
+    complete record (same rule as DriverJournal.replay)."""
+    if not os.path.exists(path):
+        return None
+    state: Optional[TuneReplay] = None
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break  # torn tail: the crash landed mid-append
+            rtype = rec.get("type")
+            if rtype == "tune_meta":
+                if (rec.get("tuner_version") == TUNER_VERSION
+                        and rec.get("fence") == fence):
+                    # Matching meta: every restarted incarnation
+                    # appends one, so keep folding across it — only
+                    # open fresh state when everything before was
+                    # fenced off.
+                    state = state if state is not None else TuneReplay()
+                else:
+                    state = None  # fenced: stale version or schema
+                continue
+            if state is None:
+                continue
+            state.records += 1
+            if rtype in ("tune_accept", "tune_freeze", "tune_replay"):
+                state.values = dict(rec.get("values", {}))
+            elif rtype == "tune_revert":
+                state.values = dict(rec.get("values", {}))
+            if rtype == "tune_accept" and "objective" in rec:
+                point = (dict(rec.get("values", {})),
+                         float(rec["objective"]))
+                state.samples.append(point)
+                state.measured.append(point)
+            elif rtype == "tune_revert" and "objective" in rec \
+                    and rec.get("applied"):
+                point = (dict(rec["applied"]), float(rec["objective"]))
+                state.samples.append(point)
+                state.measured.append(point)
+            elif rtype == "tune_apply" and "baseline" in rec \
+                    and rec.get("from"):
+                # The incumbent's baseline measurement: part of the
+                # freeze pool (the best point seen may well BE the
+                # incumbent when every move regressed).
+                state.measured.append((dict(rec["from"]),
+                                       float(rec["baseline"])))
+            if rtype == "tune_freeze":
+                state.frozen = True
+            elif rtype == "tune_replay" and rec.get("frozen"):
+                state.frozen = True  # a replayed freeze stays frozen
+    if state is not None and state.values is None and not state.samples:
+        return None  # meta only: nothing to resume
+    return state
+
+
+# --- the tuner ---------------------------------------------------------------
+
+
+class OnlineTuner:
+    """Background knob search over live objective windows.
+
+    The loop (one *round* per iteration):
+
+    1. measure a **baseline** window: ``subwindows`` rate samples give
+       a mean rate o0 and a standard error sem0 — the noise estimate;
+    2. **propose** the next joint point from the Bayesian optimizer
+       (warmed with every sample so far) and **apply** it through each
+       knob's apply path; the decision is journaled BEFORE the move is
+       live, so a crash can never leave an unexplained knob state;
+    3. measure the **guard** window: its rate o1 must not fall below
+       ``o0 * (1 - guard)`` where ``guard = max(guard_pct/100,
+       2 * sem0 / o0)`` — regressions beyond the noise band revert the
+       move (journaled as a loss); survivors are accepted (journaled);
+    4. after ``max_samples`` rounds the best measured point is applied
+       and frozen (journaled) — the search is done for this process
+       lifetime, replay restores it after a restart.
+
+    Deterministic and test-injectable: ``clock``/``wait`` default to
+    real time but tests drive the loop with a fake clock and a
+    synthetic objective, calling ``step()`` directly — no thread, no
+    sleeping, seconds per test.
+    """
+
+    def __init__(self, bindings: Sequence[KnobBinding],
+                 objective: Callable[[], float], *,
+                 window_sec: Optional[float] = None,
+                 guard_pct: Optional[float] = None,
+                 journal_path: Optional[str] = None,
+                 max_samples: int = DEFAULT_MAX_SAMPLES,
+                 subwindows: int = DEFAULT_SUBWINDOWS,
+                 seed: int = 1234,
+                 clock: Callable[[], float] = time.monotonic,
+                 wait: Optional[Callable[[float], bool]] = None):
+        if not bindings:
+            raise ValueError("OnlineTuner needs at least one knob")
+        if window_sec is None:
+            try:
+                window_sec = float(os.environ.get(
+                    "HVD_TUNE_WINDOW_SEC", "30"))
+            except ValueError:
+                window_sec = 30.0
+        if guard_pct is None:
+            try:
+                guard_pct = float(os.environ.get("HVD_TUNE_GUARD_PCT", "5"))
+            except ValueError:
+                guard_pct = 5.0
+        self.bindings = list(bindings)
+        self.objective = objective
+        self.window_sec = max(float(window_sec), 1e-6)
+        self.guard_pct = max(float(guard_pct), 0.0)
+        self.max_samples = int(max_samples)
+        self.subwindows = max(int(subwindows), 2)
+        self._clock = clock
+        self._stop = threading.Event()
+        # wait(seconds) -> True when the tuner should stop; the default
+        # sleeps on the stop event so stop() interrupts a window.
+        self._wait = wait if wait is not None else self._stop.wait
+        self._bo = BayesianOptimizer(
+            [(b.knob.lo, b.knob.hi) for b in self.bindings], seed=seed)
+        self._journal: Optional[DriverJournal] = None
+        self._journal_path = journal_path
+        self._thread: Optional[threading.Thread] = None
+        # _lock guards the search state shared between the tuner
+        # thread and state()/trajectory() readers.
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {
+            b.name: tunable_snap(b.knob, b.current())
+            for b in self.bindings}
+        # _samples counts search rounds (the freeze trigger);
+        # _measured is every (x, y) measurement including incumbent
+        # baselines — the pool _freeze picks the best point from.
+        self._samples: List[Tuple[Dict[str, float], float]] = []
+        self._measured: List[Tuple[Dict[str, float], float]] = []
+        self._trajectory: List[dict] = []
+        self._frozen = False
+        self._replayed = False
+
+    # --- journal ------------------------------------------------------------
+
+    @property
+    def fence(self) -> str:
+        return schema_fence([b.knob for b in self.bindings])
+
+    def _attach_journal(self):
+        if self._journal_path is None or self._journal is not None:
+            return
+        self._journal = DriverJournal(self._journal_path)
+        self._journal.append({
+            "type": "tune_meta",
+            "tuner_version": TUNER_VERSION,
+            "fence": self.fence,
+            "knobs": {b.name: {"lo": b.knob.lo, "hi": b.knob.hi,
+                               "step": b.knob.step}
+                      for b in self.bindings},
+        })
+
+    def _record(self, rec: dict):
+        with self._lock:
+            self._trajectory.append(rec)
+        if self._journal is not None:
+            self._journal.append(rec)
+
+    # --- replay -------------------------------------------------------------
+
+    def replay(self) -> bool:
+        """Fold an existing journal (if any) and adopt its state:
+        tuned values are re-applied, samples warm the optimizer, a
+        frozen search stays frozen. Returns True when a tuned state
+        was adopted. Must run before ``_attach_journal`` appends the
+        new incarnation's meta record."""
+        if self._journal_path is None:
+            return False
+        rep = replay_journal(self._journal_path, self.fence)
+        if rep is None:
+            return False
+        with self._lock:
+            self._samples = list(rep.samples)
+            self._measured = list(rep.measured)
+            self._frozen = rep.frozen
+            adopted = dict(rep.values) if rep.values else None
+        for values, score in rep.measured:
+            self._bo.add_sample(self._as_vector(values), score)
+        if adopted:
+            applied = self._apply_values(adopted)
+            with self._lock:
+                self._values = applied
+            self._record({"type": "tune_replay", "values": applied,
+                          "resumed_samples": len(rep.samples),
+                          "frozen": rep.frozen})
+            _M_REPLAYS.inc()
+        _G_FROZEN.set(1.0 if rep.frozen else 0.0)
+        return adopted is not None
+
+    # --- measurement --------------------------------------------------------
+
+    def _measure_window(self) -> Tuple[float, float]:
+        """(mean rate, standard error) over ``subwindows`` sub-window
+        rates of one observation window. The sem is the noise estimate
+        the guardrail's band is built from."""
+        sub = self.window_sec / self.subwindows
+        rates = []
+        last_total = self.objective()
+        last_t = self._clock()
+        for _ in range(self.subwindows):
+            if self._wait(sub):
+                break
+            total, now = self.objective(), self._clock()
+            dt = max(now - last_t, 1e-9)
+            rates.append(max(total - last_total, 0.0) / dt)
+            last_total, last_t = total, now
+        _M_WINDOWS.inc()
+        if not rates:
+            return 0.0, 0.0
+        mean = sum(rates) / len(rates)
+        var = sum((r - mean) ** 2 for r in rates) / max(len(rates) - 1, 1)
+        sem = (var ** 0.5) / (len(rates) ** 0.5)
+        return mean, sem
+
+    # --- the search round ---------------------------------------------------
+
+    def _as_vector(self, values: Dict[str, float]) -> List[float]:
+        return [float(values.get(b.name, b.knob.default))
+                for b in self.bindings]
+
+    def _apply_values(self, values: Dict[str, float]) -> Dict[str, float]:
+        return {b.name: b.apply(values[b.name])
+                for b in self.bindings if b.name in values}
+
+    def step(self) -> Optional[dict]:
+        """One search round (see class docstring); returns the round's
+        outcome record, or None once frozen/stopped."""
+        with self._lock:
+            if self._frozen:
+                return None
+            current = dict(self._values)
+            n_samples = len(self._samples)
+        if n_samples >= self.max_samples:
+            return self._freeze()
+        baseline, sem = self._measure_window()
+        if self._stop.is_set():
+            return None
+        _G_OBJECTIVE.set(baseline)
+        if baseline <= 0.0:
+            # No signal: the job is idle (serve replica before first
+            # traffic, training between phases) or the objective
+            # counter is not wired. With o0 = 0 every move would pass
+            # the guard trivially — a random walk teaching the
+            # optimizer nothing — so don't search: keep measuring
+            # until there is something to optimize. Not journaled
+            # (idle windows would bloat the journal), not counted
+            # toward freeze.
+            with self._lock:
+                # Coalesce consecutive idle windows into one record:
+                # a replica idling for weeks at the 30 s window would
+                # otherwise grow the trajectory without bound (idle
+                # rounds never count toward freeze, so the loop never
+                # terminates on its own).
+                if (self._trajectory
+                        and self._trajectory[-1]["type"] == "tune_idle"):
+                    rec = self._trajectory[-1]
+                    rec["windows"] = rec.get("windows", 1) + 1
+                else:
+                    rec = {"type": "tune_idle", "baseline": baseline,
+                           "windows": 1}
+                    self._trajectory.append(rec)
+            return rec
+        # Feed the optimizer the CURRENT point's fresh measurement too:
+        # the GP needs an anchor at the incumbent or EI has nothing to
+        # improve on. It also joins the freeze pool — when every move
+        # regresses, the best point seen IS the incumbent.
+        self._bo.add_sample(self._as_vector(current), baseline)
+        with self._lock:
+            self._measured.append((current, baseline))
+        proposal_vec = self._bo.suggest()
+        proposal = {b.name: tunable_snap(b.knob, v)
+                    for b, v in zip(self.bindings, proposal_vec)}
+        if proposal == current:
+            # Snapped onto the incumbent: nothing to A/B. Record the
+            # sample and move on (counts toward freeze, so a converged
+            # search terminates instead of spinning).
+            with self._lock:
+                self._samples.append((current, baseline))
+                self._measured.append((current, baseline))
+            rec = {"type": "tune_accept", "values": current,
+                   "objective": baseline, "noise": sem,
+                   "sample": n_samples + 1, "noop": True}
+            self._record(rec)
+            return rec
+        guard = max(self.guard_pct / 100.0,
+                    (2.0 * sem / baseline) if baseline > 0 else 0.0)
+        threshold = baseline * (1.0 - guard)
+        # Journal BEFORE the move is live (the PR 5 append-before-
+        # publish discipline): a crash mid-guard-window leaves a
+        # journal explaining exactly which knob state the process died
+        # in. proposal is already snapped, so the record matches what
+        # _apply_values pushes.
+        self._record({"type": "tune_apply", "values": proposal,
+                      "from": current, "baseline": baseline,
+                      "noise": sem, "threshold": threshold,
+                      "sample": n_samples + 1})
+        applied = self._apply_values(proposal)
+        post, _post_sem = self._measure_window()
+        if self._stop.is_set():
+            return None
+        self._bo.add_sample(self._as_vector(applied), post)
+        with self._lock:
+            self._samples.append((applied, post))
+            self._measured.append((applied, post))
+        if post < threshold:
+            # Guardrail: regression beyond the noise band — revert.
+            restored = self._apply_values(current)
+            with self._lock:
+                self._values = restored
+            rec = {"type": "tune_revert", "values": restored,
+                   "applied": applied, "objective": post,
+                   "threshold": threshold, "sample": n_samples + 1}
+            self._record(rec)
+            _M_MOVES.labels(outcome="revert").inc()
+        else:
+            with self._lock:
+                self._values = applied
+            rec = {"type": "tune_accept", "values": applied,
+                   "objective": post, "noise": sem,
+                   "sample": n_samples + 1}
+            self._record(rec)
+            _M_MOVES.labels(outcome="accept").inc()
+        return rec
+
+    def _freeze(self) -> dict:
+        with self._lock:
+            pool = list(self._measured) or list(self._samples)
+            n_samples = len(self._samples)
+        best_values, best_score = max(pool, key=lambda s: s[1])
+        applied = self._apply_values(best_values)
+        with self._lock:
+            self._values = applied
+            self._frozen = True
+        rec = {"type": "tune_freeze", "values": applied,
+               "objective": best_score, "samples": n_samples}
+        self._record(rec)
+        _G_FROZEN.set(1.0)
+        return rec
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self, replay_only: bool = False):
+        """Replay any journaled state, then (unless ``replay_only`` —
+        the ``HVD_TUNE=cache`` mode) start the background search
+        thread. Idempotent. The journal is attached FIRST so the
+        replay's ``tune_replay`` record reaches disk — post-mortem
+        forensics must be able to tell how many incarnations resumed
+        tuned, not just the in-memory counter. The fold tolerates the
+        freshly appended meta (a matching meta folds through; a
+        fenced journal yields no state either way)."""
+        if self._thread is not None:
+            return
+        self._attach_journal()
+        self.replay()
+        if replay_only:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="hvd-online-tuner")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                if self.step() is None:
+                    return
+            except Exception as e:  # analysis: allow-broad-except —
+                # the tuner is an optimizer, not a dependency: a
+                # transient metrics/apply failure must degrade to "no
+                # move this round", never take the job down.
+                logger.warning("online tuner round failed: %s", e)
+                if self._wait(self.window_sec):
+                    return
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    # --- introspection ------------------------------------------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"values": dict(self._values),
+                    "samples": len(self._samples),
+                    "frozen": self._frozen,
+                    "max_samples": self.max_samples}
+
+    def trajectory(self) -> List[dict]:
+        """Every decision record this incarnation produced (the same
+        records the journal holds) — bench.py/bench_serve.py embed
+        this in their JSON."""
+        with self._lock:
+            return list(self._trajectory)
+
+
+# --- process-global convenience ----------------------------------------------
+
+_global_lock = threading.Lock()
+_global_tuner: Optional[OnlineTuner] = None
+
+# Default knob sets per role. Training searches the wire + negotiation
+# surface (all live-safe, rank-divergence-free); non-live_safe knobs
+# (grad buckets, flash tiles) are schema-declared but never searched
+# live in a multi-rank world — docs/autotune.md#what-is-not-searched.
+TRAINING_KNOBS = ("fusion_threshold_mb", "cycle_time_ms",
+                  "ring_chunk_bytes", "socket_buf_bytes")
+SERVE_KNOBS = ("serve_max_batch", "serve_deadline_ms")
+
+
+def _journal_path_for(name: str) -> Optional[str]:
+    d = os.environ.get("HVD_TUNE_JOURNAL_DIR", "")
+    if not d:
+        return None
+    return os.path.join(d, "tuner_journal.%s.jsonl" % name)
+
+
+def start_online_tuner(role: str = "training",
+                       name: Optional[str] = None,
+                       setters: Optional[Dict[str, Callable]] = None,
+                       objective: Optional[Callable[[], float]] = None,
+                       **kwargs) -> Optional[OnlineTuner]:
+    """Start (or return) the process-wide tuner when ``HVD_TUNE`` asks
+    for one; None when tuning is off. ``role`` picks the default knob
+    set + objective ("training": wire bytes/sec over
+    fusion/cycle/ring/socket knobs; "serve": requests/sec over the
+    micro-batch knobs, whose ``setters`` the replica passes).
+    ``HVD_TUNE_FREEZE`` names are dropped from the searched set.
+    ``HVD_TUNE=cache`` replays the journal without searching."""
+    global _global_tuner
+    mode = tune_mode()
+    if not mode:
+        return None
+    with _global_lock:
+        if _global_tuner is not None:
+            return _global_tuner
+        names = TRAINING_KNOBS if role == "training" else SERVE_KNOBS
+        frozen = set(frozen_knob_names())
+        setters = setters or {}
+        bindings = [KnobBinding(TUNABLE[n], setter=setters.get(n))
+                    for n in names if n not in frozen]
+        if not bindings:
+            logger.warning("HVD_TUNE set but every %s knob is frozen "
+                           "(HVD_TUNE_FREEZE) — tuner not started", role)
+            return None
+        if objective is None:
+            objective = (wire_bytes_total if role == "training"
+                         else serve_rows_total)
+        if name is None:
+            # Per-process journal files: concurrent ranks appending to
+            # one file would interleave their decision streams.
+            name = ("rank%s" % os.environ.get("HOROVOD_RANK", "0")
+                    if role == "training" else role)
+        tuner = OnlineTuner(bindings, objective,
+                            journal_path=_journal_path_for(name),
+                            **kwargs)
+        tuner.start(replay_only=(mode == "cache"))
+        _global_tuner = tuner
+        return tuner
+
+
+def online_tuner() -> Optional[OnlineTuner]:
+    with _global_lock:
+        return _global_tuner
+
+
+def stop_online_tuner():
+    global _global_tuner
+    with _global_lock:
+        tuner, _global_tuner = _global_tuner, None
+    if tuner is not None:
+        tuner.stop()

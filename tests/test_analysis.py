@@ -707,6 +707,24 @@ def persist(path, line):
     assert _keys(run_all(project(tree)), "journal") == []
 
 
+def test_journal_online_tuner_is_not_a_primitive_owner(tree):
+    """The online tuner's decision log must go through
+    runner/journal.DriverJournal — utils/online_tuner.py is a journal
+    CONSUMER, not a third primitive owner, so a hand-rolled append-mode
+    open seeded there is a finding like anywhere else (ISSUE 11: no
+    third append-fsync implementation)."""
+    _seed(tree, "horovod_tpu/utils/online_tuner.py", '''
+import json
+
+
+def journal_decision(path, rec):
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec) + "\\n")
+''')
+    assert any(k.startswith("direct-append:open")
+               for k in _keys(run_all(project(tree)), "journal"))
+
+
 # --- jaxcompat --------------------------------------------------------------
 
 def test_jaxcompat_shard_map_import_fails(tree):

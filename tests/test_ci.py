@@ -69,6 +69,8 @@ TIER2_COVERAGE = {
         "tests/test_ci.py::test_tier2_has_tier1_coverage",
     "test_native_core_under_tsan":
         "tests/test_native_core.py::test_native_collectives",
+    "test_tuner_moves_ring_chunk_live_np2":
+        "tests/test_online_tuner.py::test_convergence_on_planted_optimum",
     "test_graft_entry_dryrun":
         "tests/test_graft_entry.py::"
         "test_flagship_shard_map_step_contains_framework_psum",

@@ -64,16 +64,22 @@ def test_timeline_env_starts_native_writer(tmp_path):
     assert "envtl.x" in text
 
 
-def test_timeline_phase_hierarchy_np2(tmp_path):
+@pytest.mark.parametrize("wire_sg", ["1", "0"], ids=["sg", "legacy"])
+def test_timeline_phase_hierarchy_np2(tmp_path, wire_sg):
     """Per-tensor phase STRUCTURE parity at np=2 (reference:
     timeline.cc:496-558 + test/parallel/test_timeline.py): each rank's
     trace must carry, on the tensor's own named lane, a closed
     NEGOTIATE_ALLREDUCE span (with rank-ready instants on the
     coordinator), then a top-level ALLREDUCE span nesting QUEUE and the
-    TCP wire op, and fused-buffer memcpys for a grouped allreduce.
-    Assertions live in timeline_worker.py."""
+    TCP wire op. The grouped-allreduce expectation is wire-path-aware
+    (root cause of the long red run of this test: the zero-copy
+    scatter-gather ring REMOVED the fusion-buffer memcpys the original
+    assertion demanded): legacy pack mode (HVD_WIRE_SG=0) must bracket
+    the wire op with MEMCPY_IN/OUT_FUSION_BUFFER, scatter-gather mode
+    must NOT emit them — both directions pinned. Assertions live in
+    timeline_worker.py."""
     env = dict(os.environ, HVD_TL_DIR=str(tmp_path),
-               HOROVOD_TIMELINE_MARK_CYCLES="1")
+               HOROVOD_TIMELINE_MARK_CYCLES="1", HVD_WIRE_SG=wire_sg)
     procs = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
          sys.executable, os.path.join(_REPO, "tests",

@@ -101,7 +101,14 @@ def main():
                  if e.get("name") == "CYCLE_START" and e.get("tid") == 0]
         assert marks, "HOROVOD_TIMELINE_MARK_CYCLES set but no marks"
 
-    # --- grouped allreduce: fused-buffer memcpys on every member ---
+    # --- grouped allreduce: phase structure depends on the wire path.
+    # Legacy pack path (HVD_WIRE_SG=0): fused-buffer memcpys bracket
+    # the wire op on every member lane. Scatter-gather path (default
+    # since the zero-copy wire PR): the ring gathers straight from /
+    # scatters straight into tensor memory, so the memcpy spans MUST
+    # NOT appear — their absence on a fused op is the timeline's proof
+    # the zero-copy path actually ran (docs/wire.md).
+    wire_sg = os.environ.get("HVD_WIRE_SG", "1") != "0"
     lanes_checked = 0
     for e in events:
         if e.get("ph") != "M":
@@ -112,12 +119,16 @@ def main():
         lane = [x for x in events
                 if x.get("tid") == e["tid"] and x.get("ph") in "BEi"]
         lane_names = [x["name"] for x in lane if x["ph"] == "B"]
-        assert "MEMCPY_IN_FUSION_BUFFER" in lane_names, lane_names
-        assert "MEMCPY_OUT_FUSION_BUFFER" in lane_names, lane_names
         assert "TCP_ALLREDUCE" in lane_names, lane_names
-        assert (lane_names.index("MEMCPY_IN_FUSION_BUFFER")
-                < lane_names.index("TCP_ALLREDUCE")
-                < lane_names.index("MEMCPY_OUT_FUSION_BUFFER"))
+        if wire_sg:
+            assert "MEMCPY_IN_FUSION_BUFFER" not in lane_names, lane_names
+            assert "MEMCPY_OUT_FUSION_BUFFER" not in lane_names, lane_names
+        else:
+            assert "MEMCPY_IN_FUSION_BUFFER" in lane_names, lane_names
+            assert "MEMCPY_OUT_FUSION_BUFFER" in lane_names, lane_names
+            assert (lane_names.index("MEMCPY_IN_FUSION_BUFFER")
+                    < lane_names.index("TCP_ALLREDUCE")
+                    < lane_names.index("MEMCPY_OUT_FUSION_BUFFER"))
         lanes_checked += 1
     assert lanes_checked == 2, lanes_checked
 

@@ -1,0 +1,84 @@
+"""np=2 worker: the online tuner moves HVD_RING_CHUNK_BYTES (and the
+socket buffers) LIVE under real allreduce traffic, with per-step
+correctness asserted — proving the native set_wire_params path retunes
+a running core without a correctness or typed-abort failure
+(ISSUE 11 acceptance; docs/autotune.md)."""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.utils.online_tuner import (  # noqa: E402
+    start_online_tuner,
+)
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+    tuner = start_online_tuner(role="training")
+    assert tuner is not None, "HVD_TUNE=1 but no tuner started"
+
+    # Drive real ring traffic while the tuner measures/moves. 1 MB
+    # payloads make the wire-bytes objective move briskly; every
+    # result is checked, so a knob move that corrupted the ring would
+    # fail here, and a wedged core would trip the subprocess timeout.
+    #
+    # The STOP decision is collective: a rank deciding alone (own
+    # clock, own trajectory) leaves its peer blocked in the next
+    # allreduce forever. Every SYNC_EVERY steps the ranks allreduce a
+    # want-stop flag with Min — traffic ends only unanimously, at the
+    # same step index on every rank.
+    payload = np.arange(262144, dtype=np.float32)  # 1 MiB
+    deadline = time.monotonic() + float(os.environ.get(
+        "TUNER_E2E_BUDGET_SEC", "45"))
+    sync_every = 25
+    steps = 0
+    while True:
+        out = hvd.allreduce(payload * (r + 1), name="tune.x",
+                            op=hvd.Sum)
+        np.testing.assert_allclose(out, payload * 3.0)
+        steps += 1
+        if steps % sync_every:
+            continue
+        moves = [rec for rec in tuner.trajectory()
+                 if rec["type"] == "tune_apply"]
+        want_stop = 1.0 if (len(moves) >= 2
+                            or time.monotonic() > deadline) else 0.0
+        unanimous = hvd.allreduce(np.array([want_stop], np.float32),
+                                  name="tune.stop", op=hvd.Min)
+        if unanimous[0] >= 1.0:
+            break
+    moves = [rec for rec in tuner.trajectory()
+             if rec["type"] == "tune_apply"]
+    assert moves, "tuner never applied a move under live traffic"
+    # At least one move actually CHANGED the ring chunk from where it
+    # started — the live set_wire_params path was exercised.
+    changed = [m for m in moves
+               if m["values"].get("ring_chunk_bytes")
+               != m["from"].get("ring_chunk_bytes")]
+    assert changed, "no move touched ring_chunk_bytes: %r" % moves
+    # The decision journal exists and holds the same records.
+    jdir = os.environ["HVD_TUNE_JOURNAL_DIR"]
+    jpath = os.path.join(jdir, "tuner_journal.rank%d.jsonl" % r)
+    assert os.path.exists(jpath), os.listdir(jdir)
+    recs = [json.loads(line) for line in open(jpath)]
+    assert recs[0]["type"] == "tune_meta"
+    assert any(rec["type"] == "tune_apply" for rec in recs)
+    hvd.shutdown()
+    print("TUNER_E2E_OK rank=%d steps=%d moves=%d" % (r, steps,
+                                                      len(moves)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,12 @@ read:
 - ``ops/block_tuner.py`` (``append_record``/``load_cache``: O_APPEND
   whole-line interleaving for concurrent writers).
 
+Consumers route through them: the online tuner's decision log
+(``utils/online_tuner.py``) appends exclusively through
+``DriverJournal`` — its replay fold only READS the file — so it is
+deliberately NOT a third primitive owner and stays inside this
+checker's scope like everything else.
+
 A third hand-rolled ``open(path, "a")`` + ``json.dumps`` persistence
 path would re-import every bug those two already fixed (welded torn
 tails, lost records after a mid-file garbage line, appends that never

@@ -466,6 +466,24 @@ def _spawn(argv_extra, timeout_s, cpu_env=False):
     return None, "rc=%d tail=%r" % (proc.returncode, lines[-8:])
 
 
+def _flightrec_dumps(since):
+    """Flight-record dump files written after ``since`` (a dying
+    child's abort/SIGTERM dump, docs/flightrec.md). Attached to
+    failure results so the post-mortem starts from the bench artifact
+    instead of a shell archaeology session."""
+    directory = os.environ.get("HVD_FLIGHTREC_DIR") or "."
+    found = []
+    try:
+        for fn in sorted(os.listdir(directory)):
+            if fn.startswith("flightrec.rank") and fn.endswith(".jsonl"):
+                path = os.path.join(directory, fn)
+                if os.path.getmtime(path) >= since - 1.0:
+                    found.append(path)
+    except OSError:
+        pass
+    return found
+
+
 def _last_metric_json(text):
     """Last line of ``text`` that parses as a result dict, or None.
 
@@ -500,6 +518,7 @@ def _git_sha():
 
 
 def main():
+    run_started = time.time()
     p = argparse.ArgumentParser()
     p.add_argument("--child", action="store_true",
                    help="(internal) run the benchmark in-process")
@@ -635,6 +654,9 @@ def main():
     if result is not None:
         if error:
             result["error"] = error
+            dumps = _flightrec_dumps(run_started)
+            if dumps:
+                result["flightrec_dumps"] = dumps
         _attach_tpu_capture(result)
         print(json.dumps(result))
         return 0
@@ -646,6 +668,9 @@ def main():
         "vs_baseline": 0.0,
         "error": "%s; cpu child failed: %s" % (error or "", diag),
     }
+    dumps = _flightrec_dumps(run_started)
+    if dumps:
+        fallback["flightrec_dumps"] = dumps
     _attach_tpu_capture(fallback)
     print(json.dumps(fallback))
     return 0

@@ -129,6 +129,28 @@ class Fleet:
             out[fn] = recs
         return out
 
+    def flightrec_evidence(self):
+        """Evidence a dead fleet left behind: the replicas' flight-
+        record dumps (written under <journal>/flightrec/<replica-id>/,
+        serve/server.py) plus the tools.trace diagnosis over them —
+        folded into the failure result JSON before the fleet tempdir
+        is reaped (docs/flightrec.md)."""
+        root = os.path.join(self._tmp.name, "journal", "flightrec")
+        if not os.path.isdir(root):
+            return {}
+        from tools import trace
+
+        dumps = trace.load_dir(root)
+        if not dumps:
+            return {}
+        trace.align(dumps)
+        paths = []
+        for dirpath, _subdirs, files in os.walk(root):
+            paths += [os.path.join(dirpath, fn) for fn in files
+                      if fn.endswith(".jsonl")]
+        return {"flightrec_dumps": sorted(paths),
+                "flightrec_diagnosis": trace.diagnose(dumps)}
+
     def stop(self):
         doc = _get_json(self.port, "/healthz") or {}
         if self.proc.poll() is None:
@@ -221,6 +243,11 @@ def run_slot(args, overrides=None):
         if tune is not None:
             result["tune"] = tune
         return result
+    except RuntimeError as e:
+        # A dead fleet's story travels with the error: main() folds
+        # the dump paths + diagnosis into the failure result JSON.
+        e.flightrec = fleet.flightrec_evidence()  # type: ignore[attr-defined]
+        raise
     finally:
         fleet.stop()
 
@@ -309,6 +336,22 @@ def main(argv=None):
 
     base_cfg = {"np": args.np_, "model": args.model,
                 "duration_s": args.duration, "threads": args.threads}
+    try:
+        return _run_modes(args, base_cfg)
+    except RuntimeError as e:
+        # A run died: one JSON document anyway, carrying the flight-
+        # record evidence (docs/flightrec.md), then a nonzero exit.
+        payload = {"mode": "error", "config": base_cfg, "error": str(e)}
+        payload.update(getattr(e, "flightrec", None) or {})
+        doc = json.dumps(payload, indent=2, sort_keys=True)
+        print(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(doc + "\n")
+        return 1
+
+
+def _run_modes(args, base_cfg):
     if args.ab:
         overrides = _parse_overrides(args.ab)
         print("# null A/A trials (slot-bias gate)...", file=sys.stderr)

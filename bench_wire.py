@@ -55,7 +55,7 @@ def _free_port():
 
 
 def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
-              sockbuf=None, timeout=600):
+              sockbuf=None, flightrec=None, timeout=600):
     """One np-wide sweep; returns the rank-0 JSON payload."""
     port = _free_port()
     procs = []
@@ -87,6 +87,8 @@ def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
             env["HVD_WIRE_SG"] = str(sg)
         if sockbuf is not None:
             env["HOROVOD_SOCKET_BUF_BYTES"] = str(sockbuf)
+        if flightrec is not None:
+            env["HVD_FLIGHTREC"] = str(flightrec)
         procs.append(subprocess.Popen(
             [sys.executable, _WORKER], env=env, cwd=_REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -121,10 +123,13 @@ def _busbw_by_size(payload):
 
 
 def _parse_overrides(spec):
-    """``--ab chunk_bytes=0,sg=1,sockbuf=...`` -> ``run_sweep``
-    kwargs (sockbuf = HOROVOD_SOCKET_BUF_BYTES, the online tuner's
-    other wire knob — docs/autotune.md)."""
-    allowed = {"chunk_bytes": int, "sg": int, "sockbuf": int}
+    """``--ab chunk_bytes=0,sg=1,sockbuf=...,flightrec=...`` ->
+    ``run_sweep`` kwargs (sockbuf = HOROVOD_SOCKET_BUF_BYTES, the
+    online tuner's other wire knob — docs/autotune.md; flightrec =
+    HVD_FLIGHTREC, the always-on recorder's overhead gate —
+    docs/flightrec.md)."""
+    allowed = {"chunk_bytes": int, "sg": int, "sockbuf": int,
+               "flightrec": int}
     out = {}
     for part in spec.split(","):
         part = part.strip()

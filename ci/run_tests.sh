@@ -40,6 +40,19 @@ run_analysis() {
         make -C horovod_tpu/core/src analyze
 }
 
+# Flightrec lane: the forensics pipeline (ring recorders, dump
+# merge/clock alignment, tools.trace diagnosis) plus a native-analyzer
+# pass over the recorder TU. Fail-fast: a broken recorder means the
+# next production failure leaves no evidence behind, which is cheaper
+# to catch here than at the post-mortem that finds empty dumps.
+run_flightrec() {
+    echo "=== flightrec: ring/merge/diagnosis units (tests/test_flightrec.py) ==="
+    timeout "${HVD_CI_FLIGHTREC_BUDGET:-240}" \
+        python -m pytest tests/test_flightrec.py -q -p no:cacheprovider
+    echo "=== flightrec: native analyzer over the recorder TU ==="
+    timeout 300 make -C horovod_tpu/core/src analyze-flightrec.cc
+}
+
 # Tier-1 wall budget: the r5 suite (288 tests; adds runner-selection,
 # per-binding sweep launchers, fake contracts, spark convert) measured
 # 876.79s on this quiet 1-core host (r4: 253 tests, 690.75s). 1200s
@@ -47,6 +60,7 @@ run_analysis() {
 # 720s) proved too thin. (Final r5 suite, 316 tests, cold cache:
 # 868.40s — holds.)
 run_tier1() {
+    run_flightrec
     echo "=== tier 1: autotune fast-fail (online tuner loop + guardrail) ==="
     # The online tuner (docs/autotune.md) mutates live knobs on every
     # training/serving job that sets HVD_TUNE; a broken guardrail
@@ -126,6 +140,10 @@ run_tier1() {
 # test is then deselected from the full tier run (driver-kill
 # precedent). Combined warm cost ~60s — absorbed by the existing
 # headroom.
+# ISSUE 12 adds the chaos forensics pair (test_chaos.py
+# test_chaos_forensics_names_culprit: sigstop np=2 + injected stall
+# np=3, each asserting tools.trace names the culprit from the dumps;
+# ~12s combined warm) — absorbed by the existing headroom.
 run_tier2() {
     echo "=== tier 2: serving smoke (bench_serve.py, jax-free fleet) ==="
     timeout "${HVD_CI_SERVE_BUDGET:-600}" \
@@ -156,8 +174,9 @@ run_tier2() {
 
 case "$TIER" in
     analysis) run_analysis ;;
+    flightrec) run_flightrec ;;
     tier1) run_tier1 ;;
     tier2) run_tier2 ;;
     all) run_analysis; run_tier1; run_tier2 ;;
-    *) echo "usage: $0 [analysis|tier1|tier2|all]" >&2; exit 2 ;;
+    *) echo "usage: $0 [analysis|flightrec|tier1|tier2|all]" >&2; exit 2 ;;
 esac

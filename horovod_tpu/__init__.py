@@ -44,6 +44,7 @@ from horovod_tpu.common import (  # noqa: F401
     add_process_set,
     cross_rank,
     cross_size,
+    dump_flight_record,
     get_process_set_ids,
     global_process_set,
     init,

@@ -1,6 +1,7 @@
 from horovod_tpu.common.basics import (  # noqa: F401
     cross_rank,
     cross_size,
+    dump_flight_record,
     init,
     is_homogeneous,
     is_initialized,

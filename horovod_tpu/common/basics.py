@@ -220,6 +220,13 @@ def init(process_sets=None):
         from horovod_tpu.utils import metrics as metrics_mod
 
         metrics_mod.start_health_reporter()
+        # Flight recorder (docs/flightrec.md): dump-on-SIGTERM so a
+        # wedge-cull's SIGTERM->SIGKILL grace window leaves evidence
+        # behind. Best-effort: init off the main thread (or
+        # HVD_FLIGHTREC_SIGNAL=0 / HVD_FLIGHTREC=0) just skips it.
+        from horovod_tpu.utils import flightrec as flightrec_mod
+
+        flightrec_mod.install_signal_handler()
         port_env = os.environ.get("HVD_METRICS_PORT")
         if port_env not in (None, ""):
             _try_start_metrics_server(
@@ -419,10 +426,36 @@ def metrics_snapshot():
     counters, data-pipeline throughput, and the stall/health gauges
     (``hvd_stalled_tensors``, ``hvd_seconds_since_last_collective``).
     Collectors (e.g. the native-counter bridge) run first, so the view
-    is fresh. See docs/metrics.md for the catalog."""
-    from horovod_tpu.utils import metrics
+    is fresh. See docs/metrics.md for the catalog.
 
-    return metrics.snapshot()
+    The snapshot also carries ``hvd_recent_failures`` — an info-style
+    entry (not a registry family) listing the last N abort/wedge
+    reasons this process recorded (docs/flightrec.md), so "why did it
+    degrade" is answerable from the same call dashboards already make.
+    """
+    from horovod_tpu.utils import flightrec, metrics
+
+    snap = metrics.snapshot()
+    snap["hvd_recent_failures"] = {
+        "type": "info",
+        "help": "Last abort/wedge/cull reasons recorded by the flight "
+                "recorder (newest last; docs/flightrec.md).",
+        "values": flightrec.recent_failures(),
+    }
+    return snap
+
+
+def dump_flight_record(directory: Optional[str] = None) -> dict:
+    """Dump both flight-recorder rings (Python planes + native core)
+    as JSONL files into ``directory`` (default ``HVD_FLIGHTREC_DIR``
+    or the cwd); returns ``{"python": path, "native": path}`` for the
+    files written. Merge and diagnose per-rank dumps with
+    ``python -m tools.trace <dir>`` (docs/flightrec.md). Callable at
+    any time — the ring is always on — and automatically triggered on
+    ``HorovodAbortedError`` and (when enabled) SIGTERM."""
+    from horovod_tpu.utils import flightrec
+
+    return flightrec.dump(directory, reason="hvd.dump_flight_record")
 
 
 def start_metrics_server(port: int = 0) -> int:
@@ -440,9 +473,28 @@ def start_metrics_server(port: int = 0) -> int:
         # metrics_only: the scrape port must not double as a writable
         # KV store (operators open it to their Prometheus fleet).
         server = KVStoreServer(port=port, metrics_only=True)
+        # On-demand flight-record dump of a LIVE job: GET it to write
+        # this rank's python+native rings to HVD_FLIGHTREC_DIR and get
+        # the paths plus the recent failure log back
+        # (docs/flightrec.md). Read-only in KV terms, so it coexists
+        # with metrics_only.
+        server.register_get_route("/debug/flightrec", _flightrec_route)
         server.start()
         _ctx.metrics_server = server
         return server.port
+
+
+def _flightrec_route():
+    from horovod_tpu.runner.http_server import json_route_result
+    from horovod_tpu.utils import flightrec
+
+    dumped = flightrec.dump(reason="/debug/flightrec")
+    status = 200 if (dumped or not flightrec.enabled()) else 500
+    return json_route_result(status, {
+        "enabled": flightrec.enabled(),
+        "dumped": dumped,
+        "recent_failures": flightrec.recent_failures(),
+    })
 
 
 def stop_metrics_server():

@@ -294,6 +294,22 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "utils/online_tuner.py: comma list of schema knob names "
          "(common/knobs.py TUNABLE) pinned at their current value — "
          "excluded from the search without disabling the tuner"),
+    # Flight recorder (core/src/flightrec.cc + utils/flightrec.py;
+    # docs/flightrec.md).
+    Knob("HVD_FLIGHTREC", HONORED,
+         "core/src/flightrec.cc + utils/flightrec.py: always-on event "
+         "rings dumped on abort/SIGTERM/demand; 0 disables both"),
+    Knob("HVD_FLIGHTREC_EVENTS", HONORED,
+         "flight-recorder ring capacity in events (default 4096 "
+         "native / 2048 python; clamped to [64, 1M])"),
+    Knob("HVD_FLIGHTREC_DIR", HONORED,
+         "directory flight-record dumps land in (default cwd; the "
+         "elastic driver and serve fleet point workers at the journal "
+         "dir so evidence survives the process)"),
+    Knob("HVD_FLIGHTREC_SIGNAL", HONORED,
+         "utils/flightrec.py: 0 disables the SIGTERM dump handler "
+         "(the wedge-cull SIGTERM->SIGKILL grace window is the dump "
+         "window)"),
     # Fault injector (core/src/comm.cc; armed only on the matching
     # rank — see docs/configuration.md and common/fault_injection.py).
     Knob("HVD_FAULT_RANK", HONORED,

@@ -67,6 +67,21 @@ _M_CORE = {
         "hvd_ring_subchunk_steps_total",
         "Pipelined ring sub-chunk reduction steps (HVD_RING_CHUNK_BYTES "
         "schedule; 0 means the serial legacy path is in use)."),
+    # The three flight-recorder families are shared with the Python
+    # ring (utils/flightrec.py registers the same names); this bridge
+    # folds the NATIVE ring's totals into them as deltas.
+    "flightrec_events": _metrics.counter(
+        "hvd_flightrec_events_total",
+        "Events recorded into the flight-recorder rings (native + "
+        "python; docs/flightrec.md)."),
+    "flightrec_dropped": _metrics.counter(
+        "hvd_flightrec_dropped_total",
+        "Flight-recorder events overwritten by ring wraparound before "
+        "any dump captured them."),
+    "flightrec_dumps": _metrics.counter(
+        "hvd_flightrec_dumps_total",
+        "Flight-record dump files written (abort auto-dumps, signal "
+        "dumps, on-demand dumps)."),
 }
 
 # StatusType values that mean "a peer is dead or wedged and the abort
@@ -234,6 +249,8 @@ class CoreSession:
                                                 ctypes.c_int]
         lib.hvd_core_timeline_stop.restype = None
         lib.hvd_core_timeline_stop.argtypes = []
+        lib.hvd_core_flightrec_dump.restype = ctypes.c_int
+        lib.hvd_core_flightrec_dump.argtypes = [ctypes.c_char_p]
         lib.hvd_core_set_callback.restype = None
         lib.hvd_core_set_callback.argtypes = [_CALLBACK_TYPE]
         lib.hvd_core_shutdown.restype = None
@@ -360,6 +377,13 @@ class CoreSession:
             exc_cls = (HorovodAbortedError
                        if status in (STATUS_ABORTED, STATUS_TIMED_OUT)
                        else HorovodInternalError)
+            if exc_cls is HorovodAbortedError:
+                # Evidence before error: dump both flight-recorder
+                # rings (rate-limited inside) while the events that
+                # explain this abort are still in them.
+                from horovod_tpu.utils import flightrec as _flightrec
+
+                _flightrec.dump_on_abort(msg)
             pending.group.complete(pending.index, None, exc_cls(msg))
             return
         try:
@@ -472,9 +496,10 @@ class CoreSession:
     def counters(self) -> Dict[str, int]:
         """Core observability counters (responses, cache hits, fusion,
         bytes, comm timeouts, abort cascades, bootstrap retries, wire
-        tx/rx bytes, pipelined ring sub-chunk steps)."""
-        buf = (ctypes.c_longlong * 11)()
-        self._lib.hvd_core_counters(buf, 11)
+        tx/rx bytes, pipelined ring sub-chunk steps, flight-recorder
+        events/drops/dumps)."""
+        buf = (ctypes.c_longlong * 14)()
+        self._lib.hvd_core_counters(buf, 14)
         return {
             "responses": buf[0],
             "cached_responses": buf[1],
@@ -487,7 +512,17 @@ class CoreSession:
             "tx_bytes": buf[8],
             "rx_bytes": buf[9],
             "ring_subchunk_steps": buf[10],
+            "flightrec_events": buf[11],
+            "flightrec_dropped": buf[12],
+            "flightrec_dumps": buf[13],
         }
+
+    def dump_flight_record(self, path: str) -> bool:
+        """Serialize the NATIVE flight-recorder ring to ``path`` as
+        JSONL (docs/flightrec.md). Returns False when the recorder is
+        disabled (HVD_FLIGHTREC=0) or the write failed. The Python
+        ring dumps separately (utils/flightrec.dump covers both)."""
+        return self._lib.hvd_core_flightrec_dump(path.encode()) >= 0
 
     def set_params(self, cycle_ms: float = -1.0, fusion_bytes: int = -1):
         self._lib.hvd_core_set_params(cycle_ms, fusion_bytes)

@@ -1,5 +1,7 @@
 #include "collectives.h"
 
+#include "flightrec.h"
+
 #include <algorithm>
 #include <cmath>
 #include <cstring>
@@ -403,6 +405,12 @@ Status RingAllreduceSegments(TcpComm& comm,
   int64_t chunk_eff = RingEffectiveChunk(comm.ring_chunk_bytes(),
                                          (int64_t)esize);
   std::vector<struct iovec> siov, riov;
+  // Chunk-schedule decision for this ring op: effective sub-chunk
+  // bytes, sub-chunks in the largest step, total payload. The event
+  // carries the executing response's (ps, seq) context.
+  FlightRec(FrKind::RING_CHUNKS, chunk_eff,
+            RingSubchunkCount(max_chunk * (int64_t)esize, chunk_eff),
+            count * (int64_t)esize, nullptr);
 
   // Phase 1: reduce-scatter. After step s, chunk (idx - s) has been
   // accumulated by its current holder. Receives land in scratch and
@@ -415,6 +423,10 @@ Status RingAllreduceSegments(TcpComm& comm,
     int64_t send_bytes = counts[(size_t)send_c] * (int64_t)esize;
     int64_t recv_bytes = counts[(size_t)recv_c] * (int64_t)esize;
     int64_t recv_base = offsets[(size_t)recv_c] * (int64_t)esize;
+    // Ring progress: step index, bytes leaving (from byte offset
+    // send_c*esize in the fused range) and landing this step. The last
+    // RING_STEP before a TIMEOUT/ABORT names how far the wire got.
+    FlightRec(FrKind::RING_STEP, s, send_bytes, recv_bytes, nullptr);
     RangeToIov(segs, offsets[(size_t)send_c] * (int64_t)esize, send_bytes,
                &siov);
     struct iovec rv{scratch.data(), (size_t)recv_bytes};
@@ -445,6 +457,9 @@ Status RingAllreduceSegments(TcpComm& comm,
   for (int s = 0; s < n - 1; ++s) {
     int send_c = ((idx + 1 - s) % n + n) % n;
     int recv_c = ((idx - s) % n + n) % n;
+    FlightRec(FrKind::RING_STEP, n - 1 + s,
+              counts[(size_t)send_c] * (int64_t)esize,
+              counts[(size_t)recv_c] * (int64_t)esize, nullptr);
     RangeToIov(segs, offsets[(size_t)send_c] * (int64_t)esize,
                counts[(size_t)send_c] * (int64_t)esize, &siov);
     RangeToIov(segs, offsets[(size_t)recv_c] * (int64_t)esize,

@@ -1,5 +1,7 @@
 #include "comm.h"
 
+#include "flightrec.h"
+
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
@@ -323,6 +325,7 @@ Status TcpComm::SendAll(int fd, const void* data, size_t len) {
     }
     if (rc == 0) {
       ++g_comm_timeouts;
+      FlightRec(FrKind::TIMEOUT, -1, -1, (long long)len, "send");
       return Status::TimedOut(
           "send made no progress for " +
           std::to_string(progress_timeout_sec_) +
@@ -354,6 +357,7 @@ Status TcpComm::RecvAll(int fd, void* data, size_t len) {
     }
     if (rc == 0) {
       ++g_comm_timeouts;
+      FlightRec(FrKind::TIMEOUT, -1, -1, (long long)len, "recv");
       return Status::TimedOut(
           "recv made no progress for " +
           std::to_string(progress_timeout_sec_) +
@@ -419,6 +423,7 @@ Status TcpComm::SendVecAll(int fd, struct iovec* iov, int iovcnt) {
     }
     if (rc == 0) {
       ++g_comm_timeouts;
+      FlightRec(FrKind::TIMEOUT, -1, -1, (long long)left, "sendv");
       return Status::TimedOut(
           "send made no progress for " +
           std::to_string(progress_timeout_sec_) +
@@ -813,31 +818,45 @@ Status TcpComm::Sendv(int peer, const struct iovec* iov, int iovcnt) {
   std::vector<struct iovec> vec((size_t)iovcnt + 1);
   vec[0] = {&h, sizeof(h)};
   for (int i = 0; i < iovcnt; ++i) vec[(size_t)(i + 1)] = iov[i];
-  return SendVecAll(fds_[(size_t)peer], vec.data(), iovcnt + 1);
+  Status s = SendVecAll(fds_[(size_t)peer], vec.data(), iovcnt + 1);
+  // The fd-level deadline event cannot know the peer; this framed
+  // wrapper can — name it, so tools/trace's straggler attribution
+  // covers control-plane (gather/bcast) wedges too.
+  if (s.type == StatusType::TIMED_OUT)
+    FlightRec(FrKind::TIMEOUT, peer, -1, (long long)len, "frame");
+  return s;
 }
 
 Status TcpComm::Recv(int peer, std::string* out) {
   FrameHeader h;
   Status s = RecvAll(fds_[(size_t)peer], &h, sizeof(h));
-  if (!s.ok()) return s;
-  if (h.magic != kMagic) return Status::Error("bad frame magic");
-  if (h.len > kMaxFrameLen)
-    return Status::Error("frame length " + std::to_string(h.len) +
-                         " exceeds sanity cap (corrupted header?)");
-  out->resize(h.len);
-  return RecvAll(fds_[(size_t)peer], out->data(), h.len);
+  if (s.ok()) {
+    if (h.magic != kMagic) return Status::Error("bad frame magic");
+    if (h.len > kMaxFrameLen)
+      return Status::Error("frame length " + std::to_string(h.len) +
+                           " exceeds sanity cap (corrupted header?)");
+    out->resize(h.len);
+    s = RecvAll(fds_[(size_t)peer], out->data(), h.len);
+  }
+  if (s.type == StatusType::TIMED_OUT)
+    FlightRec(FrKind::TIMEOUT, -1, peer, 0, "frame");
+  return s;
 }
 
 Status TcpComm::RecvInto(int peer, void* buf, size_t len) {
   FrameHeader h;
   Status s = RecvAll(fds_[(size_t)peer], &h, sizeof(h));
-  if (!s.ok()) return s;
-  if (h.magic != kMagic) return Status::Error("bad frame magic");
-  if (h.len != len)
-    return Status::Error("frame length mismatch: got " +
-                         std::to_string(h.len) + " want " +
-                         std::to_string(len));
-  return RecvAll(fds_[(size_t)peer], buf, len);
+  if (s.ok()) {
+    if (h.magic != kMagic) return Status::Error("bad frame magic");
+    if (h.len != len)
+      return Status::Error("frame length mismatch: got " +
+                           std::to_string(h.len) + " want " +
+                           std::to_string(len));
+    s = RecvAll(fds_[(size_t)peer], buf, len);
+  }
+  if (s.type == StatusType::TIMED_OUT)
+    FlightRec(FrKind::TIMEOUT, -1, peer, (long long)len, "frame");
+  return s;
 }
 
 Status TcpComm::RawSendRecv(int peer_s, const void* sbuf, size_t slen,
@@ -900,6 +919,10 @@ Status TcpComm::RawSendRecvV(int peer_s, const struct iovec* siov,
     }
     if (rc == 0) {
       ++g_comm_timeouts;
+      // Names the peers this transfer was blocked on — the flight
+      // recorder's most direct straggler evidence (tools/trace).
+      FlightRec(FrKind::TIMEOUT, peer_s, peer_r,
+                (long long)(sleft + rleft), "duplex");
       return Status::TimedOut(
           "duplex transfer made no progress for " +
           std::to_string(progress_timeout_sec_) +

@@ -153,6 +153,14 @@ struct ProcessSetState {
   std::unordered_map<std::string, int64_t> group_of;
   std::set<std::string> ready_names;  // full count, awaiting group
 
+  // Cross-rank collective sequence number: incremented once per
+  // executed response by the background loop (its only toucher). Every
+  // member executes a set's responses in the same coordinator-decided
+  // order, so the counter agrees across ranks — flight-recorder events
+  // carry it and tools/trace uses it to find the first divergent
+  // collective after a failure (docs/flightrec.md).
+  long long exec_seq = 0;
+
   // Join state.
   bool joined_locally = false;
   std::set<int> joined_ranks;  // coordinator view

@@ -1,0 +1,317 @@
+#include "flightrec.h"
+
+#include "common.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <memory>
+#include <mutex>
+#include <string>
+
+namespace hvd {
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+constexpr long long kDefaultCapacity = 4096;
+constexpr long long kMinCapacity = 64;
+constexpr long long kMaxCapacity = 1 << 20;
+constexpr int kNameBytes = 64;
+constexpr int kNameWords = kNameBytes / 8;
+
+// One ring slot. Every field is a relaxed atomic so a dump racing a
+// producer is a skipped slot, never a data race (the TSAN chaos smoke
+// runs this core). `commit` is the seqlock word: 0 = never written,
+// ticket*2+1 = write in progress, ticket*2+2 = payload consistent for
+// that ticket; release/acquire on it orders the payload stores.
+struct Slot {
+  std::atomic<unsigned long long> commit{0};
+  std::atomic<long long> ts_us{0};
+  std::atomic<int> kind{0};
+  std::atomic<int> ps{0};
+  std::atomic<long long> seq{-1};
+  std::atomic<long long> a{0}, b{0}, c{0};
+  std::atomic<unsigned long long> name8[kNameWords] = {};
+};
+
+struct Ring {
+  std::unique_ptr<Slot[]> slots;
+  size_t capacity = 0;
+  std::atomic<unsigned long long> head{0};
+  std::atomic<long long> dropped{0};
+  std::atomic<long long> dumps{0};
+  Clock::time_point origin = Clock::now();
+  std::atomic<int> rank{-1};
+  bool enabled = true;  // set once at init (or under dump_mutex in Reset)
+  // Serializes dump file writes and the test-only Reset; never taken
+  // on the record path.
+  std::mutex dump_mutex;
+};
+
+Ring* g_ring = nullptr;
+std::once_flag g_ring_once;
+
+// Per-thread collective context stamped onto events recorded while the
+// background loop executes a response (RING_*, TIMEOUT from inside the
+// wire path). Plain thread_local: no synchronization needed.
+thread_local int t_ctx_ps = 0;
+thread_local long long t_ctx_seq = -1;
+
+long long EnvCapacity() {
+  const char* v = getenv("HVD_FLIGHTREC_EVENTS");
+  if (!v || !*v) return kDefaultCapacity;
+  long long n = atoll(v);
+  if (n < kMinCapacity) return kMinCapacity;
+  if (n > kMaxCapacity) return kMaxCapacity;
+  return n;
+}
+
+void InitRing() {
+  Ring* r = new Ring();
+  const char* en = getenv("HVD_FLIGHTREC");
+  r->enabled = !(en && *en && strcmp(en, "0") == 0);
+  r->capacity = (size_t)EnvCapacity();
+  r->slots.reset(new Slot[r->capacity]);
+  g_ring = r;
+}
+
+Ring* TheRing() {
+  std::call_once(g_ring_once, InitRing);
+  return g_ring;
+}
+
+long long NowUs(const Ring* r) {
+  return (long long)std::chrono::duration_cast<std::chrono::microseconds>(
+             Clock::now() - r->origin)
+      .count();
+}
+
+void StoreName(Slot* s, const char* name) {
+  char buf[kNameBytes] = {0};
+  if (name && *name) {
+    strncpy(buf, name, kNameBytes - 1);
+  }
+  unsigned long long words[kNameWords];
+  memcpy(words, buf, kNameBytes);
+  for (int i = 0; i < kNameWords; ++i)
+    s->name8[i].store(words[i], std::memory_order_relaxed);
+}
+
+void LoadName(const Slot* s, char* buf) {
+  unsigned long long words[kNameWords];
+  for (int i = 0; i < kNameWords; ++i)
+    words[i] = s->name8[i].load(std::memory_order_relaxed);
+  memcpy(buf, words, kNameBytes);
+  buf[kNameBytes - 1] = '\0';
+}
+
+// Minimal JSON string escaping for tensor names (quotes, backslashes,
+// control bytes); names are ASCII identifiers in practice.
+void AppendEscaped(std::string* out, const char* s) {
+  for (; *s; ++s) {
+    unsigned char c = (unsigned char)*s;
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back((char)c);
+    } else if (c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out->append(buf);
+    } else {
+      out->push_back((char)c);
+    }
+  }
+}
+
+}  // namespace
+
+const char* FrKindName(FrKind k) {
+  switch (k) {
+    case FrKind::NEG_START: return "NEG_START";
+    case FrKind::NEG_READY: return "NEG_READY";
+    case FrKind::NEG_END: return "NEG_END";
+    case FrKind::RESP_BEGIN: return "RESP_BEGIN";
+    case FrKind::RESP_END: return "RESP_END";
+    case FrKind::RING_STEP: return "RING_STEP";
+    case FrKind::RING_CHUNKS: return "RING_CHUNKS";
+    case FrKind::TIMEOUT: return "TIMEOUT";
+    case FrKind::ABORT: return "ABORT";
+    case FrKind::ENQUEUE: return "ENQUEUE";
+  }
+  return "UNKNOWN";
+}
+
+bool FlightRecEnabled() { return TheRing()->enabled; }
+
+void FlightRecSetContext(int ps_id, long long seq) {
+  t_ctx_ps = ps_id;
+  t_ctx_seq = seq;
+}
+
+void FlightRecSetRank(int rank) { TheRing()->rank.store(rank); }
+
+void FlightRec(FrKind kind, long long a, long long b, long long c,
+               const char* name) {
+  Ring* r = TheRing();
+  if (!r->enabled) return;
+  unsigned long long ticket = r->head.fetch_add(1, std::memory_order_relaxed);
+  if (ticket >= r->capacity)
+    r->dropped.fetch_add(1, std::memory_order_relaxed);
+  Slot& s = r->slots[(size_t)(ticket % r->capacity)];
+  // Seqlock write side: the in-progress marker must be visible BEFORE
+  // any payload store (a release STORE only orders what came before
+  // it — the fence is what keeps the relaxed payload stores from
+  // moving above the marker).
+  s.commit.store(ticket * 2 + 1, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.ts_us.store(NowUs(r), std::memory_order_relaxed);
+  s.kind.store((int)kind, std::memory_order_relaxed);
+  s.ps.store(t_ctx_ps, std::memory_order_relaxed);
+  s.seq.store(t_ctx_seq, std::memory_order_relaxed);
+  s.a.store(a, std::memory_order_relaxed);
+  s.b.store(b, std::memory_order_relaxed);
+  s.c.store(c, std::memory_order_relaxed);
+  StoreName(&s, name);
+  s.commit.store(ticket * 2 + 2, std::memory_order_release);
+}
+
+long long FlightRecEventsTotal() {
+  return (long long)TheRing()->head.load(std::memory_order_relaxed);
+}
+
+long long FlightRecDroppedTotal() {
+  return TheRing()->dropped.load(std::memory_order_relaxed);
+}
+
+long long FlightRecDumpsTotal() {
+  return TheRing()->dumps.load(std::memory_order_relaxed);
+}
+
+int FlightRecDump(const char* path) {
+  Ring* r = TheRing();
+  if (!r->enabled || !path || !*path) return -1;
+  std::lock_guard<std::mutex> lk(r->dump_mutex);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  size_t cap = r->capacity;
+  unsigned long long head = r->head.load(std::memory_order_acquire);
+  unsigned long long begin = head > cap ? head - cap : 0;
+
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  double wall = (double)tv.tv_sec + (double)tv.tv_usec / 1e6;
+  fprintf(f,
+          "{\"flightrec\": 1, \"source\": \"native\", \"rank\": %d, "
+          "\"pid\": %d, \"wall_ts\": %.6f, \"mono_us\": %lld, "
+          "\"events_total\": %lld, \"dropped\": %lld}\n",
+          r->rank.load(), (int)getpid(), wall, NowUs(r),
+          (long long)head, r->dropped.load());
+
+  int written = 0;
+  std::string line;
+  for (unsigned long long t = begin; t < head; ++t) {
+    Slot& s = r->slots[(size_t)(t % cap)];
+    // Seqlock read: copy the payload between two identical commit
+    // reads; a mismatch (in-progress odd value, or a newer ticket —
+    // the producer lapped this dump) means torn: skip the slot.
+    unsigned long long c1 = s.commit.load(std::memory_order_acquire);
+    if (c1 != t * 2 + 2) continue;
+    long long ts = s.ts_us.load(std::memory_order_relaxed);
+    int kind = s.kind.load(std::memory_order_relaxed);
+    int ps = s.ps.load(std::memory_order_relaxed);
+    long long seq = s.seq.load(std::memory_order_relaxed);
+    long long a = s.a.load(std::memory_order_relaxed);
+    long long b = s.b.load(std::memory_order_relaxed);
+    long long c = s.c.load(std::memory_order_relaxed);
+    char name[kNameBytes];
+    LoadName(&s, name);
+    // Seqlock read side: the payload loads must complete before the
+    // validating re-read (an acquire fence orders prior loads ahead
+    // of everything after it; a bare acquire LOAD of c2 would not
+    // keep the relaxed payload loads from sinking below it).
+    std::atomic_thread_fence(std::memory_order_acquire);
+    unsigned long long c2 = s.commit.load(std::memory_order_relaxed);
+    if (c1 != c2) continue;
+    line.clear();
+    line += "{\"ts_us\": " + std::to_string(ts);
+    line += ", \"kind\": \"";
+    line += FrKindName((FrKind)kind);
+    line += "\", \"ps\": " + std::to_string(ps);
+    line += ", \"seq\": " + std::to_string(seq);
+    line += ", \"a\": " + std::to_string(a);
+    line += ", \"b\": " + std::to_string(b);
+    line += ", \"c\": " + std::to_string(c);
+    line += ", \"name\": \"";
+    AppendEscaped(&line, name);
+    line += "\"}\n";
+    if (fputs(line.c_str(), f) < 0) {
+      fclose(f);
+      return -1;
+    }
+    ++written;
+  }
+  fclose(f);
+  r->dumps.fetch_add(1, std::memory_order_relaxed);
+  return written;
+}
+
+namespace {
+
+// mkdir -p: the elastic driver / serve fleet export a dump dir under
+// the journal dir without creating it — the abort auto-dump may be
+// the first (native-only) writer, and a silent fopen failure here
+// would leave the journaled 'wedged'/'exit' records pointing at
+// evidence that never existed. Best effort; fopen is the real check.
+void MkDirs(const std::string& dir) {
+  std::string partial;
+  size_t pos = 0;
+  while (pos <= dir.size()) {
+    size_t slash = dir.find('/', pos);
+    if (slash == std::string::npos) slash = dir.size();
+    partial = dir.substr(0, slash);
+    if (!partial.empty()) mkdir(partial.c_str(), 0777);
+    pos = slash + 1;
+  }
+}
+
+}  // namespace
+
+void FlightRecAutoDump(const char* reason) {
+  Ring* r = TheRing();
+  if (!r->enabled) return;
+  const char* dir = getenv("HVD_FLIGHTREC_DIR");
+  std::string path = (dir && *dir) ? dir : ".";
+  if (dir && *dir) MkDirs(path);
+  path += "/flightrec.rank" + std::to_string(r->rank.load()) +
+          ".native.jsonl";
+  int n = FlightRecDump(path.c_str());
+  if (n >= 0) {
+    HVD_LOG(LogLevel::WARN,
+            std::string("flight record dumped to ") + path + " (" +
+                std::to_string(n) + " events): " +
+                (reason ? reason : ""));
+  }
+}
+
+void FlightRecReset(long long capacity) {
+  Ring* r = TheRing();
+  std::lock_guard<std::mutex> lk(r->dump_mutex);
+  if (capacity < kMinCapacity) capacity = kMinCapacity;
+  if (capacity > kMaxCapacity) capacity = kMaxCapacity;
+  r->capacity = (size_t)capacity;
+  r->slots.reset(new Slot[r->capacity]);
+  r->head.store(0);
+  r->dropped.store(0);
+  r->dumps.store(0);
+  r->enabled = true;
+}
+
+}  // namespace hvd

@@ -1,0 +1,83 @@
+// Flight recorder: an always-on, lock-light ring of recent
+// coordination/wire events, dumped as JSONL on abort, timeout, or
+// demand (docs/flightrec.md).
+//
+// The reference surfaces stall evidence only as coordinator log lines
+// (reference: horovod/common/stall_inspector.cc:48-115); this recorder
+// keeps the raw event stream — negotiation begin/ready/end, per-response
+// execution with the cross-rank collective sequence number, ring step
+// progress with byte counts, chunk-schedule decisions, timeout/abort
+// transitions — in a bounded in-memory ring so a post-mortem
+// (`python -m tools.trace`) can name the culprit rank and tensor after
+// the process that wedged is long gone.
+//
+// Concurrency: producers (background loop, enqueue threads, comm layer)
+// claim a slot with one atomic fetch_add and commit it with a
+// release-store of the slot's ticket; the dumping thread validates each
+// slot with a seqlock-style double read, so a dump taken mid-write
+// skips the torn slot instead of blocking any producer. No mutex, no
+// syscall, no allocation on the record path.
+
+#ifndef HVD_TPU_FLIGHTREC_H
+#define HVD_TPU_FLIGHTREC_H
+
+namespace hvd {
+
+// Stable event-kind ids; names in flightrec.cc must match
+// (append-only: tools/trace decodes dumps from older cores).
+enum class FrKind : int {
+  NEG_START = 0,   // this rank's request entered slow-path negotiation
+  NEG_READY = 1,   // coordinator: rank a's request for `name` arrived
+  NEG_END = 2,     // tensor emitted in a response list
+  RESP_BEGIN = 3,  // response execution starts (a=op, b=ntensors, c=bytes)
+  RESP_END = 4,    // response execution done (a=status type)
+  RING_STEP = 5,   // one ring step (a=step, b=send bytes, c=recv bytes)
+  RING_CHUNKS = 6, // chunk schedule (a=chunk bytes, b=subchunks, c=step bytes)
+  TIMEOUT = 7,     // progress deadline fired (a=send peer, b=recv peer)
+  ABORT = 8,       // connection-abort cascade (a=status type)
+  ENQUEUE = 9,     // op submitted through the C ABI (a=op, b=ps)
+};
+
+const char* FrKindName(FrKind k);
+
+// Cheap global gate: HVD_FLIGHTREC=0 disables (default ON — the ring
+// is bounded and the record path is syscall-free, docs/flightrec.md).
+bool FlightRecEnabled();
+
+// Record one event. `name` may be null/empty; it is truncated to the
+// slot's fixed field. The active (ps, seq) context — set by the
+// background loop before executing a response — is stamped on every
+// event recorded from that thread (thread-local, see SetContext).
+void FlightRec(FrKind kind, long long a, long long b, long long c,
+               const char* name);
+
+// Per-thread collective context: process-set id and the cross-rank
+// collective sequence number of the response being executed (stamped
+// on RING_* / TIMEOUT events recorded below the loop). seq -1 = none.
+void FlightRecSetContext(int ps_id, long long seq);
+
+// Rank stamped into dump headers (set once at core init).
+void FlightRecSetRank(int rank);
+
+// Monotonic counters (bridged through hvd_core_counters).
+long long FlightRecEventsTotal();
+long long FlightRecDroppedTotal();  // overwritten by ring wraparound
+long long FlightRecDumpsTotal();
+
+// Serialize the ring to `path` as JSONL (header line + one event per
+// line, oldest first). Returns the number of events written, or -1 on
+// I/O failure / recorder disabled. Safe from any thread.
+int FlightRecDump(const char* path);
+
+// Auto-dump into $HVD_FLIGHTREC_DIR (default ".") as
+// flightrec.rank<R>.native.jsonl; called on the abort/timeout cascade
+// paths before the error surfaces. `reason` lands in the header.
+void FlightRecAutoDump(const char* reason);
+
+// Test hook: reinitialize the ring with `capacity` slots and zero the
+// counters. NOT safe against concurrent producers — unit tests only.
+void FlightRecReset(long long capacity);
+
+}  // namespace hvd
+
+#endif  // HVD_TPU_FLIGHTREC_H

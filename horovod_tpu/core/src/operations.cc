@@ -17,6 +17,7 @@
 #endif
 
 #include "controller.h"
+#include "flightrec.h"
 #include "perf.h"
 
 #include <algorithm>
@@ -141,6 +142,8 @@ long long TlNowUs() {
 }
 
 void TlNegotiateStart(const std::string& name, OpType op) {
+  // Flight recorder first: always on, independent of the timeline.
+  FlightRec(FrKind::NEG_START, (long long)op, 0, 0, name.c_str());
   std::lock_guard<std::mutex> lk(g->timeline_mutex);
   if (!g->timeline) return;
   // Repeated entry (cache invalidation requeue) keeps the first span,
@@ -151,6 +154,7 @@ void TlNegotiateStart(const std::string& name, OpType op) {
 }
 
 void TlNegotiateRankReady(const std::string& name, int rank, OpType op) {
+  FlightRec(FrKind::NEG_READY, rank, (long long)op, 0, name.c_str());
   std::lock_guard<std::mutex> lk(g->timeline_mutex);
   if (!g->timeline) return;
   // A peer's request can reach the coordinator before this rank pops
@@ -163,6 +167,7 @@ void TlNegotiateRankReady(const std::string& name, int rank, OpType op) {
 }
 
 void TlNegotiateEnd(const std::string& name) {
+  FlightRec(FrKind::NEG_END, 0, 0, 0, name.c_str());
   std::lock_guard<std::mutex> lk(g->timeline_mutex);
   if (!g->timeline) return;
   if (g->tl_negotiating.erase(name) == 0) return;
@@ -676,6 +681,11 @@ void BackgroundLoop() {
         }
         HVD_LOG(LogLevel::ERROR,
                 "coordination failed: " + s.reason + "; failing pending ops");
+        // Evidence before error: the abort transition is recorded and
+        // the ring dumped while the events leading here are still in
+        // it (docs/flightrec.md).
+        FlightRec(FrKind::ABORT, (long long)s.type, 0, 0, s.reason.c_str());
+        FlightRecAutoDump(s.reason.c_str());
         g->failed.store(true);
         // Cascade: break every connection so peers blocked in this
         // cycle's gather/bcast fail immediately instead of hanging
@@ -711,10 +721,28 @@ void BackgroundLoop() {
           g->ctr_allreduce_bytes += bytes;
           cycle_bytes += bytes;
         }
+        // Cross-rank collective sequence number: every member executes
+        // this set's responses in the same coordinator-decided order on
+        // its single background thread, so the per-set counter agrees
+        // across ranks — the divergence axis tools/trace aligns on.
+        long long seq = ps->exec_seq++;
+        long long resp_bytes = 0;
+        for (auto cnt : responses[i].tensor_sizes)
+          resp_bytes += cnt * (long long)DataTypeSize(responses[i].dtype);
+        const std::string first_name = responses[i].tensor_names.empty()
+                                           ? std::string()
+                                           : responses[i].tensor_names[0];
+        FlightRecSetContext(ps->id, seq);
+        FlightRec(FrKind::RESP_BEGIN, (long long)responses[i].op_type,
+                  (long long)responses[i].tensor_names.size(), resp_bytes,
+                  first_name.c_str());
         auto op_start = Clock::now();
         TlAllEnd(responses[i]);  // QUEUE over: execution starts
         Status es = PerformOperation(*ps, responses[i], from_cache);
         TlAllEnd(responses[i]);  // top-level span
+        FlightRec(FrKind::RESP_END, (long long)es.type, 0, 0,
+                  first_name.c_str());
+        FlightRecSetContext(0, -1);
         {
           std::lock_guard<std::mutex> tlk(g->timeline_mutex);
           if (g->timeline) {
@@ -732,11 +760,16 @@ void BackgroundLoop() {
                     " fused)";
             g->timeline->Event(nm, OpTypeName(responses[i].op_type),
                                us(op_start),
-                               us(Clock::now()) - us(op_start));
+                               us(Clock::now()) - us(op_start), seq);
           }
         }
         if (!es.ok()) {
           HVD_LOG(LogLevel::ERROR, "collective failed: " + es.reason);
+          if (es.is_comm_failure()) {
+            FlightRec(FrKind::ABORT, (long long)es.type, 0, 0,
+                      es.reason.c_str());
+            FlightRecAutoDump(es.reason.c_str());
+          }
           g->failed.store(true);
           // A comm-level execution failure (peer closed, progress
           // deadline) means some peer is dead or wedged mid-transfer:
@@ -816,6 +849,7 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
   g = new Global();
   g->rank = rank;
   g->size = size;
+  FlightRecSetRank(rank);
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
   if (const char* mc = getenv("HOROVOD_TIMELINE_MARK_CYCLES")) {
     // No other thread can hold g yet, but the discipline (and the
@@ -926,6 +960,7 @@ int hvd_core_enqueue(long long tag, int op_type, const char* name, int dtype,
   req.splits = e.splits;
   req.group_id = e.group_id;
 
+  FlightRec(FrKind::ENQUEUE, op_type, ps_id, 0, name);
   Status s = ps->queue.Add(std::move(e), req);
   if (!s.ok()) {
     FireCallback(tag, s);
@@ -1081,18 +1116,42 @@ long long hvd_core_fusion_bytes() {
 
 // Fills out[0..n): responses, cached_responses, fused_tensors,
 // allreduced_tensors, allreduce_bytes, comm_timeouts, aborts,
-// bootstrap_retries, tx_bytes, rx_bytes, ring_subchunk_steps. Callers
+// bootstrap_retries, tx_bytes, rx_bytes, ring_subchunk_steps,
+// flightrec_events, flightrec_dropped, flightrec_dumps. Callers
 // pass the slot count they know about, so the layout is append-only.
 void hvd_core_counters(long long* out, int n) {
   if (!g || !out) return;
-  long long vals[11] = {
+  long long vals[14] = {
       g->ctr_responses.load(), g->ctr_cached_responses.load(),
       g->ctr_fused_tensors.load(), g->ctr_allreduced_tensors.load(),
       g->ctr_allreduce_bytes.load(), CommTimeoutsTotal(),
       g->ctr_aborts.load(), CommBootstrapRetriesTotal(),
-      CommTxBytesTotal(), CommRxBytesTotal(), RingSubchunkStepsTotal()};
-  for (int i = 0; i < n && i < 11; ++i) out[i] = vals[i];
+      CommTxBytesTotal(), CommRxBytesTotal(), RingSubchunkStepsTotal(),
+      FlightRecEventsTotal(), FlightRecDroppedTotal(),
+      FlightRecDumpsTotal()};
+  for (int i = 0; i < n && i < 14; ++i) out[i] = vals[i];
 }
+
+// --- flight recorder (docs/flightrec.md) ------------------------------------
+
+// Serialize the native event ring to `path` as JSONL. Works with or
+// without a live core (the ring is process-global); returns the event
+// count written, or -1 when the recorder is disabled / the write
+// failed. hvd.dump_flight_record() and the abort auto-dump use it.
+int hvd_core_flightrec_dump(const char* path) {
+  return FlightRecDump(path);
+}
+
+// Test hooks (tests/test_flightrec.py): record a synthetic event /
+// reinitialize the ring with a chosen capacity. Not part of the
+// session API; FlightRecReset is not safe against concurrent
+// producers (unit-test use only).
+void hvd_flightrec_record(int kind, long long a, long long b, long long c,
+                          const char* name) {
+  FlightRec((FrKind)kind, a, b, c, name);
+}
+
+void hvd_flightrec_reset(long long capacity) { FlightRecReset(capacity); }
 
 // --- wire-schedule test hooks (tests/test_wire.py) --------------------------
 // Pure functions over the ring math in collectives.cc, exported so the

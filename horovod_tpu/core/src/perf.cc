@@ -264,12 +264,12 @@ TimelineWriter::~TimelineWriter() { Stop(); }
 
 void TimelineWriter::Event(const std::string& name,
                            const std::string& category, long long ts_us,
-                           long long dur_us) {
+                           long long dur_us, long long seq) {
   if (!f_) return;
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (stop_) return;
-    q_.push_back({'X', name, category, ts_us, dur_us, 0});
+    q_.push_back({'X', name, category, ts_us, dur_us, 0, seq});
   }
   cv_.notify_one();
 }
@@ -374,12 +374,26 @@ void TimelineWriter::Loop() {
         const char* sep = first_ ? "" : ",\n";
         switch (r.ph) {
           case 'X':
-            std::fprintf(
-                f_,
-                "%s{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"X\", "
-                "\"ts\": %lld, \"dur\": %lld, \"pid\": %d, \"tid\": %d}",
-                sep, JsonEscape(r.name).c_str(), JsonEscape(r.cat).c_str(),
-                r.ts, r.dur, rank_, r.tid);
+            if (r.seq >= 0) {
+              // Collective sequence number (controller.h exec_seq):
+              // the trace's op row and the flight recorder index the
+              // same execution identically across ranks.
+              std::fprintf(
+                  f_,
+                  "%s{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"X\", "
+                  "\"ts\": %lld, \"dur\": %lld, \"pid\": %d, \"tid\": %d, "
+                  "\"args\": {\"seq\": %lld}}",
+                  sep, JsonEscape(r.name).c_str(),
+                  JsonEscape(r.cat).c_str(), r.ts, r.dur, rank_, r.tid,
+                  r.seq);
+            } else {
+              std::fprintf(
+                  f_,
+                  "%s{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"X\", "
+                  "\"ts\": %lld, \"dur\": %lld, \"pid\": %d, \"tid\": %d}",
+                  sep, JsonEscape(r.name).c_str(),
+                  JsonEscape(r.cat).c_str(), r.ts, r.dur, rank_, r.tid);
+            }
             break;
           case 'M':
             // thread_name metadata: names the tensor's lane.

@@ -148,8 +148,11 @@ class TimelineWriter {
 
   // Complete event ("ph":"X") on the shared loop row (tid 0).
   // ts/dur in microseconds since Start; all methods thread-safe.
+  // seq >= 0 lands as "args":{"seq":N} — the cross-rank collective
+  // sequence number (controller.h exec_seq), so the trace and the
+  // flight recorder index the same op identically.
   void Event(const std::string& name, const std::string& category,
-             long long ts_us, long long dur_us);
+             long long ts_us, long long dur_us, long long seq = -1);
   // Begin/End a span on ``tensor``'s own trace thread; spans nest.
   void Begin(const std::string& tensor, const std::string& category,
              long long ts_us);
@@ -165,6 +168,7 @@ class TimelineWriter {
     std::string name, cat;
     long long ts, dur;
     int tid;
+    long long seq = -1;  // >= 0: emitted as args.seq
   };
   // Assign (and on first use announce via thread_name metadata) the
   // tensor's tid. Caller holds mu_.

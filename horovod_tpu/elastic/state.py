@@ -106,6 +106,10 @@ class State:
 
     def commit(self):
         _M_COMMITS.inc()
+        from horovod_tpu.utils import flightrec
+
+        flightrec.record("elastic_commit",
+                         step=getattr(self, "step", None))
         self.save()
         # Persist BEFORE the host-update check: a commit that triggers
         # a graceful reset must still reach durable storage.

@@ -292,6 +292,10 @@ def reinit_for_version(min_version: int):
     # in-graph pre-flight succeeded) — the metric's contract is
     # completed resets, not attempts.
     _M_RESETS.inc()
+    from horovod_tpu.utils import flightrec
+
+    flightrec.record("elastic_reset", version=meta["version"],
+                     rank=rank, size=size)
     return meta["version"]
 
 
@@ -355,10 +359,14 @@ def run(func):
                     state.sync()
                 skip_sync = False
                 return func(state, *args, **kwargs)
-            except HorovodInternalError:
+            except HorovodInternalError as e:
                 # A rank died mid-collective: roll back to the last
                 # commit, rejoin at the next published rendezvous.
                 _M_FAILURES.inc()
+                from horovod_tpu.utils import flightrec
+
+                flightrec.record_failure("elastic_recovery",
+                                         str(e)[:200])
                 if time.monotonic() - entered > stable_sec:
                     consecutive_failures = 0
                 consecutive_failures += 1

@@ -56,6 +56,7 @@ _handle_lock = threading.Lock()
 _handles: Dict[int, Future] = {}
 _next_handle = itertools.count(1)
 _name_counters = {}
+_seq_counters: Dict[int, int] = {}
 
 
 def _reset_name_counters():
@@ -66,9 +67,25 @@ def _reset_name_counters():
     replacement workers agree on the next unnamed-op sequence number.
     Without the reset, the first unnamed collective after a recovery
     negotiates under different names on old vs new processes and
-    hangs."""
+    hangs. The collective SEQUENCE counters reset with them for the
+    same reason: cross-rank comparability within one world."""
     with _handle_lock:
         _name_counters.clear()
+        _seq_counters.clear()
+
+
+def _next_seq(process_set) -> int:
+    """Monotonic per-process-set collective sequence number, stamped
+    on flight-recorder and timeline events at submit. Ranks of one
+    world submitting the same program agree on it, which is what lets
+    ``tools/trace`` find the first divergent collective after a
+    failure (the native side keeps its own execution-ordered twin,
+    controller.h exec_seq)."""
+    ps_id = getattr(process_set, "process_set_id", 0) or 0
+    with _handle_lock:
+        n = _seq_counters.get(ps_id, 0)
+        _seq_counters[ps_id] = n + 1
+    return n
 
 
 def _auto_name(kind: str, process_set=None) -> str:
@@ -137,10 +154,35 @@ def _backend():
     return _LOCAL
 
 
-def _record_timeline(name: str, category: str, fut: Future):
+def _record_timeline(name: str, category: str, fut: Future,
+                     seq: Optional[int] = None):
     tl = basics._timeline()
     if tl is not None:
-        tl.record_future(name, category, fut)
+        tl.record_future(name, category, fut, seq=seq)
+
+
+def _record_flight(op_label: str, name: str, process_set, seq: int,
+                   fut: Future) -> None:
+    """Flight-recorder lifecycle events for one eager op: ``submit``
+    now, ``complete``/``error`` when the future resolves
+    (docs/flightrec.md). No-op when HVD_FLIGHTREC=0."""
+    from horovod_tpu.utils import flightrec
+
+    if not flightrec.enabled():
+        return
+    ps_id = getattr(process_set, "process_set_id", 0) or 0
+    flightrec.record("submit", name=name, op=op_label, ps=ps_id, seq=seq)
+
+    def _done(f: Future):
+        err = f.exception()
+        if err is not None:
+            flightrec.record("error", name=name, op=op_label, ps=ps_id,
+                             seq=seq, detail=str(err)[:200])
+        else:
+            flightrec.record("complete", name=name, op=op_label,
+                             ps=ps_id, seq=seq)
+
+    fut.add_done_callback(_done)
 
 
 def _payload_bytes(tensors) -> int:
@@ -282,12 +324,14 @@ def allreduce_async(tensor, *, name: Optional[str] = None, op: Optional[int] = N
     basics._check_initialized()
     op = _effective_op(op, average)
     name = name or _auto_name("allreduce", process_set)
+    seq = _next_seq(process_set)
     start = time.monotonic()
     fut = _backend().allreduce_async([tensor], [name], op, prescale_factor,
                                      postscale_factor, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
-    _record_timeline(name, "allreduce", out)
+    _record_timeline(name, "allreduce", out, seq)
+    _record_flight("allreduce", name, process_set, seq, out)
     _observe_metrics("allreduce", [tensor], out, start)
     return _register(out)
 
@@ -304,6 +348,7 @@ def grouped_allreduce_async(tensors: Sequence, *, name: Optional[str] = None,
     basics._check_initialized()
     op = _effective_op(op, None)
     base = name or _auto_name("grouped_allreduce", process_set)
+    seq = _next_seq(process_set)
     names = ["%s.%d" % (base, i) for i in range(len(tensors))]
     start = time.monotonic()
     fut = _backend().allreduce_async(list(tensors), names, op, prescale_factor,
@@ -311,7 +356,8 @@ def grouped_allreduce_async(tensors: Sequence, *, name: Optional[str] = None,
     out = Future()
     _chain(fut, out,
            lambda rs: [_like_input(r, t) for r, t in zip(rs, tensors)])
-    _record_timeline(base, "allreduce", out)
+    _record_timeline(base, "allreduce", out, seq)
+    _record_flight("grouped_allreduce", base, process_set, seq, out)
     _observe_metrics("grouped_allreduce", list(tensors), out, start)
     return _register(out)
 
@@ -324,11 +370,13 @@ def allgather_async(tensor, *, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
     name = name or _auto_name("allgather", process_set)
+    seq = _next_seq(process_set)
     start = time.monotonic()
     fut = _backend().allgather_async([tensor], [name], process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
-    _record_timeline(name, "allgather", out)
+    _record_timeline(name, "allgather", out, seq)
+    _record_flight("allgather", name, process_set, seq, out)
     _observe_metrics("allgather", [tensor], out, start)
     return _register(out)
 
@@ -341,11 +389,13 @@ def broadcast_async(tensor, root_rank: int, *, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> int:
     basics._check_initialized()
     name = name or _auto_name("broadcast", process_set)
+    seq = _next_seq(process_set)
     start = time.monotonic()
     fut = _backend().broadcast_async([tensor], [name], root_rank, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
-    _record_timeline(name, "broadcast", out)
+    _record_timeline(name, "broadcast", out, seq)
+    _record_flight("broadcast", name, process_set, seq, out)
     _observe_metrics("broadcast", [tensor], out, start)
     return _register(out)
 
@@ -362,12 +412,14 @@ def alltoall_async(tensor, splits=None, *, name: Optional[str] = None,
     # previously discarded it and auto-named the wire op
     # 'alltoall.native' (ADVICE.md round 5).
     name = name or _auto_name("alltoall", process_set)
+    seq = _next_seq(process_set)
     start = time.monotonic()
     fut = _backend().alltoall_async(tensor, splits, process_set, name)
     out = Future()
     _chain(fut, out,
            lambda r: (_like_input(r[0], tensor), r[1]))
-    _record_timeline(name, "alltoall", out)
+    _record_timeline(name, "alltoall", out, seq)
+    _record_flight("alltoall", name, process_set, seq, out)
     _observe_metrics("alltoall", [tensor], out, start)
     return _register(out)
 
@@ -387,11 +439,13 @@ def reducescatter_async(tensor, *, name: Optional[str] = None,
         raise ValueError(
             "reducescatter supports Sum/Average, got op=%r" % (op,))
     name = name or _auto_name("reducescatter", process_set)
+    seq = _next_seq(process_set)
     start = time.monotonic()
     fut = _backend().reducescatter_async([tensor], [name], op, process_set)
     out = Future()
     _chain(fut, out, lambda r: _like_input(r[0], tensor))
-    _record_timeline(name, "reducescatter", out)
+    _record_timeline(name, "reducescatter", out, seq)
+    _record_flight("reducescatter", name, process_set, seq, out)
     _observe_metrics("reducescatter", [tensor], out, start)
     return _register(out)
 

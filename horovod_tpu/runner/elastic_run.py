@@ -136,6 +136,21 @@ class ElasticDriver:
         self.journal: Optional[DriverJournal] = None
         journal_dir = (getattr(args, "journal_dir", None)
                        or os.environ.get("HOROVOD_ELASTIC_JOURNAL_DIR"))
+        # Flight-record dumps from culled/dead workers must survive the
+        # processes they describe: when journaling is on (and the
+        # operator didn't pick a dump dir), workers dump into the
+        # journal dir (docs/flightrec.md). Stored here, exported into
+        # every slot's env at spawn.
+        self.flightrec_dir = os.environ.get("HVD_FLIGHTREC_DIR")
+        if not self.flightrec_dir and journal_dir:
+            self.flightrec_dir = os.path.join(journal_dir, "flightrec")
+        if self.flightrec_dir:
+            # Created HERE: the native abort auto-dump may be the
+            # first writer and fopen does not mkdir.
+            try:
+                os.makedirs(self.flightrec_dir, exist_ok=True)
+            except OSError:
+                pass  # workers fall back to their cwd-relative dumps
         if journal_dir:
             self._attach_journal(journal_path(journal_dir))
 
@@ -322,6 +337,8 @@ class ElasticDriver:
             env["HOROVOD_SLOT_KEY"] = key
             env["HOROVOD_RENDEZVOUS_VERSION"] = str(self.version)
             env["HOROVOD_ELASTIC"] = "1"
+            if self.flightrec_dir:
+                env.setdefault("HVD_FLIGHTREC_DIR", self.flightrec_dir)
             # Fresh process: any heartbeat recorded for this slot key
             # belongs to a previous incarnation and would instantly
             # trip the liveness deadline during the new worker's
@@ -411,20 +428,46 @@ class ElasticDriver:
                                   "slots": sorted(decayed),
                                   "ts": now})
 
+    def _heartbeat_info(self, key: str) -> dict:
+        """The slot's last heartbeat payload (pid, rendezvous version,
+        commit count) — diagnostic fields for the journaled wedge
+        record; {} when it never beat or the payload is garbled."""
+        raw = self.rendezvous.get("heartbeat", key)
+        if raw is None:
+            return {}
+        try:
+            payload = json.loads(raw.decode())
+            if not isinstance(payload, dict):
+                return {}
+            return payload
+        except (ValueError, TypeError, AttributeError, UnicodeDecodeError):
+            # The KV is an open HTTP PUT endpoint: the payload may be
+            # arbitrary bytes — never let that take down the driver
+            # main loop.
+            return {}
+
     def _heartbeat_pid(self, key: str) -> Optional[int]:
         """The worker pid a slot last reported in its heartbeat payload
         (None when it never beat or the payload is garbled)."""
-        raw = self.rendezvous.get("heartbeat", key)
-        if raw is None:
-            return None
         try:
-            pid = int(json.loads(raw.decode()).get("pid", 0))
-        except (ValueError, TypeError, AttributeError, UnicodeDecodeError):
-            # The KV is an open HTTP PUT endpoint: the payload may be
-            # valid JSON without being an object with a numeric pid —
-            # never let that take down the driver main loop.
+            pid = int(self._heartbeat_info(key).get("pid", 0))
+        except (ValueError, TypeError):
             return None
         return pid if pid > 0 else None
+
+    def _slot_dump_path(self, rank: Optional[int]) -> Optional[str]:
+        """The flight-record dump a slot's worker left behind (the
+        SIGTERM handler or abort auto-dump wrote it into
+        ``flightrec_dir``), or None when no evidence was collected."""
+        if not self.flightrec_dir or rank is None:
+            return None
+        for source in ("python", "native"):
+            path = os.path.join(
+                self.flightrec_dir,
+                "flightrec.rank%d.%s.jsonl" % (rank, source))
+            if os.path.exists(path):
+                return path
+        return None
 
     def _wedged_slots(self, now: Optional[float] = None
                       ) -> List[Tuple[str, float]]:
@@ -455,24 +498,41 @@ class ElasticDriver:
                 "(HOROVOD_WORKER_LIVENESS_SEC=%.1f); replacing "
                 "(SIGTERM->SIGKILL)\n"
                 % (key, silent, self.liveness_sec))
+            # Last-heartbeat diagnostics BEFORE the kill wipes them:
+            # which process, at which rendezvous version, how far
+            # committed — the journaled wedge record is the structured
+            # answer to "why did this slot go" (log-only before).
+            hb = self._heartbeat_info(key)
+            pid = self._heartbeat_pid(key)
             proc = self.procs.pop(key)
+            rank = getattr(proc, "rank", None)
             if getattr(proc, "is_remote", False):
                 # terminate() below only kills the local ssh client's
                 # process group; the wedged process itself lives on the
                 # remote host, still holding its TPU. Kill it there by
                 # the pid its own heartbeats reported.
-                pid = self._heartbeat_pid(key)
                 if not proc.kill_remote(pid):
                     sys.stderr.write(
                         "elastic: could not confirm remote kill of "
                         "wedged worker %s (pid %s) — its host may need "
                         "manual cleanup before the slot is reusable\n"
                         % (key, pid))
+            # The SIGTERM->SIGKILL grace window doubles as the flight-
+            # record dump window: a worker that can still run its
+            # SIGTERM handler leaves its rings in flightrec_dir.
             proc.terminate(grace_sec=self.WEDGE_KILL_GRACE_SEC)
             self._hb_clear(key)
             self._record_slot_failure(key)
-            self._journal_append(
-                {"type": "wedged", "slot": key, "ts": time.time()})
+            record = {"type": "wedged", "slot": key,
+                      "silence_sec": round(silent, 3),
+                      "pid": pid,
+                      "version": hb.get("version"),
+                      "commits": hb.get("commits"),
+                      "ts": time.time()}
+            dump = self._slot_dump_path(rank)
+            if dump:
+                record["dump"] = dump
+            self._journal_append(record)
             replaced = True
         return replaced
 
@@ -507,10 +567,20 @@ class ElasticDriver:
                     if rc is None:
                         continue
                     proc.wait()
+                    rank = getattr(proc, "rank", None)
                     del self.procs[key]
                     self._hb_clear(key)
-                    self._journal_append({"type": "exit", "slot": key,
-                                          "rc": rc, "ts": time.time()})
+                    record = {"type": "exit", "slot": key,
+                              "rc": rc, "ts": time.time()}
+                    if rc != 0:
+                        # A worker that died on HorovodAbortedError
+                        # auto-dumped its rings; the exit record names
+                        # the evidence so the post-mortem starts from
+                        # the journal (docs/flightrec.md).
+                        dump = self._slot_dump_path(rank)
+                        if dump:
+                            record["dump"] = dump
+                    self._journal_append(record)
                     if rc == 0:
                         self.done[key] = True
                     else:

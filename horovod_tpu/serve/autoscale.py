@@ -81,7 +81,9 @@ class ReplicaMonitor:
                         "%.1fs (> HOROVOD_WORKER_LIVENESS_SEC=%.1fs); "
                         "culling from rotation", rid, age,
                         router.liveness_sec)
-                    router.cull(rid, reason="no heartbeat %.1fs" % age)
+                    router.cull(rid, reason="no heartbeat %.1fs" % age,
+                                silence_sec=age,
+                                dump=self._dump_path(rid))
                     _C_CULLED.inc()
         _G_REPLICAS.set(len(router.replicas()))
         now = time.monotonic()
@@ -93,6 +95,24 @@ class ReplicaMonitor:
                        / (now - self._last_ts))
         self._last_requests = done
         self._last_ts = now
+
+    def _dump_path(self, replica_id: str):
+        """The culled replica's flight-record dump, if it left one
+        behind under the journal dir's flightrec root (the server
+        spawns replicas with HVD_FLIGHTREC_DIR there; a replica that
+        died on an abort auto-dumped, one that merely wedged may not
+        have — the cull record then simply carries no dump path)."""
+        import os
+
+        root = getattr(self.router, "flightrec_root", None)
+        if not root:
+            return None
+        for source in ("python", "native"):
+            path = os.path.join(root, replica_id,
+                                "flightrec.rank0.%s.jsonl" % source)
+            if os.path.exists(path):
+                return path
+        return None
 
     def _run(self):
         while not self._stop.wait(self.interval):

@@ -313,12 +313,21 @@ class MicroBatcher:
             except Exception as e:  # analysis: allow-broad-except —
                 # the batch's failure belongs to its requests' futures,
                 # not to the batcher thread (which must keep serving).
+                from horovod_tpu.utils import flightrec
+
+                flightrec.record("serve_batch_error", rows=n,
+                                 requests=len(batch),
+                                 detail=str(e)[:200])
                 for req in batch:
                     if not req.future.cancelled():
                         req.future.set_exception(e)
                 continue
             _C_BATCHES.inc()
             _H_BATCH_SIZE.observe(n)
+            from horovod_tpu.utils import flightrec
+
+            flightrec.record("serve_batch", rows=n, bucket=bucket,
+                             requests=len(batch))
             off = 0
             for req in batch:
                 k = req.rows.shape[0]

@@ -121,6 +121,11 @@ class Router:
         self._requests_done = 0
         self._journal: Optional[DriverJournal] = None
         self._replayed = 0
+        # Where culled replicas' flight-record dumps land (the server
+        # spawns each replica with HVD_FLIGHTREC_DIR under this root);
+        # the monitor's cull record names the evidence.
+        self.flightrec_root = (os.path.join(journal_dir, "flightrec")
+                               if journal_dir else None)
         if journal_dir:
             path = serve_journal_path(journal_dir)
             replayed = replay_routing(path)
@@ -205,20 +210,36 @@ class Router:
                 self._order.append(replica_id)
             self._hb_seen.setdefault(replica_id, time.monotonic())
 
-    def cull(self, replica_id: str, reason: str = "silent"):
-        """Remove a replica from rotation (journaled first)."""
+    def cull(self, replica_id: str, reason: str = "silent",
+             silence_sec: Optional[float] = None,
+             dump: Optional[str] = None):
+        """Remove a replica from rotation (journaled first). The cull
+        record is structured evidence, not just a reason string: the
+        silence that triggered it, the pid the replica last reported,
+        and the flight-record dump path when one was collected
+        (docs/flightrec.md)."""
+        from horovod_tpu.utils import flightrec
+
         with self._lock:
             if replica_id not in self._table:
                 return
             if self._journal is not None:
-                self._journal.append({"type": "cull", "id": replica_id,
-                                      "reason": reason,
-                                      "ts": time.time()})
+                rec = {"type": "cull", "id": replica_id,
+                       "reason": reason,
+                       "pid": self._table[replica_id].get("pid"),
+                       "ts": time.time()}
+                if silence_sec is not None:
+                    rec["silence_sec"] = round(silence_sec, 3)
+                if dump:
+                    rec["dump"] = dump
+                self._journal.append(rec)
             self._table.pop(replica_id, None)
             if replica_id in self._order:
                 self._order.remove(replica_id)
             self._hb_seen.pop(replica_id, None)
             self._confirmed.discard(replica_id)
+        flightrec.record_failure("cull", "replica %s: %s"
+                                 % (replica_id, reason))
 
     def replicas(self) -> Dict[str, dict]:
         with self._lock:
@@ -302,6 +323,8 @@ class Router:
             info["heartbeat_age_sec"] = None if age is None \
                 else round(age, 3)
             info["confirmed"] = rid in confirmed
+        from horovod_tpu.utils import flightrec
+
         return self._json(200, {
             "ok": bool(table),
             "role": "router",
@@ -310,6 +333,10 @@ class Router:
             "liveness_sec": self.liveness_sec,
             "pid": os.getpid(),
             "port": self.port,
+            # Last N abort/wedge/cull reasons (docs/flightrec.md):
+            # "why did capacity drop" answered from the same endpoint
+            # that reports capacity.
+            "recent_failures": flightrec.recent_failures(),
         })
 
     # --- lifecycle ----------------------------------------------------------

@@ -89,6 +89,22 @@ class Server:
             cmd += ["--ckpt-dir", self.ckpt_dir]
         env = dict(os.environ)
         env.update(self.replica_env)
+        # Flight-record dumps outlive the replica: with a journal dir
+        # (and no operator-chosen dump dir) each replica dumps into its
+        # own <journal_dir>/flightrec/<replica-id>/ so evidence
+        # survives the process and the monitor's cull record can name
+        # it (serve/autoscale.py, docs/flightrec.md).
+        if self.journal_dir:
+            fr_dir = os.path.join(self.journal_dir, "flightrec",
+                                  "r%d" % index)
+            try:
+                # The replica's native abort auto-dump may be the
+                # first writer; fopen does not mkdir.
+                os.makedirs(fr_dir, exist_ok=True)
+            except OSError:
+                fr_dir = None
+            if fr_dir:
+                env.setdefault("HVD_FLIGHTREC_DIR", fr_dir)
         return subprocess.Popen(cmd, env=env)
 
     def start(self) -> int:

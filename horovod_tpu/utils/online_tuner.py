@@ -557,6 +557,9 @@ class OnlineTuner:
                       "from": current, "baseline": baseline,
                       "noise": sem, "threshold": threshold,
                       "sample": n_samples + 1})
+        from horovod_tpu.utils import flightrec
+
+        flightrec.record("tune_apply", values=dict(proposal))
         applied = self._apply_values(proposal)
         post, _post_sem = self._measure_window()
         if self._stop.is_set():
@@ -574,6 +577,8 @@ class OnlineTuner:
                    "applied": applied, "objective": post,
                    "threshold": threshold, "sample": n_samples + 1}
             self._record(rec)
+            flightrec.record("tune_revert", values=dict(restored),
+                             objective=post, threshold=threshold)
             _M_MOVES.labels(outcome="revert").inc()
         else:
             with self._lock:

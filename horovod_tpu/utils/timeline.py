@@ -76,29 +76,52 @@ class Timeline:
             if len(self._buf) >= self._FLUSH_EVERY:
                 self._flush_locked()
 
-    def begin(self, name: str, category: str):
-        self._write({"name": name, "cat": category, "ph": "B",
-                     "ts": self._now_us(), "pid": self._pid, "tid": category})
+    # ``pid`` overrides the event's process row: the merged multi-rank
+    # trace writer (tools/trace) reuses this class with one row per
+    # rank; in-process callers leave it None (this rank's row).
 
-    def end(self, name: str, category: str, args: Optional[dict] = None):
-        ev = {"name": name, "cat": category, "ph": "E",
-              "ts": self._now_us(), "pid": self._pid, "tid": category}
+    def begin(self, name: str, category: str,
+              args: Optional[dict] = None, pid: Optional[int] = None):
+        ev = {"name": name, "cat": category, "ph": "B",
+              "ts": self._now_us(),
+              "pid": self._pid if pid is None else pid, "tid": category}
         if args:
             ev["args"] = args
         self._write(ev)
 
-    def instant(self, name: str):
-        self._write({"name": name, "ph": "i", "ts": self._now_us(),
-                     "pid": self._pid, "s": "p"})
+    def end(self, name: str, category: str, args: Optional[dict] = None,
+            pid: Optional[int] = None):
+        ev = {"name": name, "cat": category, "ph": "E",
+              "ts": self._now_us(),
+              "pid": self._pid if pid is None else pid, "tid": category}
+        if args:
+            ev["args"] = args
+        self._write(ev)
 
-    def record_future(self, name: str, category: str, future):
-        """Span from now until the future resolves."""
-        self.begin(name, category)
+    def instant(self, name: str, pid: Optional[int] = None):
+        self._write({"name": name, "ph": "i", "ts": self._now_us(),
+                     "pid": self._pid if pid is None else pid, "s": "p"})
+
+    def write_raw(self, event: dict):
+        """Append one pre-built Chrome-trace event (tools/trace's
+        merged-trace path: events carry their own ts/pid/tid)."""
+        self._write(event)
+
+    def record_future(self, name: str, category: str, future,
+                      seq: Optional[int] = None):
+        """Span from now until the future resolves. ``seq`` is the
+        per-process-set collective sequence number (ops/eager.py
+        _next_seq), stamped on both edges so cross-rank tooling can
+        align this op with its flight-recorder events."""
+        self.begin(name, category,
+                   args=None if seq is None else {"seq": seq})
 
         def _done(f):
             err = f.exception()
-            self.end(name, category,
-                     args={"status": "error" if err else "ok"})
+            args = {"status": "error" if err else "ok"}
+            if seq is not None:
+                args["seq"] = seq
+            self.end(name, category, args=args)
 
         future.add_done_callback(_done)
 

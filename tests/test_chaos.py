@@ -192,6 +192,50 @@ def test_chaos_stall_injected():
     assert _counter(outputs, 0, "timeouts") >= 1, outputs[0]
 
 
+@pytest.mark.parametrize("np_,mode", [(2, "sigstop"), (3, "stall")])
+def test_chaos_forensics_names_culprit(tmp_path, np_, mode):
+    """End-to-end forensics proof (docs/flightrec.md): a wedged rank —
+    SIGSTOP at np=2, injected comm-layer stall at np=3 — leaves enough
+    evidence in the survivors' flight-record dumps for
+    ``python -m tools.trace`` to name the culprit rank AND the
+    in-flight doom tensor. The victim itself dumps nothing (it cannot
+    run); its absence plus the survivors' timeout/negotiation events
+    is exactly the attribution the recorder exists for."""
+    victim = np_ - 1
+    extra = {"HVD_FLIGHTREC_DIR": str(tmp_path)}
+    if mode == "stall":
+        extra.update(fault_env(victim, "stall", after_frames=100))
+    codes, outputs = _run_chaos(np_, mode, extra_env=extra)
+    survivors = [r for r in range(np_) if r != victim]
+    _assert_survivors_typed(codes, outputs, survivors)
+
+    from tools import trace
+
+    dumps = trace.load_dir(str(tmp_path))
+    # Every survivor auto-dumped on the typed abort; the victim left
+    # no dump (SIGSTOP/parked thread — no trigger could fire).
+    assert set(survivors) <= set(dumps), (sorted(dumps), outputs)
+    assert victim not in dumps, sorted(dumps)
+    trace.align(dumps)
+    diag = trace.diagnose(dumps, np_hint=np_)
+    assert diag["culprit_ranks"] == [victim], (diag, outputs)
+    # The in-flight tensor: the op the survivors died inside (failed/
+    # unclosed RESP), a tensor some rank never submitted, or an eager
+    # submit that never completed — whichever plane the wedge landed in.
+    named = {f["name"] for f in diag["in_flight"]}
+    named |= set(diag["stalled_tensors"])
+    named |= {p["name"] for p in diag["pending_submits"]}
+    assert any(n.startswith("doom") for n in named), (diag, outputs)
+    # The CLI agrees (the operator-facing surface of the same verdict).
+    import subprocess as sp
+
+    cli = sp.run([sys.executable, "-m", "tools.trace", str(tmp_path),
+                  "--np", str(np_)], cwd=_REPO, capture_output=True,
+                 text=True, timeout=60)
+    assert cli.returncode == 0, cli.stderr
+    assert "CULPRIT rank(s): [%d]" % victim in cli.stdout, cli.stdout
+
+
 def test_fault_injection_tsan_smoke():
     """One injected failure under ThreadSanitizer: the abort/timeout
     paths (poll deadline, cascade, status propagation) must be

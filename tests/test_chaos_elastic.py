@@ -148,6 +148,7 @@ def test_driver_kill9_journal_resume(tmp_path):
 def test_sigstop_worker_replaced_by_liveness(tmp_path):
     liveness = 6.0
     discovery = _static_discovery(tmp_path)
+    journal_dir = str(tmp_path / "journal")
     log_dir = tmp_path / "logs"
     log_dir.mkdir()
     env = _base_env(
@@ -160,9 +161,22 @@ def test_sigstop_worker_replaced_by_liveness(tmp_path):
         # monitor, far before the comm deadline could fire.
         HOROVOD_COMM_TIMEOUT_SEC="120")
     proc = subprocess.run(
-        _driver_cmd(discovery), cwd=_REPO, env=env, capture_output=True,
-        text=True, timeout=420)
+        _driver_cmd(discovery, journal_dir=journal_dir), cwd=_REPO,
+        env=env, capture_output=True, text=True, timeout=420)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # The wedge-cull reason is journaled as STRUCTURED evidence, not
+    # just a log line (docs/flightrec.md): slot, silence seconds, the
+    # last-heartbeat pid/version bookkeeping fields.
+    journal_file = os.path.join(journal_dir, "driver_journal.jsonl")
+    wedged = [r for r in map(json.loads, open(journal_file))
+              if r.get("type") == "wedged"]
+    assert wedged, "no wedged record journaled"
+    rec = wedged[0]
+    assert rec["slot"].endswith(":1"), rec
+    assert rec["silence_sec"] > liveness, rec
+    assert isinstance(rec["pid"], int) and rec["pid"] > 0, rec
+    assert "version" in rec and "commits" in rec, rec
 
     # The wedge actually happened and the liveness monitor (not a
     # worker exit) replaced it.

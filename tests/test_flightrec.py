@@ -1,0 +1,366 @@
+"""Tier-1 flight-recorder units (docs/flightrec.md).
+
+Fast, fleet-free coverage of the forensics pipeline: ring wraparound
+(python and native), torn/partial dump tolerance (the PR 5 journal
+discipline applied to dumps), clock alignment across ranks, and
+``tools.trace`` diagnosis over synthetic multi-rank fixtures. The real
+np>=2 chaos proof lives in tests/test_chaos.py (tier 2).
+"""
+
+import ctypes
+import json
+import os
+
+import pytest
+
+from horovod_tpu.utils.flightrec import FlightRecorder
+from tools import trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# FrKind ids (core/src/flightrec.h — stable, append-only).
+NEG_READY, RESP_BEGIN, RESP_END, TIMEOUT = 1, 3, 4, 7
+
+
+# --- python ring -------------------------------------------------------------
+
+def test_python_ring_wraparound():
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("submit", name="t%d" % i, seq=i)
+    snap = rec.snapshot()
+    assert snap["head"] == 20
+    assert snap["dropped"] == 12  # 20 - capacity
+    names = [e["name"] for e in snap["events"]]
+    assert names == ["t%d" % i for i in range(12, 20)]  # newest window
+    ts = [e["ts_us"] for e in snap["events"]]
+    assert ts == sorted(ts)
+
+
+def test_python_dump_roundtrip(tmp_path):
+    rec = FlightRecorder(capacity=16)
+    rec.record("submit", name="x", seq=0)
+    rec.record("complete", name="x", seq=0)
+    path = str(tmp_path / "d.jsonl")
+    assert rec.dump(path, rank=3, reason="unit") == 2
+    dump = trace.load_dump(path)
+    assert dump["header"]["rank"] == 3
+    assert dump["header"]["source"] == "python"
+    assert [e["kind"] for e in dump["events"]] == ["submit", "complete"]
+
+
+def test_record_disabled_by_knob(monkeypatch):
+    from horovod_tpu.utils import flightrec
+
+    monkeypatch.setenv("HVD_FLIGHTREC", "0")
+    before = flightrec.recorder().stats()["events_total"]
+    flightrec.record("submit", name="nope")
+    assert flightrec.recorder().stats()["events_total"] == before
+    assert flightrec.dump(reason="disabled") == {}
+
+
+# --- native ring (ctypes, no mesh needed) ------------------------------------
+
+@pytest.fixture(scope="module")
+def lib():
+    from horovod_tpu.core.build import library_path
+
+    lib = ctypes.CDLL(library_path(build_if_missing=True))
+    lib.hvd_flightrec_record.restype = None
+    lib.hvd_flightrec_record.argtypes = [
+        ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_char_p]
+    lib.hvd_flightrec_reset.restype = None
+    lib.hvd_flightrec_reset.argtypes = [ctypes.c_longlong]
+    lib.hvd_core_flightrec_dump.restype = ctypes.c_int
+    lib.hvd_core_flightrec_dump.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+def test_native_ring_wraparound_and_dump(lib, tmp_path):
+    # Capacity clamps to the 64-slot floor; overfill by 10.
+    lib.hvd_flightrec_reset(64)
+    for i in range(74):
+        lib.hvd_flightrec_record(RESP_BEGIN, i, 1, 4096, b"t%d" % i)
+    path = str(tmp_path / "native.jsonl")
+    n = lib.hvd_core_flightrec_dump(path.encode())
+    assert n == 64  # exactly the ring window survives
+    dump = trace.load_dump(path)
+    assert dump["header"]["source"] == "native"
+    assert dump["header"]["events_total"] == 74
+    assert dump["header"]["dropped"] == 10
+    names = [e["name"] for e in dump["events"]]
+    assert names == ["t%d" % i for i in range(10, 74)]
+    ts = [e["ts_us"] for e in dump["events"]]
+    assert ts == sorted(ts)
+
+
+def test_native_dump_escapes_and_truncates_names(lib, tmp_path):
+    lib.hvd_flightrec_reset(64)
+    lib.hvd_flightrec_record(TIMEOUT, 1, -1, 0, b'we"ird\\name')
+    lib.hvd_flightrec_record(TIMEOUT, 1, -1, 0, b"x" * 200)
+    path = str(tmp_path / "esc.jsonl")
+    assert lib.hvd_core_flightrec_dump(path.encode()) == 2
+    dump = trace.load_dump(path)
+    assert dump["events"][0]["name"] == 'we"ird\\name'
+    assert dump["events"][1]["name"] == "x" * 63  # 64-byte slot, NUL kept
+
+
+def test_native_dump_invalid_path(lib):
+    assert lib.hvd_core_flightrec_dump(b"/nonexistent-dir/x.jsonl") == -1
+
+
+# --- dump loading ------------------------------------------------------------
+
+def _header(rank, wall_ts=100.0, mono_us=0, source="native"):
+    return {"flightrec": 1, "source": source, "rank": rank, "pid": 1,
+            "wall_ts": wall_ts, "mono_us": mono_us, "events_total": 0,
+            "dropped": 0}
+
+
+def _write(path, header, events, torn_tail=""):
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        if torn_tail:
+            f.write(torn_tail)
+
+
+def test_load_dump_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    events = [{"ts_us": i, "kind": "ENQUEUE", "name": "t"}
+              for i in range(3)]
+    _write(path, _header(0), events, torn_tail='{"ts_us": 99, "ki')
+    dump = trace.load_dump(path)
+    assert len(dump["events"]) == 3  # the torn line is dropped, rest kept
+
+
+def test_load_dump_rejects_garbage(tmp_path):
+    path = str(tmp_path / "garbage.jsonl")
+    with open(path, "w") as f:
+        f.write("not json at all\n")
+    assert trace.load_dump(path) is None
+    assert trace.load_dump(str(tmp_path / "missing.jsonl")) is None
+
+
+def test_load_dir_finds_nested_dumps(tmp_path):
+    d = tmp_path / "sub" / "r1"
+    d.mkdir(parents=True)
+    _write(str(tmp_path / "flightrec.rank0.native.jsonl"), _header(0), [])
+    _write(str(d / "flightrec.rank1.python.jsonl"),
+           _header(1, source="python"), [])
+    dumps = trace.load_dir(str(tmp_path))
+    assert sorted(dumps) == [0, 1]
+    assert "native" in dumps[0] and "python" in dumps[1]
+
+
+# --- clock alignment ---------------------------------------------------------
+
+def test_align_maps_ranks_onto_one_wall_axis(tmp_path):
+    # Rank 0 dumped at wall 100.0 with its monotonic clock at 50s;
+    # rank 1 dumped at wall 101.0 with its clock at 10s. An event at
+    # rank0 ts=49s and one at rank1 ts=9.5s are 0.5s apart on the wall.
+    p0 = str(tmp_path / "flightrec.rank0.native.jsonl")
+    p1 = str(tmp_path / "flightrec.rank1.native.jsonl")
+    _write(p0, _header(0, wall_ts=100.0, mono_us=50_000_000),
+           [{"ts_us": 49_000_000, "kind": "ENQUEUE", "name": "a"}])
+    _write(p1, _header(1, wall_ts=101.0, mono_us=10_000_000),
+           [{"ts_us": 9_500_000, "kind": "ENQUEUE", "name": "b"}])
+    dumps = trace.load_dir(str(tmp_path))
+    trace.align(dumps)
+    ev0 = dumps[0]["native"]["events"][0]
+    ev1 = dumps[1]["native"]["events"][0]
+    # rank0 event wall = 100 - 1 = 99.0; rank1 event wall = 101 - 0.5
+    # = 100.5; origin = min(50, 91) = 50 -> abs in us relative to it.
+    assert ev1["abs_us"] - ev0["abs_us"] == pytest.approx(1_500_000)
+
+
+def test_align_offset_overrides(tmp_path):
+    p0 = str(tmp_path / "flightrec.rank0.native.jsonl")
+    p1 = str(tmp_path / "flightrec.rank1.native.jsonl")
+    _write(p0, _header(0, wall_ts=100.0, mono_us=0),
+           [{"ts_us": 0, "kind": "ENQUEUE", "name": "a"}])
+    _write(p1, _header(1, wall_ts=100.0, mono_us=0),
+           [{"ts_us": 0, "kind": "ENQUEUE", "name": "b"}])
+    dumps = trace.load_dir(str(tmp_path))
+    trace.align(dumps, offsets={1: 2.0})  # rank 1's clock is 2s behind
+    assert (dumps[1]["native"]["events"][0]["abs_us"]
+            - dumps[0]["native"]["events"][0]["abs_us"]) \
+        == pytest.approx(2_000_000)
+
+
+# --- diagnosis ---------------------------------------------------------------
+
+def _native_ev(kind, a=0, b=0, c=0, name="", ps=0, seq=-1, ts=0):
+    return {"ts_us": ts, "kind": kind, "ps": ps, "seq": seq,
+            "a": a, "b": b, "c": c, "name": name}
+
+
+def _diagnose(tmp_path, per_rank, np_hint=None):
+    for rank, events in per_rank.items():
+        _write(str(tmp_path / ("flightrec.rank%d.native.jsonl" % rank)),
+               _header(rank), events)
+    dumps = trace.load_dir(str(tmp_path))
+    trace.align(dumps)
+    return trace.diagnose(dumps, np_hint=np_hint)
+
+
+def test_diagnosis_timeout_names_culprit(tmp_path):
+    diag = _diagnose(tmp_path, {
+        0: [_native_ev("RESP_BEGIN", a=0, b=1, c=64, name="doom.3",
+                       seq=41),
+            _native_ev("TIMEOUT", a=2, b=-1, c=64, name="duplex",
+                       seq=41, ts=10)],
+        1: [_native_ev("TIMEOUT", a=-1, b=2, c=64, name="duplex",
+                       seq=41, ts=11)],
+    }, np_hint=3)
+    assert diag["culprit_ranks"] == [2]
+    assert diag["culprit_basis"] == "timeout_peers"
+    assert diag["missing_ranks"] == [2]
+    assert diag["in_flight"][0]["name"] == "doom.3"
+    assert diag["first_divergent_seq"] == {0: 41}
+
+
+def test_diagnosis_stalled_tensor_names_silent_rank(tmp_path):
+    # Coordinator saw ranks 0 and 1 announce grad.7; rank 2 never did.
+    diag = _diagnose(tmp_path, {
+        0: [_native_ev("NEG_READY", a=0, name="grad.7"),
+            _native_ev("NEG_READY", a=1, name="grad.7", ts=1)],
+        1: [],
+        2: [],
+    })
+    assert diag["world_size"] == 3
+    assert diag["stalled_tensors"]["grad.7"]["missing_ranks"] == [2]
+    assert diag["culprit_ranks"] == [2]
+    assert diag["culprit_basis"] == "stalled_tensors"
+
+
+def test_diagnosis_negotiated_tensor_not_stalled(tmp_path):
+    # A tensor that reached NEG_END is complete negotiation-wise.
+    diag = _diagnose(tmp_path, {
+        0: [_native_ev("NEG_READY", a=0, name="ok.1"),
+            _native_ev("NEG_READY", a=1, name="ok.1", ts=1),
+            _native_ev("NEG_END", name="ok.1", ts=2)],
+        1: [],
+    })
+    assert diag["stalled_tensors"] == {}
+    assert diag["culprit_ranks"] == []
+
+
+def test_diagnosis_missing_dump_and_seq_divergence(tmp_path):
+    # No timeouts, no stalled tensors: rank 2 left no dump at all.
+    diag = _diagnose(tmp_path, {
+        0: [_native_ev("RESP_BEGIN", name="s", seq=7),
+            _native_ev("RESP_END", name="s", seq=7, ts=1)],
+        1: [_native_ev("RESP_BEGIN", name="s", seq=7),
+            _native_ev("RESP_END", name="s", seq=7, ts=1)],
+    }, np_hint=3)
+    assert diag["culprit_ranks"] == [2]
+    assert diag["culprit_basis"] == "missing_dumps"
+
+    # Seq divergence among dumping ranks: rank 1 stopped at seq 5.
+    diag2 = _diagnose(tmp_path, {
+        0: [_native_ev("RESP_BEGIN", name="s", seq=6),
+            _native_ev("RESP_END", seq=6, ts=1)],
+        1: [_native_ev("RESP_BEGIN", name="s", seq=5),
+            _native_ev("RESP_END", seq=5, ts=1)],
+    }, np_hint=2)
+    assert diag2["culprit_ranks"] == [1]
+    assert diag2["culprit_basis"] == "lowest_seq"
+    assert diag2["first_divergent_seq"] == {0: 6}
+
+
+def test_render_diagnosis_mentions_culprit(tmp_path):
+    diag = _diagnose(tmp_path, {
+        0: [_native_ev("TIMEOUT", a=1, b=-1, name="duplex")],
+    }, np_hint=2)
+    text = trace.render_diagnosis(diag)
+    assert "CULPRIT rank(s): [1]" in text
+    assert "timeout_peers" in text
+
+
+def test_merged_chrome_trace(tmp_path):
+    for rank in (0, 1):
+        _write(str(tmp_path / ("flightrec.rank%d.native.jsonl" % rank)),
+               _header(rank),
+               [_native_ev("RESP_BEGIN", name="g", seq=3, c=256),
+                _native_ev("RESP_END", seq=3, ts=50),
+                _native_ev("TIMEOUT", a=1, b=-1, name="duplex", ts=60)])
+    dumps = trace.load_dir(str(tmp_path))
+    trace.align(dumps)
+    out = str(tmp_path / "merged.json")
+    n = trace.write_chrome_trace(dumps, out)
+    assert n > 0
+    text = open(out).read().rstrip().rstrip(",").rstrip()
+    if not text.endswith("]"):
+        text += "]"
+    events = json.loads(text)
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}  # one row per rank
+    assert all(e["args"]["seq"] == 3 for e in spans)
+
+
+def test_trace_cli_main(tmp_path, capsys):
+    from tools.trace.__main__ import main
+
+    _write(str(tmp_path / "flightrec.rank0.native.jsonl"), _header(0),
+           [_native_ev("TIMEOUT", a=1, b=-1, name="duplex")])
+    out_trace = str(tmp_path / "merged.json")
+    assert main([str(tmp_path), "--np", "2", "--trace", out_trace]) == 0
+    captured = capsys.readouterr()
+    assert "CULPRIT rank(s): [1]" in captured.out
+    assert os.path.exists(out_trace)
+    assert main([str(tmp_path), "--json"]) == 0
+    diag = json.loads(capsys.readouterr().out)
+    assert diag["culprit_ranks"] == [1]
+    assert main([str(tmp_path / "empty-subdir-nope")]) == 2
+
+
+# --- process-level plumbing --------------------------------------------------
+
+def test_recent_failures_in_snapshot_and_bounded():
+    from horovod_tpu.common import basics
+    from horovod_tpu.utils import flightrec
+
+    for i in range(25):
+        flightrec.record_failure("unit_test", "reason %d" % i)
+    recent = flightrec.recent_failures()
+    assert len(recent) == 16  # bounded
+    assert recent[-1]["detail"] == "reason 24"
+    snap = basics.metrics_snapshot()
+    assert snap["hvd_recent_failures"]["type"] == "info"
+    assert snap["hvd_recent_failures"]["values"][-1]["detail"] \
+        == "reason 24"
+
+
+def test_dump_on_abort_rate_limited(tmp_path, monkeypatch):
+    from horovod_tpu.utils import flightrec
+
+    monkeypatch.setenv("HVD_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "_last_abort_dump", [0.0])
+    first = flightrec.dump_on_abort("unit abort")
+    assert "python" in first
+    # Immediately after: suppressed (one coherent dump per storm).
+    assert flightrec.dump_on_abort("unit abort again") == {}
+
+
+def test_debug_flightrec_route(tmp_path, monkeypatch):
+    import http.client
+
+    from horovod_tpu.common import basics
+
+    monkeypatch.setenv("HVD_FLIGHTREC_DIR", str(tmp_path))
+    port = basics.start_metrics_server(0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/debug/flightrec")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read().decode())
+        conn.close()
+        assert resp.status == 200
+        assert doc["enabled"] is True
+        assert doc["dumped"]["python"].startswith(str(tmp_path))
+        assert os.path.exists(doc["dumped"]["python"])
+    finally:
+        basics.stop_metrics_server()

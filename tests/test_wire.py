@@ -118,6 +118,16 @@ def _run_equality(np_, extra_env):
     codes, outputs = _launch(np_, _WORKER, extra_env=extra_env, timeout=180)
     assert codes == [0] * np_, "\n".join(outputs)
     assert sum("WIRE_EQ_OK" in o for o in outputs) == np_
+    # Collective-sequence pin (docs/flightrec.md): every rank's native
+    # flight record must report the SAME highest executed seq — the
+    # agreement tools/trace's cross-rank divergence detection relies on.
+    seqs = []
+    for out in outputs:
+        for line in out.splitlines():
+            if line.startswith("WIRE_EQ_SEQ "):
+                seqs.append(int(line.split()[1]))
+    assert len(seqs) == np_, "\n".join(outputs)
+    assert len(set(seqs)) == 1 and seqs[0] > 0, seqs
     return _eq_counters(outputs)
 
 

@@ -95,6 +95,26 @@ def main():
     assert depths["TCP_ALLREDUCE"] == 1
     assert names.index("QUEUE") < names.index("TCP_ALLREDUCE")
 
+    # --- collective sequence numbers (docs/flightrec.md) ---
+    # The loop-row op events carry args.seq — the cross-rank execution
+    # sequence the flight recorder indexes by. Strictly increasing on
+    # this rank, and present for every executed op. (This used to be
+    # dropped entirely; tools/trace needs it for divergence detection.)
+    loop_ops = [e for e in events
+                if e.get("tid") == 0 and e.get("ph") == "X"
+                and e.get("cat") in ("ALLREDUCE", "BARRIER")]
+    op_seqs = [e.get("args", {}).get("seq") for e in loop_ops]
+    assert op_seqs and all(s is not None for s in op_seqs), loop_ops
+    assert op_seqs == sorted(op_seqs), op_seqs
+
+    # The eager (python) timeline stamps the per-process-set submit
+    # seq on both span edges.
+    py_events = load_trace(path)
+    py_spans = [e for e in py_events
+                if e.get("cat") == "allreduce" and e.get("ph") in "BE"]
+    py_seqs = {e.get("args", {}).get("seq") for e in py_spans}
+    assert py_spans and py_seqs - {None}, py_events
+
     # --- cycle marks on the loop row when the knob is set ---
     if os.environ.get("HOROVOD_TIMELINE_MARK_CYCLES", "") not in ("", "0"):
         marks = [e for e in events

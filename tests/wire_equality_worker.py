@@ -116,6 +116,28 @@ def main():
             {k: counters[k] for k in ("tx_bytes", "rx_bytes",
                                       "ring_subchunk_steps",
                                       "fused_tensors")}))
+
+    # Pin the cross-rank collective sequence number (docs/flightrec.md):
+    # every rank dumps its native flight-recorder ring and reports the
+    # highest executed seq — the test asserts they agree, which is the
+    # property tools/trace's divergence detection stands on.
+    import tempfile
+
+    fr_path = os.path.join(
+        tempfile.gettempdir(),
+        "wire_eq_flightrec_r%d_pid%d.jsonl" % (r, os.getpid()))
+    assert session.dump_flight_record(fr_path), "native dump failed"
+    max_seq = -1
+    with open(fr_path) as f:
+        header = json.loads(f.readline())
+        assert header.get("flightrec") == 1 and header["rank"] == r
+        for line in f:
+            rec = json.loads(line)
+            if rec["kind"] == "RESP_BEGIN":
+                max_seq = max(max_seq, rec["seq"])
+    os.unlink(fr_path)
+    print("WIRE_EQ_SEQ %d" % max_seq)
+
     session.shutdown()
     print("WIRE_EQ_OK rank %d" % r)
     return 0

@@ -32,6 +32,7 @@ DEFAULT_ALLOWLIST: Dict[str, str] = {
     "HVD_BENCH_TPU_RETRIES": "bench.py harness: TPU-claim retry count",
     "HVD_BENCH_TPU_BACKOFF": "bench.py harness: TPU-claim retry backoff",
     "HVD_CI_METRICS_BUDGET": "ci/run_tests.sh lane budget",
+    "HVD_CI_FLIGHTREC_BUDGET": "ci/run_tests.sh lane budget",
     "HVD_CI_TIER1_BUDGET": "ci/run_tests.sh lane budget",
     "HVD_CI_TIER2_BUDGET": "ci/run_tests.sh lane budget",
     "HVD_CI_ANALYSIS_BUDGET": "ci/run_tests.sh lane budget",

@@ -1,0 +1,426 @@
+"""Cross-rank flight-record forensics (``hvd-trace``).
+
+Merges the per-rank JSONL dumps the flight recorder leaves behind
+(``flightrec.rank<R>.{python,native}.jsonl`` — written on abort, on a
+wedge-cull's SIGTERM, or on demand; docs/flightrec.md) and answers the
+question the reference's stall inspector answers live, but post-hoc and
+across ranks at once (reference: horovod/common/stall_inspector.cc
+warning text "ranks that submitted / ranks that did not"):
+
+- which rank is the straggler/culprit,
+- the first divergent collective sequence number,
+- which tensors were negotiated but never submitted, per rank,
+- what was in flight when the world died.
+
+Everything here is pure parsing over the dumps — no jax, no live job —
+so the module is importable anywhere (the tier-1 tests feed it
+synthetic fixtures).
+
+Entry points: ``load_dump`` / ``load_dir`` (torn-tail tolerant),
+``align`` (wall/monotonic clock pairing from the dump headers),
+``diagnose`` (the verdict dict), ``write_chrome_trace`` (one merged
+Perfetto file, one process row per rank, reusing
+``horovod_tpu.utils.timeline.Timeline`` as the writer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import Counter, defaultdict
+from typing import Dict, List, Optional
+
+_DUMP_RE = re.compile(r"flightrec\.rank(\d+)\.(python|native)\.jsonl$")
+
+# Native status-type names for ABORT/RESP_END events
+# (core/src/common.h StatusType).
+_STATUS_NAMES = {0: "OK", 1: "UNKNOWN_ERROR", 2: "PRECONDITION_ERROR",
+                 3: "ABORTED", 4: "INVALID_ARGUMENT", 5: "IN_PROGRESS",
+                 6: "TIMED_OUT"}
+
+
+def load_dump(path: str) -> Optional[dict]:
+    """Parse one dump: ``{"header": {...}, "events": [...]}``. A torn
+    tail (the process died mid-write) truncates at the last complete
+    line — the PR 5 journal-read discipline; a missing/empty/garbled
+    file returns None instead of raising, because a post-mortem tool
+    must degrade to "less evidence", never to a crash."""
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    header = None
+    events: List[dict] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            break  # torn tail: everything before it is still evidence
+        if not isinstance(rec, dict):
+            break
+        if header is None:
+            if rec.get("flightrec") != 1:
+                return None
+            header = rec
+        else:
+            events.append(rec)
+    if header is None:
+        return None
+    return {"header": header, "events": events}
+
+
+def load_dir(directory: str) -> Dict[int, Dict[str, dict]]:
+    """All rank dumps under ``directory`` (recursive — the serve
+    layout nests per-replica subdirs): ``{rank: {source: dump}}``."""
+    out: Dict[int, Dict[str, dict]] = defaultdict(dict)
+    for dirpath, _subdirs, files in os.walk(directory):
+        for fn in sorted(files):
+            m = _DUMP_RE.search(fn)
+            if not m:
+                continue
+            dump = load_dump(os.path.join(dirpath, fn))
+            if dump is None:
+                continue
+            rank = int(m.group(1))
+            hdr_rank = dump["header"].get("rank", -1)
+            if isinstance(hdr_rank, int) and hdr_rank >= 0:
+                rank = hdr_rank
+            out[rank][m.group(2)] = dump
+    return dict(out)
+
+
+def align(dumps: Dict[int, Dict[str, dict]],
+          offsets: Optional[Dict[int, float]] = None) -> None:
+    """Stamp every event with ``abs_us`` — microseconds on a shared
+    wall-clock axis. Each dump header carries the (wall_ts, mono_us)
+    pair sampled at dump time, so an event's wall time is
+    ``wall_ts - (mono_us - ts_us)/1e6``; the earliest origin across
+    dumps becomes 0. ``offsets`` adds per-rank skew corrections in
+    seconds (e.g. derived from heartbeat arrival deltas) for multi-host
+    jobs whose wall clocks disagree. Mutates the dumps in place."""
+    offsets = offsets or {}
+    origins = []
+    for rank, sources in dumps.items():
+        for dump in sources.values():
+            h = dump["header"]
+            origin = (float(h.get("wall_ts", 0.0))
+                      - float(h.get("mono_us", 0)) / 1e6
+                      + float(offsets.get(rank, 0.0)))
+            dump["_origin_wall"] = origin
+            origins.append(origin)
+    if not origins:
+        return
+    t0 = min(origins)
+    for sources in dumps.values():
+        for dump in sources.values():
+            base_us = (dump["_origin_wall"] - t0) * 1e6
+            for ev in dump["events"]:
+                ev["abs_us"] = base_us + float(ev.get("ts_us", 0))
+
+
+def _world_size(dumps: Dict[int, Dict[str, dict]],
+                np_hint: Optional[int] = None) -> int:
+    """Ranks in the world: an explicit hint wins; otherwise the max of
+    every rank seen in headers and NEG_READY announcements + 1 — a
+    rank that died without dumping still shows up through the
+    coordinator's view of its requests."""
+    if np_hint:
+        return int(np_hint)
+    top = max(dumps) if dumps else 0
+    for sources in dumps.values():
+        for dump in sources.values():
+            for ev in dump["events"]:
+                if ev.get("kind") == "NEG_READY":
+                    top = max(top, int(ev.get("a", -1)))
+    return top + 1
+
+
+def diagnose(dumps: Dict[int, Dict[str, dict]],
+             np_hint: Optional[int] = None) -> dict:
+    """The forensic verdict over a set of per-rank dumps.
+
+    Evidence, strongest first:
+
+    1. ``TIMEOUT`` events name the peer a duplex ring transfer was
+       blocked on — direct straggler attribution from a survivor.
+    2. Ranks with no dump at all (died before any trigger could fire —
+       SIGKILL, SIGSTOP) are suspects by absence.
+    3. Per-tensor negotiation: a tensor some ranks announced
+       (``NEG_READY`` on the coordinator) but others never did is the
+       reference stall-inspector check run post-hoc; the silent ranks
+       are culprits and the tensor is the one in flight.
+    4. The collective sequence axis: the first seq not executed by
+       every rank (``RESP_BEGIN`` per process set), and any
+       ``RESP_BEGIN`` without its ``RESP_END`` — the op the world died
+       inside.
+    """
+    world = _world_size(dumps, np_hint)
+    missing_ranks = sorted(set(range(world)) - set(dumps))
+
+    timeout_peers: Counter = Counter()
+    aborts: List[dict] = []
+    # Per process set: rank -> max RESP_BEGIN seq; plus unclosed RESP.
+    max_seq: Dict[int, Dict[int, int]] = defaultdict(dict)
+    in_flight: List[dict] = []
+    # Tensor negotiation view (coordinator dumps): name -> ready ranks.
+    ready_by_tensor: Dict[str, set] = defaultdict(set)
+    negotiated_done: set = set()
+    negotiation_seen: set = set()
+
+    # Eager ops submitted but never completed (python ring: a `submit`
+    # with no matching `complete`/`error`) — the enqueue-side view of
+    # "in flight", which survives even when the failure hit before the
+    # native negotiation ever saw the tensor.
+    pending_submits: List[dict] = []
+
+    for rank, sources in sorted(dumps.items()):
+        python = sources.get("python")
+        if python is not None:
+            open_sub: Dict[tuple, dict] = {}
+            for ev in python["events"]:
+                kind = ev.get("kind")
+                key = (ev.get("ps", 0), ev.get("name", ""),
+                       ev.get("seq", -1))
+                if kind == "submit":
+                    open_sub[key] = ev
+                elif kind in ("complete", "error"):
+                    open_sub.pop(key, None)
+            for (ps, name, seq), ev in sorted(open_sub.items(),
+                                              key=lambda kv: kv[0][2]):
+                pending_submits.append({"rank": rank, "ps": ps,
+                                        "name": name, "seq": seq,
+                                        "op": ev.get("op")})
+        native = sources.get("native")
+        if native is None:
+            continue
+        open_resp: Dict[int, dict] = {}
+        for ev in native["events"]:
+            kind = ev.get("kind")
+            if kind == "TIMEOUT":
+                for peer in (ev.get("a", -1), ev.get("b", -1)):
+                    if isinstance(peer, int) and peer >= 0:
+                        timeout_peers[peer] += 1
+            elif kind == "ABORT":
+                aborts.append({
+                    "rank": rank,
+                    "status": _STATUS_NAMES.get(ev.get("a"),
+                                                str(ev.get("a"))),
+                    "reason": ev.get("name", ""),
+                    "abs_us": ev.get("abs_us"),
+                })
+            elif kind == "RESP_BEGIN":
+                ps, seq = int(ev.get("ps", 0)), int(ev.get("seq", -1))
+                if seq >= 0:
+                    prev = max_seq[ps].get(rank, -1)
+                    max_seq[ps][rank] = max(prev, seq)
+                    open_resp[ps] = ev
+            elif kind == "RESP_END":
+                begin = open_resp.pop(int(ev.get("ps", 0)), None)
+                status = ev.get("a", 0)
+                if begin is not None and status not in (0, None):
+                    # A response that ENDED with a non-OK status is the
+                    # op the world died inside — the background loop
+                    # records the failed end before it dumps.
+                    in_flight.append({
+                        "rank": rank, "ps": int(begin.get("ps", 0)),
+                        "seq": int(begin.get("seq", -1)),
+                        "name": begin.get("name", ""),
+                        "op": begin.get("a"),
+                        "status": _STATUS_NAMES.get(status, str(status)),
+                    })
+            elif kind == "NEG_READY":
+                name = ev.get("name", "")
+                peer = ev.get("a", -1)
+                if name and isinstance(peer, int) and peer >= 0:
+                    ready_by_tensor[name].add(peer)
+                    negotiation_seen.add(name)
+            elif kind == "NEG_START":
+                if ev.get("name"):
+                    negotiation_seen.add(ev["name"])
+            elif kind == "NEG_END":
+                if ev.get("name"):
+                    negotiated_done.add(ev["name"])
+        for ps, ev in open_resp.items():
+            in_flight.append({"rank": rank, "ps": ps,
+                              "seq": int(ev.get("seq", -1)),
+                              "name": ev.get("name", ""),
+                              "op": ev.get("a")})
+
+    # Stalled tensors: announced by some member ranks, never by others,
+    # and never emitted in a response (the post-hoc stall check).
+    stalled_tensors = {}
+    for name in sorted(negotiation_seen - negotiated_done):
+        ready = sorted(ready_by_tensor.get(name, set()))
+        if not ready:
+            continue  # only a worker-side NEG_START: no rank attribution
+        missing = sorted(set(range(world)) - set(ready))
+        if missing:
+            stalled_tensors[name] = {"ready_ranks": ready,
+                                     "missing_ranks": missing}
+
+    # First divergent collective seq per process set: the smallest seq
+    # not executed by every rank that dumped. Divergence also counts a
+    # rank whose dump exists but never reached the others' max.
+    first_divergent = {}
+    for ps, per_rank in sorted(max_seq.items()):
+        if not per_rank:
+            continue
+        lo, hi = min(per_rank.values()), max(per_rank.values())
+        if lo != hi:
+            first_divergent[ps] = lo + 1
+        elif in_flight:
+            stuck = [f for f in in_flight if f["ps"] == ps]
+            if stuck:
+                first_divergent[ps] = min(f["seq"] for f in stuck)
+
+    # Culprit ranking: timeout-named peers > stalled-tensor silence >
+    # absence > lowest executed seq.
+    culprits: List[int] = []
+    basis = None
+    if timeout_peers:
+        top = max(timeout_peers.values())
+        culprits = sorted(r for r, n in timeout_peers.items() if n == top)
+        basis = "timeout_peers"
+    elif stalled_tensors:
+        miss: Counter = Counter()
+        for info in stalled_tensors.values():
+            miss.update(info["missing_ranks"])
+        top = max(miss.values())
+        culprits = sorted(r for r, n in miss.items() if n == top)
+        basis = "stalled_tensors"
+    elif missing_ranks:
+        culprits = missing_ranks
+        basis = "missing_dumps"
+    else:
+        for ps, per_rank in sorted(max_seq.items()):
+            lo, hi = min(per_rank.values()), max(per_rank.values())
+            if lo != hi:
+                culprits = sorted(r for r, v in per_rank.items()
+                                  if v == lo)
+                basis = "lowest_seq"
+                break
+
+    return {
+        "world_size": world,
+        "ranks_with_dumps": sorted(dumps),
+        "missing_ranks": missing_ranks,
+        "culprit_ranks": culprits,
+        "culprit_basis": basis,
+        "timeout_peers": dict(timeout_peers),
+        "aborts": aborts,
+        "first_divergent_seq": first_divergent,
+        "in_flight": sorted(in_flight,
+                            key=lambda f: (f["ps"], f["seq"])),
+        "pending_submits": pending_submits,
+        "stalled_tensors": stalled_tensors,
+    }
+
+
+def render_diagnosis(diag: dict) -> str:
+    """Human-readable verdict (the CLI's default output)."""
+    lines = []
+    lines.append("flight-record diagnosis over %d/%d rank dump(s)"
+                 % (len(diag["ranks_with_dumps"]), diag["world_size"]))
+    if diag["missing_ranks"]:
+        lines.append("  no dump from rank(s) %s (died before any dump "
+                     "trigger — SIGKILL/SIGSTOP shaped)"
+                     % diag["missing_ranks"])
+    if diag["culprit_ranks"]:
+        lines.append("  CULPRIT rank(s): %s (basis: %s)"
+                     % (diag["culprit_ranks"], diag["culprit_basis"]))
+    else:
+        lines.append("  no divergence detected (clean shutdown or "
+                     "symmetric failure)")
+    for ps, seq in sorted(diag["first_divergent_seq"].items()):
+        lines.append("  first divergent collective: seq %d "
+                     "(process set %d)" % (seq, ps))
+    for f in diag["in_flight"]:
+        lines.append("  in flight on rank %d: %r (seq %d, ps %d)"
+                     % (f["rank"], f["name"], f["seq"], f["ps"]))
+    for name, info in diag["stalled_tensors"].items():
+        lines.append("  tensor %r: ready on rank(s) %s, NEVER submitted "
+                     "by rank(s) %s"
+                     % (name, info["ready_ranks"], info["missing_ranks"]))
+    for p in diag.get("pending_submits", []):
+        lines.append("  submitted but never completed on rank %d: %r "
+                     "(submit seq %d, ps %d)"
+                     % (p["rank"], p["name"], p["seq"], p["ps"]))
+    for peer, n in sorted(diag["timeout_peers"].items()):
+        lines.append("  progress deadline fired %d time(s) blocked on "
+                     "peer rank %d" % (n, peer))
+    for ab in diag["aborts"]:
+        lines.append("  abort on rank %d (%s): %s"
+                     % (ab["rank"], ab["status"], ab["reason"][:100]))
+    return "\n".join(lines)
+
+
+def write_chrome_trace(dumps: Dict[int, Dict[str, dict]],
+                       out_path: str) -> int:
+    """One merged Chrome/Perfetto trace: a process row per rank
+    (pid = rank), native and python events on separate thread rows,
+    RESP_BEGIN/RESP_END folded into duration spans. Reuses
+    ``horovod_tpu.utils.timeline.Timeline`` as the writer (its
+    streaming-array format is what chrome://tracing already accepts
+    for the live timelines). Returns the event count written.
+    Call ``align`` first."""
+    from horovod_tpu.utils.timeline import Timeline
+
+    tl = Timeline(out_path)
+    written = 0
+    try:
+        for rank, sources in sorted(dumps.items()):
+            tl.write_raw({"name": "process_name", "ph": "M", "pid": rank,
+                          "args": {"name": "rank %d" % rank}})
+            for source, dump in sorted(sources.items()):
+                open_resp: Dict[int, dict] = {}
+                for ev in dump["events"]:
+                    ts = ev.get("abs_us", ev.get("ts_us", 0))
+                    kind = ev.get("kind", "event")
+                    if kind == "RESP_BEGIN":
+                        open_resp[int(ev.get("ps", 0))] = dict(ev, _ts=ts)
+                        continue
+                    if kind == "RESP_END":
+                        begin = open_resp.pop(int(ev.get("ps", 0)), None)
+                        if begin is not None:
+                            tl.write_raw({
+                                "name": "%s #%d" % (begin.get("name")
+                                                    or "collective",
+                                                    begin.get("seq", -1)),
+                                "cat": "collective", "ph": "X",
+                                "ts": begin["_ts"],
+                                "dur": max(0.0, ts - begin["_ts"]),
+                                "pid": rank, "tid": source,
+                                "args": {"seq": begin.get("seq"),
+                                         "ps": begin.get("ps"),
+                                         "bytes": begin.get("c")}})
+                            written += 1
+                        continue
+                    args = {k: ev[k] for k in
+                            ("seq", "ps", "a", "b", "c", "op", "detail")
+                            if k in ev and ev[k] not in (None, "")}
+                    name = ev.get("name") or kind
+                    tl.write_raw({"name": "%s:%s" % (kind, name)
+                                  if ev.get("name") else kind,
+                                  "cat": source, "ph": "i", "s": "t",
+                                  "ts": ts, "pid": rank, "tid": source,
+                                  "args": args})
+                    written += 1
+                # Unclosed spans: emit as instants so the evidence of
+                # "died inside seq N" is visible on the row.
+                for begin in open_resp.values():
+                    tl.write_raw({
+                        "name": "UNFINISHED %s #%d"
+                                % (begin.get("name") or "collective",
+                                   begin.get("seq", -1)),
+                        "cat": "collective", "ph": "i", "s": "t",
+                        "ts": begin["_ts"], "pid": rank, "tid": source,
+                        "args": {"seq": begin.get("seq")}})
+                    written += 1
+    finally:
+        tl.close()
+    return written

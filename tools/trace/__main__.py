@@ -1,0 +1,75 @@
+"""``python -m tools.trace <dump-dir>`` — merge per-rank flight-record
+dumps, print the cross-rank diagnosis (culprit rank, first divergent
+collective, negotiated-but-unsubmitted tensors), and optionally emit a
+merged Chrome/Perfetto trace (docs/flightrec.md).
+
+Exit status: 0 when dumps were found and parsed (whatever the verdict
+says — "no divergence" is a valid answer), 2 when the directory holds
+no usable dumps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.trace import (
+    align,
+    diagnose,
+    load_dir,
+    render_diagnosis,
+    write_chrome_trace,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hvd-trace", description=__doc__.splitlines()[0])
+    ap.add_argument("dump_dir",
+                    help="directory holding flightrec.rank*.jsonl dumps "
+                         "(searched recursively; e.g. the elastic "
+                         "journal dir's flightrec/ subdir)")
+    ap.add_argument("--np", type=int, default=None, dest="np_",
+                    help="world size override (default: inferred from "
+                         "the dumps and coordinator announcements)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the diagnosis as JSON instead of text")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="also write a merged Chrome/Perfetto trace "
+                         "(one process row per rank)")
+    ap.add_argument("--offset", action="append", default=[],
+                    metavar="RANK=SECONDS",
+                    help="per-rank wall-clock skew correction, "
+                         "repeatable (multi-host jobs whose clocks "
+                         "disagree; heartbeat arrival deltas are a "
+                         "good source)")
+    args = ap.parse_args(argv)
+
+    offsets = {}
+    for spec in args.offset:
+        if "=" not in spec:
+            ap.error("--offset expects RANK=SECONDS, got %r" % spec)
+        rank, sec = spec.split("=", 1)
+        offsets[int(rank)] = float(sec)
+
+    dumps = load_dir(args.dump_dir)
+    if not dumps:
+        print("hvd-trace: no flightrec.rank*.jsonl dumps under %s"
+              % args.dump_dir, file=sys.stderr)
+        return 2
+    align(dumps, offsets=offsets)
+    diag = diagnose(dumps, np_hint=args.np_)
+    if args.trace:
+        n = write_chrome_trace(dumps, args.trace)
+        print("# merged trace: %s (%d events)" % (args.trace, n),
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps(diag, indent=2, sort_keys=True))
+    else:
+        print(render_diagnosis(diag))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

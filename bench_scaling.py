@@ -288,6 +288,21 @@ def native_child() -> int:
 # Supervisor
 # --------------------------------------------------------------------------
 
+def _plan_stamp():
+    """Sharding-planner record for the harness's DP workload on the
+    virtual mesh (docs/planner.md), stamped into SCALING.json so a
+    mesh-choice regression (the planner no longer picking plain DP
+    for this small-model workload) is diffable round to round."""
+    from horovod_tpu.parallel import planner
+
+    dim, classes, per_device_batch = 256, 10, 64  # mesh_child's MLP
+    param_bytes = 4 * (dim * dim + dim + dim * classes + classes)
+    p = planner.plan(param_bytes=param_bytes,
+                     batch=N_DEVICES * per_device_batch,
+                     d_model=dim, n_layers=2, chips=N_DEVICES)
+    return p.to_json()
+
+
 def _cpu_env(n_devices=N_DEVICES):
     env = dict(os.environ)
     env.update({
@@ -371,6 +386,7 @@ def main() -> int:
     payload = {
         "generated_by": "bench_scaling.py",
         "device_kind": "virtual-cpu-%d" % N_DEVICES,
+        "plan": _plan_stamp(),
         "records": records,
         "note": (
             "Virtual XLA devices share this host's CPU cores, so raw "

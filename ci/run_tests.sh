@@ -61,6 +61,18 @@ run_flightrec() {
 # 868.40s — holds.)
 run_tier1() {
     run_flightrec
+    echo "=== tier 1: planner fast-fail (cost-model units + planner-swept dryrun smoke) ==="
+    # The sharding planner (docs/planner.md) owns layout for every
+    # multi-axis training run and for the MULTICHIP dryrun's mesh
+    # choices; a broken cost model or a sweep that stops composing
+    # should fail in seconds, before the full tier burns its wall
+    # budget. Cost-model units are pure Python (~1 s); the smoke
+    # executes the 5-scenario planner sweep on the 8 virtual devices
+    # (a few seconds warm, tens cold) — both far inside the budget.
+    timeout "${HVD_CI_PLAN_BUDGET:-240}" \
+        python -m pytest tests/test_costmodel.py \
+        "tests/test_planner.py::test_planner_swept_dryrun_smoke" \
+        -q -p no:cacheprovider
     echo "=== tier 1: autotune fast-fail (online tuner loop + guardrail) ==="
     # The online tuner (docs/autotune.md) mutates live knobs on every
     # training/serving job that sets HVD_TUNE; a broken guardrail

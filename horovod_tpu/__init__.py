@@ -135,10 +135,18 @@ def __getattr__(name):
     """Lazy subsystem attributes (PEP 562): ``hvd.serve`` loads the
     inference-serving subsystem (docs/serving.md) on first touch —
     training imports never pay for it, and the serve package itself
-    defers jax until a replica loads a real model."""
+    defers jax until a replica loads a real model. ``hvd.plan`` (plus
+    the Plan/Topology/Workload types) resolves the sharding planner
+    (docs/planner.md) the same way: the planner drags in the whole
+    parallel strategy stack, which data-parallel-only jobs never
+    touch."""
     if name == "serve":
         import horovod_tpu.serve as _serve
 
         return _serve
+    if name in ("plan", "Plan", "PlanError", "Topology", "Workload"):
+        from horovod_tpu import parallel as _parallel
+
+        return getattr(_parallel, name)
     raise AttributeError("module %r has no attribute %r"
                          % (__name__, name))

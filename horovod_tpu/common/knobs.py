@@ -310,6 +310,25 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "utils/flightrec.py: 0 disables the SIGTERM dump handler "
          "(the wedge-cull SIGTERM->SIGKILL grace window is the dump "
          "window)"),
+    # Sharding planner (parallel/planner.py + parallel/costmodel.py;
+    # docs/planner.md).
+    Knob("HVD_PLAN", HONORED,
+         "__graft_entry__.dryrun_multichip planner mode: sweep = "
+         "execute planner-chosen meshes across workload shapes "
+         "instead of the fixed legs (docs/planner.md)"),
+    Knob("HVD_PLAN_ICI_BW_GBPS", HONORED,
+         "parallel/costmodel.py: ICI (intra-slice) bandwidth weight "
+         "in GB/s for the planner's cost model (default 90)"),
+    Knob("HVD_PLAN_DCN_BW_GBPS", HONORED,
+         "parallel/costmodel.py: DCN (cross-slice) bandwidth weight "
+         "in GB/s for the planner's cost model (default 6.25)"),
+    Knob("HVD_PLAN_MEM_PER_CHIP_GB", HONORED,
+         "parallel/costmodel.py: per-chip memory bound (GB) for the "
+         "planner's memory-fit rejection (default 16)"),
+    Knob("HVD_PLAN_GRAD_OVERLAP", HONORED,
+         "parallel/costmodel.py: fraction of gradient-sync time the "
+         "cost model counts as exposed (the rest hides under backprop "
+         "via bucketing, docs/mfu.md; default 0.25, clamped to [0,1])"),
     # Fault injector (core/src/comm.cc; armed only on the matching
     # rank — see docs/configuration.md and common/fault_injection.py).
     Knob("HVD_FAULT_RANK", HONORED,
@@ -410,6 +429,26 @@ TUNABLE: Dict[str, TunableKnob] = {t.name: t for t in [
                 "HVD_SERVE_BATCH_DEADLINE_MS", 5.0, True,
                 "serving micro-batch deadline trigger "
                 "(MicroBatcher.set_tunables)"),
+    # Sharding-planner cost-model weights (parallel/costmodel.py,
+    # docs/planner.md): searched OFFLINE only — plans are chosen at
+    # setup time and per-rank divergence would pick divergent meshes,
+    # the same trace-time hazard as grad_bucket_bytes. Autotune 2.0
+    # fits them against measured step times (docs/autotune.md).
+    TunableKnob("plan_ici_bw_gbps", 10.0, 1010.0, 10.0, "env",
+                "HVD_PLAN_ICI_BW_GBPS", 90.0, False,
+                "planner cost model: ICI bandwidth weight (GB/s); "
+                "only the ICI:DCN ratio has to be right for the "
+                "argmin to be right"),
+    TunableKnob("plan_dcn_bw_gbps", 1.0, 101.0, 0.25, "env",
+                "HVD_PLAN_DCN_BW_GBPS", 6.25, False,
+                "planner cost model: DCN bandwidth weight (GB/s); "
+                "lowering it pushes plans toward hierarchical "
+                "factorizations that starve the slow links"),
+    TunableKnob("plan_grad_overlap", 0.0, 1.0, 0.05, "env",
+                "HVD_PLAN_GRAD_OVERLAP", 0.25, False,
+                "planner cost model: exposed fraction of gradient-"
+                "sync time (the rest overlaps backprop via bucketed "
+                "issue, docs/mfu.md); 1.0 = no overlap credit"),
 ]}
 
 

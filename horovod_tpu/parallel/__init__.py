@@ -1,5 +1,7 @@
 """Parallelism strategies over the device mesh: data / tensor / sequence /
-expert / pipeline axes, hierarchical collectives, Adasum."""
+expert / pipeline axes, hierarchical collectives, Adasum — composed by
+the cost-model-driven sharding planner (``hvd.plan``, docs/planner.md).
+"""
 
 from horovod_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
@@ -15,3 +17,62 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     reset_global_mesh,
     set_global_mesh,
 )
+
+# Lazy submodule attributes (PEP 562): the strategy modules pull in
+# flax/jnp machinery that plain mesh users never need, and the planner
+# pulls in all of them. ``from horovod_tpu.parallel import planner``
+# (or ``hvd.plan``) resolves through here on first touch.
+_LAZY_ATTRS = {
+    "bucketing": "horovod_tpu.parallel.bucketing",
+    "costmodel": "horovod_tpu.parallel.costmodel",
+    "hierarchical": "horovod_tpu.parallel.hierarchical",
+    "moe": "horovod_tpu.parallel.moe",
+    "pipeline": "horovod_tpu.parallel.pipeline",
+    "planner": "horovod_tpu.parallel.planner",
+    "sequence": "horovod_tpu.parallel.sequence",
+    "adasum": "horovod_tpu.parallel.adasum",
+}
+
+# Helper functions re-exported flat: name -> (module, attr). These are
+# the previously deep-import-only surfaces the API-surface test pins
+# (tests/test_api_surface.py).
+_LAZY_FUNCS = {
+    "plan": ("horovod_tpu.parallel.planner", "plan"),
+    "Plan": ("horovod_tpu.parallel.planner", "Plan"),
+    "PlanError": ("horovod_tpu.parallel.planner", "PlanError"),
+    "Topology": ("horovod_tpu.parallel.planner", "Topology"),
+    "Workload": ("horovod_tpu.parallel.planner", "Workload"),
+    "workload_from_params": ("horovod_tpu.parallel.planner",
+                             "workload_from_params"),
+    "expert_parallel_moe": ("horovod_tpu.parallel.moe",
+                            "expert_parallel_moe"),
+    "moe_ffn": ("horovod_tpu.parallel.moe", "moe_ffn"),
+    "pipeline_apply": ("horovod_tpu.parallel.pipeline", "pipeline_apply"),
+    "pipeline_loss": ("horovod_tpu.parallel.pipeline", "pipeline_loss"),
+    "ring_attention": ("horovod_tpu.parallel.sequence", "ring_attention"),
+    "ulysses_attention": ("horovod_tpu.parallel.sequence",
+                          "ulysses_attention"),
+    "hierarchical_allreduce": ("horovod_tpu.parallel.hierarchical",
+                               "hierarchical_allreduce"),
+    "grouped_hierarchical_allreduce": (
+        "horovod_tpu.parallel.hierarchical",
+        "grouped_hierarchical_allreduce"),
+    "make_hierarchical_axes": ("horovod_tpu.parallel.hierarchical",
+                               "make_hierarchical_axes"),
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY_ATTRS:
+        return importlib.import_module(_LAZY_ATTRS[name])
+    if name in _LAZY_FUNCS:
+        mod, attr = _LAZY_FUNCS[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS) | set(_LAZY_FUNCS))

@@ -87,7 +87,18 @@ def traced_axis_size(axis) -> int:
     return jax.lax.psum(1, axis)
 
 
-_STANDARD_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+# Outer-to-inner mesh order. The hierarchical factorization of the
+# data axis (parallel/hierarchical.py: "data_dcn" x "data_ici") sits in
+# the data slot — data_dcn OUTERMOST so the slice boundary of a real
+# multi-slice pod falls between dcn groups, and data_ici directly
+# inside it so ici neighbors stay physically adjacent. (Before ISSUE
+# 13 these two fell through to the custom-axes-last branch, which put
+# any standard axis — e.g. a model axis — OUTSIDE them: on a real pod
+# that routed blocking tensor-parallel collectives across DCN while
+# the ladder's "slow" psum rode ICI, inverting the hierarchy's whole
+# bandwidth argument.)
+_STANDARD_ORDER = (PIPE_AXIS, "data_dcn", "data_ici", DATA_AXIS,
+                   EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 _lock = threading.Lock()
 _global_mesh: Optional[Mesh] = None
@@ -105,10 +116,12 @@ def make_mesh(
     the plain data-parallel layout matching the reference's single flat
     communicator.
 
-    Axes are laid out in the order pipe, data, expert, seq, model (outer to
-    inner) so that the innermost (most communication-intensive) axes land on
-    adjacent devices — on a real pod that keeps tensor/sequence collectives
-    on the fastest ICI links; axes not named in ``axis_sizes`` are omitted.
+    Axes are laid out in the order pipe, data_dcn, data_ici, data,
+    expert, seq, model (outer to inner) so that the innermost (most
+    communication-intensive) axes land on adjacent devices — on a real
+    pod that keeps tensor/sequence collectives on the fastest ICI
+    links, and puts the slice boundary of a multi-slice pod between
+    ``data_dcn`` groups; axes not named in ``axis_sizes`` are omitted.
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs)

@@ -69,7 +69,34 @@ SURFACE = {
     ],
     "horovod_tpu.ray": ["RayExecutor", "ElasticRayExecutor",
                         "BaseHorovodWorker"],
+    # The parallel strategy stack (ISSUE 13): the planner plus the
+    # formerly deep-import-only moe/pipeline/sequence/hierarchical
+    # helpers, re-exported flat (lazy PEP 562 attrs).
+    "horovod_tpu.parallel": [
+        "plan", "Plan", "PlanError", "Topology", "Workload",
+        "workload_from_params", "expert_parallel_moe", "moe_ffn",
+        "pipeline_apply", "pipeline_loss", "ring_attention",
+        "ulysses_attention", "hierarchical_allreduce",
+        "grouped_hierarchical_allreduce", "make_hierarchical_axes",
+        "make_mesh", "set_global_mesh", "global_mesh",
+        "planner", "costmodel", "moe", "pipeline", "sequence",
+    ],
 }
+
+
+def test_root_planner_exports():
+    """``hvd.plan`` works without deep imports (lazy root attr), and
+    resolves to the parallel.planner implementation."""
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel import planner
+
+    assert hvd.plan is planner.plan
+    assert hvd.Plan is planner.Plan
+    assert hvd.PlanError is planner.PlanError
+    assert hvd.Topology is planner.Topology
+    assert hvd.Workload is planner.Workload
+    p = hvd.plan(param_bytes=1 << 20, batch=8, chips=4)
+    assert p.mesh_axes == {"data": 4}
 
 
 def test_root_run_export():

@@ -16,6 +16,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # a tier-1 stand-in. This map documents the pairing; test_tier2_has_
 # tier1_coverage enforces that the named stand-ins exist.
 TIER2_COVERAGE = {
+    "test_planner_swept_dryrun_np16":
+        "tests/test_planner.py::test_planner_swept_dryrun_smoke",
     "test_chaos_forensics_names_culprit":
         "tests/test_flightrec.py::test_diagnosis_timeout_names_culprit",
     "test_keras_mnist_advanced_example":

@@ -1,0 +1,213 @@
+"""Planner integration: plan-vs-manual bit-equality + swept dryrun.
+
+ISSUE 13 acceptance: for every supported workload shape the
+planner-emitted layout trains BIT-identically to the manual
+composition it replaces (np=2 flat, 2x2 hierarchical — the PR 7/8
+equality discipline), and the planner-mode MULTICHIP dryrun sweeps
+>= 4 distinct planner-chosen meshes on 8 host devices. Pure-Python
+cost-model units live in tests/test_costmodel.py.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu.parallel import make_mesh, planner
+from horovod_tpu.parallel.mesh import shard_map_compat
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "w1": jnp.asarray(rng.randn(16, 32) * 0.1, jnp.float32),
+        "b1": jnp.zeros((32,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(32, 4) * 0.1, jnp.float32),
+    }
+
+
+def _loss(p, x):
+    h = jnp.tanh(x @ p["w1"] + p["b1"])
+    return jnp.mean((h @ p["w2"]) ** 2)
+
+
+def _make_step(tx):
+    def step(p, o, x):
+        loss, grads = jax.value_and_grad(_loss)(p, x)
+        updates, o = tx.update(grads, o, p)
+        p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
+        return p, o, loss
+
+    return step
+
+
+def _train(tx, mesh, data_spec, params, x, steps=2):
+    step = _make_step(tx)
+    sm = jax.jit(shard_map_compat(
+        step, mesh=mesh, in_specs=(P(), P(), data_spec),
+        out_specs=(P(), P(), P())))
+    o = tx.init(params)
+    for _ in range(steps):
+        params, o, loss = sm(params, o, x)
+    return jax.tree_util.tree_map(np.asarray, params), float(loss)
+
+
+def _assert_bitwise_equal(a, b):
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        assert la.dtype == lb.dtype
+        assert np.array_equal(la, lb), "planner layout diverged bitwise"
+
+
+def test_plan_vs_manual_flat_dp_bit_equal_np2():
+    """Flat data parallelism at np=2: the planner-emitted layout (mesh
+    + specs + optimizer axis) trains bit-identically to the hand-built
+    composition it replaces, through a real DistributedOptimizer
+    step."""
+    params = _params()
+    x = jnp.asarray(np.random.RandomState(1).randn(8, 16), jnp.float32)
+
+    # Manual composition: hand-built mesh, hand-picked axis.
+    manual_mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    manual_tx = hvd_jax.DistributedOptimizer(optax.sgd(0.1))
+    manual_params, manual_loss = _train(
+        manual_tx, manual_mesh, P("data", None), params, x)
+
+    # Planner composition for the same workload.
+    p = planner.plan(params, batch=8, chips=2)
+    assert p.mesh_axes == {"data": 2}
+    assert p.sync == "psum"
+    plan_mesh = make_mesh(p.mesh_axes, devices=jax.devices()[:2])
+    # leaf_specs: pure-DP plans replicate every param, matching P().
+    for spec in jax.tree_util.tree_leaves(
+            p.leaf_specs(params),
+            is_leaf=lambda s: isinstance(s, P)):
+        assert tuple(spec) == ()
+    plan_params, plan_loss = _train(
+        p.optimizer(optax.sgd(0.1)), plan_mesh, p.batch_spec(2),
+        params, x)
+
+    _assert_bitwise_equal(manual_params, plan_params)
+    assert manual_loss == plan_loss
+
+
+def test_plan_vs_manual_hierarchical_bit_equal(monkeypatch):
+    """Hierarchical DP on a 2x2 (dcn x ici) factorization: the
+    planner-emitted layout (mesh dict, (dcn, ici) optimizer axis,
+    ladder routing) trains bit-identically to the manual
+    composition."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    params = _params()
+    x = jnp.asarray(np.random.RandomState(2).randn(8, 16), jnp.float32)
+    dp = ("data_dcn", "data_ici")
+
+    manual_mesh = make_mesh({"data_dcn": 2, "data_ici": 2},
+                            devices=jax.devices()[:4])
+    manual_tx = hvd_jax.DistributedOptimizer(optax.sgd(0.1), axis=dp)
+    manual_params, manual_loss = _train(
+        manual_tx, manual_mesh, P(dp, None), params, x)
+
+    p = planner.plan(params, batch=8, chips=4, dcn=2)
+    assert p.mesh_axes == {"data_dcn": 2, "data_ici": 2}
+    assert p.sync == "hierarchical"
+    assert p.grad_axes == dp
+    plan_mesh = p.apply(devices=jax.devices()[:4])
+    plan_params, plan_loss = _train(
+        p.optimizer(optax.sgd(0.1)), plan_mesh, p.batch_spec(2),
+        params, x)
+
+    _assert_bitwise_equal(manual_params, plan_params)
+    assert manual_loss == plan_loss
+
+
+def test_leaf_spec_rules():
+    p = planner.plan(param_bytes=1 << 30, batch=8, seq_len=32,
+                     d_model=1024, n_layers=2, chips=8,
+                     require_axes={"model": 2, "data": 4})
+    assert p.mesh_axes.get("model") == 2
+    # Last dim divisible by the model size shards over model.
+    assert tuple(p.leaf_spec((1024, 4096))) == (None, "model")
+    # 1-D bias: divisible, shards too (column-parallel convention).
+    assert tuple(p.leaf_spec((4096,))) == ("model",)
+    # Indivisible dims replicate.
+    assert tuple(p.leaf_spec((7, 13))) == ()
+    # Expert-leading leaves shard dim 0 over expert when present.
+    pe = planner.plan(param_bytes=64 << 20, batch=16, seq_len=1,
+                      d_model=63, n_layers=2, num_experts=4,
+                      expert_param_bytes=60 << 20, chips=8,
+                      require_axes={"expert": 4, "data": 2})
+    assert tuple(pe.leaf_spec((4, 63, 128)))[0] == "expert"
+
+
+def test_workload_from_params_infers_dtype_bytes():
+    """A bf16-dominated pytree plans with 2-byte activations — the
+    cost model's activation terms must not be double-counted at a
+    hardcoded fp32 width (and the override wins when given)."""
+    params = {"w": jnp.zeros((64, 64), jnp.bfloat16),
+              "b": jnp.zeros((64,), jnp.float32)}
+    w = planner.workload_from_params(params, batch=8)
+    assert w.dtype_bytes == 2
+    assert w.param_bytes == 64 * 64 * 2 + 64 * 4
+    w4 = planner.workload_from_params(params, batch=8, dtype_bytes=4)
+    assert w4.dtype_bytes == 4
+    p = planner.plan(param_bytes=1 << 20, batch=8, chips=2,
+                     dtype_bytes=2)
+    assert p.workload.dtype_bytes == 2
+
+
+def test_planner_swept_dryrun_smoke(monkeypatch, capsys):
+    """ISSUE 13 acceptance: dryrun_multichip in planner mode sweeps
+    >= 4 distinct planner-chosen meshes on the 8 virtual host devices,
+    each probe executing through the framework's own collectives
+    (asserted inside the sweep via jaxpr introspection)."""
+    import __graft_entry__ as g
+
+    monkeypatch.setenv("HVD_PLAN", "sweep")
+    # The sweep restores any PRE-EXISTING routing flag by design, so
+    # clear ambient state before asserting it leaves none behind.
+    monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
+    g.dryrun_multichip(8)
+    out = capsys.readouterr().out
+    m = re.search(r"planner sweep OK: (\d+) scenarios, (\d+) distinct "
+                  r"meshes", out)
+    assert m, out
+    assert int(m.group(2)) >= 4
+    assert out.count("plan[") >= 8  # summary + probe line per scenario
+    assert "sync=hierarchical" in out
+    # The sweep must leave no routing flag behind.
+    assert os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE") is None
+
+
+@pytest.mark.tier2
+@pytest.mark.slow
+def test_planner_swept_dryrun_np16(tmp_path):
+    """Heavier sweep: 16 virtual devices in a fresh interpreter (the
+    device count is fixed per process), same >= 4 distinct-mesh bar."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HVD_PLAN": "sweep",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=16",
+        "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(16)"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    m = re.search(r"planner sweep OK: (\d+) scenarios, (\d+) distinct "
+                  r"meshes", out.stdout)
+    assert m and int(m.group(2)) >= 4, out.stdout

@@ -87,6 +87,28 @@ def test_scaling_json_has_adasum_overhead():
     assert all(r["value"] > 0 for r in sync)
 
 
+def test_scaling_json_has_plan_stamp():
+    """ISSUE 13: SCALING.json carries the sharding-planner record for
+    the harness workload (docs/planner.md), and the committed stamp
+    matches what the planner chooses today — a silent cost-model drift
+    that flips the harness mesh fails here, not in a bench diff."""
+    import bench_scaling
+
+    payload = _load()
+    stamp = payload.get("plan")
+    assert stamp, "SCALING.json lacks the planner stamp"
+    assert stamp["chips"] == 8
+    assert stamp["sync"] in ("psum", "hierarchical", "none")
+    assert stamp["rejected"], "stamp must record scored-and-rejected " \
+                              "candidates"
+    fresh = bench_scaling._plan_stamp()
+    assert fresh["mesh_axes"] == stamp["mesh_axes"], (
+        "planner now chooses %r for the harness workload but "
+        "SCALING.json records %r — regenerate with bench_scaling.py"
+        % (fresh["mesh_axes"], stamp["mesh_axes"]))
+    assert fresh["sync"] == stamp["sync"]
+
+
 def test_collective_overhead_is_bounded():
     """The gradient psum must not dominate the step: on >=4 virtual
     devices the sharded step with collectives stays within 50% of the

@@ -36,6 +36,7 @@ DEFAULT_ALLOWLIST: Dict[str, str] = {
     "HVD_CI_TIER1_BUDGET": "ci/run_tests.sh lane budget",
     "HVD_CI_TIER2_BUDGET": "ci/run_tests.sh lane budget",
     "HVD_CI_ANALYSIS_BUDGET": "ci/run_tests.sh lane budget",
+    "HVD_CI_PLAN_BUDGET": "ci/run_tests.sh lane budget",
     # Test-suite internals (set and read only by tests/).
     "HVD_FUZZ_SEED": "tests/fuzz_worker.py reproducibility seed",
     "HVD_WIRE_BENCH_SIZES": "tests/wire_bench_worker.py payload sweep "

@@ -29,7 +29,7 @@ run_analysis() {
     # this loop just guarantees the attribution shows up as the LAST
     # lane header even if the combined run is skipped or wrapped.
     for checker in knobs counters ctypes metrics excepts \
-                   locks journal jaxcompat testtier; do
+                   locks journal jaxcompat testtier spmd; do
         echo "--- checker: $checker"
         timeout 60 python -m tools.analysis --checker "$checker"
     done

@@ -49,6 +49,17 @@ class _Context:
     reference: horovod/common/global_state.h:39-126)."""
 
     initialized: bool = False
+    # Bumped on every (re)init: world-scoped caches (e.g. the flash
+    # tuner's synced winner view) key off it so an elastic reset
+    # invalidates them in lockstep with the collective name/sequence
+    # counters.
+    generation: int = 0
+    # True once this process has EVER formed a multi-rank world; never
+    # cleared. is_shared_world() stays conservatively True during the
+    # shutdown->reinit window of an elastic reset, so per-rank
+    # decisions gated on it (live-unsafe knob applies) cannot sneak
+    # through mid-teardown.
+    shared_high_water: bool = False
     topology: Topology = field(default_factory=Topology)
     # Native core handle (horovod_tpu.core.CoreSession) when size > 1.
     core: Optional[object] = None
@@ -190,6 +201,9 @@ def init(process_sets=None):
 
                 negotiate_controller_port(_ctx.topology.rank)
             _ctx.core = CoreSession.start(_ctx.topology)
+        _ctx.generation += 1
+        if _ctx.topology.size > 1:
+            _ctx.shared_high_water = True
         _ctx.initialized = True
         timeline_path = os.environ.get("HOROVOD_TIMELINE")
         if timeline_path:
@@ -244,6 +258,30 @@ def init(process_sets=None):
                     "metrics server restart after reset") is not None:
                 _ctx.metrics_restart_port = None
         atexit.register(shutdown)
+    # Flash-tile cache sync (ops/block_tuner.py): multi-rank tile
+    # decisions come from rank 0's cache view, shipped ONCE per world
+    # formation — here, where every rank (elastic survivors and
+    # respawns alike) passes symmetrically, never at trace time where
+    # only a subset of ranks may re-trace. Runs outside the init lock
+    # (it issues an eager broadcast on the now-live world). Every rank
+    # participates unconditionally — rank 0's env decides the payload,
+    # so per-rank HVD_FLASH_TUNE divergence cannot wedge init.
+    if _ctx.topology.size > 1:
+        from horovod_tpu.ops import block_tuner
+
+        try:
+            block_tuner.sync_cache_across_world()
+        except Exception as e:  # analysis: allow-broad-except — this
+            # init runs on the ELASTIC RESET path (reinit_for_version),
+            # OUTSIDE the worker's recovery try/except: a peer dying
+            # mid-broadcast must degrade to "no synced view this
+            # world" (all ranks fail the cascade together and fall
+            # back to defaults uniformly; the next in-loop collective
+            # triggers normal rollback/rejoin), never kill survivors
+            # that still have failure budget.
+            logger.warning(
+                "flash tuner cache sync failed (%s); continuing "
+                "without a synced view for this world", e)
 
 
 def shutdown():
@@ -291,6 +329,32 @@ def shutdown():
 
 def is_initialized() -> bool:
     return _ctx.initialized
+
+
+def init_generation() -> int:
+    """Monotone per-process init epoch (bumped by every init/reinit).
+    World-scoped caches compare it to decide "is my memo from THIS
+    world?" — every rank of a freshly formed world has just bumped,
+    so epoch-keyed memos start empty on every member in lockstep."""
+    return _ctx.generation
+
+
+def is_shared_world() -> bool:
+    """True when this process is one rank of an initialized
+    multi-rank world — the condition under which per-rank decisions
+    that feed traced programs or collective sequences become SPMD
+    hazards (docs/static_analysis.md#spmd). One definition, shared by
+    the flash-tile tuner and the online knob tuner, and checked at
+    decision time rather than cached: elastic worlds grow and shrink
+    across a process lifetime. During the shutdown->reinit window of
+    an elastic reset (not initialized, but the process HAS been part
+    of a multi-rank world) this answers conservatively True, so a
+    concurrent thread cannot slip a per-rank mutation through
+    mid-teardown. An initialized size-1 world after an elastic shrink
+    answers False — the process really is alone."""
+    if is_initialized():
+        return size() > 1
+    return _ctx.shared_high_water
 
 
 def _check_initialized():

@@ -236,6 +236,13 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HVD_FLASH_TUNE_ITERS", HONORED,
          "ops/block_tuner.py: timed fwd+bwd iterations per candidate "
          "after the untimed compile/warmup call (default 3)"),
+    Knob("HVD_FLASH_TUNE_SYNC", HONORED,
+         "ops/block_tuner.py: 0 ON RANK 0 disables the init-time "
+         "rank-0 cache sync for the whole world (best_blocks reads "
+         "the per-host cache file again; the opt-out rides the sync "
+         "broadcast, so other ranks' settings are ignored); the "
+         "divergence hazard then falls back on the docs/mfu.md "
+         "multi-host rule"),
     # Wire path (core/src/comm.cc + collectives.cc; docs/wire.md).
     Knob("HVD_RING_CHUNK_BYTES", HONORED,
          "core/src/comm.cc + collectives.cc: pipelined-ring sub-chunk "

@@ -351,6 +351,26 @@ def run(func):
             if reset_version is not None:
                 new_version = reinit_for_version(reset_version)
                 state._known_version = new_version
+                # The world just re-formed (may have grown): a tuner
+                # that searched or froze live-unsafe knobs while this
+                # process was alone must restore them BEFORE
+                # state.on_reset() — reset callbacks routinely rebuild
+                # and retrace the step, and must see uniform values
+                # (docs/autotune.md#what-is-not-searched-live).
+                from horovod_tpu.utils.online_tuner import (
+                    on_world_change,
+                )
+
+                try:
+                    on_world_change()
+                except Exception as e:  # analysis: allow-broad-except
+                    # — the tuner is an optimizer, not a dependency
+                    # (its own loop has the same rule): a journal
+                    # fsync or apply failure here must not kill a
+                    # survivor that still has failure budget.
+                    sys.stderr.write(
+                        "elastic: tuner world-change hook failed "
+                        "(%s); continuing reset\n" % e)
                 state.on_reset()
                 reset_version = None
             entered = time.monotonic()

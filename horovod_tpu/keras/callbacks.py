@@ -98,7 +98,13 @@ class MetricAverageCallback(keras.callbacks.Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if logs and hvd.size() > 1:
-            for k in list(logs.keys()):
+            # Sorted, not insertion order: one allreduce is issued PER
+            # KEY, and ranks whose callbacks populated logs in a
+            # different order would otherwise negotiate these
+            # collectives in a different sequence (the spmd contract —
+            # docs/static_analysis.md#spmd). Sorting pins the order to
+            # the key set itself.
+            for k in sorted(logs.keys()):
                 value = np.asarray(float(logs[k]), dtype=np.float64)
                 logs[k] = float(np.asarray(hvd.allreduce(
                     value, op=hvd.Average, name="metric.%s" % k)))

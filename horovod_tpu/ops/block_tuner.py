@@ -26,10 +26,20 @@ read it). Explicit ``HVD_FLASH_BLOCK_Q/K`` env overrides and explicit
 SPMD caveat: winners are timing-derived, so two processes cold-tuning
 the same shape concurrently can pick DIFFERENT tiles — and divergent
 tile choices lower to divergent programs across ranks of one jitted
-step, which desyncs its collectives. Multi-host jobs must warm the
-cache first (one process, or rank 0 before the others trace) and run
-with ``HVD_FLASH_TUNE=cache``; ``=1`` is for single-process tuning
-and benches.
+step, which desyncs its collectives. In a multi-rank world the tile
+decision is therefore RANK-0-AUTHORITATIVE and synced at INIT time:
+``sync_cache_across_world`` (called by ``basics.init`` on every world
+formation, elastic reinits included — every rank runs init, so the
+broadcast is symmetric) ships rank 0's folded cache to all ranks, and
+``best_blocks`` answers exclusively from that uniform view. No
+collective ever runs at TRACE time — a trace-time broadcast would
+wedge whenever only a subset of ranks re-traces (a respawned elastic
+peer traces from scratch while survivors' jitted steps stay
+compiled). Cold-tuning (``=1``) is refused in a multi-rank world:
+misses fall back to defaults uniformly; warm the cache from one
+process first (docs/mfu.md; ``tests/test_block_tuner.py`` pins the
+lockstep with a real np=2 run). Uninitialized/single-process tuning
+is unchanged.
 """
 
 from __future__ import annotations
@@ -59,6 +69,18 @@ DEFAULT_ITERS = 3
 # process; avoids re-reading the JSONL on every traced call site.
 _mem_cache: Dict[str, Dict] = {}
 _mem_cache_path: Optional[str] = None
+
+# Rank-0-authoritative synced cache view for THIS world, established
+# by sync_cache_across_world at init/reinit (the generation stamp
+# rejects a stale view from a previous world). Multi-rank tile reads
+# come exclusively from here — per-host cache drift cannot desync
+# traces, and trace time stays collective-free.
+_synced_cache: Optional[Dict[str, Dict]] = None
+_synced_generation: Optional[int] = None
+# Rank 0 had HVD_FLASH_TUNE_SYNC=0 at world formation (carried by the
+# same broadcast, so the opt-out applies to every rank or none).
+_synced_optout = False
+_warned_cold_multirank = False
 
 
 def tune_mode() -> str:
@@ -273,6 +295,23 @@ def best_blocks(seq_q: int, seq_kv: int, head_dim: int, dtype,
     caller keeps its defaults.
     """
     mode = tune_mode()
+    # Multi-rank worlds answer exclusively from the init-time synced
+    # view (see sync_cache_across_world): reads stay purely local at
+    # trace time, and per-host cache drift cannot desync the traced
+    # programs. The synced view OVERRIDES the local env gate — rank
+    # 0's settings are authoritative for the world, so a rank whose
+    # own HVD_FLASH_TUNE is unset must still adopt tiles rank 0
+    # synced (per-rank env divergence must never split the traced
+    # programs). HVD_FLASH_TUNE_SYNC=0 on RANK 0 opts the whole world
+    # back into local reads (the caller owns the docs/mfu.md
+    # divergence hazard) — the opt-out rides the broadcast payload,
+    # never the local env, so it cannot apply to a subset of ranks.
+    if _multi_rank_world() and not _world_opted_out():
+        if _synced_view() is None and not mode:
+            return None  # nobody tuning: skip the key computation
+        key = shape_key(seq_q, seq_kv, head_dim, dtype, causal,
+                        _device_kind())
+        return _best_blocks_synced(key, mode)
     if not mode:
         return None
     path = cache_path()
@@ -285,6 +324,121 @@ def best_blocks(seq_q: int, seq_kv: int, head_dim: int, dtype,
         return None
     return tune(seq_q, seq_kv, head_dim, dtype, causal,
                 interpret=interpret, batch=batch, heads=heads)
+
+
+def _multi_rank_world() -> bool:
+    from horovod_tpu.common import basics
+
+    return basics.is_shared_world()
+
+
+def _sync_enabled() -> bool:
+    """Local env read — consulted ONLY by rank 0 when building the
+    sync payload (sync_cache_across_world). The READ path must never
+    look at it: a per-rank HVD_FLASH_TUNE_SYNC=0 (stale launcher env
+    on a respawned elastic worker, say) would flip that rank alone to
+    local cache reads while its peers adopt the synced view — the
+    asymmetric divergence the sync exists to close. Use
+    _world_opted_out() on read paths instead."""
+    return os.environ.get("HVD_FLASH_TUNE_SYNC", "1") != "0"
+
+
+def _world_opted_out() -> bool:
+    """Rank-0-authoritative sync opt-out for THIS world, carried by
+    the init-time broadcast: True only when rank 0 had
+    HVD_FLASH_TUNE_SYNC=0 at world formation. A world whose sync never
+    ran (generation mismatch) is NOT opted out — reads stay on the
+    uniform no-view path rather than falling back to divergent
+    per-host caches."""
+    from horovod_tpu.common.basics import init_generation
+
+    return _synced_generation == init_generation() and _synced_optout
+
+
+def sync_cache_across_world() -> None:
+    """Ship rank 0's folded winner cache to every rank of the world.
+
+    Called by ``basics.init()`` at every world formation — elastic
+    reinits included, where EVERY rank (survivor and respawn alike)
+    runs init, so the broadcast is symmetric. That symmetry is the
+    whole design: a TRACE-time collective would wedge whenever only a
+    subset of ranks re-traces (a respawned peer traces from scratch
+    while survivors' jitted steps stay compiled and never re-enter
+    best_blocks). No-op when tuning is off, the sync is opted out, or
+    the world is not shared."""
+    global _synced_cache, _synced_generation, _synced_optout
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.objects import broadcast_object
+
+    if not basics.is_shared_world():
+        return
+    # Participation is UNCONDITIONAL for every rank of the world —
+    # gating it on per-rank env (HVD_FLASH_TUNE / HVD_FLASH_TUNE_SYNC)
+    # would wedge every rank inside init the moment the env diverges
+    # (e.g. tuning exported on rank 0 only). Rank 0's own settings
+    # decide the PAYLOAD instead: the opt-out flag rides the broadcast
+    # (so it applies to every rank or none), and the cache is None
+    # when rank 0 has tuning off — downstream reads treat that as "no
+    # synced view". One tiny broadcast per world formation.
+    payload = {"optout": False, "cache": None}
+    if basics.rank() == 0:
+        if not _sync_enabled():
+            payload["optout"] = True
+        elif tune_mode():
+            payload["cache"] = load_cache()
+    payload = broadcast_object(payload, root_rank=0,
+                               name="flash_tune.cache_sync")
+    _synced_optout = bool(payload["optout"])
+    _synced_cache = payload["cache"]
+    _synced_generation = basics.init_generation()
+    if _synced_cache is not None:
+        logger.info("flash tuner: synced %d cached winner(s) from "
+                    "rank 0", len(_synced_cache))
+
+
+def _synced_view() -> Optional[Dict[str, Dict]]:
+    """The world-synced cache when it belongs to THIS world (the
+    generation stamp rejects a view from a previous world), else
+    None."""
+    from horovod_tpu.common.basics import init_generation
+
+    if _synced_generation != init_generation():
+        return None
+    return _synced_cache
+
+
+def world_synced_view_active() -> bool:
+    """True when a multi-rank world holds a synced (rank-0) tile view
+    this rank must consult even with its own ``HVD_FLASH_TUNE`` unset
+    — rank 0's settings are authoritative for the world, so a caller
+    that gates the ``best_blocks`` lookup on its LOCAL env alone
+    (``flash_attention`` does) would re-open the per-rank-env
+    divergence hole the sync closes. Purely local reads, trace-safe."""
+    return (_multi_rank_world() and not _world_opted_out()
+            and _synced_view() is not None)
+
+
+def _best_blocks_synced(key: str, mode: str) -> Optional[Tuple[int, int]]:
+    """Tile lookup against the world-synced view — purely local, no
+    collective, identical on every rank by construction. Cold-tuning
+    is refused here: a per-rank timing sweep is the divergence hazard
+    itself, and a rank-0-only sweep would need a trace-time collective
+    to publish (the wedge shape above). Misses fall back to defaults
+    uniformly; warm the cache from one process first (docs/mfu.md)."""
+    global _warned_cold_multirank
+
+    rec = (_synced_view() or {}).get(key)
+    if rec is not None:
+        return rec["block_q"], rec["block_k"]
+    if mode == "1" and not _warned_cold_multirank:
+        _warned_cold_multirank = True
+        logger.warning(
+            "flash tuner: HVD_FLASH_TUNE=1 in a multi-rank world — "
+            "cold-tuning is refused (per-rank timing sweeps trace "
+            "divergent programs); shape %s falls back to defaults on "
+            "every rank. Warm the cache from a single process and "
+            "relaunch with HVD_FLASH_TUNE=cache (docs/mfu.md)", key)
+    return None
 
 
 def tuned_snapshot() -> Dict[str, Dict]:

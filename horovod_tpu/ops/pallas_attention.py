@@ -411,11 +411,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
             "HVD_FLASH_BLOCK_K" not in os.environ:
         from horovod_tpu.ops import block_tuner
 
-        if block_tuner.tune_mode():
+        if block_tuner.tune_mode() \
+                or block_tuner.world_synced_view_active():
             # On-first-call autotuning: the sweep (or a cache hit from
             # an earlier process) picks the tiles for this live shape.
             # Runs at trace time on synthetic same-shape inputs, so a
-            # jitted caller tunes exactly once per shape.
+            # jitted caller tunes exactly once per shape. The second
+            # arm matters when THIS rank has HVD_FLASH_TUNE unset but
+            # the world synced rank 0's tile view at init: rank 0's
+            # settings are authoritative, and skipping the lookup
+            # here would trace default tiles against rank 0's tuned
+            # ones — the per-rank env divergence docs/mfu.md forbids.
             picked = block_tuner.best_blocks(
                 q.shape[1], k.shape[1], d, q.dtype, causal,
                 interpret=interpret)

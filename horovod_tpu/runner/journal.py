@@ -38,9 +38,13 @@ final line (the crash landed mid-append) is tolerated and dropped.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Set
+
+logger = logging.getLogger("horovod_tpu")
 
 # Default blacklist threshold for standalone replay() calls; the
 # driver passes its own ElasticDriver.MAX_SLOT_FAILURES so the two
@@ -74,8 +78,23 @@ class DriverJournal:
     version some worker already saw exceeded.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, drop_after_close: bool = False):
         self.path = path
+        # drop_after_close: the online tuner's journal opts in — its
+        # elastic on_world_change restore legitimately races
+        # stop_online_tuner, and a dropped tune record is a lost
+        # optimization, not a lost WAL entry. The driver/router
+        # journals keep the default: there an append-after-close IS a
+        # WAL-ordering bug, and it must keep failing loudly (the
+        # closed-file ValueError) instead of silently losing the
+        # record replay/forensics depend on.
+        self._drop_after_close = drop_after_close
+        # Serializes appends: the online tuner journals from both its
+        # search thread and the elastic worker's on_world_change
+        # restore — interleaved fh.write calls would merge two records
+        # into one unparsable MID-file line, and replay stops at the
+        # first bad line.
+        self._append_lock = threading.Lock()
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._truncate_torn_tail(path)
@@ -117,15 +136,28 @@ class DriverJournal:
             return
 
     def append(self, record: dict) -> None:
-        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
+        with self._append_lock:
+            if self._fh.closed and self._drop_after_close:
+                # A writer racing teardown (the elastic worker's
+                # on_world_change vs stop_online_tuner): drop the
+                # record rather than raise out of the reset path —
+                # but LOUDLY. Default-mode journals fall through to
+                # the write below and raise the closed-file
+                # ValueError: for them this is a WAL-ordering bug.
+                logger.warning(
+                    "journal %s: dropping %r record appended after "
+                    "close", self.path, record.get("type"))
+                return
+            self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
 
     def close(self) -> None:
-        try:
-            self._fh.close()
-        except OSError:
-            pass
+        with self._append_lock:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
 
     @staticmethod
     def replay(path: str,

@@ -154,6 +154,30 @@ def serve_rows_total() -> float:
 # --- knob application --------------------------------------------------------
 
 
+def _shared_world() -> bool:
+    """Lazy-import delegate (this module must stay importable without
+    triggering basics' init-time machinery); checked at every
+    live-unsafe apply, not just tuner start, because elastic worlds
+    grow after the tuner thread is already running."""
+    from horovod_tpu.common import basics
+
+    return basics.is_shared_world()
+
+
+# Serializes every KnobBinding.apply — gate check AND write as one
+# atomic unit. Closes the TOCTOU between the live_safe gate and the
+# env/native write: a search thread that passed the gate at size 1
+# could otherwise be descheduled, the world grow via elastic reinit,
+# on_world_change restore the launch value, and the stale write then
+# land on top — leaving this rank's next retrace divergent. With the
+# lock, a stale apply either completes BEFORE the restore (which then
+# overwrites it, uniform) or acquires after, re-reads _shared_world()
+# — already True by the time on_world_change runs, program order in
+# the worker thread — and refuses. Leaf lock: apply never takes
+# another tuner lock inside it.
+_apply_lock = threading.Lock()
+
+
 class KnobBinding:
     """One schema knob wired to its apply path. ``setter`` overrides
     the schema path (the serve batcher registers one); otherwise
@@ -165,6 +189,20 @@ class KnobBinding:
                  setter: Optional[Callable[[float], None]] = None):
         self.knob = knob
         self._setter = setter
+        # Launch anchor, captured RAW at binding construction (before
+        # any tuner mutation): the one rank-uniform restore target in
+        # a shared world — freshly joined peers inherit the same job
+        # env this process launched with. _apply_locked clamps
+        # shared-world restores of live-unsafe knobs to it UNDER the
+        # lock, so a revert whose target was computed before an
+        # elastic reinit cannot land a stale per-rank incumbent.
+        # Presence matters as much as the value: when the env mirror
+        # was UNSET at launch, the uniform restore must DELETE it —
+        # e.g. flash_attention's tuner gate triggers on the mere
+        # presence of HVD_FLASH_BLOCK_Q/K, so a left-behind mirror
+        # would flip this rank out of the rank-0 synced tile view.
+        self._launch = float(self.current())
+        self._launch_env_set = bool(knob.env) and knob.env in os.environ
 
     @property
     def name(self) -> str:
@@ -182,17 +220,73 @@ class KnobBinding:
             return raw
         return self.knob.default
 
-    def apply(self, value: float) -> float:
+    def apply(self, value: float, *, restore: bool = False) -> float:
         """Snap ``value`` to the knob's grid, push it through the apply
-        path, mirror it to the env knob; returns the snapped value."""
-        value = tunable_snap(self.knob, value)
+        path, mirror it to the env knob; returns the snapped value.
+
+        live_safe gate: a ``live_safe=False`` knob is never mutated
+        while this process shares a world — the start-time filter in
+        ``start_online_tuner`` drops such knobs from the searched set,
+        but an ELASTIC world can grow after the tuner started (size 1
+        at start, peers join via reinit), and per-rank mutation of a
+        trace-time knob then lowers divergent XLA programs. Refusing
+        at the apply path closes that window no matter how the
+        binding was composed; the refusal returns the live value so
+        the tuner's bookkeeping stays coherent. ``restore=True``
+        (the guardrail's revert) is exempt: blocking a revert would
+        strand the knob at the mid-search value the guard just
+        rejected — restoring the incumbent moves TOWARD uniformity,
+        never away from it.
+
+        The whole check-then-write runs under the module ``_apply_lock``
+        (see its comment): the gate re-reads ``_shared_world()``
+        atomically with the write, so a stale search-thread apply can
+        never land AFTER on_world_change's uniform restore."""
+        with _apply_lock:
+            return self._apply_locked(value, restore)
+
+    def _apply_locked(self, value: float, restore: bool) -> float:
+        # analysis: holds-lock(_apply_lock) — only apply() calls this,
+        # with the lock held.
+        unset_env = False
+        if restore:
+            # Restores bypass the grid snap: the launch anchor must be
+            # re-applied BYTE-uniform with peers that inherit the raw
+            # job env — snapping an off-grid HVD_GRAD_BUCKET_BYTES
+            # onto the box would itself diverge from them.
+            value = float(value)
+            if not self.knob.live_safe and _shared_world():
+                # Re-derived UNDER the lock: restore targets are
+                # computed before the lock, so a revert racing an
+                # elastic reinit could carry a stale per-rank
+                # incumbent chosen at size 1 and land it after
+                # on_world_change's uniform restore. In a shared
+                # world the only uniform target for a live-unsafe
+                # knob is the launch anchor — including its ABSENCE:
+                # a mirror the job never set must be deleted, not
+                # written back as the default (peers gate on the
+                # var's mere presence, e.g. flash_attention skipping
+                # the synced tile view for HVD_FLASH_BLOCK_Q/K).
+                value = self._launch
+                unset_env = not self._launch_env_set
+        else:
+            value = tunable_snap(self.knob, value)
+            if not self.knob.live_safe and _shared_world():
+                logger.warning(
+                    "online tuner: refusing to apply live-unsafe knob "
+                    "%s in a multi-rank world (trace-time divergence "
+                    "hazard, docs/mfu.md)", self.knob.name)
+                return tunable_snap(self.knob, self.current())
         if self._setter is not None:
             self._setter(value)
         elif self.knob.apply_path == "native":
             self._apply_native(value)
         # env mirror (and the whole story for "env" knobs): next
         # use/trace/bootstrap reads the tuned value.
-        if self.knob.env:
+        if self.knob.env and unset_env:
+            # Restore-to-absent: the launch state had no mirror.
+            os.environ.pop(self.knob.env, None)
+        elif self.knob.env:
             if self.knob.name == "fusion_threshold_mb":
                 # The box's 0 MB endpoint means "unfused"; <=0 is "no
                 # update" downstream, so spell it as a 1-byte threshold
@@ -349,7 +443,8 @@ class OnlineTuner:
                  subwindows: int = DEFAULT_SUBWINDOWS,
                  seed: int = 1234,
                  clock: Callable[[], float] = time.monotonic,
-                 wait: Optional[Callable[[float], bool]] = None):
+                 wait: Optional[Callable[[float], bool]] = None,
+                 fence_knobs: Optional[Sequence[TunableKnob]] = None):
         if not bindings:
             raise ValueError("OnlineTuner needs at least one knob")
         if window_sec is None:
@@ -374,14 +469,27 @@ class OnlineTuner:
         # wait(seconds) -> True when the tuner should stop; the default
         # sleeps on the stop event so stop() interrupts a window.
         self._wait = wait if wait is not None else self._stop.wait
+        self._seed = seed
+        # The journal fence hashes the COMPOSED schema, captured once
+        # at init: the searched set may shrink (start-time live_safe
+        # drop in a multi-rank world, mid-run prune when the world
+        # grows), and a journal written by the full composition must
+        # keep replaying across those recompositions — values for
+        # knobs no longer bound are simply filtered at adoption.
+        self._fence_knobs = (list(fence_knobs) if fence_knobs is not None
+                             else [b.knob for b in self.bindings])
         self._bo = BayesianOptimizer(
             [(b.knob.lo, b.knob.hi) for b in self.bindings], seed=seed)
         self._journal: Optional[DriverJournal] = None
         self._journal_path = journal_path
         self._thread: Optional[threading.Thread] = None
         # _lock guards the search state shared between the tuner
-        # thread and state()/trajectory() readers.
+        # thread and state()/trajectory() readers. _prune_lock
+        # serializes _prune_live_unsafe between the search loop and
+        # on_world_change (the second entrant sees no live-unsafe
+        # bindings and no-ops).
         self._lock = threading.Lock()
+        self._prune_lock = threading.Lock()
         self._values: Dict[str, float] = {
             b.name: tunable_snap(b.knob, b.current())
             for b in self.bindings}
@@ -398,19 +506,21 @@ class OnlineTuner:
 
     @property
     def fence(self) -> str:
-        return schema_fence([b.knob for b in self.bindings])
+        return schema_fence(self._fence_knobs)
 
     def _attach_journal(self):
         if self._journal_path is None or self._journal is not None:
             return
-        self._journal = DriverJournal(self._journal_path)
+        self._journal = DriverJournal(self._journal_path,
+                                      drop_after_close=True)
         self._journal.append({
             "type": "tune_meta",
             "tuner_version": TUNER_VERSION,
             "fence": self.fence,
-            "knobs": {b.name: {"lo": b.knob.lo, "hi": b.knob.hi,
-                               "step": b.knob.step}
-                      for b in self.bindings},
+            # The fence schema, not the (possibly narrower) searched
+            # set — the fence string above hashes exactly these.
+            "knobs": {k.name: {"lo": k.lo, "hi": k.hi, "step": k.step}
+                      for k in self._fence_knobs},
         })
 
     def _record(self, rec: dict):
@@ -442,7 +552,7 @@ class OnlineTuner:
         if adopted:
             applied = self._apply_values(adopted)
             with self._lock:
-                self._values = applied
+                self._values.update(applied)
             self._record({"type": "tune_replay", "values": applied,
                           "resumed_samples": len(rep.samples),
                           "frozen": rep.frozen})
@@ -481,13 +591,123 @@ class OnlineTuner:
         return [float(values.get(b.name, b.knob.default))
                 for b in self.bindings]
 
-    def _apply_values(self, values: Dict[str, float]) -> Dict[str, float]:
-        return {b.name: b.apply(values[b.name])
+    def _apply_values(self, values: Dict[str, float],
+                      restore: bool = False) -> Dict[str, float]:
+        return {b.name: b.apply(values[b.name], restore=restore)
                 for b in self.bindings if b.name in values}
+
+    def _prune_live_unsafe(self) -> None:
+        """Elastic worlds grow mid-search: the start-time filter in
+        ``start_online_tuner`` cannot see a size-1 world that later
+        gains peers, and leaning on ``KnobBinding.apply``'s per-apply
+        refusal alone would leave a permanently dead search dimension
+        (every window proposing a value that can never land, with a
+        warning each time). Drop live-unsafe bindings ONCE when the
+        shared world is first observed, rebuild the optimizer box over
+        the survivors, and re-feed the measured samples projected onto
+        the remaining dims. With nothing left to search, freeze.
+
+        Only the search thread calls this on a LIVE search (step's
+        round top); on_world_change calls it only once that thread is
+        no longer running — so ``self.bindings`` is never swapped
+        under a concurrently built proposal. The lock just serializes
+        the two callers at that hand-off."""
+        with self._prune_lock:
+            self._prune_live_unsafe_locked()
+
+    def _prune_live_unsafe_locked(self) -> None:
+        # analysis: holds-lock(_prune_lock) — only _prune_live_unsafe
+        # calls this, with the lock held.
+        if not any(not b.knob.live_safe for b in self.bindings):
+            return
+        if not _shared_world():
+            return
+        dropped = sorted(b.name for b in self.bindings
+                         if not b.knob.live_safe)
+        logger.warning(
+            "online tuner: world grew mid-search — dropping "
+            "live-unsafe knob(s) %s and restoring their launch values "
+            "(trace-time divergence hazard, docs/mfu.md)",
+            ", ".join(dropped))
+        restored = self._restore_unsafe_to_launch()
+        keep = [b for b in self.bindings if b.knob.live_safe]
+        self.bindings = keep
+        if not keep:
+            # Nothing left to search: freeze AT the restored values,
+            # journaled — state()/bench JSON must report what is
+            # actually live, and post-mortem forensics (and a
+            # replaying restart) must see why the search ended. When
+            # the search had ALREADY frozen (the on_world_change
+            # path), record the restore as a prune instead of a
+            # second freeze.
+            with self._lock:
+                was_frozen = self._frozen
+                self._values = dict(restored)
+                self._frozen = True
+            if was_frozen:
+                self._record({"type": "tune_prune", "dropped": dropped,
+                              "restored": restored})
+            else:
+                self._record({"type": "tune_freeze",
+                              "values": dict(restored),
+                              "pruned": dropped,
+                              "reason": "live-unsafe knobs in a "
+                                        "shared world"})
+            _G_FROZEN.set(1.0)
+            return
+        self._bo = BayesianOptimizer(
+            [(b.knob.lo, b.knob.hi) for b in keep], seed=self._seed)
+        with self._lock:
+            measured = list(self._measured)
+            # The restored launch values STAY in _values: state() and
+            # the bench JSON must keep reporting what is live for the
+            # pruned knobs, not silently forget them.
+            self._values.update(restored)
+            for b in keep:
+                self._values.setdefault(
+                    b.name, tunable_snap(b.knob, b.current()))
+        self._record({"type": "tune_prune", "dropped": dropped,
+                      "restored": restored})
+        for values, score in measured:
+            self._bo.add_sample(self._as_vector(values), score)
+
+    def _restore_unsafe_to_launch(self) -> Dict[str, float]:
+        """Apply the launch anchor to every live-unsafe binding;
+        returns {name: restored value}. The anchor lives ON the
+        binding (KnobBinding._launch, captured raw at construction)
+        and _apply_locked clamps every shared-world live-unsafe
+        restore to it under the apply lock — one store, one clamp,
+        so the restore target cannot drift and a racing stale revert
+        cannot bypass it."""
+        restored: Dict[str, float] = {}
+        for b in list(self.bindings):
+            if not b.knob.live_safe:
+                restored[b.name] = b.apply(b._launch, restore=True)
+        return restored
+
+    def _restore_live_unsafe_values(self) -> None:
+        """Inline launch-value restore for live-unsafe bindings,
+        WITHOUT touching ``bindings``/``_bo`` — safe to call from
+        another thread while the search loop runs (a values-only
+        restore cannot misalign a concurrently built proposal; the
+        loop's own round-top prune does the structural drop). Called
+        by ``on_world_change`` so the worker's imminent retrace sees
+        uniform values instead of waiting up to a measurement window
+        for the round top. Shared-world gated like the structural
+        prune: a reset that lands on (or stays at) size 1 must not
+        yank values the tuner legitimately searches alone."""
+        if not _shared_world():
+            return
+        restored = self._restore_unsafe_to_launch()
+        if restored:
+            with self._lock:
+                self._values.update(restored)
+            self._record({"type": "tune_restore", "restored": restored})
 
     def step(self) -> Optional[dict]:
         """One search round (see class docstring); returns the round's
         outcome record, or None once frozen/stopped."""
+        self._prune_live_unsafe()
         with self._lock:
             if self._frozen:
                 return None
@@ -533,7 +753,13 @@ class OnlineTuner:
         proposal_vec = self._bo.suggest()
         proposal = {b.name: tunable_snap(b.knob, v)
                     for b, v in zip(self.bindings, proposal_vec)}
-        if proposal == current:
+        # Compare over the SEARCHED dims only: after a mid-search
+        # live-unsafe prune, _values deliberately retains the pruned
+        # knobs' restored entries for state() reporting, and a
+        # whole-dict comparison would never match — the converged
+        # search would burn a second measurement window every round.
+        if proposal == {b.name: current.get(b.name, b.knob.default)
+                        for b in self.bindings}:
             # Snapped onto the incumbent: nothing to A/B. Record the
             # sample and move on (counts toward freeze, so a converged
             # search terminates instead of spinning).
@@ -570,9 +796,17 @@ class OnlineTuner:
             self._measured.append((applied, post))
         if post < threshold:
             # Guardrail: regression beyond the noise band — revert.
-            restored = self._apply_values(current)
+            # restore=True: a revert must land even for a live-unsafe
+            # knob in a world that grew mid-search (see KnobBinding
+            # .apply). For such a knob _apply_locked redirects the
+            # restore to the binding's LAUNCH anchor, under the apply
+            # lock: the incumbent passed here may itself be a
+            # mid-search per-rank value adopted before the world
+            # grew, and re-applying it would undo on_world_change's
+            # uniform restore.
+            restored = self._apply_values(current, restore=True)
             with self._lock:
-                self._values = restored
+                self._values.update(restored)
             rec = {"type": "tune_revert", "values": restored,
                    "applied": applied, "objective": post,
                    "threshold": threshold, "sample": n_samples + 1}
@@ -582,7 +816,7 @@ class OnlineTuner:
             _M_MOVES.labels(outcome="revert").inc()
         else:
             with self._lock:
-                self._values = applied
+                self._values.update(applied)
             rec = {"type": "tune_accept", "values": applied,
                    "objective": post, "noise": sem,
                    "sample": n_samples + 1}
@@ -597,7 +831,9 @@ class OnlineTuner:
         best_values, best_score = max(pool, key=lambda s: s[1])
         applied = self._apply_values(best_values)
         with self._lock:
-            self._values = applied
+            # Merge, not replace: values restored by a mid-search
+            # live-unsafe prune must stay visible in state().
+            self._values.update(applied)
             self._frozen = True
         rec = {"type": "tune_freeze", "values": applied,
                "objective": best_score, "samples": n_samples}
@@ -710,9 +946,42 @@ def start_online_tuner(role: str = "training",
         setters = setters or {}
         bindings = [KnobBinding(TUNABLE[n], setter=setters.get(n))
                     for n in names if n not in frozen]
+        # The journal fence is pinned to this COMPOSED set, before any
+        # live_safe drop: a journal written at size 1 (full set) must
+        # still replay after a restart into a multi-rank world (and
+        # vice versa) — only a real schema/freeze change re-fences.
+        fence_knobs = [b.knob for b in bindings]
+        # live_safe contract, runtime half (docs/autotune.md): knobs
+        # whose per-rank mutation lowers rank-divergent XLA programs
+        # (live_safe=False: grad buckets, flash tiles, planner
+        # weights) must never be searched while this process shares a
+        # world. The static half — the spmd checker — gates the
+        # DECLARED *_KNOBS sets; this guards whatever was actually
+        # composed at runtime, and degrades by dropping the knob, not
+        # the tuner. (KnobBinding.apply refuses live-unsafe mutations
+        # too, covering elastic worlds that GROW after start.)
+        dropped_unsafe: List[str] = []
+        if _shared_world():
+            dropped_unsafe = sorted(
+                b.name for b in bindings if not b.knob.live_safe)
+            if dropped_unsafe:
+                logger.warning(
+                    "online tuner: dropping live-unsafe knob(s) %s in "
+                    "a multi-rank world — per-rank search of "
+                    "trace-time knobs desyncs the collective sequence "
+                    "(docs/mfu.md)", ", ".join(dropped_unsafe))
+                bindings = [b for b in bindings if b.knob.live_safe]
         if not bindings:
-            logger.warning("HVD_TUNE set but every %s knob is frozen "
-                           "(HVD_TUNE_FREEZE) — tuner not started", role)
+            if dropped_unsafe:
+                logger.warning(
+                    "HVD_TUNE set but every remaining %s knob is "
+                    "live-unsafe in this multi-rank world (%s) — "
+                    "tuner not started", role,
+                    ", ".join(dropped_unsafe))
+            else:
+                logger.warning(
+                    "HVD_TUNE set but every %s knob is frozen "
+                    "(HVD_TUNE_FREEZE) — tuner not started", role)
             return None
         if objective is None:
             objective = (wire_bytes_total if role == "training"
@@ -724,7 +993,7 @@ def start_online_tuner(role: str = "training",
                     if role == "training" else role)
         tuner = OnlineTuner(bindings, objective,
                             journal_path=_journal_path_for(name),
-                            **kwargs)
+                            fence_knobs=fence_knobs, **kwargs)
         tuner.start(replay_only=(mode == "cache"))
         _global_tuner = tuner
         return tuner
@@ -733,6 +1002,39 @@ def start_online_tuner(role: str = "training",
 def online_tuner() -> Optional[OnlineTuner]:
     with _global_lock:
         return _global_tuner
+
+
+def on_world_change() -> None:
+    """Called by the elastic worker after a reinit changed the world
+    (the only in-tree mechanism by which a process's world size moves
+    mid-lifetime). A tuner that searched — or already FROZE at — a
+    live-unsafe value while alone must restore it the moment the
+    world is shared: the search thread exits at freeze, so the
+    in-loop prune can never fire for the frozen case.
+
+    Thread discipline: a LIVE search loop prunes itself at its next
+    round top (within one round; KnobBinding.apply's refusal covers
+    the gap), so this never swaps ``bindings`` under a concurrently
+    built proposal — it only prunes inline once the search thread is
+    no longer running. A frozen thread does no further waits, so the
+    short join below is bounded. No-op without a tuner or live-unsafe
+    bindings."""
+    tuner = online_tuner()
+    if tuner is None:
+        return
+    # Values restore FIRST, unconditionally (thread-safe by design):
+    # whatever the search thread's state — live, frozen-and-exiting,
+    # or wedged in an error backoff — the worker retraces immediately
+    # after this reset and must see uniform values.
+    tuner._restore_live_unsafe_values()
+    t = tuner._thread
+    if t is not None and t.is_alive():
+        if not tuner.state()["frozen"]:
+            return  # live search: its round-top prune drops bindings
+        t.join(timeout=5)  # frozen: the loop is exiting, no sleeps left
+        if t.is_alive():
+            return  # did not exit in time; retry on the next reset
+    tuner._prune_live_unsafe()
 
 
 def stop_online_tuner():

@@ -1,0 +1,83 @@
+"""np=2 worker: flash-tile adoption stays lockstep across ranks.
+
+Regression pin for the multi-host cold-tune divergence hazard
+(docs/mfu.md, enforced by the ``spmd`` sweep of ISSUE 14): each rank
+is seeded with a DIFFERENT per-host tuner cache for the same shape —
+exactly the state a drifted fleet cache produces — before ``init``.
+Pre-fix, each rank answered from its own cache and the job would
+trace divergent XLA programs whose collective sequences desync;
+post-fix ``basics.init`` ships rank 0's folded cache to every rank
+(``block_tuner.sync_cache_across_world``), ``best_blocks`` answers
+only from that uniform view with NO trace-time collective, and
+multi-rank cold-tuning is refused uniformly instead of sweeping
+per rank.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from horovod_tpu.ops import block_tuner  # noqa: E402
+
+# Per-rank cache files simulate per-HOST caches that drifted: the same
+# shape key maps to different winners on each "host". Seeded BEFORE
+# init so the init-time sync sees them.
+_RANK = int(os.environ["HOROVOD_RANK"])
+_CACHE = os.environ["HVD_FLASH_SYNC_CACHE_DIR"] + "/rank%d.jsonl" % _RANK
+os.environ["HVD_FLASH_TUNE_CACHE"] = _CACHE
+os.environ["HVD_FLASH_TUNE"] = "cache"
+_KEY = block_tuner.shape_key(256, 256, 64, "float32", True,
+                             block_tuner._device_kind())
+_MINE = (256, 512) if _RANK == 0 else (128, 128)
+block_tuner.append_record({
+    "version": block_tuner.CACHE_VERSION, "key": _KEY,
+    "block_q": _MINE[0], "block_k": _MINE[1]}, _CACHE)
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    assert r == _RANK
+
+    # From here on, NO collective may run at trace/lookup time: a
+    # respawned elastic peer traces while survivors' compiled steps
+    # never re-enter best_blocks, so any in-band broadcast would
+    # wedge. Poison the broadcast path to prove lookups are local.
+    from horovod_tpu.common import objects as _objects
+
+    def _no_broadcast(*a, **kw):
+        raise AssertionError("best_blocks issued a trace-time "
+                             "collective")
+
+    real_broadcast = _objects.broadcast_object
+    _objects.broadcast_object = _no_broadcast
+
+    got = block_tuner.best_blocks(256, 256, 64, "float32", True)
+    # A shape NO rank has a record for resolves to None uniformly.
+    miss = block_tuner.best_blocks(64, 64, 32, "float32", False)
+    # Cold-tune in a multi-rank world is refused uniformly — no
+    # per-rank sweep, no error, defaults everywhere.
+    os.environ["HVD_FLASH_TUNE"] = "1"
+    cold = block_tuner.best_blocks(96, 96, 16, "float32", False)
+
+    _objects.broadcast_object = real_broadcast
+    everyone = hvd.allgather_object((got, miss, cold),
+                                    name="flash_sync.verdict")
+    assert len(everyone) == 2, everyone
+    # Lockstep: every rank adopted rank 0's winner, not its own cache,
+    # and every miss/refusal is None on both ranks.
+    assert everyone[0] == everyone[1] == ((256, 512), None, None), \
+        "ranks diverged: %r (rank %d seeded local %r)" % (
+            everyone, r, _MINE)
+
+    print("FLASH_SYNC_OK rank", r)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -450,7 +450,7 @@ def test_every_checker_ran_against_fixture(tree):
     """Guard against a checker silently dropping out of run_all."""
     assert set(CHECKERS) == {"knobs", "counters", "ctypes", "metrics",
                              "excepts", "locks", "journal", "jaxcompat",
-                             "testtier"}
+                             "testtier", "spmd"}
 
 
 def test_build_refuses_any_sanitizer_preload(monkeypatch, tmp_path):
@@ -473,7 +473,7 @@ def test_build_refuses_any_sanitizer_preload(monkeypatch, tmp_path):
 # locks / journal / jaxcompat / testtier: same fixture-tree discipline —
 # known-good passes, each seeded violation fails, tags suppress, the
 # real tree stays clean (test_real_tree_is_clean above already runs all
-# nine checkers).
+# checkers).
 
 # --- locks: python ----------------------------------------------------------
 
@@ -945,3 +945,482 @@ def test_crashing_checker_dies_with_its_name(tree, monkeypatch):
     monkeypatch.setitem(pkg.CHECKERS, "locks", boom)
     with pytest.raises(RuntimeError, match="checker 'locks' crashed"):
         run_all(project(tree))
+
+
+# ====================== spmd checker (ISSUE 14) ==============================
+# Interprocedural SPMD-divergence & collective-deadlock lanes: fixture
+# root-collective stubs below stand in for ops/eager.py; each seeded
+# violation fails under --checker spmd, tags suppress, the machinery
+# baselines, the real tree stays clean (test_real_tree_is_clean runs
+# all ten checkers).
+
+SPMD_EAGER_STUB = '''
+def allreduce(x, **kw):
+    return x
+
+
+def allreduce_async(x, **kw):
+    return 0
+
+
+def allgather(x, **kw):
+    return x
+
+
+def barrier():
+    pass
+
+
+def synchronize(handle):
+    return handle
+'''
+
+SPMD_PKG_STUB = '''
+from horovod_tpu.ops.eager import allreduce, allgather, barrier
+
+
+def rank():
+    return 0
+
+
+def size():
+    return 1
+'''
+
+
+def _seed_spmd_roots(tree):
+    _seed(tree, "horovod_tpu/ops/__init__.py", "")
+    _seed(tree, "horovod_tpu/ops/eager.py", SPMD_EAGER_STUB)
+    # Overwrites the minimal fixture __init__ with a re-exporting one
+    # so `import horovod_tpu as hvd; hvd.allreduce(...)` resolves.
+    _seed(tree, "horovod_tpu/__init__.py", SPMD_PKG_STUB)
+
+
+def test_spmd_known_good_fixture_passes(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/clean.py", '''
+import horovod_tpu as hvd
+
+
+def main():
+    out = hvd.allreduce(1)
+    if hvd.rank() == 0:
+        print(out)  # divergent print is fine: no collective inside
+    return out
+''')
+    assert _keys(run_all(project(tree)), "spmd") == []
+
+
+def test_spmd_tainted_branch_collective_fails(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/gated.py", '''
+import horovod_tpu as hvd
+
+
+def main():
+    if hvd.rank() == 0:
+        hvd.allreduce(1)
+''')
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert any(k.startswith("divergent:main:") and ":branch:" in k
+               for k in keys), keys
+
+
+def test_spmd_transitive_helper_divergence_fails(tree):
+    """The helper issues the collective; the caller's tainted branch
+    is where the world desyncs — the call graph must connect them."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/helper.py", '''
+import horovod_tpu as hvd
+
+
+def sync_up(x):
+    return hvd.allreduce(x)
+
+
+def main():
+    r = hvd.rank()
+    if r == 0:
+        return sync_up(1)
+''')
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert any(k.startswith("divergent:main:") for k in keys), keys
+    # The helper itself is NOT a finding: it issues unconditionally.
+    assert not any(k.startswith("divergent:sync_up:") for k in keys)
+
+
+def test_spmd_early_exit_domination_fails(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/early.py", '''
+import horovod_tpu as hvd
+
+
+def main():
+    if hvd.rank() != 0:
+        return
+    hvd.barrier()
+''')
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert any(":early-exit:" in k for k in keys), keys
+
+
+def test_spmd_tainted_while_and_loop_bound_fail(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/loops.py", '''
+import random
+import time
+
+import horovod_tpu as hvd
+
+
+def timed(deadline):
+    while time.monotonic() < deadline:
+        hvd.allreduce(1)
+
+
+def randomized():
+    for _ in range(random.randint(1, 4)):
+        hvd.barrier()
+''')
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert any(k.startswith("divergent:timed:") and ":loop:" in k
+               for k in keys), keys
+    assert any(k.startswith("divergent:randomized:") and ":loop:" in k
+               for k in keys), keys
+
+
+def test_spmd_while_else_runs_uniformly(tree):
+    """A tainted while's ELSE clause runs on normal loop exit —
+    every rank reaches it (same rule as for-else) — so a collective
+    there is NOT dominated by the loop condition."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/while_else.py", '''
+import time
+
+import horovod_tpu as hvd
+
+
+def drain(deadline):
+    while time.monotonic() < deadline:
+        pass
+    else:
+        hvd.barrier()
+''')
+    assert _keys(run_all(project(tree)), "spmd") == []
+
+
+def test_spmd_per_rank_env_gate_fails(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/envgate.py", '''
+import os
+
+import horovod_tpu as hvd
+
+
+def main():
+    if os.environ.get("HVD_FAULT_RANK") == "1":
+        hvd.barrier()
+''')
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert any(k.startswith("divergent:main:") for k in keys), keys
+
+
+def test_spmd_rank_uniform_tag_suppresses(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/tagged.py", '''
+import horovod_tpu as hvd
+
+
+def main():
+    # analysis: rank-uniform(every rank reads the same journal, so the
+    # replayed decision — and this branch — agree across the world)
+    if hvd.rank() >= 0:
+        hvd.allreduce(1)
+''')
+    assert _keys(run_all(project(tree)), "spmd") == []
+
+
+def test_spmd_callback_thread_collective_fails_and_tag(tree):
+    _seed_spmd_roots(tree)
+    body = '''
+import threading
+
+from horovod_tpu.ops import eager
+
+
+class Svc:
+    def _beat(self):
+        eager.barrier()
+
+    def start(self):
+        t = threading.Thread(target=self._beat, daemon=True)
+        t.start()
+'''
+    _seed(tree, "horovod_tpu/svc.py", body)
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert "thread-collective:Svc._beat" in keys, keys
+    # Async submission from a thread is fine — only BLOCKING waits
+    # can deadlock the completing thread against itself.
+    _seed(tree, "horovod_tpu/svc.py",
+          body.replace("eager.barrier()", "eager.allreduce_async(1)"))
+    assert _keys(run_all(project(tree)), "spmd") == []
+    # thread-ok tag on the registration suppresses.
+    _seed(tree, "horovod_tpu/svc.py", body.replace(
+        "        t = threading.Thread(target=self._beat, daemon=True)",
+        "        # analysis: thread-ok(joined before init; no world)\n"
+        "        t = threading.Thread(target=self._beat, daemon=True)"))
+    assert _keys(run_all(project(tree)), "spmd") == []
+
+
+def test_spmd_put_callback_entry_fails(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "horovod_tpu/kv.py", '''
+from horovod_tpu.ops import eager
+
+
+def on_put(scope, key):
+    eager.allgather(key)
+
+
+def serve(server_cls):
+    return server_cls(port=0, put_callback=on_put)
+''')
+    assert "thread-collective:on_put" in \
+        _keys(run_all(project(tree)), "spmd")
+
+
+def test_spmd_live_unsafe_knob_in_runtime_loop_fails(tree):
+    _seed_spmd_roots(tree)
+    _seed(tree, "horovod_tpu/common/knobs.py", KNOBS_PY + '''
+from typing import Dict, Optional
+
+
+class TunableKnob(NamedTuple):
+    name: str
+    lo: float
+    hi: float
+    step: float
+    apply_path: str
+    env: Optional[str]
+    default: float
+    live_safe: bool
+    detail: str
+
+
+TUNABLE: Dict[str, TunableKnob] = {t.name: t for t in [
+    TunableKnob("cycle_time_ms", 1.0, 100.0, 0.5, "native",
+                "HOROVOD_CYCLE_TIME", 1.0, True, "safe"),
+    TunableKnob("grad_bucket_bytes", 0.0, 64.0, 1.0, "env",
+                "HVD_GRAD_BUCKET_BYTES", 4.0, False, "trace-time"),
+]}
+''')
+    _seed(tree, "horovod_tpu/utils/__init__.py", "")
+    _seed(tree, "horovod_tpu/utils/online_tuner.py",
+          'TRAINING_KNOBS = ("cycle_time_ms",)\n')
+    assert _keys(run_all(project(tree)), "spmd") == []
+    _seed(tree, "horovod_tpu/utils/online_tuner.py",
+          'TRAINING_KNOBS = ("cycle_time_ms", "grad_bucket_bytes")\n')
+    assert "live-unsafe:grad_bucket_bytes" in \
+        _keys(run_all(project(tree)), "spmd")
+
+
+def test_spmd_findings_are_baselinable(tree, tmp_path):
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/gated.py", '''
+import horovod_tpu as hvd
+
+
+def main():
+    if hvd.rank() == 0:
+        hvd.allreduce(1)
+''')
+    baseline = str(tmp_path / "baseline.json")
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "spmd"]) == 1
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "spmd", "--update-baseline"]) == 0
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "spmd"]) == 0
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "spmd", "--no-baseline"]) == 1
+
+
+def test_json_format_output(tree, tmp_path, capsys):
+    """--format json: machine-readable findings with fingerprints and
+    baselined-ness; exit codes unchanged; text default untouched."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/gated.py", '''
+import horovod_tpu as hvd
+
+
+def main():
+    if hvd.rank() == 0:
+        hvd.allreduce(1)
+''')
+    baseline = str(tmp_path / "baseline.json")
+    rc = analysis_main(["--root", tree, "--baseline", baseline,
+                        "--checker", "spmd", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["ok"] is False and doc["new"] == 1
+    [f] = doc["findings"]
+    assert f["checker"] == "spmd"
+    assert f["fingerprint"].startswith("spmd::examples/gated.py::")
+    assert f["file"] == "examples/gated.py" and f["line"] > 0
+    assert f["location"] == "%s:%d" % (f["file"], f["line"])
+    assert f["baselined"] is False and f["justification"] is None
+    # Baselined finding: ok flips, the justification rides along.
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "spmd", "--update-baseline"]) == 0
+    capsys.readouterr()
+    rc = analysis_main(["--root", tree, "--baseline", baseline,
+                        "--checker", "spmd", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["ok"] is True and doc["new"] == 0
+    [f] = doc["findings"]
+    assert f["baselined"] is True and f["justification"]
+
+
+def test_analysis_runtime_stays_in_seconds():
+    """Deflake guard (ISSUE 14 ridealong): the whole ten-checker run
+    over the REAL tree must stay interactive — the spmd call graph
+    rides the same per-run AST memoization as the other checkers (one
+    parse per file per Project), so the full run is a few seconds of
+    pure-Python AST work. 60 s is ~10x headroom for a loaded CI host;
+    breaching it means a second parse pass or quadratic propagation
+    crept in."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    rc = analysis_main(["--root", _REPO])
+    elapsed = _time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 60.0, "analysis run took %.1fs" % elapsed
+
+
+def test_spmd_shares_the_ast_memoization():
+    """No second parse pass: after one run_all, every file the spmd
+    surface shares with the python scan surface sits in the SAME
+    Project parse cache (parsed() memoizes per rel path)."""
+    from tools.analysis.common import Project as _P
+
+    p = _P(_REPO)
+    run_all(p)
+    shared = set(p.python_files()) & set(p.spmd_files())
+    assert shared, "surfaces unexpectedly disjoint"
+    missing = [rel for rel in shared if rel not in p._ast_cache]
+    assert not missing, missing[:5]
+
+
+def test_spmd_collective_in_nested_header_under_taint_fails(tree):
+    """Review fix: a collective inside a nested statement's HEADER
+    expression (for-iter, while-test, with-item) under a tainted
+    branch must be flagged — header expressions execute whenever
+    control reaches the statement, so the outer taint dominates."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/header.py", '''
+import horovod_tpu as hvd
+
+
+def main(ys):
+    if hvd.rank() == 0:
+        for x in hvd.allgather(ys):
+            print(x)
+''')
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert any(k.startswith("divergent:main:") for k in keys), keys
+
+
+def test_json_format_update_baseline_emits_json(tree, tmp_path, capsys):
+    """Review fix: --format json --update-baseline must keep the
+    one-JSON-document-on-stdout contract, not fall through to text."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "examples/gated.py", '''
+import horovod_tpu as hvd
+
+
+def main():
+    if hvd.rank() == 0:
+        hvd.allreduce(1)
+''')
+    baseline = str(tmp_path / "baseline.json")
+    rc = analysis_main(["--root", tree, "--baseline", baseline,
+                        "--checker", "spmd", "--update-baseline",
+                        "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["ok"] is True and doc["updated"] == 1
+    assert doc["baseline"] == baseline
+
+
+def test_spmd_local_named_like_collective_is_not_flagged(tree):
+    """Review fix: a local/parameter that merely SHARES a collective's
+    name (barrier, join, broadcast...) must not resolve to a root —
+    only names vouched for by an import or def may."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "horovod_tpu/localnames.py", '''
+from horovod_tpu import rank
+
+
+def f(make_barrier):
+    barrier = make_barrier()
+    if rank() == 0:
+        barrier()
+
+
+def g(rows):
+    join = rows.join
+    if rank() == 0:
+        return join(",")
+''')
+    assert _keys(run_all(project(tree)), "spmd") == []
+
+
+def test_spmd_imported_class_state_method_still_resolves(tree):
+    """Review fix: `from ...state import State; State.commit(...)`
+    must reach the state-method root fallback instead of being
+    misread as a submodule lookup that resolves to nothing."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "horovod_tpu/elastic/__init__.py", "")
+    _seed(tree, "horovod_tpu/elastic/state.py", '''
+class State:
+    @staticmethod
+    def commit(s):
+        pass
+''')
+    _seed(tree, "examples/clsmeth.py", '''
+from horovod_tpu import rank
+from horovod_tpu.elastic.state import State
+
+
+def main(s):
+    if rank() == 0:
+        State.commit(s)
+''')
+    keys = _keys(run_all(project(tree)), "spmd")
+    assert any(k.startswith("divergent:main:State.commit")
+               for k in keys), keys
+
+
+def test_spmd_bare_name_never_resolves_to_sibling_method(tree):
+    """Review fix: a bare call inside a method must not resolve to a
+    same-named sibling METHOD (Python bare names cannot see class
+    attributes) — only nested defs, enclosing-function defs, and
+    module-namespace names count."""
+    _seed_spmd_roots(tree)
+    _seed(tree, "horovod_tpu/driver.py", '''
+from horovod_tpu import rank
+from horovod_tpu.ops import eager
+
+
+def helper_shutdown():
+    pass
+
+
+class Driver:
+    def shutdown(self):
+        eager.barrier()
+
+    def run(self, shutdown=helper_shutdown):
+        if rank() == 0:
+            shutdown()
+''')
+    assert _keys(run_all(project(tree)), "spmd") == []

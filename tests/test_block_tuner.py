@@ -196,3 +196,127 @@ def test_cpu_interpreter_sweep_selects_non_default_winner(
     flash_attention(q, q, q, causal=True)
     assert metrics.REGISTRY.snapshot()[
         "hvd_flash_tuner_trials_total"]["values"][0]["value"] == trials
+
+
+# --- multi-rank lockstep (ISSUE 14 spmd sweep) ------------------------------
+
+def test_np2_divergent_caches_adopt_rank0_winner(tmp_path):
+    """Regression pin for the real divergence the spmd sweep fixed:
+    two ranks seeded with DIFFERENT per-host cache winners for one
+    shape must both trace rank 0's tiles (init ships rank 0's cache
+    view to every rank; pre-fix each rank returned its own hit and
+    lowered divergent programs), with NO collective at trace time
+    (the worker poisons broadcast_object around its lookups) and
+    multi-rank cold-tuning refused uniformly. Runs a REAL np=2 fleet
+    over the native control plane — the assertions live in
+    tests/flash_sync_worker.py."""
+    from tests.test_native_core import _launch
+
+    codes, outputs = _launch(
+        2, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "flash_sync_worker.py"),
+        extra_env={"HVD_FLASH_SYNC_CACHE_DIR": str(tmp_path)})
+    for r, (c, out) in enumerate(zip(codes, outputs)):
+        assert c == 0, "rank %d failed:\n%s" % (r, out)
+    assert sum("FLASH_SYNC_OK" in o for o in outputs) == 2
+
+
+def test_synced_view_overrides_local_env_gate(monkeypatch):
+    """Review fix: a rank whose own HVD_FLASH_TUNE is unset must still
+    adopt tiles from the world-synced view (rank 0's settings are
+    authoritative) — per-rank env divergence must never split the
+    traced programs."""
+    from horovod_tpu.common import basics
+
+    monkeypatch.delenv("HVD_FLASH_TUNE", raising=False)
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    key = block_tuner.shape_key(256, 256, 64, "float32", True,
+                                block_tuner._device_kind())
+    monkeypatch.setattr(block_tuner, "_synced_cache",
+                        {key: _rec(key, 256, 512)})
+    monkeypatch.setattr(block_tuner, "_synced_generation",
+                        basics.init_generation())
+    assert block_tuner.best_blocks(256, 256, 64, "float32", True) \
+        == (256, 512)
+    # No synced view and tuning locally off: defaults, no key math.
+    monkeypatch.setattr(block_tuner, "_synced_cache", None)
+    assert block_tuner.best_blocks(256, 256, 64, "float32", True) \
+        is None
+
+
+def test_local_sync_optout_env_cannot_split_the_read_path(monkeypatch):
+    """Review fix: HVD_FLASH_TUNE_SYNC=0 in THIS rank's env (stale
+    launcher env on a respawn, say) must not flip this rank alone to
+    local cache reads while peers adopt the synced view — the opt-out
+    is rank-0-authoritative, carried by the sync broadcast, so the
+    local env is ignored on the read path."""
+    from horovod_tpu.common import basics
+
+    monkeypatch.setenv("HVD_FLASH_TUNE_SYNC", "0")
+    monkeypatch.delenv("HVD_FLASH_TUNE", raising=False)
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    key = block_tuner.shape_key(256, 256, 64, "float32", True,
+                                block_tuner._device_kind())
+    monkeypatch.setattr(block_tuner, "_synced_cache",
+                        {key: _rec(key, 256, 512)})
+    monkeypatch.setattr(block_tuner, "_synced_generation",
+                        basics.init_generation())
+    monkeypatch.setattr(block_tuner, "_synced_optout", False)
+    assert block_tuner.best_blocks(256, 256, 64, "float32", True) \
+        == (256, 512)
+    assert block_tuner.world_synced_view_active()
+    # The broadcast opt-out (rank 0's decision) DOES flip the world
+    # to local reads — uniformly, because every rank received it.
+    monkeypatch.setattr(block_tuner, "_synced_optout", True)
+    monkeypatch.setenv("HVD_FLASH_TUNE", "cache")
+    block_tuner.append_record(_rec(key, 128, 128))
+    block_tuner._mem_cache = {}
+    block_tuner._mem_cache_path = None
+    assert block_tuner.best_blocks(256, 256, 64, "float32", True) \
+        == (128, 128)
+    assert not block_tuner.world_synced_view_active()
+
+
+def test_flash_attention_consults_synced_view_without_local_env(
+        monkeypatch):
+    """Review fix: flash_attention's local HVD_FLASH_TUNE gate must
+    not bypass best_blocks when the world synced rank 0's tile view —
+    otherwise a rank with the env unset traces DEFAULT tiles against
+    rank 0's tuned ones, the per-rank-env divergence the init-time
+    sync exists to close. Pinned at the caller level: the synced
+    winner (32, 16) is a tile choice the defaults (256, 512) would
+    never produce at this shape."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops import pallas_attention
+
+    monkeypatch.delenv("HVD_FLASH_TUNE", raising=False)
+    monkeypatch.delenv("HVD_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("HVD_FLASH_BLOCK_K", raising=False)
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    key = block_tuner.shape_key(64, 64, 8, "float32", True,
+                                block_tuner._device_kind())
+    monkeypatch.setattr(block_tuner, "_synced_cache",
+                        {key: _rec(key, 32, 16)})
+    monkeypatch.setattr(block_tuner, "_synced_generation",
+                        basics.init_generation())
+    assert block_tuner.world_synced_view_active()
+
+    picked = {}
+    real_flash = pallas_attention._flash
+
+    def spy(qt, kt, vt, causal, block_q, block_k, scale, interpret):
+        picked["blocks"] = (block_q, block_k)
+        return real_flash(qt, kt, vt, causal, block_q, block_k, scale,
+                          interpret)
+
+    monkeypatch.setattr(pallas_attention, "_flash", spy)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 64, 1, 8), jnp.float32)
+    out = pallas_attention.flash_attention(q, q, q, causal=True)
+    assert out.shape == q.shape
+    assert picked["blocks"] == (32, 16)

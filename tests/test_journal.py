@@ -1,0 +1,28 @@
+import pytest
+
+
+def test_append_after_close_drop_is_opt_in(tmp_path):
+    """Review fix (ISSUE 14): the tuner journal gained a second writer
+    thread (the elastic worker's on_world_change restore records), so
+    an append racing close() must drop the record instead of raising
+    out of the reset path — but ONLY for journals that opt in via
+    drop_after_close: for the driver/router WALs an append-after-close
+    is an ordering bug and must keep failing loudly."""
+    from horovod_tpu.runner.journal import DriverJournal
+
+    j = DriverJournal(str(tmp_path / "tuner.jsonl"), drop_after_close=True)
+    j.append({"type": "a"})
+    j.close()
+    j.append({"type": "late"})  # must not raise
+    lines = open(str(tmp_path / "tuner.jsonl")).read().splitlines()
+    assert len(lines) == 1
+
+
+def test_append_after_close_raises_by_default(tmp_path):
+    from horovod_tpu.runner.journal import DriverJournal
+
+    j = DriverJournal(str(tmp_path / "driver.jsonl"))
+    j.append({"type": "a"})
+    j.close()
+    with pytest.raises(ValueError):
+        j.append({"type": "late"})

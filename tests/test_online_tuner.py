@@ -432,6 +432,38 @@ def test_start_online_tuner_off_and_all_frozen(monkeypatch):
     ot.stop_online_tuner()
 
 
+def test_live_unsafe_knobs_dropped_in_multi_rank_world(monkeypatch):
+    """Runtime half of the spmd live_safe contract (the static half is
+    tools/analysis/check_spmd.py): if the composed knob set ever grows
+    a live_safe=False entry — a trace-time read whose per-rank search
+    lowers divergent XLA programs — a tuner starting inside a shared
+    world must drop the knob (and keep the rest), not search it."""
+    from horovod_tpu.common import basics
+
+    monkeypatch.setenv("HVD_TUNE", "cache")  # no search thread needed
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    monkeypatch.setattr(ot, "TRAINING_KNOBS",
+                        ("ring_chunk_bytes", "grad_bucket_bytes"))
+    ot.stop_online_tuner()
+    try:
+        tuner = ot.start_online_tuner(role="training")
+        assert tuner is not None
+        searched = {b.name for b in tuner.bindings}
+        assert searched == {"ring_chunk_bytes"}, searched
+    finally:
+        ot.stop_online_tuner()
+    # Alone in its world the same set stays searchable (single-process
+    # flash/bucket tuning is legitimate — docs/autotune.md).
+    monkeypatch.setattr(basics, "size", lambda: 1)
+    try:
+        tuner = ot.start_online_tuner(role="training")
+        assert {b.name for b in tuner.bindings} == \
+            {"ring_chunk_bytes", "grad_bucket_bytes"}
+    finally:
+        ot.stop_online_tuner()
+
+
 # --- metrics ----------------------------------------------------------------
 
 
@@ -558,3 +590,318 @@ def test_tuner_moves_ring_chunk_live_np2(tmp_path):
         capture_output=True, text=True, timeout=240, env=env)
     assert procs.returncode == 0, procs.stdout + procs.stderr
     assert procs.stdout.count("TUNER_E2E_OK") == 2, procs.stdout
+
+
+def test_live_unsafe_apply_refused_after_world_grows(monkeypatch):
+    """Review fix: the start-time live_safe filter samples world size
+    once, but an ELASTIC world can grow after the tuner thread is
+    running (size 1 at start, peers join via reinit). The apply path
+    itself must refuse to mutate a live_safe=False knob the moment
+    the world is shared — per-rank mutation of a trace-time knob
+    lowers divergent XLA programs."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.knobs import TUNABLE
+
+    monkeypatch.delenv("HVD_GRAD_BUCKET_BYTES", raising=False)
+    b = ot.KnobBinding(TUNABLE["grad_bucket_bytes"])
+    # Alone in its world: the apply lands and mirrors to env.
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 1)
+    applied = b.apply(float(8 << 20))
+    assert applied == float(8 << 20)
+    assert os.environ["HVD_GRAD_BUCKET_BYTES"] == str(8 << 20)
+    # World grew: the apply is refused, env mirror untouched, and the
+    # returned value reports the LIVE state so tuner bookkeeping
+    # stays coherent.
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    refused = b.apply(float(16 << 20))
+    assert refused == float(8 << 20)
+    assert os.environ["HVD_GRAD_BUCKET_BYTES"] == str(8 << 20)
+    # The guardrail's REVERT is exempt (restore=True): blocking it
+    # would strand the knob at the mid-search value the guard just
+    # rejected. In the shared world it lands the LAUNCH anchor —
+    # here "unset", so the mirror is deleted and the schema default
+    # (what an absent mirror means) is reported.
+    restored = b.apply(float(4 << 20), restore=True)
+    assert restored == float(4 << 20)  # launch anchor == default
+    assert "HVD_GRAD_BUCKET_BYTES" not in os.environ
+    # live_safe=True knobs are untouched by the gate.
+    monkeypatch.delenv("HVD_RING_CHUNK_BYTES", raising=False)
+    safe = ot.KnobBinding(TUNABLE["ring_chunk_bytes"])
+    assert safe.apply(float(2 << 20)) == float(2 << 20)
+    monkeypatch.delenv("HVD_GRAD_BUCKET_BYTES", raising=False)
+    monkeypatch.delenv("HVD_RING_CHUNK_BYTES", raising=False)
+
+
+def test_live_unsafe_apply_gate_is_atomic_with_the_write(monkeypatch):
+    """Review fix (TOCTOU): the live_safe gate check and the env
+    write run as one atomic unit under ot._apply_lock — the same lock
+    every restore takes. A search-thread apply that raced an elastic
+    reinit could otherwise pass the gate at size 1, stall, and land
+    its stale write AFTER on_world_change's uniform restore. Pinned
+    by holding the lock (the restore-in-progress stand-in), growing
+    the world, and proving the blocked apply re-checks the gate when
+    it finally acquires — refusing instead of clobbering."""
+    import threading
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.knobs import TUNABLE
+
+    monkeypatch.delenv("HVD_GRAD_BUCKET_BYTES", raising=False)
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    size = {"v": 1}
+    monkeypatch.setattr(basics, "size", lambda: size["v"])
+    b = ot.KnobBinding(TUNABLE["grad_bucket_bytes"])
+
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(b.apply(float(16 << 20))))
+    with ot._apply_lock:
+        t.start()
+        t.join(timeout=0.5)
+        assert t.is_alive(), "apply must serialize on _apply_lock"
+        size["v"] = 2  # the world grows while the apply is parked
+    t.join(timeout=5)
+    assert not t.is_alive()
+    # The parked apply re-read the gate under the lock and refused:
+    # no env write, live (default) value returned.
+    assert "HVD_GRAD_BUCKET_BYTES" not in os.environ
+    assert results == [TUNABLE["grad_bucket_bytes"].default]
+
+
+def test_shared_world_revert_clamps_to_launch_anchor(monkeypatch):
+    """Review fix (revert-side TOCTOU): restore=True bypasses the
+    live_safe gate, and the revert TARGET (the incumbent) is computed
+    outside _apply_lock — so a guardrail revert racing an elastic
+    reinit could land a stale per-rank incumbent chosen at size 1
+    AFTER on_world_change's uniform restore. _apply_locked now
+    re-derives the target under the lock: a shared-world restore of a
+    live-unsafe knob always lands the LAUNCH anchor, whatever stale
+    value the caller computed."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.knobs import TUNABLE
+
+    monkeypatch.setenv("HVD_GRAD_BUCKET_BYTES", str(6 << 20))
+    b = ot.KnobBinding(TUNABLE["grad_bucket_bytes"])  # launch = 6 MiB
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    size = {"v": 1}
+    monkeypatch.setattr(basics, "size", lambda: size["v"])
+    # Alone: a mid-search apply lands (the stale incumbent-to-be).
+    assert b.apply(float(16 << 20)) == float(16 << 20)
+    # World grows; a revert still carrying the 16 MiB incumbent must
+    # land the launch anchor instead.
+    size["v"] = 2
+    assert b.apply(float(16 << 20), restore=True) == float(6 << 20)
+    assert os.environ["HVD_GRAD_BUCKET_BYTES"] == str(6 << 20)
+    # Alone again (shrunk world): restores keep the caller's target —
+    # the incumbent revert is the correct single-process behavior.
+    size["v"] = 1
+    assert b.apply(float(8 << 20), restore=True) == float(8 << 20)
+
+
+def test_live_unsafe_binding_pruned_when_world_grows(monkeypatch):
+    """Review fix: when an elastic world grows mid-search, a
+    live_safe=False binding must be dropped from the searched set
+    ONCE (optimizer box rebuilt over the survivors, measured samples
+    re-fed) instead of proposing dead moves + warning every window
+    for the life of the process."""
+    from horovod_tpu.common import basics
+
+    sim = Sim(lambda v: 100.0)
+    tuner = _make_tuner(sim, ["ring_chunk_bytes", "grad_bucket_bytes"],
+                        max_samples=3)
+    # Alone in its world: both knobs searched.
+    assert {b.name for b in tuner.bindings} == \
+        {"ring_chunk_bytes", "grad_bucket_bytes"}
+    rec = tuner.step()
+    assert rec is not None
+    # The world grows: the next round prunes to the safe survivor and
+    # the search carries on over it alone.
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    rec = tuner.step()
+    assert {b.name for b in tuner.bindings} == {"ring_chunk_bytes"}
+    assert rec is not None
+    # The prune restored the dropped knob to its START-TIME value,
+    # KEPT it visible in state() (bench JSON reports what is live),
+    # and journaled the decision.
+    assert sim.values["grad_bucket_bytes"] == \
+        TUNABLE["grad_bucket_bytes"].default
+    assert tuner.state()["values"]["grad_bucket_bytes"] == \
+        TUNABLE["grad_bucket_bytes"].default
+    assert any(r["type"] == "tune_prune" and
+               r["dropped"] == ["grad_bucket_bytes"]
+               for r in tuner.trajectory())
+    # With ONLY unsafe knobs, the prune freezes the search outright —
+    # at the restored values, with a journaled freeze record.
+    sim2 = Sim(lambda v: 100.0)
+    t2 = _make_tuner(sim2, ["grad_bucket_bytes"], max_samples=3)
+    assert t2.step() is None and t2.state()["frozen"]
+    [frz] = [r for r in t2.trajectory() if r["type"] == "tune_freeze"]
+    assert frz["pruned"] == ["grad_bucket_bytes"]
+    assert t2.state()["values"] == frz["values"]
+
+
+def test_pruned_knob_restores_job_env_value_not_schema_default(
+        monkeypatch):
+    """Review fix: a fleet launched with an explicit env value for a
+    live-unsafe knob must be restored to THAT value on prune — fresh
+    elastic peers inherit the job env, so the launch value (not the
+    schema default) is the rank-uniform anchor."""
+    from horovod_tpu.common import basics
+
+    monkeypatch.setenv("HVD_GRAD_BUCKET_BYTES", str(8 << 20))
+    sim = Sim(lambda v: 100.0)
+    tuner = _make_tuner(sim, ["ring_chunk_bytes", "grad_bucket_bytes"],
+                        max_samples=3)
+    assert tuner.state()["values"]["grad_bucket_bytes"] == \
+        float(8 << 20)
+    # A mid-search move lands while the process is alone in its world.
+    [b] = [b for b in tuner.bindings if b.name == "grad_bucket_bytes"]
+    b.apply(float(16 << 20))
+    assert os.environ["HVD_GRAD_BUCKET_BYTES"] == str(16 << 20)
+    # The world grows: prune restores the LAUNCH value, not 4 MiB.
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    assert tuner.step() is not None
+    assert os.environ["HVD_GRAD_BUCKET_BYTES"] == str(8 << 20)
+
+
+def test_journal_replays_across_live_safe_recomposition(
+        tmp_path, monkeypatch):
+    """Review fix: a journal written by the full composed knob set
+    (size-1 world) must replay after a restart whose live_safe drop
+    narrowed the SEARCHED set — the fence hashes the composition, not
+    the post-filter survivors, so tuned live-safe values are not
+    silently discarded on an elastic re-bootstrap."""
+    from horovod_tpu.common.knobs import TUNABLE as _T
+
+    jp = str(tmp_path / "tuner_journal.jsonl")
+    sim = Sim(lambda v: 100.0)
+    both = ["ring_chunk_bytes", "grad_bucket_bytes"]
+    t1 = _make_tuner(sim, both, journal_path=jp, max_samples=2)
+    t1._attach_journal()
+    t1.replay()
+    _drive(t1)
+    tuned = t1.state()["values"]["ring_chunk_bytes"]
+    t1.stop()
+    # Restart composes the same schema but searches only the safe
+    # survivor (what start_online_tuner does in a multi-rank world).
+    sim2 = Sim(lambda v: 100.0)
+    t2 = ot.OnlineTuner([sim2.binding("ring_chunk_bytes")],
+                        sim2.objective, journal_path=jp,
+                        clock=sim2.clock, wait=sim2.wait,
+                        window_sec=1.0, max_samples=2,
+                        fence_knobs=[_T[n] for n in both])
+    t2._attach_journal()
+    assert t2.replay() is True
+    assert t2.state()["values"]["ring_chunk_bytes"] == tuned
+    assert t2.state()["frozen"]
+    t2.stop()
+
+
+def test_frozen_live_unsafe_value_restored_on_world_change(
+        monkeypatch):
+    """Review fix: freeze is the terminal state of every search and
+    exits the tuner thread, so a live-unsafe value frozen while the
+    process was alone would outlive any in-loop protection. The
+    elastic worker calls on_world_change() after each reinit; it must
+    restore the launch value even on a frozen tuner."""
+    from horovod_tpu.common import basics
+
+    monkeypatch.delenv("HVD_GRAD_BUCKET_BYTES", raising=False)
+    # Rate rewards bigger buckets, so the size-1 search freezes at a
+    # NON-default value.
+    sim = Sim(lambda v: 1.0 + v.get("grad_bucket_bytes", 0.0))
+    tuner = _make_tuner(sim, ["grad_bucket_bytes"], max_samples=3)
+    _drive(tuner)
+    assert tuner.state()["frozen"]
+    frozen_val = sim.values["grad_bucket_bytes"]
+    assert frozen_val != TUNABLE["grad_bucket_bytes"].default
+    # The world grows; the elastic worker's reinit hook fires.
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    monkeypatch.setattr(ot, "_global_tuner", tuner)
+    ot.on_world_change()
+    assert sim.values["grad_bucket_bytes"] == \
+        TUNABLE["grad_bucket_bytes"].default
+    assert tuner.state()["values"]["grad_bucket_bytes"] == \
+        TUNABLE["grad_bucket_bytes"].default
+    # Recorded as a prune (the search was already frozen), and a
+    # second world change is a no-op.
+    assert any(r["type"] == "tune_prune" for r in tuner.trajectory())
+    n = len(tuner.trajectory())
+    ot.on_world_change()
+    assert len(tuner.trajectory()) == n
+    monkeypatch.setattr(ot, "_global_tuner", None)
+    assert ot.on_world_change() is None  # no tuner: no-op
+
+
+def test_live_search_world_change_restores_values_inline(monkeypatch):
+    """Review fix: with the search thread LIVE, on_world_change must
+    restore live-unsafe VALUES immediately (the worker retraces right
+    after the reset) while leaving bindings/_bo to the loop's own
+    round-top prune — a cross-thread structural swap could misalign a
+    concurrently built proposal."""
+    from horovod_tpu.common import basics
+
+    monkeypatch.delenv("HVD_GRAD_BUCKET_BYTES", raising=False)
+    sim = Sim(lambda v: 100.0)
+    tuner = _make_tuner(sim, ["ring_chunk_bytes", "grad_bucket_bytes"],
+                        max_samples=3)
+    [b] = [b for b in tuner.bindings if b.name == "grad_bucket_bytes"]
+    b.apply(float(16 << 20))  # legal mid-search move while alone
+
+    class _FakeThread:
+        @staticmethod
+        def is_alive():
+            return True
+
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    monkeypatch.setattr(ot, "_global_tuner", tuner)
+    tuner._thread = _FakeThread()
+    ot.on_world_change()
+    # Values restored to launch state NOW...
+    assert sim.values["grad_bucket_bytes"] == \
+        TUNABLE["grad_bucket_bytes"].default
+    assert any(r["type"] == "tune_restore" for r in tuner.trajectory())
+    # ...but the structural drop is left to the search thread.
+    assert {b.name for b in tuner.bindings} == \
+        {"ring_chunk_bytes", "grad_bucket_bytes"}
+    tuner._thread = None
+    monkeypatch.setattr(ot, "_global_tuner", None)
+
+
+def test_shared_world_restore_deletes_env_mirror_unset_at_launch(
+        monkeypatch):
+    """Review fix: the env mirror must restore launch PRESENCE, not
+    just the launch value — flash_attention's tuner gate triggers on
+    the mere existence of HVD_FLASH_BLOCK_Q/K, so a shared-world
+    restore that wrote the default back (instead of deleting a mirror
+    the job never set) would keep this rank out of the rank-0 synced
+    tile view while its peers adopt it: divergent traced tiles, the
+    exact wedge the sync closes."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.knobs import TUNABLE
+
+    monkeypatch.delenv("HVD_FLASH_BLOCK_Q", raising=False)
+    b = ot.KnobBinding(TUNABLE["flash_block_q"])  # launch: UNSET
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    size = {"v": 1}
+    monkeypatch.setattr(basics, "size", lambda: size["v"])
+    # Alone: a search apply lands and mirrors to env.
+    assert b.apply(384.0) == 384.0
+    assert os.environ["HVD_FLASH_BLOCK_Q"] == "384"
+    # World grows: the uniform restore DELETES the mirror (launch
+    # state was absent) and reports the launch value.
+    size["v"] = 2
+    assert b.apply(384.0, restore=True) == TUNABLE["flash_block_q"].default
+    assert "HVD_FLASH_BLOCK_Q" not in os.environ
+    # A mirror the job DID set at launch is written back, not deleted
+    # (test_shared_world_revert_clamps_to_launch_anchor pins the
+    # value side).
+    monkeypatch.setenv("HVD_FLASH_BLOCK_K", "256")
+    bk = ot.KnobBinding(TUNABLE["flash_block_k"])
+    assert bk.apply(512.0, restore=True) == 256.0
+    assert os.environ["HVD_FLASH_BLOCK_K"] == "256"

@@ -1,8 +1,8 @@
 """Cross-language static-analysis gate (docs/static_analysis.md).
 
-Nine contract checkers keep the hand-maintained bridges between the
+Ten contract checkers keep the hand-maintained bridges between the
 C++ core, the ctypes layer, the knob registry, the docs, and the
-concurrency/persistence disciplines honest:
+concurrency/persistence/SPMD disciplines honest:
 
   knobs     every HOROVOD_*/HVD_* env read is registered + documented
   counters  the hvd_core_counters slot layout agrees on both sides
@@ -15,6 +15,10 @@ concurrency/persistence disciplines honest:
             primitives
   jaxcompat drift-prone jax APIs only behind parallel/mesh.py shims
   testtier  minutes-long tests carry BOTH tier2 and slow markers
+  spmd      every rank issues the same collectives in the same order:
+            no collective under a rank-divergent branch/loop, no
+            blocking collective from callback/daemon threads, no
+            live tuner search over live_safe=False knobs
 
 Run ``python -m tools.analysis`` (CI does, before the test lanes);
 pre-existing accepted findings live in ``baseline.json``.
@@ -33,6 +37,7 @@ from tools.analysis import (
     check_knobs,
     check_locks,
     check_metrics,
+    check_spmd,
     check_testtier,
 )
 from tools.analysis.common import Finding, Project
@@ -47,6 +52,7 @@ CHECKERS: Dict[str, Callable[[Project], List[Finding]]] = {
     "journal": check_journal.check,
     "jaxcompat": check_jaxcompat.check,
     "testtier": check_testtier.check,
+    "spmd": check_spmd.check,
 }
 
 

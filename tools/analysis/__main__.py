@@ -9,6 +9,7 @@ entries get a TODO that a reviewer must replace or fix).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -35,6 +36,10 @@ def main(argv=None) -> int:
                     help="accept the current finding set as the baseline")
     ap.add_argument("--checker", action="append", choices=sorted(CHECKERS),
                     help="run only this checker (repeatable)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="findings output: human text (default) or a "
+                         "machine-readable JSON document for CI and "
+                         "tools/trace consumers")
     args = ap.parse_args(argv)
 
     root = os.path.abspath(args.root)
@@ -54,8 +59,17 @@ def main(argv=None) -> int:
             preserved = {fp: j for fp, j in old.items()
                          if fp.split("::", 1)[0] not in args.checker}
         save_baseline(args.baseline, findings, old, extra=preserved)
-        print("baseline updated: %d finding(s) recorded in %s"
-              % (len(findings) + len(preserved), args.baseline))
+        if args.format == "json":
+            # The one-JSON-document-on-stdout contract holds for every
+            # mode a consumer can invoke (docs/static_analysis.md).
+            print(json.dumps({
+                "updated": len(findings) + len(preserved),
+                "baseline": args.baseline,
+                "ok": True,
+            }, indent=2))
+        else:
+            print("baseline updated: %d finding(s) recorded in %s"
+                  % (len(findings) + len(preserved), args.baseline))
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
@@ -66,6 +80,30 @@ def main(argv=None) -> int:
     stale = sorted(
         fp for fp in set(baseline) - {f.fingerprint for f in findings}
         if not args.checker or fp.split("::", 1)[0] in args.checker)
+
+    if args.format == "json":
+        # One self-contained document on stdout; the exit-code
+        # contract is unchanged so CI lanes can switch formats without
+        # touching their pass/fail logic.
+        doc = {
+            "checkers": sorted(args.checker or CHECKERS),
+            "findings": [{
+                "checker": f.checker,
+                "fingerprint": f.fingerprint,
+                "file": f.path,
+                "line": f.line,
+                "location": "%s:%d" % (f.path, f.line),
+                "message": f.message,
+                "baselined": f.fingerprint in baseline,
+                "justification": baseline.get(f.fingerprint),
+            } for f in findings],
+            "new": len(new),
+            "suppressed": suppressed,
+            "stale_baseline_entries": stale,
+            "ok": not new,
+        }
+        print(json.dumps(doc, indent=2, sort_keys=False))
+        return 1 if new else 0
 
     for f in new:
         print(f.render())

@@ -39,6 +39,9 @@ DEFAULT_ALLOWLIST: Dict[str, str] = {
     "HVD_CI_PLAN_BUDGET": "ci/run_tests.sh lane budget",
     # Test-suite internals (set and read only by tests/).
     "HVD_FUZZ_SEED": "tests/fuzz_worker.py reproducibility seed",
+    "HVD_FLASH_SYNC_CACHE_DIR": "tests/flash_sync_worker.py per-rank "
+                                "cache directory (set by the np=2 "
+                                "flash-tile lockstep regression test)",
     "HVD_WIRE_BENCH_SIZES": "tests/wire_bench_worker.py payload sweep "
                             "(set by the bench_wire.py harness)",
     "HVD_WIRE_BENCH_ITERS": "tests/wire_bench_worker.py timed "

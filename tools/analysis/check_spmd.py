@@ -1,0 +1,897 @@
+"""SPMD-divergence & collective-deadlock checker (``spmd``).
+
+Horovod's whole contract is that every rank issues the same collectives
+in the same order — the core negotiates which tensors are globally
+ready *by name and sequence*, so one rank that skips, reorders, or adds
+a collective wedges the world until the comm deadline fires (PAPER.md
+L2/L3). This repo hit that bug class live twice: the PR 10 tuner's
+per-rank stop decision deadlocked the peer's next allreduce (fixed by
+making the decision collective via ``hvd.Min``), and the multi-host
+cold-tune divergence hazard was documented in docs/mfu.md but enforced
+nowhere. The PR 12 flight recorder can only *diagnose* the wedge
+post-mortem; this checker statically prevents it.
+
+Four lanes over ``horovod_tpu/``, ``examples/``, and the bench/dryrun
+entry points (one shared parse + call graph, AST-only, jax-free):
+
+1. **Call graph + issues-collective propagation.** Roots are the eager
+   collectives (``ops/eager.py``), the in-graph ops
+   (``ops/collective_ops.py``), the object collectives
+   (``common/objects.py``), plus method-shape roots that always mean a
+   collective regardless of receiver (``apply_gradients`` on a
+   DistributedOptimizer/Plan optimizer, ``broadcast_variables`` et al.,
+   elastic ``state.commit()``/``state.sync()``). Any function that
+   transitively calls a root *issues collectives*.
+
+2. **Rank-divergence taint.** Branch conditions, loop bounds, and
+   early returns built from rank identity (``rank()``,
+   ``local_rank()``, ``jax.process_index()``), wall clocks
+   (``time.time/monotonic/perf_counter``), unsynced RNGs
+   (``random``/``np.random``), or per-rank env knobs
+   (``HVD_FAULT_RANK``, ``HOROVOD_RANK``, ...) diverge across ranks.
+   A collective-issuing call dominated by such a condition is a
+   finding: hoist the decision, collectivize it (PR 10's ``hvd.Min``
+   pattern), or tag the branch/call with
+   ``# analysis: rank-uniform(<reason>)`` when it is provably uniform.
+
+3. **Thread-context lane.** Functions reachable from KV
+   ``put_callback``s, ``Thread(target=...)`` entries,
+   ``add_done_callback``s, and HTTP handler methods must not
+   transitively issue *blocking* eager collectives — the controller
+   thread that would complete them may be the one blocked (the PR 5/9
+   callback-thread deadlock shape). Escape:
+   ``# analysis: thread-ok(<reason>)``.
+
+4. **live_safe contract.** ``TUNABLE`` knobs declared
+   ``live_safe=False`` (trace-time reads whose per-rank mutation
+   lowers divergent XLA programs) must not appear in the knob sets the
+   online tuner searches at runtime (``utils/online_tuner.py``'s
+   ``*_KNOBS`` tuples / literal ``TUNABLE[...]`` lookups).
+
+Known limits (by design, documented in docs/static_analysis.md):
+resolution is name- and import-based — dynamic dispatch, decorators
+that swap callables, and cross-instance method calls are not modeled;
+taint flows through direct local assignments (``r = hvd.rank()``) but
+not through containers or attributes. The escape tag covers the
+residue; precision over recall keeps the shipped baseline EMPTY.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.common import Finding, Project
+
+RANK_UNIFORM_TAG_RE = re.compile(r"analysis:\s*rank-uniform\(")
+THREAD_OK_TAG_RE = re.compile(r"analysis:\s*thread-ok\(")
+
+# The package whose modules count as "ours" for collective resolution.
+ROOT_PKG = "horovod_tpu"
+
+# name -> blocking?  (the eager sync variants block the calling thread
+# until the world completes the op; _async variants only enqueue; the
+# in-graph ops lower into the jitted program — divergence desyncs the
+# traced program, but they never block a host thread).
+EAGER_COLLECTIVES: Dict[str, bool] = {
+    "allreduce": True, "allreduce_async": False,
+    "grouped_allreduce": True, "grouped_allreduce_async": False,
+    "allgather": True, "allgather_async": False,
+    "broadcast": True, "broadcast_async": False,
+    "alltoall": True, "alltoall_async": False,
+    "reducescatter": True, "reducescatter_async": False,
+    "barrier": True, "join": True,
+}
+INGRAPH_COLLECTIVES = ("allreduce", "grouped_allreduce", "allgather",
+                       "broadcast", "alltoall", "reducescatter")
+
+# Root functions by module identity (module dotted path -> {name: blocking}).
+ROOT_FUNCS: Dict[str, Dict[str, bool]] = {
+    ROOT_PKG + ".ops.eager": dict(EAGER_COLLECTIVES),
+    ROOT_PKG + ".ops.collective_ops": {n: False
+                                       for n in INGRAPH_COLLECTIVES},
+    ROOT_PKG + ".common.objects": {"broadcast_object": True,
+                                   "allgather_object": True},
+}
+
+# Names that are collectives no matter how they are reached (bindings
+# re-export them; the fallback below also accepts any of these resolved
+# through a horovod_tpu module we could not parse a table for).
+COLLECTIVE_NAMES: Dict[str, bool] = dict(EAGER_COLLECTIVES)
+COLLECTIVE_NAMES.update({
+    "broadcast_object": True, "allgather_object": True,
+})
+
+# Method-shape roots: attribute calls that mean "this issues
+# collectives" regardless of receiver resolution. apply_gradients is
+# the DistributedOptimizer/Plan.optimizer contract (gradients allreduce
+# before apply); the broadcast_* family only exists on the hvd surface.
+ALWAYS_METHODS: Dict[str, bool] = {
+    "apply_gradients": True,
+    "broadcast_variables": True,
+    "broadcast_parameters": True,
+    "broadcast_optimizer_state": True,
+    "broadcast_global_variables": True,
+    "broadcast_object": True,
+    "allgather_object": True,
+}
+
+# state.commit()/state.sync(): elastic State collectives (commit may
+# enter the checkpoint barrier; sync broadcasts rank 0's state). Only
+# when the receiver looks like an elastic state object.
+STATE_METHODS = ("commit", "sync")
+_STATE_RECV_RE = re.compile(r"(^|\.|_)state$", re.IGNORECASE)
+
+# Blocking waits that do not ISSUE a collective (handle waits): they
+# matter for the thread lane only.
+BLOCKING_WAITS = {"synchronize"}
+
+# Branch-condition taint sources.
+RANK_CALLS = {"rank", "local_rank", "cross_rank", "process_index"}
+TIME_CALLS = {"time", "monotonic", "perf_counter", "time_ns",
+              "monotonic_ns", "perf_counter_ns"}
+RANDOM_FNS = {"random", "randint", "randn", "rand", "choice", "shuffle",
+              "uniform", "sample", "randrange", "normal"}
+PER_RANK_ENV = {"HVD_FAULT_RANK"}
+_PER_RANK_ENV_RE = re.compile(r"(^|_)(LOCAL_|CROSS_)?RANK$")
+
+
+def _module_name(rel: str) -> str:
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("\\", "/").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _dotted(expr: ast.AST) -> Optional[List[str]]:
+    """['a', 'b', 'c'] for a pure Name/Attribute chain a.b.c, else
+    None (calls/subscripts in the chain defeat static resolution)."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+class _CallSite:
+    __slots__ = ("name", "parts", "line", "node", "is_self")
+
+    def __init__(self, node: ast.Call):
+        self.node = node
+        self.line = node.lineno
+        f = node.func
+        self.parts = _dotted(f)
+        self.is_self = bool(self.parts and self.parts[0] == "self")
+        if isinstance(f, ast.Attribute):
+            self.name: Optional[str] = f.attr
+        elif isinstance(f, ast.Name):
+            self.name = f.id
+        else:
+            self.name = None
+
+
+class _Func:
+    """One function/method in the scanned surface."""
+
+    __slots__ = ("key", "rel", "qual", "node", "cls", "module",
+                 "issues", "blocks")
+
+    def __init__(self, key, rel, qual, node, cls, module):
+        self.key = key            # "mod::qualname"
+        self.rel = rel
+        self.qual = qual
+        self.node = node
+        self.cls = cls            # innermost enclosing class name or None
+        self.module = module
+        # (api, witness) once known to issue collectives; witness is
+        # "" for a direct call or "via <callee qual>" transitively.
+        self.issues: Optional[Tuple[str, str]] = None
+        self.blocks: Optional[Tuple[str, str]] = None
+
+
+class _Index:
+    """Whole-surface symbol tables + call graph."""
+
+    def __init__(self):
+        self.funcs: Dict[str, _Func] = {}
+        # module -> {name: ("def", funckey) | ("mod", module) |
+        #            ("ref", module, name)}
+        self.ns: Dict[str, Dict[str, tuple]] = {}
+        self.mod_rel: Dict[str, str] = {}
+        # funckey -> list of _CallSite (unresolved; resolved on demand)
+        self.calls: Dict[str, List[_CallSite]] = {}
+        self.lines: Dict[str, List[str]] = {}
+
+
+def _index_module(index: _Index, rel: str, tree: ast.Module,
+                  lines: List[str]) -> None:
+    mod = _module_name(rel)
+    index.mod_rel[mod] = rel
+    ns = index.ns.setdefault(mod, {})
+    index.lines[rel] = lines
+
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                ns[a.asname or a.name.split(".")[0]] = (
+                    "mod", a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                ns[a.asname or a.name] = ("ref", node.module, a.name)
+
+    def visit(node: ast.AST, scope: Tuple[str, ...], cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(scope + (child.name,))
+                key = "%s::%s" % (mod, qual)
+                fn = _Func(key, rel, qual, child, cls, mod)
+                index.funcs[key] = fn
+                if not scope:
+                    ns.setdefault(child.name, ("def", key))
+                # Function-local imports shape resolution too (the
+                # lazy-import idiom is everywhere in this tree); fold
+                # them into the module namespace — coarse but sound
+                # for root detection.
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.ImportFrom) and sub.module \
+                            and sub.level == 0:
+                        for a in sub.names:
+                            if a.name != "*":
+                                ns.setdefault(a.asname or a.name,
+                                              ("ref", sub.module, a.name))
+                    elif isinstance(sub, ast.Import):
+                        for a in sub.names:
+                            ns.setdefault(
+                                a.asname or a.name.split(".")[0],
+                                ("mod", a.name if a.asname
+                                 else a.name.split(".")[0]))
+                visit(child, scope + (child.name,), cls)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, scope + (child.name,), child.name)
+            else:
+                visit(child, scope, cls)
+
+    visit(tree, (), None)
+
+
+def _resolve_name(index: _Index, mod: str, name: str,
+                  seen: Optional[Set[Tuple[str, str]]] = None
+                  ) -> Optional[object]:
+    """Resolve ``name`` in ``mod``'s namespace to a function key, a
+    ("root", api, blocking) synthetic for collective names re-exported
+    from unparsed horovod_tpu modules, or None."""
+    seen = seen or set()
+    if (mod, name) in seen:
+        return None
+    seen.add((mod, name))
+    entry = index.ns.get(mod, {}).get(name)
+    if entry is None:
+        # No namespace entry: a local/parameter/comprehension name.
+        # Deliberately NOT a root even when it matches a collective
+        # name inside a horovod_tpu module — `barrier = make_barrier()
+        # ... barrier()` is an ordinary local, and flagging it would
+        # break the empty-baseline contract with false positives. The
+        # unparsed-re-export case is covered by the "ref" path below,
+        # where an IMPORT vouches for the name's origin.
+        return None
+    kind = entry[0]
+    if kind == "def":
+        return entry[1]
+    if kind == "ref":
+        target_mod, target_name = entry[1], entry[2]
+        key = "%s::%s" % (target_mod, target_name)
+        if key in index.funcs:
+            return key
+        if target_mod in index.ns:
+            # Re-export chain (horovod_tpu/__init__ -> ops -> eager).
+            return _resolve_name(index, target_mod, target_name, seen)
+        if target_mod.startswith(ROOT_PKG):
+            root_names = ROOT_FUNCS.get(target_mod)
+            if root_names and target_name in root_names:
+                return ("root", "%s.%s" % (target_mod, target_name),
+                        root_names[target_name])
+            if target_name in COLLECTIVE_NAMES:
+                return ("root", "%s.%s" % (target_mod, target_name),
+                        COLLECTIVE_NAMES[target_name])
+        return None
+    return None  # bare module reference
+
+
+def _resolve_call(index: _Index, fn: _Func, site: _CallSite):
+    """A call site resolves to one of:
+    ("func", key)          — a scanned function
+    ("root", api, blocking) — a root collective
+    ("wait", api)          — a blocking handle wait (thread lane only)
+    None                   — unknown/out of scope
+    """
+    name = site.name
+    if name is None:
+        return None
+    parts = site.parts
+    # self.method() -> method in the same class (best effort: any
+    # scanned method of that name on the same class in the same module).
+    if site.is_self and parts is not None and len(parts) == 2:
+        if fn.cls:
+            for cand, f2 in index.funcs.items():
+                if f2.module == fn.module and f2.cls == fn.cls \
+                        and f2.qual.endswith("." + name):
+                    return ("func", cand)
+        return None
+    if parts is not None and len(parts) == 1:
+        # Nested def in the same function first (thread targets and
+        # done-callbacks are routinely closures), then enclosing
+        # scopes, then the module namespace. METHODS are excluded: a
+        # bare name inside a method does NOT see class attributes in
+        # Python (`self.`/`cls.` is required), so resolving `shutdown()`
+        # to a same-named sibling method would be a false positive.
+        scope = fn.qual.split(".")
+        for depth in range(len(scope), 0, -1):
+            key = "%s::%s.%s" % (fn.module, ".".join(scope[:depth]), name)
+            cand = index.funcs.get(key)
+            if cand is None:
+                continue
+            cand_parts = cand.qual.split(".")
+            is_method = (cand.cls is not None and len(cand_parts) >= 2
+                         and cand_parts[-2] == cand.cls)
+            if is_method:
+                continue
+            return ("func", key)
+        resolved = _resolve_name(index, fn.module, name)
+        if isinstance(resolved, tuple):
+            return resolved
+        if isinstance(resolved, str):
+            return ("func", resolved)
+        return None
+    if parts is not None and len(parts) >= 2:
+        base, rest, attr = parts[0], parts[1:-1], parts[-1]
+        entry = index.ns.get(fn.module, {}).get(base)
+        target_mod = None
+        if entry is not None and entry[0] == "mod":
+            target_mod = entry[1]
+        elif entry is not None and entry[0] == "ref" \
+                and not rest and entry[1].startswith(ROOT_PKG):
+            # `from horovod_tpu.ops import eager` -> eager.allreduce():
+            # the ref MAY name a submodule rather than a function. A
+            # scanned module or root module is conclusive; anything
+            # else (an imported function/class, e.g.
+            # `from ...state import State; State.commit(...)`) must
+            # fall through to the method-shape roots below instead of
+            # being misread as a module lookup.
+            maybe_mod = "%s.%s" % (entry[1], entry[2])
+            if maybe_mod in index.ns or maybe_mod in ROOT_FUNCS:
+                target_mod = maybe_mod
+                rest = []
+        if target_mod is not None:
+            full_mod = ".".join([target_mod] + list(rest))
+            key = "%s::%s" % (full_mod, attr)
+            if key in index.funcs:
+                return ("func", key)
+            root_names = ROOT_FUNCS.get(full_mod)
+            if root_names and attr in root_names:
+                return ("root", "%s.%s" % (full_mod, attr),
+                        root_names[attr])
+            if full_mod.startswith(ROOT_PKG):
+                if attr in COLLECTIVE_NAMES:
+                    return ("root", "%s.%s" % (full_mod, attr),
+                            COLLECTIVE_NAMES[attr])
+                if attr in BLOCKING_WAITS:
+                    return ("wait", "%s.%s" % (full_mod, attr))
+                resolved = _resolve_name(index, full_mod, attr)
+                if isinstance(resolved, tuple):
+                    return resolved
+                if isinstance(resolved, str):
+                    return ("func", resolved)
+            # Unresolved through the module: fall through to the
+            # method-shape roots rather than concluding "not a
+            # collective".
+    # Method-shape roots on unresolved receivers.
+    if name in ALWAYS_METHODS and parts != [name]:
+        # attribute form only: a bare local helper named
+        # apply_gradients would have resolved above.
+        if isinstance(site.node.func, ast.Attribute):
+            return ("root", name, ALWAYS_METHODS[name])
+    if name in STATE_METHODS and isinstance(site.node.func, ast.Attribute):
+        recv = site.node.func.value
+        recv_src = ast.unparse(recv)
+        if _STATE_RECV_RE.search(recv_src.split("(")[0]) or (
+                recv_src == "super()" and fn.cls
+                and fn.cls.endswith("State")):
+            return ("root", "State.%s" % name, True)
+    return None
+
+
+def _build_graph(index: _Index) -> None:
+    """Collect call sites per function and propagate issues/blocks."""
+    for key, fn in index.funcs.items():
+        sites = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                sites.append(_CallSite(node))
+        index.calls[key] = sites
+
+    # The root APIs are roots BY IDENTITY, not by what their bodies
+    # happen to resolve to (eager.py's bodies bottom out in backend
+    # attribute calls this analysis cannot see).
+    for mod, names in ROOT_FUNCS.items():
+        for name, blocking in names.items():
+            fn = index.funcs.get("%s::%s" % (mod, name))
+            if fn is not None:
+                api = "%s.%s" % (mod.rsplit(".", 1)[-1], name)
+                fn.issues = (api, "")
+                if blocking:
+                    fn.blocks = (api, "")
+    for key, fn in index.funcs.items():
+        if fn.module.startswith(ROOT_PKG) \
+                and fn.qual in BLOCKING_WAITS and fn.blocks is None \
+                and fn.module in ROOT_FUNCS:
+            fn.blocks = ("%s.%s" % (fn.module.rsplit(".", 1)[-1],
+                                    fn.qual), "")
+
+    # Seed direct issuers, wire caller edges.
+    pending: List[str] = []
+    edges: Dict[str, List[Tuple[str, str]]] = {}  # callee -> [(caller, _)]
+    for key, fn in index.funcs.items():
+        for site in index.calls[key]:
+            r = _resolve_call(index, fn, site)
+            if r is None:
+                continue
+            if r[0] == "root":
+                api, blocking = r[1], r[2]
+                if fn.issues is None:
+                    fn.issues = (api, "")
+                if blocking and fn.blocks is None:
+                    fn.blocks = (api, "")
+            elif r[0] == "wait":
+                if fn.blocks is None:
+                    fn.blocks = (r[1], "")
+            elif r[0] == "func":
+                edges.setdefault(r[1], []).append((key, site.name or ""))
+        if fn.issues is not None or fn.blocks is not None:
+            pending.append(key)
+
+    # BFS the reverse edges.
+    while pending:
+        key = pending.pop()
+        fn = index.funcs[key]
+        for caller_key, _ in edges.get(key, ()):
+            caller = index.funcs[caller_key]
+            changed = False
+            if fn.issues is not None and caller.issues is None:
+                caller.issues = (fn.issues[0], "via %s()" % fn.qual)
+                changed = True
+            if fn.blocks is not None and caller.blocks is None:
+                caller.blocks = (fn.blocks[0], "via %s()" % fn.qual)
+                changed = True
+            if changed:
+                pending.append(caller_key)
+
+
+# --- taint -------------------------------------------------------------------
+
+
+def _env_key_of(node: ast.AST) -> Optional[str]:
+    """Literal env-var key when ``node`` reads one, else None."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if fname in ("getenv", "get") and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            if fname == "getenv":
+                return node.args[0].value
+            if isinstance(f, ast.Attribute) \
+                    and "environ" in ast.unparse(f.value):
+                return node.args[0].value
+    if isinstance(node, ast.Subscript) \
+            and isinstance(node.slice, ast.Constant) \
+            and isinstance(node.slice.value, str) \
+            and "environ" in ast.unparse(node.value):
+        return node.slice.value
+    return None
+
+
+def _taint_of(expr: ast.AST, tainted_names: Set[str]) -> Optional[str]:
+    """Reason string when ``expr`` derives from a rank-divergent
+    source, else None."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in tainted_names:
+            return "local '%s'" % node.id
+        if isinstance(node, ast.Call):
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname in RANK_CALLS:
+                return "%s()" % fname
+            if fname in TIME_CALLS and isinstance(f, ast.Attribute) \
+                    and _dotted(f.value) in (["time"], ["datetime"]):
+                return "time.%s()" % fname
+            if fname in RANDOM_FNS and isinstance(f, ast.Attribute):
+                recv = _dotted(f.value)
+                if recv and recv[-1] == "random":
+                    return "%s.%s()" % (".".join(recv), fname)
+        key = _env_key_of(node)
+        if key is not None and (key in PER_RANK_ENV
+                                or _PER_RANK_ENV_RE.search(key)):
+            return "env %s" % key
+    return None
+
+
+def _tainted_locals(fn: ast.AST) -> Set[str]:
+    """Names assigned (anywhere in the function, flow-insensitive)
+    from a tainted expression — catches ``r = hvd.rank()`` feeding a
+    later ``if r == 0:``. One round of transitive closure covers the
+    ``rank = hvd.rank(); is_root = rank == 0`` chain."""
+    names: Set[str] = set()
+    for _ in range(2):
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and node.value is not None:
+                if _taint_of(node.value, names):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) \
+                                and t.id not in names:
+                            names.add(t.id)
+                            changed = True
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                    and getattr(node, "value", None) is not None:
+                if _taint_of(node.value, names) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.target.id not in names:
+                    names.add(node.target.id)
+                    changed = True
+        if not changed:
+            break
+    return names
+
+
+def _terminates(stmts: Sequence[ast.stmt]) -> bool:
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, ast.Expr) and isinstance(last.value, ast.Call):
+        parts = _dotted(last.value.func)
+        if parts and parts[-1] in ("exit", "_exit", "abort"):
+            return True
+    return False
+
+
+class _Taint:
+    __slots__ = ("reason", "line", "kind")
+
+    def __init__(self, reason: str, line: int, kind: str):
+        self.reason = reason
+        self.line = line
+        self.kind = kind  # "branch" | "loop" | "early-exit"
+
+
+def _tag_near(lines: List[str], lineno: int, tag_re) -> bool:
+    """Tag on the flagged line, or anywhere in the contiguous comment
+    block immediately above it (justifications routinely wrap)."""
+    if 1 <= lineno <= len(lines) and tag_re.search(lines[lineno - 1]):
+        return True
+    ln = lineno - 1
+    while 1 <= ln <= len(lines):
+        stripped = lines[ln - 1].strip()
+        if not stripped.startswith("#"):
+            break
+        if tag_re.search(stripped):
+            return True
+        ln -= 1
+    return False
+
+
+def _divergence_findings(index: _Index, project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, fn in sorted(index.funcs.items()):
+        lines = index.lines[fn.rel]
+        tainted_names = _tainted_locals(fn.node)
+        per_key: Dict[str, int] = {}
+
+        def check_calls(stmt: ast.stmt, ctx: List[_Taint]):
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                site = _CallSite(node)
+                r = _resolve_call(index, fn, site)
+                if r is None:
+                    continue
+                if r[0] == "root":
+                    api, witness = r[1], ""
+                elif r[0] == "func":
+                    callee = index.funcs[r[1]]
+                    if callee.issues is None:
+                        continue
+                    api = callee.issues[0]
+                    witness = "%s() transitively issues it" % callee.qual
+                else:
+                    continue
+                if _tag_near(lines, node.lineno, RANK_UNIFORM_TAG_RE):
+                    continue
+                t = ctx[-1]
+                # The taint reason joins the key so the fingerprint is
+                # content-addressed: a new tainted call inserted
+                # earlier in the function must not renumber (and so
+                # un-baseline) unrelated findings below it. The
+                # ordinal only disambiguates true repeats of the same
+                # (api, kind, reason) in one function.
+                reason = re.sub(r"[^A-Za-z0-9_.()-]+", "_", t.reason)
+                base = "divergent:%s:%s:%s:%s" % (fn.qual, api, t.kind,
+                                                  reason)
+                n = per_key.get(base, 0)
+                per_key[base] = n + 1
+                findings.append(Finding(
+                    "spmd", fn.rel, node.lineno,
+                    "%s:%d" % (base, n),
+                    "collective %s issued under rank-divergent %s "
+                    "(%s, line %d)%s in %s() — one rank deciding "
+                    "differently desyncs the world's collective "
+                    "sequence; hoist or collectivize the decision "
+                    "(docs/static_analysis.md#spmd), or tag the %s "
+                    "with '# analysis: rank-uniform(<why>)'"
+                    % (api, t.kind, t.reason, t.line,
+                       (" [%s]" % witness) if witness else "",
+                       fn.qual,
+                       "loop" if t.kind == "loop" else "branch")))
+
+        def walk(stmts: Sequence[ast.stmt], ctx: List[_Taint]):
+            ctx = list(ctx)
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue  # separate nodes; scanned on their own
+                if isinstance(stmt, (ast.If, ast.While)):
+                    # The header expression executes whenever control
+                    # reaches the statement: collectives INSIDE it are
+                    # dominated by the enclosing context, not by this
+                    # statement's own condition.
+                    if ctx:
+                        check_calls(stmt.test, ctx)
+                    reason = _taint_of(stmt.test, tainted_names)
+                    suppressed = reason is not None and _tag_near(
+                        lines, stmt.lineno, RANK_UNIFORM_TAG_RE)
+                    kind = ("loop" if isinstance(stmt, ast.While)
+                            else "branch")
+                    if reason and not suppressed:
+                        inner = ctx + [_Taint(reason, stmt.lineno, kind)]
+                    else:
+                        inner = ctx
+                    walk(stmt.body, inner)
+                    # An If's else-branch is dominated by the tainted
+                    # condition just like the then-branch; a While's
+                    # else runs on NORMAL loop exit — every rank gets
+                    # there (same rule as For-else below), so it
+                    # inherits only the enclosing context.
+                    walk(stmt.orelse,
+                         ctx if isinstance(stmt, ast.While) else inner)
+                    if isinstance(stmt, ast.If) and reason \
+                            and not suppressed \
+                            and _terminates(stmt.body) and not stmt.orelse:
+                        # `if <tainted>: return` dominates the rest of
+                        # this block: only some ranks get there.
+                        ctx.append(_Taint(reason, stmt.lineno,
+                                          "early-exit"))
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    if ctx:
+                        check_calls(stmt.iter, ctx)
+                    reason = _taint_of(stmt.iter, tainted_names)
+                    suppressed = reason is not None and _tag_near(
+                        lines, stmt.lineno, RANK_UNIFORM_TAG_RE)
+                    if reason and not suppressed:
+                        inner = ctx + [_Taint(reason, stmt.lineno, "loop")]
+                    else:
+                        inner = ctx
+                    walk(stmt.body, inner)
+                    walk(stmt.orelse, ctx)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    if ctx:
+                        for item in stmt.items:
+                            check_calls(item.context_expr, ctx)
+                    walk(stmt.body, ctx)
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body, ctx)
+                    for h in stmt.handlers:
+                        walk(h.body, ctx)
+                    walk(stmt.orelse, ctx)
+                    walk(stmt.finalbody, ctx)
+                else:
+                    if ctx:
+                        check_calls(stmt, ctx)
+
+        walk(fn.node.body, [])
+    return findings
+
+
+# --- thread lane -------------------------------------------------------------
+
+
+def _thread_findings(index: _Index) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+    for key, fn in sorted(index.funcs.items()):
+        lines = index.lines[fn.rel]
+        for site in index.calls[key]:
+            node = site.node
+            target = None
+            how = None
+            if site.name == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target, how = kw.value, "Thread(target=...)"
+            elif site.name == "add_done_callback" and node.args:
+                target, how = node.args[0], "add_done_callback"
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "put_callback":
+                        target, how = kw.value, "put_callback="
+            if target is None:
+                continue
+            resolved = None
+            parts = _dotted(target)
+            if parts is not None:
+                fake = _CallSite(ast.Call(func=target, args=[],
+                                          keywords=[], lineno=node.lineno,
+                                          col_offset=0))
+                resolved = _resolve_call(index, fn, fake)
+            if not resolved or resolved[0] != "func":
+                continue
+            entry = index.funcs[resolved[1]]
+            if entry.blocks is None:
+                continue
+            if _tag_near(lines, node.lineno, THREAD_OK_TAG_RE):
+                continue
+            entry_lines = index.lines[entry.rel]
+            if _tag_near(entry_lines, entry.node.lineno, THREAD_OK_TAG_RE):
+                continue
+            k = "thread-collective:%s" % entry.qual
+            # Dedup by the entry's MODULE-qualified identity: two
+            # same-named entries in different files are two findings.
+            if entry.key in seen:
+                continue
+            seen.add(entry.key)
+            api, via = entry.blocks
+            findings.append(Finding(
+                "spmd", fn.rel, node.lineno, k,
+                "%s entry %s() transitively issues/waits a BLOCKING "
+                "collective (%s%s) — background threads must never "
+                "block on the world (the PR 5/9 callback-thread "
+                "deadlock shape); move the collective to the main "
+                "loop, or tag with '# analysis: thread-ok(<why>)'"
+                % (how, entry.qual, api,
+                   (" " + via) if via else "")))
+    # HTTP handler methods are entry points without a registration call.
+    for key, fn in sorted(index.funcs.items()):
+        if fn.qual.split(".")[-1] not in ("do_GET", "do_PUT", "do_POST",
+                                          "do_DELETE"):
+            continue
+        if fn.blocks is None:
+            continue
+        lines = index.lines[fn.rel]
+        if _tag_near(lines, fn.node.lineno, THREAD_OK_TAG_RE):
+            continue
+        k = "thread-collective:%s" % fn.qual
+        if fn.key in seen:
+            continue
+        seen.add(fn.key)
+        api, via = fn.blocks
+        findings.append(Finding(
+            "spmd", fn.rel, fn.node.lineno, k,
+            "HTTP handler %s() transitively issues/waits a BLOCKING "
+            "collective (%s%s) — server threads must never block on "
+            "the world; or tag with '# analysis: thread-ok(<why>)'"
+            % (fn.qual, api, (" " + via) if via else "")))
+    return findings
+
+
+# --- live_safe lane ----------------------------------------------------------
+
+
+def _tunable_live_safety(project: Project) -> Dict[str, Tuple[bool, int]]:
+    """knob name -> (live_safe, line) parsed from the TUNABLE schema."""
+    out: Dict[str, Tuple[bool, int]] = {}
+    if not project.exists(project.knobs_py):
+        return out
+    try:
+        tree = project.parsed(project.knobs_py)
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if fname != "TunableKnob":
+            continue
+        name = None
+        live_safe = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+        if len(node.args) > 7 and isinstance(node.args[7], ast.Constant):
+            live_safe = bool(node.args[7].value)
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                name = kw.value.value
+            elif kw.arg == "live_safe" \
+                    and isinstance(kw.value, ast.Constant):
+                live_safe = bool(kw.value.value)
+        if name is not None and live_safe is not None:
+            out[name] = (live_safe, node.lineno)
+    return out
+
+
+def _live_safe_findings(project: Project) -> List[Finding]:
+    safety = _tunable_live_safety(project)
+    if not safety or not project.exists(project.tuner_py):
+        return []
+    try:
+        tree = project.parsed(project.tuner_py)
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return []
+    searched: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.endswith("_KNOBS") \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    searched.append((elt.value, elt.lineno))
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str) \
+                and ast.unparse(node.value).endswith("TUNABLE"):
+            searched.append((node.slice.value, node.lineno))
+    findings = []
+    seen: Set[str] = set()
+    for name, line in searched:
+        info = safety.get(name)
+        if info is None or info[0] or name in seen:
+            continue
+        seen.add(name)
+        findings.append(Finding(
+            "spmd", project.tuner_py, line,
+            "live-unsafe:%s" % name,
+            "tunable knob %r is declared live_safe=False (%s:%d: its "
+            "per-rank mutation lowers rank-divergent XLA programs) but "
+            "the online tuner's runtime loop searches it — remove it "
+            "from the searched set or make its apply path rank-uniform"
+            % (name, project.knobs_py, safety[name][1])))
+    return findings
+
+
+# --- entry -------------------------------------------------------------------
+
+
+def check(project: Project) -> List[Finding]:
+    index = _Index()
+    for rel in project.spmd_files():
+        try:
+            tree = project.parsed(rel)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        try:
+            lines = project.read(rel).splitlines()
+        except (OSError, UnicodeDecodeError):
+            continue
+        _index_module(index, rel, tree, lines)
+    _build_graph(index)
+    findings = _divergence_findings(index, project)
+    findings += _thread_findings(index)
+    findings += _live_safe_findings(project)
+    return findings

@@ -65,6 +65,12 @@ class Project:
                      "horovod_tpu/parallel/mesh.py",),
                  jax_scan_files: Sequence[str] = ("__graft_entry__.py",),
                  test_scan_dirs: Sequence[str] = ("tests",),
+                 spmd_scan_dirs: Sequence[str] = ("horovod_tpu",
+                                                  "examples"),
+                 spmd_scan_files: Sequence[str] = (
+                     "bench.py", "bench_scaling.py", "bench_wire.py",
+                     "bench_serve.py", "__graft_entry__.py"),
+                 tuner_py: str = "horovod_tpu/utils/online_tuner.py",
                  knob_allowlist: Optional[Dict[str, str]] = None):
         self.root = os.path.abspath(root)
         self.knobs_py = knobs_py
@@ -82,6 +88,9 @@ class Project:
         self.jax_allowed_files = tuple(jax_allowed_files)
         self.jax_scan_files = tuple(jax_scan_files)
         self.test_scan_dirs = tuple(test_scan_dirs)
+        self.spmd_scan_dirs = tuple(spmd_scan_dirs)
+        self.spmd_scan_files = tuple(spmd_scan_files)
+        self.tuner_py = tuner_py
         self.knob_allowlist = knob_allowlist
         self._ast_cache: Dict[str, object] = {}
 
@@ -155,6 +164,17 @@ class Project:
     def test_files(self) -> List[str]:
         return [rel for rel in self._walk(self.test_scan_dirs, (".py",))
                 if os.path.basename(rel).startswith("test_")]
+
+    def spmd_files(self) -> List[str]:
+        """The SPMD-checked surface: the library, the examples, and
+        the bench/dryrun entry points (check_spmd.py). Library files
+        overlap python_files(), so the shared ``parsed`` memoization
+        means no second parse pass."""
+        files = self._walk(self.spmd_scan_dirs, (".py",))
+        for rel in self.spmd_scan_files:
+            if self.exists(rel):
+                files.append(rel)
+        return sorted(set(files))
 
 
 # --- baseline ---------------------------------------------------------------

@@ -55,8 +55,11 @@ def _free_port():
 
 
 def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
-              sockbuf=None, flightrec=None, timeout=600):
-    """One np-wide sweep; returns the rank-0 JSON payload."""
+              sockbuf=None, flightrec=None, fault=None, timeout=600):
+    """One np-wide sweep; returns the rank-0 JSON payload. ``fault``
+    is an injector env dict (common.fault_injection.fault_env) exported
+    to every worker — the self-healing-wire measurement hook
+    (docs/wire.md#reconnect)."""
     port = _free_port()
     procs = []
     for r in range(np_):
@@ -89,6 +92,8 @@ def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
             env["HOROVOD_SOCKET_BUF_BYTES"] = str(sockbuf)
         if flightrec is not None:
             env["HVD_FLIGHTREC"] = str(flightrec)
+        if fault:
+            env.update(fault)
         procs.append(subprocess.Popen(
             [sys.executable, _WORKER], env=env, cwd=_REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -148,7 +153,7 @@ def _parse_overrides(spec):
     return out
 
 
-def run_paired_trials(args, b_overrides=None):
+def run_paired_trials(args, b_overrides=None, collect_b=None):
     """Interleaved slot-paired trials: each trial runs slot A then
     slot B back-to-back. Identical configs (``b_overrides=None``)
     measure the box's slot bias (the A/A null test); with overrides the
@@ -166,6 +171,8 @@ def run_paired_trials(args, b_overrides=None):
                       timeout=args.timeout, **base)
         b = run_sweep(args.np_, args.sizes, args.iters, args.warmup,
                       timeout=args.timeout, **b_cfg)
+        if collect_b is not None:
+            collect_b.append(b)
         bw_a, bw_b = _busbw_by_size(a), _busbw_by_size(b)
         for size in bw_a:
             if size in bw_b:
@@ -186,6 +193,35 @@ def _verdict(ab_ratio, null_ratios):
     if lo <= ab_ratio <= hi:
         return "within_slot_bias"
     return "faster" if ab_ratio > hi else "slower"
+
+
+def run_gated_trials(args, b_overrides, ratio_key, b_label,
+                     collect_b=None):
+    """The null-gated A/B discipline shared by ``--ab`` and
+    ``--fault reconnect_storm``: run the A/A null trials, run the
+    interleaved B trials, and verdict each size's B/A ratio against
+    the observed slot-bias band. Returns the ``per_size`` payload
+    (ratio under ``ratio_key``) after printing the verdict table."""
+    print("# null A/A trials (slot-bias gate)...", file=sys.stderr)
+    null = run_paired_trials(args)
+    print("# %s trials..." % b_label, file=sys.stderr)
+    b = run_paired_trials(args, b_overrides, collect_b=collect_b)
+    per_size = {}
+    for s in sorted(set(null) & set(b), key=int):
+        row = {
+            ratio_key: b[s]["median_ratio"],
+            "null_bias_median_ratio": null[s]["median_ratio"],
+            "null_bias_spread": [round(min(null[s]["ratios"]), 4),
+                                 round(max(null[s]["ratios"]), 4)],
+            "verdict": _verdict(b[s]["median_ratio"], null[s]["ratios"]),
+        }
+        per_size[s] = row
+        print("# %10s %s %.3f | null bias %.3f (spread %.3f-%.3f) -> %s"
+              % (s, ratio_key, row[ratio_key],
+                 row["null_bias_median_ratio"],
+                 row["null_bias_spread"][0], row["null_bias_spread"][1],
+                 row["verdict"]), file=sys.stderr)
+    return per_size
 
 
 def main(argv=None):
@@ -216,39 +252,111 @@ def main(argv=None):
                          "delta's verdict")
     ap.add_argument("--trials", type=int, default=5,
                     help="paired trials for --null-ab/--ab (default 5)")
+    ap.add_argument("--fault", default=None,
+                    choices=("reset", "reconnect_storm"),
+                    help="self-healing-wire measurement "
+                         "(docs/wire.md#reconnect): 'reset' injects "
+                         "one hard RST on rank 1 mid-sweep and reports "
+                         "recovery latency (break -> resumed stream); "
+                         "'reconnect_storm' resets every "
+                         "--fault-every-frames frames and reports "
+                         "busbw degradation as interleaved "
+                         "fault-vs-clean trials gated by the A/A null "
+                         "test, like --ab")
+    ap.add_argument("--fault-after-frames", type=int, default=50,
+                    help="frames before the first injected reset "
+                         "(default 50: past bootstrap + warmup)")
+    ap.add_argument("--fault-every-frames", type=int, default=50,
+                    help="reconnect_storm period in frames (default 50)")
+    ap.add_argument("--fault-count", type=int, default=5,
+                    help="reconnect_storm reset bound (default 5)")
     args = ap.parse_args(argv)
 
-    if args.ab:
+    if args.fault == "reset":
+        # Recovery-latency measurement: one sweep with a single hard
+        # RST injected on rank 1 mid-run. The sweep must complete
+        # (healing is transparent); `recovery` reports the native
+        # break-detect -> handshake+retransmit-done duration.
+        from horovod_tpu.common.fault_injection import fault_env
+
+        fenv = fault_env(1, "reset",
+                         after_frames=args.fault_after_frames)
+        run = run_sweep(args.np_, args.sizes, args.iters, args.warmup,
+                        chunk_bytes=args.chunk_bytes, sg=args.sg,
+                        fault=fenv, timeout=args.timeout)
+        counters = run.get("counters", {})
+        recovery = run.get("reconnect", {})
+        healed = (counters.get("reconnects", 0) >= 1
+                  and counters.get("reconnect_failures", 0) == 0)
+        payload = {
+            "mode": "fault",
+            "fault": "reset",
+            "np": args.np_,
+            "fault_env": fenv,
+            "healed": healed,
+            "recovery": recovery,
+            "results": run["results"],
+            "counters": counters,
+        }
+        print("# reset injected after %d frames -> healed=%s "
+              "recovery last=%.1fms max=%.1fms (reconnects=%d, "
+              "frames retransmitted=%d)"
+              % (args.fault_after_frames, healed,
+                 recovery.get("last_heal_us", 0) / 1000.0,
+                 recovery.get("max_heal_us", 0) / 1000.0,
+                 counters.get("reconnects", 0),
+                 counters.get("frames_retransmitted", 0)),
+              file=sys.stderr)
+        if not healed:
+            print("# WARNING: no heal observed — sweep too short to "
+                  "reach the injection point, or reconnect failed",
+                  file=sys.stderr)
+    elif args.fault == "reconnect_storm":
+        # Busbw degradation under repeated blips, measured with the
+        # same discipline as --ab: interleaved clean-vs-storm trials,
+        # the A/A null test alongside, verdicts gated by the observed
+        # slot bias (docs/benchmarks.md).
+        from horovod_tpu.common.fault_injection import fault_env
+
+        fenv = fault_env(1, "reconnect_storm",
+                         after_frames=args.fault_after_frames,
+                         every_frames=args.fault_every_frames,
+                         count=args.fault_count)
+        b_payloads = []
+        per_size = run_gated_trials(
+            args, {"fault": fenv}, "storm_median_ratio",
+            "storm (B: %d resets every %d frames)"
+            % (args.fault_count, args.fault_every_frames),
+            collect_b=b_payloads)
+        recovery = {
+            "reconnects": max((b.get("counters", {}).get("reconnects", 0)
+                               for b in b_payloads), default=0),
+            "max_heal_us": max((b.get("reconnect", {}).get(
+                "max_heal_us", 0) for b in b_payloads), default=0),
+            "reconnect_failures": sum(
+                b.get("counters", {}).get("reconnect_failures", 0)
+                for b in b_payloads),
+        }
+        payload = {
+            "mode": "fault",
+            "fault": "reconnect_storm",
+            "np": args.np_,
+            "trials": args.trials,
+            "fault_env": fenv,
+            "recovery": recovery,
+            "per_size": per_size,
+        }
+    elif args.ab:
         overrides = _parse_overrides(args.ab)
-        print("# null A/A trials (slot-bias gate)...", file=sys.stderr)
-        null = run_paired_trials(args)
-        print("# A/B trials (B: %s)..." % args.ab, file=sys.stderr)
-        ab = run_paired_trials(args, overrides)
-        sizes = sorted(set(null) & set(ab), key=int)
         payload = {
             "mode": "ab",
             "np": args.np_,
             "trials": args.trials,
             "b_overrides": overrides,
-            "per_size": {
-                s: {
-                    "ab_median_ratio": ab[s]["median_ratio"],
-                    "null_bias_median_ratio": null[s]["median_ratio"],
-                    "null_bias_spread": [round(min(null[s]["ratios"]), 4),
-                                         round(max(null[s]["ratios"]), 4)],
-                    "verdict": _verdict(ab[s]["median_ratio"],
-                                        null[s]["ratios"]),
-                } for s in sizes
-            },
+            "per_size": run_gated_trials(args, overrides,
+                                         "ab_median_ratio",
+                                         "A/B (B: %s)" % args.ab),
         }
-        for s in sizes:
-            row = payload["per_size"][s]
-            print("# %10s B/A %.3f | null bias %.3f (spread %.3f-%.3f)"
-                  " -> %s" % (s, row["ab_median_ratio"],
-                              row["null_bias_median_ratio"],
-                              row["null_bias_spread"][0],
-                              row["null_bias_spread"][1],
-                              row["verdict"]), file=sys.stderr)
     elif args.null_ab:
         payload = {
             "mode": "null_ab",

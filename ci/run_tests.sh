@@ -156,6 +156,11 @@ run_tier1() {
 # test_chaos_forensics_names_culprit: sigstop np=2 + injected stall
 # np=3, each asserting tools.trace names the culprit from the dumps;
 # ~12s combined warm) — absorbed by the existing headroom.
+# ISSUE 15 adds the self-healing-wire lane: a bench_wire --fault reset
+# recovery smoke + the np=3 mid-chunk heal drive run FAIL-FAST (the
+# heal drive is then deselected from the full tier, driver-kill
+# precedent), and the storm/legacy-pin chaos pair rides the full tier
+# (~8s combined warm) — absorbed by the existing headroom.
 run_tier2() {
     echo "=== tier 2: serving smoke (bench_serve.py, jax-free fleet) ==="
     timeout "${HVD_CI_SERVE_BUDGET:-600}" \
@@ -172,6 +177,18 @@ run_tier2() {
     timeout "${HVD_CI_WIRE_BUDGET:-180}" \
         python bench_wire.py --np 2 --sizes 65536,4194304 \
         --iters 4 --warmup 1 > /dev/null
+    echo "=== tier 2: self-healing wire smoke (reset recovery + fail-fast heal) ==="
+    # ISSUE 15 fail-fast pair: the recovery-latency lane of bench_wire
+    # (a hard RST mid-sweep must heal and report break->resume timing)
+    # and the np=3 mid-pipelined-chunk heal drive. A broken reconnect
+    # path turns every transient blip back into a full world teardown,
+    # so it is cheaper to catch before the tier burns its budget.
+    timeout "${HVD_CI_RECONNECT_BUDGET:-300}" \
+        python bench_wire.py --np 2 --fault reset --sizes 4194304 \
+        --iters 4 --warmup 1 > /dev/null
+    timeout "${HVD_CI_RECONNECT_BUDGET:-300}" python -m pytest \
+        tests/test_chaos.py::test_chaos_reset_heals_in_place \
+        -q -p no:cacheprovider --override-ini 'addopts='
     echo "=== tier 2: driver-kill chaos smoke (journal + auto-resume) ==="
     timeout 600 python -m pytest \
         tests/test_chaos_elastic.py::test_driver_kill9_journal_resume \
@@ -181,7 +198,8 @@ run_tier2() {
         python -m pytest tests/ -q -p no:cacheprovider \
         --override-ini 'addopts=' -m tier2 \
         --deselect tests/test_chaos_elastic.py::test_driver_kill9_journal_resume \
-        --deselect tests/test_chaos_serve.py::test_serve_chaos_replica_kill9_then_router_sigkill
+        --deselect tests/test_chaos_serve.py::test_serve_chaos_replica_kill9_then_router_sigkill \
+        --deselect tests/test_chaos.py::test_chaos_reset_heals_in_place
 }
 
 case "$TIER" in

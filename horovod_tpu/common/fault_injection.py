@@ -4,11 +4,11 @@ The C++ data plane (``core/src/comm.cc``) compiles in an env-driven
 fault injector — zero-cost when unarmed — that sabotages a chosen
 rank's connections so failure-detection paths (the
 ``HOROVOD_COMM_TIMEOUT_SEC`` progress deadline, the connection-abort
-cascade, elastic recovery) can be exercised deterministically without
-root, tc/netem, or kernel features. This module is the supported way
-to build those environments: the tier-2 chaos suite
-(``tests/test_chaos.py``) uses it, and operators can use it for
-game-day drills.
+cascade, the self-healing wire's in-place reconnect, elastic recovery)
+can be exercised deterministically without root, tc/netem, or kernel
+features. This module is the supported way to build those
+environments: the tier-2 chaos suite (``tests/test_chaos.py``) uses
+it, and operators can use it for game-day drills.
 
 Modes (the injector arms only on the rank matching ``HVD_FAULT_RANK``):
 
@@ -18,8 +18,18 @@ Modes (the injector arms only on the rank matching ``HVD_FAULT_RANK``):
   socket case; only the progress deadline can save the peers.
 - ``half_close``: shutdown(SHUT_WR) toward ``peer`` (or all peers) —
   the victim keeps reading but never writes again.
-- ``delay``: sleep ``delay_ms`` before each frame — latency injection
-  for soak tests; never fails anything by itself.
+- ``delay``: sleep ``delay_ms`` before each frame (latency injection
+  for soak tests; never fails anything by itself).
+- ``reset``: SO_LINGER-0 close of the target connection(s) — a hard
+  RST on the wire, the transient-network-blip signature the
+  self-healing wire reconnects from IN PLACE
+  (docs/wire.md#reconnect). One-shot. With ``after_subchunks`` the
+  RST fires from inside a pipelined ring transfer, after that many
+  sub-chunk reductions, instead of at a frame boundary.
+- ``reconnect_storm``: ``reset`` repeated every ``every_frames``
+  frames, at most ``count`` times — the repeated-blip soak that
+  proves healing is re-entrant and measures busbw degradation
+  (``bench_wire.py --fault reconnect_storm``).
 
 Triggering is frame-counted: the fault fires on the first framed send /
 duplex transfer after ``after_frames`` of them completed, so a test can
@@ -32,7 +42,8 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional
 
-MODES = ("drop", "stall", "half_close", "delay")
+MODES = ("drop", "stall", "half_close", "delay", "reset",
+         "reconnect_storm")
 
 #: Env vars the native injector reads (core/src/comm.cc ParseFaultEnv).
 FAULT_ENV_KEYS = (
@@ -41,30 +52,44 @@ FAULT_ENV_KEYS = (
     "HVD_FAULT_PEER",
     "HVD_FAULT_AFTER_FRAMES",
     "HVD_FAULT_DELAY_MS",
+    "HVD_FAULT_AFTER_SUBCHUNKS",
+    "HVD_FAULT_EVERY_FRAMES",
+    "HVD_FAULT_COUNT",
 )
 
 
 def fault_env(rank: int, mode: str, *, peer: int = -1,
-              after_frames: int = 0, delay_ms: int = 0) -> Dict[str, str]:
+              after_frames: int = 0, delay_ms: int = 0,
+              after_subchunks: int = 0, every_frames: int = 1,
+              count: int = 5) -> Dict[str, str]:
     """Build the env-var dict arming the injector on ``rank``.
 
     The same dict can be exported to every rank of a job (the injector
     self-arms only where ``HVD_FAULT_RANK`` matches), which is exactly
     what subprocess launchers that share one env need.
+    ``after_subchunks`` applies to ``reset`` (fire mid-pipelined-
+    transfer); ``every_frames``/``count`` apply to
+    ``reconnect_storm``.
     """
     if mode not in MODES:
         raise ValueError("unknown fault mode %r (choose from %s)"
                          % (mode, ", ".join(MODES)))
     if rank < 0:
         raise ValueError("rank must be >= 0, got %d" % rank)
-    if after_frames < 0 or delay_ms < 0:
-        raise ValueError("after_frames/delay_ms must be >= 0")
+    if after_frames < 0 or delay_ms < 0 or after_subchunks < 0:
+        raise ValueError(
+            "after_frames/delay_ms/after_subchunks must be >= 0")
+    if every_frames < 1 or count < 0:
+        raise ValueError("every_frames must be >= 1 and count >= 0")
     return {
         "HVD_FAULT_RANK": str(rank),
         "HVD_FAULT_MODE": mode,
         "HVD_FAULT_PEER": str(peer),
         "HVD_FAULT_AFTER_FRAMES": str(after_frames),
         "HVD_FAULT_DELAY_MS": str(delay_ms),
+        "HVD_FAULT_AFTER_SUBCHUNKS": str(after_subchunks),
+        "HVD_FAULT_EVERY_FRAMES": str(every_frames),
+        "HVD_FAULT_COUNT": str(count),
     }
 
 

@@ -255,6 +255,18 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "core/src/operations.cc: =0 restores the fusion-buffer "
          "pack/unpack path for fused allreduces instead of the "
          "scatter-gather ring over tensor memory"),
+    Knob("HVD_WIRE_RECONNECT_SEC", HONORED,
+         "core/src/comm.cc: in-place reconnect budget for a peer link "
+         "that breaks with an RST-shaped error — redial/re-accept + "
+         "epoch handshake + retransmit instead of a world teardown "
+         "(default 30, clamped to HOROVOD_COMM_TIMEOUT_SEC so the "
+         "typed-abort deadline never grows; 0 = legacy "
+         "abort-on-break; docs/wire.md#reconnect)"),
+    Knob("HVD_WIRE_RETRANSMIT_BUF_BYTES", HONORED,
+         "core/src/comm.cc: per-peer retransmit ring over sent stream "
+         "bytes — bounds how much in-flight loss a reconnect can "
+         "replay; a larger gap falls back to abort-on-break, recorded "
+         "(default 8 MiB; 0 disables buffering)"),
     # Inference serving (horovod_tpu/serve/; docs/serving.md).
     Knob("HVD_SERVE_MAX_BATCH", HONORED,
          "serve/batching.py: micro-batch size trigger — a batch fires "
@@ -341,13 +353,36 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HVD_FAULT_RANK", HONORED,
          "core/src/comm.cc: rank that self-sabotages (unset = off)"),
     Knob("HVD_FAULT_MODE", HONORED,
-         "core/src/comm.cc: drop | stall | half_close | delay"),
+         "core/src/comm.cc: drop | stall | half_close | delay | "
+         "reset (hard RST the self-healing wire reconnects from) | "
+         "reconnect_storm (reset every K frames, bounded count)"),
     Knob("HVD_FAULT_PEER", HONORED,
-         "core/src/comm.cc: half_close target rank (-1 = all peers)"),
+         "core/src/comm.cc: half_close/reset target rank (-1 = all "
+         "peers)"),
     Knob("HVD_FAULT_AFTER_FRAMES", HONORED,
          "core/src/comm.cc: arm after this many framed sends"),
     Knob("HVD_FAULT_DELAY_MS", HONORED,
          "core/src/comm.cc: per-frame sleep for delay mode"),
+    Knob("HVD_FAULT_AFTER_SUBCHUNKS", HONORED,
+         "core/src/comm.cc: reset mode fires after this many pipelined "
+         "ring sub-chunk reductions — the RST lands mid-transfer, "
+         "between sub-chunks, instead of at a frame boundary"),
+    Knob("HVD_FAULT_EVERY_FRAMES", HONORED,
+         "core/src/comm.cc: reconnect_storm period in frames "
+         "(default 1)"),
+    Knob("HVD_FAULT_COUNT", HONORED,
+         "core/src/comm.cc: reconnect_storm bound — total resets fired "
+         "(default 5)"),
+    # Serving router breaker (serve/router.py; docs/serving.md).
+    Knob("HVD_SERVE_BREAKER_THRESHOLD", HONORED,
+         "serve/router.py: consecutive forward failures that trip a "
+         "replica's breaker — it leaves round-robin rotation for a "
+         "jittered cooldown window instead of eating live traffic "
+         "(default 3; 0 disables the breaker)"),
+    Knob("HVD_SERVE_BREAKER_COOLDOWN_SEC", HONORED,
+         "serve/router.py: base cooldown for a tripped replica "
+         "breaker, jittered +/-50% and doubled per consecutive trip "
+         "(capped at 8x; default 5)"),
 ]}
 
 

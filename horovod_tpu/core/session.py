@@ -82,6 +82,20 @@ _M_CORE = {
         "hvd_flightrec_dumps_total",
         "Flight-record dump files written (abort auto-dumps, signal "
         "dumps, on-demand dumps)."),
+    # Self-healing wire (docs/wire.md#reconnect).
+    "reconnects": _metrics.counter(
+        "hvd_comm_reconnects_total",
+        "Peer links healed in place by the self-healing wire (epoch "
+        "handshake + retransmit, no world teardown)."),
+    "frames_retransmitted": _metrics.counter(
+        "hvd_comm_frames_retransmitted_total",
+        "Frames / raw ring segments whose in-flight bytes were "
+        "retransmitted across a reconnect handshake."),
+    "reconnect_failures": _metrics.counter(
+        "hvd_comm_reconnect_failures_total",
+        "In-place reconnect attempts that exhausted "
+        "HVD_WIRE_RECONNECT_SEC (or an oversize in-flight gap) and "
+        "escalated to the legacy typed abort."),
 }
 
 # StatusType values that mean "a peer is dead or wedged and the abort
@@ -232,6 +246,9 @@ class CoreSession:
         lib.hvd_core_join.argtypes = [ctypes.c_longlong, ctypes.c_int]
         lib.hvd_core_counters.restype = None
         lib.hvd_core_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvd_wire_reconnect_stats.restype = None
+        lib.hvd_wire_reconnect_stats.argtypes = [
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
         lib.hvd_core_set_params.restype = None
         lib.hvd_core_set_params.argtypes = [
@@ -497,9 +514,10 @@ class CoreSession:
         """Core observability counters (responses, cache hits, fusion,
         bytes, comm timeouts, abort cascades, bootstrap retries, wire
         tx/rx bytes, pipelined ring sub-chunk steps, flight-recorder
-        events/drops/dumps)."""
-        buf = (ctypes.c_longlong * 14)()
-        self._lib.hvd_core_counters(buf, 14)
+        events/drops/dumps, self-healing-wire reconnects/retransmits/
+        failures)."""
+        buf = (ctypes.c_longlong * 17)()
+        self._lib.hvd_core_counters(buf, 17)
         return {
             "responses": buf[0],
             "cached_responses": buf[1],
@@ -515,6 +533,25 @@ class CoreSession:
             "flightrec_events": buf[11],
             "flightrec_dropped": buf[12],
             "flightrec_dumps": buf[13],
+            "reconnects": buf[14],
+            "frames_retransmitted": buf[15],
+            "reconnect_failures": buf[16],
+        }
+
+    def wire_reconnect_stats(self) -> Dict[str, int]:
+        """Self-healing-wire stats (docs/wire.md#reconnect): reconnect
+        and retransmit totals plus the last/slowest heal duration in
+        microseconds (break detection -> handshake + retransmit done).
+        ``bench_wire.py --fault`` reads the recovery-latency number
+        from here."""
+        buf = (ctypes.c_longlong * 5)()
+        self._lib.hvd_wire_reconnect_stats(buf, 5)
+        return {
+            "reconnects": buf[0],
+            "frames_retransmitted": buf[1],
+            "reconnect_failures": buf[2],
+            "last_heal_us": buf[3],
+            "max_heal_us": buf[4],
         }
 
     def dump_flight_record(self, path: str) -> bool:

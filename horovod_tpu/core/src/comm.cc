@@ -28,9 +28,39 @@ namespace {
 struct FrameHeader {
   uint32_t magic;
   uint32_t sender;
+  // Self-healing wire (docs/wire.md#reconnect): the sender's connection
+  // epoch at frame-composition time and a per-link monotonically
+  // increasing frame ordinal. A frame retransmitted after a reconnect
+  // legally carries an OLDER epoch (it was composed before the break);
+  // an epoch from the future or a sequence gap is corruption and fails
+  // the link hard (WireFrameCheck).
+  uint32_t epoch;
+  uint32_t reserved;
+  uint64_t seq;
   uint64_t len;
 };
 constexpr uint32_t kMagic = 0x48564454;  // "HVDT"
+
+// Reconnect handshake, exchanged on the fresh socket before any stream
+// byte: the dialer (lower rank) sends Hello, the acceptor replies.
+// rx_total/tx_total are cumulative stream positions; each side
+// retransmits [peer_rx, my_tx) from its ring and expects
+// [my_rx, peer_tx) back.
+struct ReconnectHello {
+  uint32_t magic;
+  uint32_t rank;      // dialer's rank
+  uint32_t epoch;     // dialer's proposed epoch (its old epoch + 1)
+  uint32_t flags;     // reserved, 0
+  uint64_t rx_total;  // bytes of the peer's stream the dialer received
+  uint64_t tx_total;  // bytes the dialer wrote toward the peer
+};
+struct ReconnectReply {
+  uint32_t magic;
+  uint32_t epoch;  // agreed epoch (WireAgreeEpoch)
+  uint64_t rx_total;
+  uint64_t tx_total;
+};
+constexpr uint32_t kReconnMagic = 0x48565252;  // "HVRR"
 
 // Sanity cap on a received frame length before out->resize(h.len): a
 // corrupted header must not become an unbounded (or OOM-killing)
@@ -152,6 +182,12 @@ std::atomic<long long> g_bootstrap_retries{0};
 std::atomic<long long> g_tx_bytes{0};
 std::atomic<long long> g_rx_bytes{0};
 std::atomic<long long> g_ring_subchunks{0};
+// Self-healing wire (docs/wire.md#reconnect): links healed in place,
+// frames retransmitted across reconnect handshakes, and heals that
+// exhausted HVD_WIRE_RECONNECT_SEC and fell back to the typed abort.
+std::atomic<long long> g_comm_reconnects{0};
+std::atomic<long long> g_frames_retransmitted{0};
+std::atomic<long long> g_reconnect_failures{0};
 
 // ------------------------------------------------------- fault injection ---
 // Env-driven chaos hooks for the tier-2 failure-detection tests
@@ -169,21 +205,46 @@ std::atomic<long long> g_ring_subchunks{0};
 //                              (or every peer when unset)
 //   HVD_FAULT_MODE=delay       sleep HVD_FAULT_DELAY_MS before each
 //                              frame (latency injection)
+//   HVD_FAULT_MODE=reset       SO_LINGER-0 close (hard RST to the
+//                              peer) of the target connection(s) —
+//                              the transient-blip case the self-
+//                              healing wire reconnects in place
+//                              (docs/wire.md#reconnect). With
+//                              HVD_FAULT_AFTER_SUBCHUNKS=K the RST
+//                              fires mid-pipelined-transfer, after K
+//                              ring sub-chunk reductions, instead of
+//                              at a frame boundary.
+//   HVD_FAULT_MODE=reconnect_storm
+//                              reset every HVD_FAULT_EVERY_FRAMES
+//                              frames (default 1), at most
+//                              HVD_FAULT_COUNT times (default 5)
 //   HVD_FAULT_AFTER_FRAMES=K   trigger after K framed sends / duplex
 //                              transfers (default 0 = first one)
 //
 // The Python shim horovod_tpu.common.fault_injection builds these env
 // dicts; docs/troubleshooting.md documents the harness.
 
-enum class FaultMode { OFF, DROP, STALL, HALF_CLOSE, DELAY };
+enum class FaultMode { OFF, DROP, STALL, HALF_CLOSE, DELAY, RESET, STORM };
 
 struct FaultState {
   FaultMode mode = FaultMode::OFF;
-  int peer = -1;  // half_close target; -1 = all peers
+  int peer = -1;  // half_close/reset target; -1 = all peers
   long long after_frames = 0;
   long long delay_ms = 0;
-  bool half_closed = false;  // fire half_close once
+  long long after_subchunks = 0;  // reset: fire mid-pipelined-transfer
+  // g_ring_subchunks at arm time: the trigger counts sub-chunks SINCE
+  // the injector armed, not since the process started (a second Init
+  // in one process — elastic reinit — must not fire instantly).
+  long long subchunk_base = 0;
+  long long every_frames = 1;     // reconnect_storm period
+  long long max_count = 5;        // reconnect_storm bound
+  long long fired = 0;            // resets fired so far
+  bool half_closed = false;       // fire half_close once
   std::atomic<long long> frames{0};
+  // Active communicator for the sub-chunk trigger (set at Init when a
+  // reset-family mode is armed, cleared at Close; background-thread
+  // only, like every other injector action).
+  TcpComm* comm = nullptr;
 };
 
 FaultState g_fault;
@@ -195,8 +256,13 @@ void ParseFaultEnv(int rank) {
   g_fault.peer = -1;
   g_fault.after_frames = 0;
   g_fault.delay_ms = 0;
+  g_fault.after_subchunks = 0;
+  g_fault.every_frames = 1;
+  g_fault.max_count = 5;
+  g_fault.fired = 0;
   g_fault.half_closed = false;
   g_fault.frames.store(0);
+  g_fault.comm = nullptr;
   const char* fr = getenv("HVD_FAULT_RANK");
   if (!fr || !*fr || atoi(fr) != rank) return;
   const char* fm = getenv("HVD_FAULT_MODE");
@@ -205,6 +271,8 @@ void ParseFaultEnv(int rank) {
   else if (strcmp(fm, "stall") == 0) g_fault.mode = FaultMode::STALL;
   else if (strcmp(fm, "half_close") == 0) g_fault.mode = FaultMode::HALF_CLOSE;
   else if (strcmp(fm, "delay") == 0) g_fault.mode = FaultMode::DELAY;
+  else if (strcmp(fm, "reset") == 0) g_fault.mode = FaultMode::RESET;
+  else if (strcmp(fm, "reconnect_storm") == 0) g_fault.mode = FaultMode::STORM;
   else {
     HVD_LOG(LogLevel::WARN,
             std::string("unknown HVD_FAULT_MODE '") + fm + "'; ignored");
@@ -213,6 +281,11 @@ void ParseFaultEnv(int rank) {
   g_fault.peer = (int)EnvLL("HVD_FAULT_PEER", -1);
   g_fault.after_frames = EnvLL("HVD_FAULT_AFTER_FRAMES", 0);
   g_fault.delay_ms = EnvLL("HVD_FAULT_DELAY_MS", 0);
+  g_fault.after_subchunks = EnvLL("HVD_FAULT_AFTER_SUBCHUNKS", 0);
+  g_fault.subchunk_base = g_ring_subchunks.load(std::memory_order_relaxed);
+  g_fault.every_frames = EnvLL("HVD_FAULT_EVERY_FRAMES", 1);
+  if (g_fault.every_frames < 1) g_fault.every_frames = 1;
+  g_fault.max_count = EnvLL("HVD_FAULT_COUNT", 5);
   HVD_LOG(LogLevel::WARN,
           std::string("fault injector ARMED: mode=") + fm +
               " peer=" + std::to_string(g_fault.peer) + " after_frames=" +
@@ -226,8 +299,79 @@ long long CommBootstrapRetriesTotal() { return g_bootstrap_retries.load(); }
 long long CommTxBytesTotal() { return g_tx_bytes.load(); }
 long long CommRxBytesTotal() { return g_rx_bytes.load(); }
 long long RingSubchunkStepsTotal() { return g_ring_subchunks.load(); }
+long long CommReconnectsTotal() { return g_comm_reconnects.load(); }
+long long CommFramesRetransmittedTotal() {
+  return g_frames_retransmitted.load();
+}
+long long CommReconnectFailuresTotal() {
+  return g_reconnect_failures.load();
+}
 void CountRingSubchunkStep() {
   g_ring_subchunks.fetch_add(1, std::memory_order_relaxed);
+  // reset + HVD_FAULT_AFTER_SUBCHUNKS: fire the RST from inside the
+  // pipelined duplex loop (between sub-chunk reductions), so the break
+  // lands mid-transfer instead of at a frame boundary. Same thread as
+  // every other injector action.
+  if (g_fault.mode == FaultMode::RESET && g_fault.after_subchunks > 0 &&
+      g_fault.comm != nullptr && g_fault.fired == 0 &&
+      g_ring_subchunks.load(std::memory_order_relaxed) -
+              g_fault.subchunk_base >=
+          g_fault.after_subchunks) {
+    g_fault.fired = 1;
+    g_fault.comm->InjectReset();
+  }
+}
+
+// --- reconnect protocol math (pure; ctypes-exported in operations.cc) ------
+
+long long WireRetxGap(long long tx_total, long long peer_rx) {
+  if (tx_total < 0 || peer_rx < 0 || peer_rx > tx_total) return -1;
+  return tx_total - peer_rx;
+}
+
+int WireAgreeEpoch(int proposed, int current) {
+  return proposed > current + 1 ? proposed : current + 1;
+}
+
+int WireFrameCheck(long long epoch, long long seq, long long cur_epoch,
+                   long long expect_seq) {
+  if (epoch > cur_epoch) return -1;  // epoch from the future: corruption
+  if (seq != expect_seq) return -2;  // lost/duplicated frame across resume
+  return 0;
+}
+
+void RetxRing::append(const char* data, size_t n) {
+  if (cap_ == 0) return;
+  if (buf_.empty()) buf_.assign(cap_, 0);  // lazy: idle peers cost nothing
+  const char* src = data;
+  size_t take = n;
+  if (take > cap_) {  // only the newest cap_ bytes stay retransmittable
+    src += take - cap_;
+    take = cap_;
+  }
+  unsigned long long pos = (end_ + (n - take)) % cap_;
+  size_t copied = 0;
+  while (copied < take) {
+    size_t run = std::min(take - copied, cap_ - (size_t)(pos % cap_));
+    memcpy(buf_.data() + (size_t)(pos % cap_), src + copied, run);
+    pos += run;
+    copied += run;
+  }
+  end_ += n;
+  len_ = std::min(cap_, len_ + n);
+}
+
+bool RetxRing::read(unsigned long long from, size_t n, char* out) const {
+  if (cap_ == 0 || buf_.empty()) return n == 0;
+  if (from < begin() || from + n > end_) return false;
+  size_t copied = 0;
+  while (copied < n) {
+    size_t pos = (size_t)((from + copied) % cap_);
+    size_t run = std::min(n - copied, cap_ - pos);
+    memcpy(out + copied, buf_.data() + pos, run);
+    copied += run;
+  }
+  return true;
 }
 
 Status TcpComm::MaybeInjectFault(int peer) {
@@ -244,9 +388,10 @@ Status TcpComm::MaybeInjectFault(int peer) {
       if (!g_fault.half_closed) {
         g_fault.half_closed = true;
         for (int p = 0; p < (int)fds_.size(); ++p) {
-          if (fds_[(size_t)p] < 0) continue;
+          int fd = fds_[(size_t)p].load();
+          if (fd < 0) continue;
           if (g_fault.peer >= 0 && p != g_fault.peer) continue;
-          ::shutdown(fds_[(size_t)p], SHUT_WR);
+          ::shutdown(fd, SHUT_WR);
         }
         HVD_LOG(LogLevel::WARN, "fault injector: half-closed connection(s)");
       }
@@ -259,6 +404,22 @@ Status TcpComm::MaybeInjectFault(int peer) {
       HVD_LOG(LogLevel::WARN,
               "fault injector: stalling background thread forever");
       for (;;) std::this_thread::sleep_for(std::chrono::hours(1));
+    case FaultMode::RESET:
+      // The sub-chunk-triggered variant fires from
+      // CountRingSubchunkStep instead; one-shot either way.
+      if (g_fault.after_subchunks == 0 && g_fault.fired == 0) {
+        g_fault.fired = 1;
+        InjectReset();
+      }
+      return Status::OK();
+    case FaultMode::STORM: {
+      if (g_fault.fired >= g_fault.max_count) return Status::OK();
+      if ((k - g_fault.after_frames) % g_fault.every_frames == 0) {
+        ++g_fault.fired;
+        InjectReset();
+      }
+      return Status::OK();
+    }
     case FaultMode::OFF:
       break;
   }
@@ -266,21 +427,49 @@ Status TcpComm::MaybeInjectFault(int peer) {
   return Status::OK();
 }
 
+void TcpComm::InjectReset() {
+  // SO_LINGER{on, 0} + close = hard RST to the peer AND instant local
+  // teardown — the kernel discards unsent data instead of FIN-draining
+  // it. The peer sees ECONNRESET (the transient-blip signature the
+  // self-healing wire reconnects from); this side finds the slot at -1
+  // on its next I/O and heals the same way.
+  for (int p = 0; p < (int)fds_.size(); ++p) {
+    if (g_fault.peer >= 0 && p != g_fault.peer) continue;
+    int fd = fds_[(size_t)p].exchange(-1);
+    if (fd < 0) continue;
+    struct linger lg;
+    lg.l_onoff = 1;
+    lg.l_linger = 0;
+    setsockopt(fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+    ::close(fd);
+    HVD_LOG(LogLevel::WARN,
+            "fault injector: hard-reset (RST) connection to peer " +
+                std::to_string(p));
+  }
+}
+
 TcpComm::~TcpComm() { Close(); }
 
 void TcpComm::Abort() {
-  for (auto fd : fds_) {
-    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  // Disarm in-place reconnect FIRST: a heal attempt mid-dial/accept
+  // must fail fast instead of burning its budget against a world being
+  // torn down (the dial/accept loops poll this flag).
+  abort_requested_.store(true);
+  for (auto& fd : fds_) {
+    int f = fd.load();
+    if (f >= 0) ::shutdown(f, SHUT_RDWR);
   }
   if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
 }
 
 void TcpComm::Close() {
+  abort_requested_.store(true);
+  if (g_fault.comm == this) g_fault.comm = nullptr;
   for (auto& fd : fds_) {
-    if (fd >= 0) {
-      ::shutdown(fd, SHUT_RDWR);
-      ::close(fd);
-      fd = -1;
+    int f = fd.exchange(-1);
+    if (f >= 0) {
+      ::shutdown(f, SHUT_RDWR);
+      ::close(f);
     }
   }
   if (listen_fd_ >= 0) {
@@ -294,15 +483,24 @@ void TcpComm::set_socket_buf_bytes(long long v) {
   g_sockbuf_override.store(v);
   // Resize live peer sockets too (setsockopt is fd-level thread-safe;
   // the background loop may be mid-send on one — the kernel applies
-  // the new buffer size to subsequent queueing). fds_ is sized at Init
-  // and entries only flip to -1 at Close, so walking it off-thread is
-  // safe. v == 0 cannot restore "kernel autotuned" on a live fd, so it
-  // only resets the override for future sockets.
+  // the new buffer size to subsequent queueing). fds_ entries are
+  // atomics: a heal/reset swapping an entry concurrently means at
+  // worst we resize an fd about to be closed, or a replacement socket
+  // that would get ApplySockBuf at connect time anyway — both benign.
+  // v == 0 cannot restore "kernel autotuned" on a live fd, so it only
+  // resets the override for future sockets.
   if (v > 0) {
-    for (auto fd : fds_) {
-      if (fd >= 0) ApplySockBuf(fd, v);
+    for (auto& fd : fds_) {
+      int f = fd.load();
+      if (f >= 0) ApplySockBuf(f, v);
     }
   }
+}
+
+void TcpComm::reconnect_stats(long long* last_us, long long* max_us) {
+  std::lock_guard<std::mutex> lk(heal_mu_);
+  if (last_us) *last_us = heal_last_us_;
+  if (max_us) *max_us = heal_max_us_;
 }
 
 Status TcpComm::SendAll(int fd, const void* data, size_t len) {
@@ -368,6 +566,35 @@ Status TcpComm::RecvAll(int fd, void* data, size_t len) {
   return Status::OK();
 }
 
+Status TcpComm::RecvAllTimed(int fd, void* data, size_t len,
+                             int timeout_ms) {
+  // Reconnect-handshake reads: bounded by the heal budget, not the
+  // (possibly much larger) progress deadline — a stale or hostile
+  // connection in the accept backlog must not pin the heal loop.
+  char* p = static_cast<char*>(data);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, MSG_DONTWAIT);
+    if (n > 0) {
+      g_rx_bytes.fetch_add(n, std::memory_order_relaxed);
+      p += n;
+      len -= (size_t)n;
+      continue;
+    }
+    if (n == 0) return Status::Aborted("peer closed during handshake");
+    if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      return SocketError("recv");
+    struct pollfd pfd{fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms > 0 ? timeout_ms : 1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0)
+      return Status::TimedOut("reconnect handshake read timed out");
+  }
+  return Status::OK();
+}
+
 namespace {
 
 // Consume `n` bytes of progress from an iovec list in place, skipping
@@ -397,11 +624,52 @@ int SkipEmptyIov(const struct iovec* iov, int iovcnt, int idx) {
 
 }  // namespace
 
-Status TcpComm::SendVecAll(int fd, struct iovec* iov, int iovcnt) {
+bool TcpComm::HealEligible(int err, int peer) {
+  if (reconnect_budget_sec_ <= 0 || abort_requested_.load()) return false;
+  if (peer < 0 || peer >= size_ || peer == rank_) return false;
+  // EBADF only when the fault injector (or a prior heal) already
+  // swapped the slot out from under this iteration; a genuine stray
+  // EBADF stays a hard error.
+  if (err == EBADF) return fds_[(size_t)peer].load() < 0;
+  // RST-shaped breakage heals. A clean FIN (recv 0) deliberately does
+  // NOT reach here: that is the peer-exit / abort-cascade signature
+  // and must keep escalating (docs/wire.md#reconnect).
+  return IsPeerGoneErrno(err);
+}
+
+void TcpComm::RecordTx(int peer, const struct iovec* iov, int idx,
+                       int iovcnt, size_t n) {
+  PeerSlot& sl = peers_[(size_t)peer];
+  if (sl.ring.enabled()) {
+    size_t left = n;
+    for (int i = idx; i < iovcnt && left > 0; ++i) {
+      size_t take = std::min(left, iov[i].iov_len);
+      if (take > 0) sl.ring.append((const char*)iov[i].iov_base, take);
+      left -= take;
+    }
+  }
+  sl.tx_total += n;
+}
+
+void TcpComm::MarkSegStart(int peer) {
+  PeerSlot& sl = peers_[(size_t)peer];
+  if (!sl.ring.enabled()) return;
+  sl.seg_starts.push_back(sl.tx_total);
+  while (!sl.seg_starts.empty() && sl.seg_starts.front() < sl.ring.begin())
+    sl.seg_starts.pop_front();
+}
+
+Status TcpComm::PeerSend(int peer, struct iovec* iov, int iovcnt) {
   size_t left = 0;
   for (int i = 0; i < iovcnt; ++i) left += iov[i].iov_len;
   int idx = 0;
   while (left > 0) {
+    int fd = fds_[(size_t)peer].load();
+    if (fd < 0) {
+      Status h = HealPeer(peer, "send on a broken link");
+      if (!h.ok()) return h;
+      continue;
+    }
     idx = SkipEmptyIov(iov, iovcnt, idx);
     struct msghdr msg {};
     msg.msg_iov = iov + idx;
@@ -409,12 +677,21 @@ Status TcpComm::SendVecAll(int fd, struct iovec* iov, int iovcnt) {
     ssize_t n = ::sendmsg(fd, &msg, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (n > 0) {
       g_tx_bytes.fetch_add(n, std::memory_order_relaxed);
+      // Ring capture BEFORE AdvanceIov consumes the window (the heal
+      // handshake retransmits from the ring, not the caller's iovecs).
+      RecordTx(peer, iov, idx, iovcnt, (size_t)n);
       left -= (size_t)n;
       AdvanceIov(iov, iovcnt, &idx, (size_t)n);
       continue;  // progress: the deadline below restarts
     }
-    if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+    if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      if (HealEligible(errno, peer)) {
+        Status h = HealPeer(peer, strerror(errno));
+        if (!h.ok()) return h;
+        continue;  // resume exactly where the iovec window stopped
+      }
       return SocketError("sendmsg");
+    }
     struct pollfd pfd{fd, POLLOUT, 0};
     int rc = ::poll(&pfd, 1, progress_timeout_ms_);
     if (rc < 0) {
@@ -423,9 +700,71 @@ Status TcpComm::SendVecAll(int fd, struct iovec* iov, int iovcnt) {
     }
     if (rc == 0) {
       ++g_comm_timeouts;
-      FlightRec(FrKind::TIMEOUT, -1, -1, (long long)left, "sendv");
+      FlightRec(FrKind::TIMEOUT, peer, -1, (long long)left, "sendv");
       return Status::TimedOut(
           "send made no progress for " +
+          std::to_string(progress_timeout_sec_) +
+          "s (HOROVOD_COMM_TIMEOUT_SEC); peer wedged or network "
+          "blackholed");
+    }
+  }
+  return Status::OK();
+}
+
+Status TcpComm::PeerRecv(int peer, void* data, size_t len) {
+  PeerSlot& sl = peers_[(size_t)peer];
+  char* p = static_cast<char*>(data);
+  while (len > 0) {
+    // Handshake read-ahead first: those are the OLDEST stream bytes
+    // (already counted into rx_total when they landed in pending).
+    size_t avail = sl.pending.size() - sl.pending_off;
+    if (avail > 0) {
+      size_t take = std::min(avail, len);
+      memcpy(p, sl.pending.data() + sl.pending_off, take);
+      sl.pending_off += take;
+      p += take;
+      len -= take;
+      if (sl.pending_off == sl.pending.size()) {
+        sl.pending.clear();
+        sl.pending_off = 0;
+      }
+      continue;
+    }
+    int fd = fds_[(size_t)peer].load();
+    if (fd < 0) {
+      Status h = HealPeer(peer, "recv on a broken link");
+      if (!h.ok()) return h;
+      continue;
+    }
+    ssize_t n = ::recv(fd, p, len, MSG_DONTWAIT);
+    if (n > 0) {
+      g_rx_bytes.fetch_add(n, std::memory_order_relaxed);
+      sl.rx_total += (size_t)n;
+      p += n;
+      len -= (size_t)n;
+      continue;
+    }
+    if (n == 0)  // clean FIN: deliberate close — escalate, never heal
+      return Status::Aborted("peer closed connection");
+    if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      if (HealEligible(errno, peer)) {
+        Status h = HealPeer(peer, strerror(errno));
+        if (!h.ok()) return h;
+        continue;  // resume at the same buffer offset
+      }
+      return SocketError("recv");
+    }
+    struct pollfd pfd{fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, progress_timeout_ms_);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) {
+      ++g_comm_timeouts;
+      FlightRec(FrKind::TIMEOUT, -1, peer, (long long)len, "recv");
+      return Status::TimedOut(
+          "recv made no progress for " +
           std::to_string(progress_timeout_sec_) +
           "s (HOROVOD_COMM_TIMEOUT_SEC); peer wedged or network "
           "blackholed");
@@ -444,6 +783,10 @@ Status TcpComm::ConnectTo(const std::string& host, int port, int* fd_out,
                   (unsigned)::getpid();
   long long attempt = 0;
   while (true) {
+    // Teardown (Abort) must never wait out a dial budget — heal-path
+    // redials poll this; during bootstrap the flag is always false.
+    if (abort_requested_.load())
+      return Status::Aborted("comm aborted during connect");
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons((uint16_t)port);
@@ -519,6 +862,8 @@ Status TcpComm::AcceptWithDeadline(int listen_fd, double timeout_sec,
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration<double>(timeout_sec);
   while (true) {
+    if (abort_requested_.load())
+      return Status::Aborted("comm aborted during accept");
     struct pollfd pfd{listen_fd, POLLIN, 0};
     int wait_ms = -1;
     if (timeout_sec > 0) {
@@ -551,11 +896,45 @@ Status TcpComm::AcceptWithDeadline(int listen_fd, double timeout_sec,
   }
 }
 
+namespace {
+
+// Strict "host:port" parse: a corrupted entry must fail fast as
+// "malformed endpoint", not burn a dial budget on port 0.
+bool ParseEndpoint(const std::string& ep, std::string* host, int* port) {
+  auto colon = ep.rfind(':');
+  if (colon == std::string::npos) return false;
+  const char* port_str = ep.c_str() + colon + 1;
+  char* port_end = nullptr;
+  long p = strtol(port_str, &port_end, 10);
+  if (port_end == port_str || *port_end != '\0' || p <= 0 || p > 65535)
+    return false;
+  *host = ep.substr(0, colon);
+  *port = (int)p;
+  return true;
+}
+
+}  // namespace
+
 Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
                      int controller_port, double timeout_sec) {
   rank_ = rank;
   size_ = size;
-  fds_.assign((size_t)size, -1);
+  abort_requested_.store(false);
+  fds_ = std::vector<std::atomic<int>>((size_t)size);
+  for (auto& fd : fds_) fd.store(-1);
+  peers_.assign((size_t)size, PeerSlot{});
+  peer_hosts_.assign((size_t)size, std::string());
+  peer_ports_.assign((size_t)size, -1);
+  // Self-healing wire (docs/wire.md#reconnect): in-place reconnect
+  // budget, carved OUT OF the progress deadline (never added to it) so
+  // exhausted retries surface the same typed abort within the same
+  // overall deadline; 0 = legacy abort-on-break. The per-peer
+  // retransmit window bounds how many in-flight bytes a heal can
+  // replay — a gap beyond it falls back to abort-on-break (recorded).
+  reconnect_budget_sec_ = EnvDouble("HVD_WIRE_RECONNECT_SEC", 30.0);
+  if (reconnect_budget_sec_ < 0) reconnect_budget_sec_ = 0.0;
+  retx_cap_bytes_ = EnvLL("HVD_WIRE_RETRANSMIT_BUF_BYTES", 8LL << 20);
+  if (retx_cap_bytes_ < 0) retx_cap_bytes_ = 0;
   // Progress deadline for every post-bootstrap blocking wait. Default
   // generous (300 s — far beyond any healthy collective, small enough
   // that a wedged peer becomes an error the same day); 0 keeps the
@@ -572,7 +951,20 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
   // transfer. 0 (or negative/malformed) = serial legacy schedule —
   // the fallback that saved np=8 on oversubscribed hosts.
   set_ring_chunk_bytes(EnvLL("HVD_RING_CHUNK_BYTES", 1 << 20));
+  // Clamp the reconnect budget INSIDE the progress deadline: a heal
+  // that exhausts its retries must fail no later than the deadline the
+  // operator already configured for a wedged peer.
+  if (progress_timeout_sec_ > 0 &&
+      reconnect_budget_sec_ > progress_timeout_sec_)
+    reconnect_budget_sec_ = progress_timeout_sec_;
+  if (reconnect_budget_sec_ > 0 && retx_cap_bytes_ > 0) {
+    for (int p = 0; p < size; ++p) {
+      if (p != rank) peers_[(size_t)p].ring.reset((size_t)retx_cap_bytes_);
+    }
+  }
   ParseFaultEnv(rank);
+  if (g_fault.mode == FaultMode::RESET || g_fault.mode == FaultMode::STORM)
+    g_fault.comm = this;
   if (size == 1) return Status::OK();
 
   // Data-plane listener on an ephemeral port.
@@ -735,27 +1127,23 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
     }
   }
 
+  // Retain the endpoint table for in-place reconnects: the heal path
+  // re-dials the SAME data-plane listener (listen_fd_ stays open for
+  // the communicator's whole life, so the port survives the break).
+  for (int j = 0; j < size; ++j) {
+    if (j == rank) continue;
+    if (!ParseEndpoint(table[(size_t)j], &peer_hosts_[(size_t)j],
+                       &peer_ports_[(size_t)j]))
+      return Status::Error("malformed endpoint for rank " +
+                           std::to_string(j) + ": '" + table[(size_t)j] +
+                           "'");
+  }
+
   // --- full-mesh connect: i dials j for i < j; j accepts ---
   for (int j = rank + 1; j < size; ++j) {
-    auto colon = table[(size_t)j].rfind(':');
-    if (colon == std::string::npos)
-      return Status::Error("malformed endpoint for rank " +
-                           std::to_string(j) + ": '" + table[(size_t)j] +
-                           "'");
-    std::string host = table[(size_t)j].substr(0, colon);
-    // Strict port parse: a corrupted entry must fail fast as
-    // "malformed endpoint", not burn the rendezvous budget dialing
-    // port 0 (same satellite as the bounds checks above).
-    const char* port_str = table[(size_t)j].c_str() + colon + 1;
-    char* port_end = nullptr;
-    long port = strtol(port_str, &port_end, 10);
-    if (port_end == port_str || *port_end != '\0' || port <= 0 ||
-        port > 65535)
-      return Status::Error("malformed endpoint for rank " +
-                           std::to_string(j) + ": '" + table[(size_t)j] +
-                           "'");
     int fd = -1;
-    Status s = ConnectTo(host, port, &fd, timeout_sec);
+    Status s = ConnectTo(peer_hosts_[(size_t)j], peer_ports_[(size_t)j],
+                         &fd, timeout_sec);
     if (!s.ok()) return s;
     int32_t r32 = rank;
     s = SendAll(fd, &r32, sizeof(r32));
@@ -763,7 +1151,7 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
       ::close(fd);
       return s;
     }
-    fds_[(size_t)j] = fd;
+    fds_[(size_t)j].store(fd);
   }
   for (int i = 0; i < rank; ++i) {
     int fd = -1;
@@ -780,10 +1168,10 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
                            std::to_string(peer_rank) +
                            " (accepting ranks below " +
                            std::to_string(rank) + ")");
-    if (fds_[(size_t)peer_rank] != -1)
+    if (fds_[(size_t)peer_rank].load() != -1)
       return Status::Error("mesh peer rank " + std::to_string(peer_rank) +
                            " connected twice");
-    fds_[(size_t)peer_rank] = accepted.release();
+    fds_[(size_t)peer_rank].store(accepted.release());
   }
   HVD_LOG(LogLevel::DEBUG, "TCP mesh established, size=" +
                                std::to_string(size) +
@@ -793,6 +1181,331 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
                                               progress_timeout_sec_) +
                                           "s"
                                     : ", comm deadline=off"));
+  return Status::OK();
+}
+
+// ------------------------------------------------- self-healing wire ------
+// (docs/wire.md#reconnect) A link that breaks with an RST-shaped errno
+// is reconnected IN PLACE: the lower-rank side re-dials the peer's
+// data-plane listener (same jittered-backoff ConnectTo discipline as
+// bootstrap, counted in hvd_bootstrap_retries_total), the higher-rank
+// side re-accepts, a versioned handshake agrees a new epoch and
+// exchanges cumulative stream positions, and each side retransmits the
+// peer's lost in-flight bytes from its bounded ring. The interrupted
+// operation then resumes at the exact byte offset it stopped at — the
+// pipelined ring's sub-chunk bookkeeping lives in the caller's frame
+// and is untouched.
+
+Status TcpComm::HealPeer(int peer, const char* why) {
+  if (peer < 0 || peer >= size_ || peer == rank_)
+    return Status::Aborted(std::string("connection failure: ") + why);
+  PeerSlot& sl = peers_[(size_t)peer];
+  int old = fds_[(size_t)peer].exchange(-1);
+  if (old >= 0) ::close(old);
+  if (reconnect_budget_sec_ <= 0 || abort_requested_.load()) {
+    // Legacy abort-on-break (HVD_WIRE_RECONNECT_SEC=0, or teardown in
+    // progress): same typed abort the pre-reconnect core raised.
+    return Status::Aborted("connection to peer " + std::to_string(peer) +
+                           " broke (" + why +
+                           "); in-place reconnect is disabled");
+  }
+  FlightRec(FrKind::WIRE_BREAK, peer, (long long)sl.epoch,
+            (long long)(sl.tx_total - sl.ring.begin()), why);
+  HVD_LOG(LogLevel::WARN,
+          "wire: link to peer " + std::to_string(peer) + " broke (" + why +
+              "); attempting in-place reconnect (budget " +
+              std::to_string(reconnect_budget_sec_) + "s)");
+  auto t0 = std::chrono::steady_clock::now();
+  auto deadline =
+      t0 + std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+               std::chrono::duration<double>(reconnect_budget_sec_));
+  Status last = Status::Error("no reconnect attempt completed");
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (abort_requested_.load()) {
+      last = Status::Aborted("comm aborted during reconnect");
+      break;
+    }
+    last = rank_ < peer ? HealDial(peer, deadline)
+                        : HealAccept(peer, deadline);
+    if (last.ok()) {
+      long long us = (long long)std::chrono::duration_cast<
+                         std::chrono::microseconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count();
+      {
+        std::lock_guard<std::mutex> lk(heal_mu_);
+        heal_last_us_ = us;
+        if (us > heal_max_us_) heal_max_us_ = us;
+      }
+      FlightRec(FrKind::WIRE_RESUME, peer,
+                (long long)peers_[(size_t)peer].epoch, us, why);
+      HVD_LOG(LogLevel::WARN,
+              "wire: link to peer " + std::to_string(peer) +
+                  " healed in-place in " + std::to_string(us / 1000) +
+                  " ms (epoch " +
+                  std::to_string(peers_[(size_t)peer].epoch) + ")");
+      return Status::OK();
+    }
+    // An unrecoverable stream gap cannot shrink on retry: escalate now.
+    if (last.reason.find("retransmit window") != std::string::npos) break;
+  }
+  g_reconnect_failures.fetch_add(1, std::memory_order_relaxed);
+  FlightRec(FrKind::WIRE_BREAK, peer, -1, 0, "reconnect-exhausted");
+  return Status::Aborted(
+      "in-place reconnect to peer " + std::to_string(peer) +
+      " failed within " + std::to_string(reconnect_budget_sec_) +
+      "s (HVD_WIRE_RECONNECT_SEC, carved out of HOROVOD_COMM_TIMEOUT_SEC): " +
+      last.reason);
+}
+
+Status TcpComm::HealDial(int peer,
+                         std::chrono::steady_clock::time_point deadline) {
+  FlightRec(FrKind::WIRE_REDIAL, peer, 0, 0, "dial");
+  double remaining = std::chrono::duration<double>(
+                         deadline - std::chrono::steady_clock::now())
+                         .count();
+  if (remaining <= 0) return Status::TimedOut("reconnect budget exhausted");
+  int fd = -1;
+  Status s = ConnectTo(peer_hosts_[(size_t)peer], peer_ports_[(size_t)peer],
+                       &fd, remaining);
+  if (!s.ok()) return s;
+  ScopedFd guard(fd);
+  PeerSlot& sl = peers_[(size_t)peer];
+  ReconnectHello h{kReconnMagic, (uint32_t)rank_, sl.epoch + 1, 0,
+                   sl.rx_total, sl.tx_total};
+  // 32 bytes into a fresh socket's empty sndbuf: cannot block.
+  s = SendAll(guard.get(), &h, sizeof(h));
+  if (!s.ok()) return s;
+  ReconnectReply rep{};
+  remaining = std::chrono::duration<double>(
+                  deadline - std::chrono::steady_clock::now())
+                  .count();
+  int wait_ms = (int)std::min(std::max(remaining, 0.001) * 1000.0,
+                              2147483000.0);
+  s = RecvAllTimed(guard.get(), &rep, sizeof(rep), wait_ms);
+  if (!s.ok()) return s;
+  if (rep.magic != kReconnMagic)
+    return Status::Error("bad reconnect reply magic");
+  return FinishHandshake(peer, guard.release(), rep.epoch, rep.rx_total,
+                         rep.tx_total, deadline);
+}
+
+Status TcpComm::HealAccept(int peer,
+                           std::chrono::steady_clock::time_point deadline) {
+  FlightRec(FrKind::WIRE_REDIAL, peer, 1, 0, "accept");
+  while (true) {
+    double remaining = std::chrono::duration<double>(
+                           deadline - std::chrono::steady_clock::now())
+                           .count();
+    if (remaining <= 0)
+      return Status::TimedOut("reconnect accept timed out: peer " +
+                              std::to_string(peer) + " never re-dialed");
+    if (abort_requested_.load())
+      return Status::Aborted("comm aborted during reconnect accept");
+    int fd = -1;
+    Status s = AcceptWithDeadline(listen_fd_, remaining, &fd, "reconnect");
+    if (!s.ok()) return s;
+    ScopedFd guard(fd);
+    SetSockOpts(guard.get());
+    ReconnectHello h{};
+    int wait_ms = (int)std::min(std::min(remaining, 5.0) * 1000.0,
+                                2147483000.0);
+    s = RecvAllTimed(guard.get(), &h, sizeof(h), wait_ms);
+    if (!s.ok() || h.magic != kReconnMagic) {
+      HVD_LOG(LogLevel::WARN,
+              "wire: dropped a reconnect-accept connection without a "
+              "valid hello (" +
+                  (s.ok() ? std::string("bad magic") : s.reason) + ")");
+      continue;  // stale backlog entry / abandoned dial attempt
+    }
+    int r = (int)h.rank;
+    // Only lower ranks dial us (the mesh orientation); anything else
+    // is corruption — drop and keep listening within the budget.
+    if (r < 0 || r >= rank_) {
+      HVD_LOG(LogLevel::WARN,
+              "wire: reconnect hello announced invalid rank " +
+                  std::to_string(r) + "; dropping connection");
+      continue;
+    }
+    PeerSlot& sl = peers_[(size_t)r];
+    uint32_t agreed = (uint32_t)WireAgreeEpoch((int)h.epoch, (int)sl.epoch);
+    ReconnectReply rep{kReconnMagic, agreed, sl.rx_total, sl.tx_total};
+    s = SendAll(guard.get(), &rep, sizeof(rep));
+    if (!s.ok()) {
+      HVD_LOG(LogLevel::WARN,
+              "wire: reconnect reply to rank " + std::to_string(r) +
+                  " failed (" + s.reason + "); re-listening");
+      continue;
+    }
+    // A link we had not yet noticed was broken may still hold an old
+    // fd — the peer's re-dial IS the break notification. Replace it.
+    int old = fds_[(size_t)r].exchange(-1);
+    if (old >= 0) ::close(old);
+    s = FinishHandshake(r, guard.release(), agreed, h.rx_total, h.tx_total,
+                        deadline);
+    if (r == peer) return s;
+    // Adopted an out-of-order re-dial from ANOTHER lower rank (both
+    // links of a ring neighbor pair can break in one fault); its slot
+    // is healed (or marked broken again on failure — its next I/O
+    // retries), and the accept loop keeps waiting for the peer this
+    // heal was entered for.
+    if (s.ok()) {
+      FlightRec(FrKind::WIRE_RESUME, r,
+                (long long)peers_[(size_t)r].epoch, 0, "adopted");
+      HVD_LOG(LogLevel::WARN,
+              "wire: link to peer " + std::to_string(r) +
+                  " healed in-place (adopted re-dial, epoch " +
+                  std::to_string(peers_[(size_t)r].epoch) + ")");
+    } else {
+      HVD_LOG(LogLevel::WARN,
+              "wire: adopted reconnect from rank " + std::to_string(r) +
+                  " failed its handshake: " + s.reason);
+    }
+  }
+}
+
+Status TcpComm::FinishHandshake(
+    int peer, int fd, uint32_t agreed_epoch, unsigned long long peer_rx,
+    unsigned long long peer_tx,
+    std::chrono::steady_clock::time_point deadline) {
+  ScopedFd guard(fd);
+  PeerSlot& sl = peers_[(size_t)peer];
+  long long gap = WireRetxGap((long long)sl.tx_total, (long long)peer_rx);
+  if (gap < 0 || peer_tx < sl.rx_total)
+    return Status::Error(
+        "reconnect handshake positions impossible (peer claims more "
+        "bytes than were ever sent)");
+  unsigned long long expect_in = peer_tx - sl.rx_total;
+  if (gap > 0 && (!sl.ring.enabled() ||
+                  peer_rx < sl.ring.begin())) {
+    // Oversize in-flight loss: the bytes fell out of the bounded
+    // retransmit window. Fall back to abort-on-break, recorded.
+    FlightRec(FrKind::WIRE_BREAK, peer, (long long)agreed_epoch, gap,
+              "gap-exceeds-retransmit-window");
+    return Status::Aborted(
+        "cannot resume link to peer " + std::to_string(peer) + ": " +
+        std::to_string(gap) +
+        " in-flight bytes exceed the retransmit window "
+        "(HVD_WIRE_RETRANSMIT_BUF_BYTES)");
+  }
+  if (gap > 0) {
+    // hvd_comm_frames_retransmitted_total: frames/raw segments whose
+    // bytes this handshake replays — every recorded segment start in
+    // the gap, plus the partially-sent segment the gap starts inside.
+    long long frames = 0;
+    bool mid_segment = true;
+    for (unsigned long long s : sl.seg_starts) {
+      if (s >= peer_rx && s < sl.tx_total) {
+        ++frames;
+        if (s == peer_rx) mid_segment = false;
+      }
+    }
+    if (mid_segment) ++frames;
+    g_frames_retransmitted.fetch_add(frames, std::memory_order_relaxed);
+  }
+  Status s = RetransmitPump(peer, guard.get(), peer_rx,
+                            (unsigned long long)gap, expect_in, deadline);
+  if (!s.ok()) return s;
+  sl.epoch = agreed_epoch;
+  // Install-vs-Abort race: Abort() sets the flag BEFORE sweeping the
+  // fd table, so either (a) we observe the flag here and shut the new
+  // socket down ourselves, or (b) the flag was not yet set at our
+  // store and Abort's subsequent sweep finds the installed fd. Either
+  // way no live socket escapes the teardown sweep.
+  int installed = guard.release();
+  fds_[(size_t)peer].store(installed);
+  if (abort_requested_.load()) {
+    ::shutdown(installed, SHUT_RDWR);
+    return Status::Aborted("comm aborted during reconnect");
+  }
+  g_comm_reconnects.fetch_add(1, std::memory_order_relaxed);
+  FlightRec(FrKind::WIRE_HANDSHAKE, peer, (long long)agreed_epoch, gap,
+            "resume");
+  return Status::OK();
+}
+
+Status TcpComm::RetransmitPump(
+    int peer, int fd, unsigned long long from, unsigned long long len,
+    unsigned long long expect_in,
+    std::chrono::steady_clock::time_point deadline) {
+  // Replay [from, from+len) from the ring while opportunistically
+  // absorbing the peer's own replay into `pending` — both sides pump
+  // concurrently, so neither can deadlock on full kernel buffers even
+  // when both gaps approach the ring bound. Whatever part of
+  // expect_in has not arrived when our send side finishes simply
+  // continues as ordinary stream bytes under the resumed operation.
+  PeerSlot& sl = peers_[(size_t)peer];
+  char out[64 * 1024];
+  char in[64 * 1024];
+  size_t out_have = 0, out_off = 0;
+  unsigned long long sent = 0;
+  while (sent < len) {
+    struct pollfd pfds[2];
+    pfds[0] = {fd, POLLOUT, 0};
+    int n = 1;
+    if (expect_in > 0) {
+      pfds[1] = {fd, POLLIN, 0};
+      n = 2;
+    }
+    // Bounded by the HEAL deadline, not the (possibly much larger)
+    // progress deadline: a peer that wedges mid-retransmit must fail
+    // the heal within HVD_WIRE_RECONNECT_SEC — per-round progress
+    // never restarts this clock.
+    double remaining = std::chrono::duration<double>(
+                           deadline - std::chrono::steady_clock::now())
+                           .count();
+    if (remaining <= 0) {
+      ++g_comm_timeouts;
+      return Status::TimedOut(
+          "reconnect retransmit exceeded the reconnect budget");
+    }
+    int wait_ms = (int)std::min(remaining * 1000.0, 2147483000.0);
+    if (progress_timeout_ms_ > 0 && progress_timeout_ms_ < wait_ms)
+      wait_ms = progress_timeout_ms_;
+    int rc = ::poll(pfds, (nfds_t)n, wait_ms > 0 ? wait_ms : 1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) continue;  // re-evaluate the deadline above
+    if (pfds[0].revents & (POLLOUT | POLLERR | POLLHUP)) {
+      if (out_off == out_have) {
+        out_off = 0;
+        out_have = (size_t)std::min<unsigned long long>(sizeof(out),
+                                                        len - sent);
+        if (!sl.ring.read(from + sent, out_have, out))
+          return Status::Aborted(
+              "retransmit range fell out of the retransmit window "
+              "mid-heal");
+      }
+      ssize_t w = ::send(fd, out + out_off, out_have - out_off,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR)
+        return SocketError("retransmit send");
+      if (w > 0) {
+        g_tx_bytes.fetch_add(w, std::memory_order_relaxed);
+        out_off += (size_t)w;
+        sent += (unsigned long long)w;
+      }
+    }
+    if (n == 2 && (pfds[1].revents & (POLLIN | POLLERR | POLLHUP))) {
+      size_t want = (size_t)std::min<unsigned long long>(sizeof(in),
+                                                         expect_in);
+      ssize_t r = ::recv(fd, in, want, MSG_DONTWAIT);
+      if (r == 0)
+        return Status::Aborted("peer closed during retransmit");
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR)
+        return SocketError("retransmit recv");
+      if (r > 0) {
+        g_rx_bytes.fetch_add(r, std::memory_order_relaxed);
+        sl.pending.append(in, (size_t)r);
+        sl.rx_total += (unsigned long long)r;
+        expect_in -= (unsigned long long)r;
+      }
+    }
+  }
   return Status::OK();
 }
 
@@ -812,13 +1525,20 @@ Status TcpComm::Sendv(int peer, const struct iovec* iov, int iovcnt) {
   }
   uint64_t len = 0;
   for (int i = 0; i < iovcnt; ++i) len += iov[i].iov_len;
-  FrameHeader h{kMagic, (uint32_t)rank_, len};
+  // Epoch/seq-stamped header (docs/wire.md#reconnect): the epoch is
+  // the link's epoch at COMPOSITION time — a retransmitted copy of
+  // this frame after a reconnect legally carries it even though the
+  // link has moved on; the receiver only rejects epochs from the
+  // future and sequence gaps.
+  PeerSlot& sl = peers_[(size_t)peer];
+  MarkSegStart(peer);
+  FrameHeader h{kMagic, (uint32_t)rank_, sl.epoch, 0, ++sl.send_seq, len};
   // Header + payload in one gather list: a single vectored call per
   // frame (no Nagle-unfriendly header/payload split, no pack copy).
   std::vector<struct iovec> vec((size_t)iovcnt + 1);
   vec[0] = {&h, sizeof(h)};
   for (int i = 0; i < iovcnt; ++i) vec[(size_t)(i + 1)] = iov[i];
-  Status s = SendVecAll(fds_[(size_t)peer], vec.data(), iovcnt + 1);
+  Status s = PeerSend(peer, vec.data(), iovcnt + 1);
   // The fd-level deadline event cannot know the peer; this framed
   // wrapper can — name it, so tools/trace's straggler attribution
   // covers control-plane (gather/bcast) wedges too.
@@ -829,14 +1549,31 @@ Status TcpComm::Sendv(int peer, const struct iovec* iov, int iovcnt) {
 
 Status TcpComm::Recv(int peer, std::string* out) {
   FrameHeader h;
-  Status s = RecvAll(fds_[(size_t)peer], &h, sizeof(h));
+  Status s = PeerRecv(peer, &h, sizeof(h));
   if (s.ok()) {
     if (h.magic != kMagic) return Status::Error("bad frame magic");
     if (h.len > kMaxFrameLen)
       return Status::Error("frame length " + std::to_string(h.len) +
                            " exceeds sanity cap (corrupted header?)");
+    PeerSlot& sl = peers_[(size_t)peer];
+    int rc = WireFrameCheck((long long)h.epoch, (long long)h.seq,
+                            (long long)sl.epoch,
+                            (long long)(sl.recv_seq + 1));
+    if (rc == -1)
+      return Status::Error("frame from peer " + std::to_string(peer) +
+                           " carries epoch " + std::to_string(h.epoch) +
+                           " from the future (link epoch " +
+                           std::to_string(sl.epoch) + ")");
+    if (rc == -2)
+      return Status::Error("frame sequence gap from peer " +
+                           std::to_string(peer) + ": got seq " +
+                           std::to_string(h.seq) + " want " +
+                           std::to_string(sl.recv_seq + 1) +
+                           " (a frame was lost or duplicated across a "
+                           "reconnect)");
+    sl.recv_seq = h.seq;
     out->resize(h.len);
-    s = RecvAll(fds_[(size_t)peer], out->data(), h.len);
+    s = PeerRecv(peer, out->data(), h.len);
   }
   if (s.type == StatusType::TIMED_OUT)
     FlightRec(FrKind::TIMEOUT, -1, peer, 0, "frame");
@@ -845,14 +1582,23 @@ Status TcpComm::Recv(int peer, std::string* out) {
 
 Status TcpComm::RecvInto(int peer, void* buf, size_t len) {
   FrameHeader h;
-  Status s = RecvAll(fds_[(size_t)peer], &h, sizeof(h));
+  Status s = PeerRecv(peer, &h, sizeof(h));
   if (s.ok()) {
     if (h.magic != kMagic) return Status::Error("bad frame magic");
     if (h.len != len)
       return Status::Error("frame length mismatch: got " +
                            std::to_string(h.len) + " want " +
                            std::to_string(len));
-    s = RecvAll(fds_[(size_t)peer], buf, len);
+    PeerSlot& sl = peers_[(size_t)peer];
+    int rc = WireFrameCheck((long long)h.epoch, (long long)h.seq,
+                            (long long)sl.epoch,
+                            (long long)(sl.recv_seq + 1));
+    if (rc != 0)
+      return Status::Error(
+          "frame epoch/seq validation failed from peer " +
+          std::to_string(peer) + " (rc=" + std::to_string(rc) + ")");
+    sl.recv_seq = h.seq;
+    s = PeerRecv(peer, buf, len);
   }
   if (s.type == StatusType::TIMED_OUT)
     FlightRec(FrKind::TIMEOUT, -1, peer, (long long)len, "frame");
@@ -877,21 +1623,72 @@ Status TcpComm::RawSendRecvV(int peer_s, const struct iovec* siov,
     Status fs = MaybeInjectFault(peer_s);
     if (!fs.ok()) return fs;
   }
-  int sfd = peer_s >= 0 ? fds_[(size_t)peer_s] : -1;
-  int rfd = peer_r >= 0 ? fds_[(size_t)peer_r] : -1;
   std::vector<struct iovec> sv, rv;
   size_t sleft = 0, rleft = 0;
-  if (sfd >= 0) {
+  if (peer_s >= 0) {
     sv.assign(siov, siov + siovcnt);
     for (auto& v : sv) sleft += v.iov_len;
+    if (sleft > 0) MarkSegStart(peer_s);
   }
-  if (rfd >= 0) {
+  if (peer_r >= 0) {
     rv.assign(riov, riov + riovcnt);
     for (auto& v : rv) rleft += v.iov_len;
   }
   int sidx = 0, ridx = 0;
   size_t rtotal = rleft, rdone = 0, rfired = 0;
+  // Sub-chunk boundary bookkeeping lives HERE, in the call frame: a
+  // mid-transfer heal preserves rdone/rfired, so the pipelined
+  // reduce-scatter resumes at the exact chunk boundary it stopped at.
+  auto fire_chunks = [&]() {
+    if (rchunk == 0 || !on_chunk) return;
+    while (rdone - rfired >= rchunk) {
+      on_chunk(rfired, rfired + rchunk);
+      rfired += rchunk;
+    }
+    if (rleft == 0 && rfired < rtotal) {
+      on_chunk(rfired, rtotal);
+      rfired = rtotal;
+    }
+  };
+  // Drain handshake read-ahead (oldest stream bytes, already counted
+  // into rx_total when a heal absorbed them) before any socket read.
+  auto drain_pending = [&]() {
+    if (peer_r < 0 || rleft == 0) return;
+    PeerSlot& sl = peers_[(size_t)peer_r];
+    while (rleft > 0 && sl.pending_off < sl.pending.size()) {
+      ridx = SkipEmptyIov(rv.data(), (int)rv.size(), ridx);
+      struct iovec& v = rv[(size_t)ridx];
+      size_t take = std::min(v.iov_len, sl.pending.size() - sl.pending_off);
+      memcpy(v.iov_base, sl.pending.data() + sl.pending_off, take);
+      sl.pending_off += take;
+      rleft -= take;
+      rdone += take;
+      AdvanceIov(rv.data(), (int)rv.size(), &ridx, take);
+      fire_chunks();
+    }
+    if (sl.pending_off == sl.pending.size()) {
+      sl.pending.clear();
+      sl.pending_off = 0;
+    }
+  };
   while (sleft > 0 || rleft > 0) {
+    drain_pending();
+    if (sleft == 0 && rleft == 0) break;
+    // Re-read the fd table every round: a heal (ours, or one that
+    // ADOPTED the other neighbor's re-dial) and the reset injector
+    // both swap entries under this loop.
+    int sfd = (sleft > 0) ? fds_[(size_t)peer_s].load() : -1;
+    int rfd = (rleft > 0) ? fds_[(size_t)peer_r].load() : -1;
+    if (sleft > 0 && sfd < 0) {
+      Status h = HealPeer(peer_s, "duplex send on a broken link");
+      if (!h.ok()) return h;
+      continue;
+    }
+    if (rleft > 0 && rfd < 0) {
+      Status h = HealPeer(peer_r, "duplex recv on a broken link");
+      if (!h.ok()) return h;
+      continue;
+    }
     struct pollfd pfds[2];
     int n = 0;
     int si = -1, ri = -1;
@@ -936,10 +1733,18 @@ Status TcpComm::RawSendRecvV(int peer_s, const struct iovec* siov,
       msg.msg_iovlen =
           (size_t)std::min((int)sv.size() - sidx, MaxIovPerCall());
       ssize_t w = ::sendmsg(sfd, &msg, MSG_NOSIGNAL | MSG_DONTWAIT);
-      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR) {
+        if (HealEligible(errno, peer_s)) {
+          Status h = HealPeer(peer_s, strerror(errno));
+          if (!h.ok()) return h;
+          continue;  // resume at the same iovec offset
+        }
         return SocketError("sendmsg");
+      }
       if (w > 0) {
         g_tx_bytes.fetch_add(w, std::memory_order_relaxed);
+        RecordTx(peer_s, sv.data(), sidx, (int)sv.size(), (size_t)w);
         sleft -= (size_t)w;
         AdvanceIov(sv.data(), (int)sv.size(), &sidx, (size_t)w);
       }
@@ -951,26 +1756,24 @@ Status TcpComm::RawSendRecvV(int peer_s, const struct iovec* siov,
       msg.msg_iovlen =
           (size_t)std::min((int)rv.size() - ridx, MaxIovPerCall());
       ssize_t r = ::recvmsg(rfd, &msg, MSG_DONTWAIT);
-      if (r == 0) return Status::Aborted("peer closed connection");
-      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      if (r == 0)  // clean FIN: deliberate close — escalate, never heal
+        return Status::Aborted("peer closed connection");
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR) {
+        if (HealEligible(errno, peer_r)) {
+          Status h = HealPeer(peer_r, strerror(errno));
+          if (!h.ok()) return h;
+          continue;  // rdone/rfired preserved: exact-boundary resume
+        }
         return SocketError("recvmsg");
+      }
       if (r > 0) {
         g_rx_bytes.fetch_add(r, std::memory_order_relaxed);
+        peers_[(size_t)peer_r].rx_total += (unsigned long long)r;
         rleft -= (size_t)r;
         rdone += (size_t)r;
         AdvanceIov(rv.data(), (int)rv.size(), &ridx, (size_t)r);
-        if (rchunk > 0 && on_chunk) {
-          // Fire every fully-landed sub-chunk; the tail (< rchunk)
-          // fires once the whole range is in.
-          while (rdone - rfired >= rchunk) {
-            on_chunk(rfired, rfired + rchunk);
-            rfired += rchunk;
-          }
-          if (rleft == 0 && rfired < rtotal) {
-            on_chunk(rfired, rtotal);
-            rfired = rtotal;
-          }
-        }
+        fire_chunks();
       }
     }
   }

@@ -8,6 +8,17 @@
 // endpoint table, then ranks connect pairwise (i connects to j for i < j)
 // to form the mesh. All collective traffic is framed and runs on the
 // single background thread, so no per-connection locking is needed.
+//
+// Self-healing wire (docs/wire.md#reconnect): each peer link carries a
+// connection epoch, per-direction frame sequence numbers, and cumulative
+// byte-stream positions. When a link breaks with an RST-shaped errno,
+// the lower-rank side re-dials the peer's (still listening) data-plane
+// port while the higher-rank side re-accepts; a versioned handshake
+// exchanges epochs + stream positions, the lost in-flight bytes are
+// retransmitted from a bounded per-peer ring, and the interrupted
+// transfer resumes at the exact byte (and pipelined sub-chunk) boundary.
+// A clean FIN is NOT healed — it is the deliberate-close signature of a
+// peer exit or an abort cascade, and must keep escalating as before.
 
 #ifndef HVD_TPU_COMM_H
 #define HVD_TPU_COMM_H
@@ -17,7 +28,10 @@
 #include <sys/uio.h>
 
 #include <atomic>
+#include <chrono>
+#include <deque>
 #include <functional>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -36,6 +50,62 @@ long long CommTxBytesTotal();
 long long CommRxBytesTotal();
 long long RingSubchunkStepsTotal();
 void CountRingSubchunkStep();
+// Self-healing wire counters (docs/wire.md#reconnect): links healed
+// in place, frames retransmitted across a reconnect handshake, and
+// reconnect attempts that exhausted HVD_WIRE_RECONNECT_SEC.
+long long CommReconnectsTotal();
+long long CommFramesRetransmittedTotal();
+long long CommReconnectFailuresTotal();
+
+// --- reconnect protocol math (pure; unit-tested via ctypes exports) --------
+
+// Bytes the sender must retransmit after a reconnect handshake:
+// tx_total - peer_rx. Returns -1 on an impossible exchange (the peer
+// claims to have received more than was ever sent) — a protocol
+// violation that must fail the handshake, not underflow.
+long long WireRetxGap(long long tx_total, long long peer_rx);
+
+// Epoch agreement: both sides bump past their own view and the
+// dialer's proposal, so the agreed epoch is strictly newer than any
+// epoch either side ever stamped on a frame.
+int WireAgreeEpoch(int proposed, int current);
+
+// Frame-header validation against the receiving slot's state:
+// 0 = ok, -1 = epoch from the future (sender claims an epoch newer
+// than the handshake agreed — corruption), -2 = sequence gap (a frame
+// was lost or duplicated across the resume — the exact bug the
+// retransmit ring exists to prevent). Retransmitted frames legally
+// carry OLDER epochs (they were composed before the break).
+int WireFrameCheck(long long epoch, long long seq, long long cur_epoch,
+                   long long expect_seq);
+
+// Bounded byte ring of recently-sent stream bytes (the retransmit
+// window). Offsets are absolute stream positions: end() == the peer
+// slot's tx_total, begin() == the oldest byte still retransmittable.
+// Backing storage is allocated lazily on first append, so disabled /
+// idle peers cost nothing.
+class RetxRing {
+ public:
+  void reset(size_t cap) {
+    cap_ = cap;
+    buf_.clear();
+    len_ = 0;
+    end_ = 0;
+  }
+  bool enabled() const { return cap_ > 0; }
+  unsigned long long end() const { return end_; }
+  unsigned long long begin() const { return end_ - len_; }
+  void append(const char* data, size_t n);
+  // Copy [from, from + n) into out; false when the range has already
+  // been overwritten (fell out of the window) or was never written.
+  bool read(unsigned long long from, size_t n, char* out) const;
+
+ private:
+  std::vector<char> buf_;
+  size_t cap_ = 0;
+  size_t len_ = 0;              // bytes retained (<= cap_)
+  unsigned long long end_ = 0;  // stream offset one past the newest byte
+};
 
 class TcpComm {
  public:
@@ -48,6 +118,8 @@ class TcpComm {
   // Unblock any thread stuck in send/recv (shutdown(2) on every socket,
   // fds stay valid) — call before joining the background thread during
   // teardown; a blocked peer exchange then fails with "peer closed".
+  // Also disarms in-place reconnect: a heal attempt in progress fails
+  // fast instead of burning its budget against a world being torn down.
   void Abort();
   void Close();
 
@@ -60,6 +132,7 @@ class TcpComm {
   // payloads (Sendv gathers straight from the caller's buffers). One
   // Send/Sendv call == one frame for the fault injector's
   // HVD_FAULT_AFTER_FRAMES accounting, however many iovecs it gathers.
+  // Headers are epoch/sequence-stamped (docs/wire.md#reconnect).
   Status Send(int peer, const void* data, size_t len);
   Status Sendv(int peer, const struct iovec* iov, int iovcnt);
   Status Recv(int peer, std::string* out);
@@ -87,7 +160,10 @@ class TcpComm {
   // fires after every rchunk received bytes (and once for the final
   // partial chunk) — the pipelined ring's reduce hook. One call == one
   // frame for HVD_FAULT_AFTER_FRAMES, regardless of iovec or sub-chunk
-  // count. Either peer may be -1 to skip that side.
+  // count. Either peer may be -1 to skip that side. A mid-transfer
+  // link break heals in place (HVD_WIRE_RECONNECT_SEC): the byte and
+  // sub-chunk positions are preserved across the reconnect, so
+  // pipelined reduce-scatter state is never corrupted.
   Status RawSendRecvV(int peer_s, const struct iovec* siov, int siovcnt,
                       int peer_r, const struct iovec* riov, int riovcnt,
                       size_t rchunk = 0,
@@ -108,6 +184,17 @@ class TcpComm {
   // an explicit setsockopt cannot be un-done on a live fd.
   void set_socket_buf_bytes(long long v);
 
+  // Heal-duration stats for bench_wire --fault and the scrape bridge:
+  // microseconds from break detection to handshake-complete (the
+  // retransmit pump included) for the last and slowest heal.
+  void reconnect_stats(long long* last_us, long long* max_us);
+
+  // Fault-injector action for reset/reconnect_storm modes: SO_LINGER-0
+  // close (hard RST to the peer) of the armed target connections.
+  // Public so the sub-chunk trigger (CountRingSubchunkStep) can fire
+  // it mid-pipelined-transfer; background thread only.
+  void InjectReset();
+
   // --- control-plane collectives over the star/mesh (blocking) ---
   // Gather variable-size blobs to `root` (root gets all, others send).
   Status Gatherv(const std::string& mine, std::vector<std::string>* all,
@@ -120,6 +207,28 @@ class TcpComm {
   Status Barrier(int root, const std::vector<int>& members);
 
  private:
+  // Per-peer link state for the self-healing wire. Touched only on the
+  // background thread (the single-threaded-comm invariant), so no
+  // locking; the cross-thread surfaces are the atomic fd table and the
+  // heal stats below.
+  struct PeerSlot {
+    uint32_t epoch = 0;             // connection epoch (handshake-agreed)
+    unsigned long long send_seq = 0;  // frames sent on this link
+    unsigned long long recv_seq = 0;  // frames received on this link
+    unsigned long long tx_total = 0;  // stream bytes written toward peer
+    unsigned long long rx_total = 0;  // stream bytes delivered to this app
+    RetxRing ring;                  // retransmit window over sent bytes
+    // Stream offsets where framed sends / raw segments began, for the
+    // hvd_comm_frames_retransmitted_total accounting (pruned to the
+    // ring window).
+    std::deque<unsigned long long> seg_starts;
+    // Handshake read-ahead: retransmitted peer bytes that arrived
+    // while our own retransmit pump ran. Drained (without re-counting
+    // rx_total) before any socket read, preserving stream order.
+    std::string pending;
+    size_t pending_off = 0;
+  };
+
   Status ConnectTo(const std::string& host, int port, int* fd_out,
                    double timeout_sec);
   Status AcceptWithDeadline(int listen_fd, double timeout_sec, int* fd_out,
@@ -131,22 +240,83 @@ class TcpComm {
   // Status::TimedOut instead of an infinite hang. 0 = legacy infinite.
   Status SendAll(int fd, const void* data, size_t len);
   Status RecvAll(int fd, void* data, size_t len);
-  // Vectored SendAll: one sendmsg per poll round over the remaining
-  // iovec tail (gather I/O with partial-write resumption). Mutates the
-  // caller's iovec array to track progress.
-  Status SendVecAll(int fd, struct iovec* iov, int iovcnt);
+  // Bounded variant for reconnect handshake reads: a stale or hostile
+  // connection must not pin the heal loop for the full progress
+  // deadline.
+  Status RecvAllTimed(int fd, void* data, size_t len, int timeout_ms);
+
+  // Peer-aware stream I/O (post-mesh framed path): byte accounting,
+  // retransmit-ring capture, and in-place heal on RST-shaped failures.
+  Status PeerSend(int peer, struct iovec* iov, int iovcnt);
+  Status PeerRecv(int peer, void* data, size_t len);
+
+  // True when `err` on `peer`'s link should be healed in place rather
+  // than escalated (reconnect armed, not aborting, RST-shaped).
+  bool HealEligible(int err, int peer);
+  // Reconnect `peer`'s link in place: lower rank re-dials, higher rank
+  // re-accepts; handshake + retransmit; bounded by the reconnect
+  // budget (carved out of HOROVOD_COMM_TIMEOUT_SEC, never added).
+  // The heal deadline (HealPeer's entry time + the reconnect budget)
+  // threads through every stage — dial, accept, handshake reads, and
+  // the retransmit pump — so a peer that wedges MID-HEAL still fails
+  // within HVD_WIRE_RECONNECT_SEC, not within the (possibly much
+  // larger) progress deadline per poll round.
+  Status HealPeer(int peer, const char* why);
+  Status HealDial(int peer, std::chrono::steady_clock::time_point deadline);
+  Status HealAccept(int peer,
+                    std::chrono::steady_clock::time_point deadline);
+  // Common tail of both handshake roles: validate stream positions,
+  // retransmit [peer_rx, tx_total) from the ring while absorbing the
+  // peer's own retransmit into `pending`, then install the fd.
+  Status FinishHandshake(int peer, int fd, uint32_t agreed_epoch,
+                         unsigned long long peer_rx,
+                         unsigned long long peer_tx,
+                         std::chrono::steady_clock::time_point deadline);
+  Status RetransmitPump(int peer, int fd, unsigned long long from,
+                        unsigned long long len,
+                        unsigned long long expect_in,
+                        std::chrono::steady_clock::time_point deadline);
+  // Record `n` freshly-sent stream bytes (ring capture + tx_total),
+  // walking the live iovec window before AdvanceIov consumes it.
+  void RecordTx(int peer, const struct iovec* iov, int idx, int iovcnt,
+                size_t n);
+  // Mark the start of a framed send / raw segment for retransmit-frame
+  // accounting.
+  void MarkSegStart(int peer);
   // Fault injector hook (HVD_FAULT_* env, comm.cc): zero-cost single
   // branch when unarmed; called on every framed send / duplex transfer.
   Status MaybeInjectFault(int peer);
 
   int rank_ = 0;
   int size_ = 1;
-  std::vector<int> fds_;  // fds_[peer] = socket, -1 for self
+  // fds_[peer] = socket, -1 for self/broken. Atomic entries: HealPeer
+  // and the fault injector's reset swap live entries on the background
+  // thread while Abort() (shutdown path) and set_socket_buf_bytes (the
+  // online tuner thread) walk the table.
+  std::vector<std::atomic<int>> fds_;
+  std::vector<PeerSlot> peers_;
+  // Data-plane endpoints from the bootstrap table, kept for re-dialing
+  // (lower rank dials higher rank's listener, at Init and at heal).
+  std::vector<std::string> peer_hosts_;
+  std::vector<int> peer_ports_;
   int listen_fd_ = -1;
   // Poll timeout derived from HOROVOD_COMM_TIMEOUT_SEC at Init
   // (-1 = infinite, the legacy behavior when the knob is 0).
   int progress_timeout_ms_ = -1;
   double progress_timeout_sec_ = 0.0;
+  // In-place reconnect budget (HVD_WIRE_RECONNECT_SEC, default 30,
+  // clamped to HOROVOD_COMM_TIMEOUT_SEC so the overall typed-abort
+  // deadline never grows; 0 = legacy abort-on-break) and per-peer
+  // retransmit window (HVD_WIRE_RETRANSMIT_BUF_BYTES, default 8 MiB).
+  double reconnect_budget_sec_ = 0.0;
+  long long retx_cap_bytes_ = 0;
+  // Set by Abort(): heal attempts (and ConnectTo retries) fail fast so
+  // teardown is never stuck behind a reconnect budget.
+  std::atomic<bool> abort_requested_{false};
+  // Heal-duration stats, read off-thread by hvd_wire_reconnect_stats.
+  std::mutex heal_mu_;
+  long long heal_last_us_ = 0;  // GUARDED_BY(heal_mu_)
+  long long heal_max_us_ = 0;  // GUARDED_BY(heal_mu_)
   // HVD_RING_CHUNK_BYTES at Init (retunable, see set_ring_chunk_bytes);
   // 0 disables the pipelined sub-chunk schedule (serial fallback — see
   // docs/wire.md).

@@ -145,6 +145,10 @@ const char* FrKindName(FrKind k) {
     case FrKind::TIMEOUT: return "TIMEOUT";
     case FrKind::ABORT: return "ABORT";
     case FrKind::ENQUEUE: return "ENQUEUE";
+    case FrKind::WIRE_BREAK: return "WIRE_BREAK";
+    case FrKind::WIRE_REDIAL: return "WIRE_REDIAL";
+    case FrKind::WIRE_HANDSHAKE: return "WIRE_HANDSHAKE";
+    case FrKind::WIRE_RESUME: return "WIRE_RESUME";
   }
   return "UNKNOWN";
 }

@@ -36,6 +36,14 @@ enum class FrKind : int {
   TIMEOUT = 7,     // progress deadline fired (a=send peer, b=recv peer)
   ABORT = 8,       // connection-abort cascade (a=status type)
   ENQUEUE = 9,     // op submitted through the C ABI (a=op, b=ps)
+  // Self-healing wire (docs/wire.md#reconnect): a link break, the
+  // redial/re-accept attempt, the completed handshake, and the
+  // resumed transfer. tools/trace folds these into its healed-vs-
+  // wedged verdict.
+  WIRE_BREAK = 10,     // link broke (a=peer, b=epoch, c=bytes at risk)
+  WIRE_REDIAL = 11,    // reconnect attempt (a=peer, b=0 dial / 1 accept)
+  WIRE_HANDSHAKE = 12, // handshake done (a=peer, b=epoch, c=retx bytes)
+  WIRE_RESUME = 13,    // link healed (a=peer, b=epoch, c=duration us)
 };
 
 const char* FrKindName(FrKind k);

@@ -1117,19 +1117,35 @@ long long hvd_core_fusion_bytes() {
 // Fills out[0..n): responses, cached_responses, fused_tensors,
 // allreduced_tensors, allreduce_bytes, comm_timeouts, aborts,
 // bootstrap_retries, tx_bytes, rx_bytes, ring_subchunk_steps,
-// flightrec_events, flightrec_dropped, flightrec_dumps. Callers
+// flightrec_events, flightrec_dropped, flightrec_dumps, reconnects,
+// frames_retransmitted, reconnect_failures. Callers
 // pass the slot count they know about, so the layout is append-only.
 void hvd_core_counters(long long* out, int n) {
   if (!g || !out) return;
-  long long vals[14] = {
+  long long vals[17] = {
       g->ctr_responses.load(), g->ctr_cached_responses.load(),
       g->ctr_fused_tensors.load(), g->ctr_allreduced_tensors.load(),
       g->ctr_allreduce_bytes.load(), CommTimeoutsTotal(),
       g->ctr_aborts.load(), CommBootstrapRetriesTotal(),
       CommTxBytesTotal(), CommRxBytesTotal(), RingSubchunkStepsTotal(),
       FlightRecEventsTotal(), FlightRecDroppedTotal(),
-      FlightRecDumpsTotal()};
-  for (int i = 0; i < n && i < 14; ++i) out[i] = vals[i];
+      FlightRecDumpsTotal(), CommReconnectsTotal(),
+      CommFramesRetransmittedTotal(), CommReconnectFailuresTotal()};
+  for (int i = 0; i < n && i < 17; ++i) out[i] = vals[i];
+}
+
+// Self-healing-wire heal-duration stats (docs/wire.md#reconnect):
+// out[0]=reconnects out[1]=frames_retransmitted out[2]=failures
+// out[3]=last_heal_us out[4]=max_heal_us. bench_wire --fault uses
+// these for the recovery-latency (break -> resumed stream) number.
+void hvd_wire_reconnect_stats(long long* out, int n) {
+  if (!out) return;
+  long long last_us = 0, max_us = 0;
+  if (g) g->comm.reconnect_stats(&last_us, &max_us);
+  long long vals[5] = {CommReconnectsTotal(),
+                       CommFramesRetransmittedTotal(),
+                       CommReconnectFailuresTotal(), last_us, max_us};
+  for (int i = 0; i < n && i < 5; ++i) out[i] = vals[i];
 }
 
 // --- flight recorder (docs/flightrec.md) ------------------------------------
@@ -1181,6 +1197,54 @@ long long hvd_ring_subchunk_count(long long step_count, long long esize,
   if (step_count < 0 || esize <= 0) return -1;
   int64_t eff = RingEffectiveChunk((int64_t)chunk_bytes, (int64_t)esize);
   return (long long)RingSubchunkCount(step_count * esize, eff);
+}
+
+// --- self-healing-wire test hooks (tests/test_wire.py) ----------------------
+// The reconnect protocol's pure math (comm.h/comm.cc), exported so the
+// epoch agreement, frame validation, gap computation, and retransmit-
+// ring window are unit-testable in-process via ctypes without breaking
+// a live mesh (the hvd_ring_partition pattern). Not part of the
+// session API; the ring hooks share one static instance and are NOT
+// thread-safe (unit-test use only).
+
+long long hvd_wire_retx_gap(long long tx_total, long long peer_rx) {
+  return WireRetxGap(tx_total, peer_rx);
+}
+
+int hvd_wire_agree_epoch(int proposed, int current) {
+  return WireAgreeEpoch(proposed, current);
+}
+
+int hvd_wire_frame_check(long long epoch, long long seq,
+                         long long cur_epoch, long long expect_seq) {
+  return WireFrameCheck(epoch, seq, cur_epoch, expect_seq);
+}
+
+static RetxRing g_test_retx;
+
+int hvd_retx_test_reset(long long capacity) {
+  if (capacity < 0) return -1;
+  g_test_retx.reset((size_t)capacity);
+  return 0;
+}
+
+int hvd_retx_test_append(const char* data, long long len) {
+  if (!data || len < 0) return -1;
+  g_test_retx.append(data, (size_t)len);
+  return 0;
+}
+
+long long hvd_retx_test_begin() { return (long long)g_test_retx.begin(); }
+long long hvd_retx_test_end() { return (long long)g_test_retx.end(); }
+
+// Copy stream range [from, from+len) out of the test ring; -1 when the
+// range fell out of the bounded window (the abort-on-break fallback
+// condition) or was never written.
+int hvd_retx_test_read(long long from, long long len, char* out) {
+  if (from < 0 || len < 0 || !out) return -1;
+  return g_test_retx.read((unsigned long long)from, (size_t)len, out)
+             ? 0
+             : -1;
 }
 
 }  // extern "C"

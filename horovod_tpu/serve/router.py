@@ -3,8 +3,14 @@
 The router is the one address clients know. It owns:
 
 - ``POST /v1/predict``: forwarded to a live replica, round-robin; a
-  failed forward (connect refused, timeout, non-200) is retried ONCE
-  against a different replica before the client sees a 502;
+  failed forward (connect refused, timeout, 5xx) is retried against
+  the other replicas, and each failure charges the replica's
+  per-replica failure budget — ``HVD_SERVE_BREAKER_THRESHOLD``
+  consecutive failures trip its breaker and park it in a jittered
+  cooling window (exponential per consecutive trip) instead of
+  leaving it in round-robin rotation to eat live traffic. A
+  successful forward resets the budget; heartbeat re-admission of a
+  culled/unknown replica (PR 8) closes the breaker outright;
 - ``GET /healthz``: routing-table view (live replicas, heartbeat ages);
 - ``GET /metrics`` / ``/metrics.json``: the process-wide registry
   (free — the router rides ``runner/http_server.KVStoreServer``);
@@ -30,6 +36,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -60,6 +67,15 @@ _G_QPS = _metrics.gauge(
     "hvd_serve_qps",
     "Predict requests per second over the autoscaler's last "
     "monitoring window.")
+_C_BREAKER_TRIPS = _metrics.counter(
+    "hvd_serve_breaker_trips_total",
+    "Replica breakers tripped: consecutive forward failures exceeded "
+    "HVD_SERVE_BREAKER_THRESHOLD and the replica was parked in a "
+    "jittered cooling window.")
+_G_COOLING = _metrics.gauge(
+    "hvd_serve_replicas_cooling",
+    "Replicas currently parked by a tripped breaker (out of the "
+    "round-robin rotation until their cooldown expires).")
 
 
 def serve_journal_path(journal_dir: str) -> str:
@@ -118,6 +134,17 @@ class Router:
         # their first live beat — readiness checks must not count a
         # possibly-dead replayed entry as serving capacity.
         self._confirmed: Set[str] = set()
+        # Per-replica failure budget (the breaker): consecutive forward
+        # failures, the monotonic deadline a tripped replica cools
+        # until, and the consecutive-trip streak driving the
+        # exponential cooldown. All guarded by _lock.
+        self._fail_count: Dict[str, int] = {}
+        self._cooling_until: Dict[str, float] = {}
+        self._trip_streak: Dict[str, int] = {}
+        self.breaker_threshold = int(float_env(
+            "HVD_SERVE_BREAKER_THRESHOLD", 3))
+        self.breaker_cooldown_sec = float_env(
+            "HVD_SERVE_BREAKER_COOLDOWN_SEC", 5.0)
         self._requests_done = 0
         self._journal: Optional[DriverJournal] = None
         self._replayed = 0
@@ -209,6 +236,14 @@ class Router:
             if replica_id not in self._order:
                 self._order.append(replica_id)
             self._hb_seen.setdefault(replica_id, time.monotonic())
+            # (Re-)admission closes the breaker: a culled-then-
+            # rediscovered replica, or one respawned on a new endpoint,
+            # starts with a clean failure budget (the PR 8 heartbeat
+            # re-admission path lands here).
+            self._fail_count.pop(replica_id, None)
+            self._cooling_until.pop(replica_id, None)
+            self._trip_streak.pop(replica_id, None)
+            _G_COOLING.set(len(self._cooling_until))
 
     def cull(self, replica_id: str, reason: str = "silent",
              silence_sec: Optional[float] = None,
@@ -238,6 +273,10 @@ class Router:
                 self._order.remove(replica_id)
             self._hb_seen.pop(replica_id, None)
             self._confirmed.discard(replica_id)
+            self._fail_count.pop(replica_id, None)
+            self._cooling_until.pop(replica_id, None)
+            self._trip_streak.pop(replica_id, None)
+            _G_COOLING.set(len(self._cooling_until))
         flightrec.record_failure("cull", "replica %s: %s"
                                  % (replica_id, reason))
 
@@ -252,12 +291,63 @@ class Router:
 
     def _pick(self, exclude: Set[str]) -> Optional[Tuple[str, dict]]:
         with self._lock:
-            candidates = [rid for rid in self._order if rid not in exclude]
+            now = time.monotonic()
+            # Expired cooldowns re-enter rotation (half-open: the fail
+            # count is still at/over the threshold, so one more failure
+            # re-trips immediately with a doubled cooldown).
+            expired = [rid for rid, until in self._cooling_until.items()
+                       if until <= now]
+            for rid in expired:
+                self._cooling_until.pop(rid, None)
+            if expired:
+                _G_COOLING.set(len(self._cooling_until))
+            candidates = [rid for rid in self._order
+                          if rid not in exclude
+                          and rid not in self._cooling_until]
+            if not candidates:
+                # Every live replica is cooling: serving nothing is
+                # strictly worse than trying a suspect — fall back to
+                # the cooling set rather than 502 a healthy fleet.
+                candidates = [rid for rid in self._order
+                              if rid not in exclude]
             if not candidates:
                 return None
             rid = candidates[self._rr % len(candidates)]
             self._rr += 1
             return rid, dict(self._table[rid])
+
+    def _note_failure(self, rid: str):
+        """Charge one forward failure to ``rid``'s budget; trip the
+        breaker past HVD_SERVE_BREAKER_THRESHOLD consecutive ones."""
+        from horovod_tpu.utils import flightrec
+
+        tripped = None
+        with self._lock:
+            if rid not in self._table:
+                return
+            self._fail_count[rid] = self._fail_count.get(rid, 0) + 1
+            if (self.breaker_threshold > 0
+                    and self._fail_count[rid] >= self.breaker_threshold
+                    and rid not in self._cooling_until):
+                streak = self._trip_streak.get(rid, 0) + 1
+                self._trip_streak[rid] = streak
+                base = self.breaker_cooldown_sec * min(2 ** (streak - 1), 8)
+                cooldown = base * random.uniform(0.5, 1.5)  # jittered
+                self._cooling_until[rid] = time.monotonic() + cooldown
+                _G_COOLING.set(len(self._cooling_until))
+                tripped = (self._fail_count[rid], cooldown)
+        if tripped is not None:
+            _C_BREAKER_TRIPS.inc()
+            flightrec.record_failure(
+                "breaker", "replica %s: %d consecutive forward failures; "
+                "cooling %.1fs" % (rid, tripped[0], tripped[1]))
+
+    def _note_success(self, rid: str):
+        with self._lock:
+            self._fail_count.pop(rid, None)
+            self._trip_streak.pop(rid, None)
+            if self._cooling_until.pop(rid, None) is not None:
+                _G_COOLING.set(len(self._cooling_until))
 
     # --- predict proxy ------------------------------------------------------
 
@@ -281,14 +371,19 @@ class Router:
         timeout = float_env("HVD_SERVE_PROXY_TIMEOUT_SEC", 30.0)
         tried: Set[str] = set()
         last_err = "no live replicas"
-        for attempt in range(2):
+        attempt = 0
+        # Try each non-cooling replica at most once. Every forward
+        # failure charges that replica's breaker budget; the client
+        # only sees a 502 once every candidate failed this request.
+        while True:
             picked = self._pick(tried)
             if picked is None:
                 break
             rid, info = picked
             tried.add(rid)
-            if attempt == 1:
+            if attempt >= 1:
                 _C_RETRIES.inc()
+            attempt += 1
             try:
                 status, payload = self._forward(info, body, timeout)
             except (OSError, http.client.HTTPException) as e:
@@ -296,14 +391,18 @@ class Router:
                 # misses: a replica killed AFTER sending headers but
                 # mid-body raises IncompleteRead/BadStatusLine — that
                 # forward failed just as hard and earns the same
-                # retry-once-then-502 treatment.
+                # budget-charge-and-retry treatment.
                 last_err = "replica %s unreachable: %s" % (rid, e)
+                self._note_failure(rid)
                 continue
             if status >= 500:
                 last_err = "replica %s returned %d" % (rid, status)
+                self._note_failure(rid)
                 continue
             # 2xx and client errors (4xx) both end the retry loop: a
-            # malformed request fails identically everywhere.
+            # malformed request fails identically everywhere. Either
+            # way the REPLICA worked — its failure budget resets.
+            self._note_success(rid)
             _H_LATENCY.observe(time.monotonic() - t0)
             with self._lock:
                 self._requests_done += 1
@@ -318,11 +417,19 @@ class Router:
         with self._lock:
             table = {k: dict(v) for k, v in self._table.items()}
             confirmed = set(self._confirmed)
+            now = time.monotonic()
+            cooling = {rid: round(until - now, 3)
+                       for rid, until in self._cooling_until.items()
+                       if until > now}
+            fail_counts = dict(self._fail_count)
         for rid, info in table.items():
             age = self.heartbeat_age(rid)
             info["heartbeat_age_sec"] = None if age is None \
                 else round(age, 3)
             info["confirmed"] = rid in confirmed
+            info["consecutive_failures"] = fail_counts.get(rid, 0)
+            if rid in cooling:
+                info["cooling_sec_left"] = cooling[rid]
         from horovod_tpu.utils import flightrec
 
         return self._json(200, {

@@ -192,6 +192,76 @@ def test_chaos_stall_injected():
     assert _counter(outputs, 0, "timeouts") >= 1, outputs[0]
 
 
+def test_chaos_reset_heals_in_place(tmp_path):
+    """ISSUE 15 acceptance: np=3 pipelined-ring allreduce loop with a
+    hard RST injected MID-TRANSFER (between pipelined sub-chunk
+    reductions) heals in place — every step completes bit-identical to
+    the fault-free run, hvd_comm_reconnects_total >= 1 on every rank,
+    ZERO aborts, ZERO elastic resets (no restart machinery runs at
+    all), and tools.trace reads the flight records as 'healed', not
+    'wedged'."""
+    victim = 2
+    codes, outputs = _run_chaos(
+        3, "reset_heal",
+        extra_env=dict(fault_env(victim, "reset", after_subchunks=30),
+                       HVD_RING_CHUNK_BYTES="262144",
+                       HVD_FLIGHTREC_DIR=str(tmp_path),
+                       # Big ring: the heal happens early and the loop
+                       # keeps recording for seconds afterwards — the
+                       # WIRE_* evidence must not wrap away before the
+                       # end-of-run dump.
+                       HVD_FLIGHTREC_EVENTS="65536"))
+    for r in range(3):
+        assert codes[r] == 0, "rank %d:\n%s" % (r, outputs[r])
+        assert "OK healed" in outputs[r], outputs[r]
+        assert "elastic_resets=0" in outputs[r], outputs[r]
+    # Every rank healed at least one link (the victim healed two).
+    heals = [int(outputs[r].split("reconnects=")[1].split()[0])
+             for r in range(3)]
+    assert all(h >= 1 for h in heals), heals
+    assert heals[victim] >= 2, heals
+
+    from tools import trace
+
+    dumps = trace.load_dir(str(tmp_path))
+    assert set(dumps) == {0, 1, 2}, sorted(dumps)
+    trace.align(dumps)
+    diag = trace.diagnose(dumps, np_hint=3)
+    assert diag["verdict"] == "healed", diag
+    assert diag["culprit_ranks"] == [], diag
+    assert len(diag["wire_heals"]) >= 4, diag["wire_heals"]
+
+
+def test_chaos_reconnect_storm_heals_repeatedly():
+    """reconnect_storm: the link RSTs again and again (bounded count)
+    while 16 MB rings are in flight — healing must be re-entrant, each
+    resume exact, and the job still completes every step bit-identical."""
+    codes, outputs = _run_chaos(
+        2, "reset_heal",
+        extra_env=dict(fault_env(1, "reconnect_storm", after_frames=200,
+                                 every_frames=400, count=3),
+                       HVD_RING_CHUNK_BYTES="262144"))
+    for r in range(2):
+        assert codes[r] == 0, "rank %d:\n%s" % (r, outputs[r])
+        assert "OK healed" in outputs[r], outputs[r]
+    heals = [int(outputs[r].split("reconnects=")[1].split()[0])
+             for r in range(2)]
+    assert all(h >= 2 for h in heals), heals
+
+
+def test_chaos_reset_reconnect_disabled_legacy_abort():
+    """HVD_WIRE_RECONNECT_SEC=0 regression-pins the escalation path:
+    the SAME injection produces the legacy typed HorovodAbortedError on
+    every rank within 2x HOROVOD_COMM_TIMEOUT_SEC — byte-compatible
+    with the pre-reconnect failure story (elastic recovery takes over
+    from here exactly as before)."""
+    codes, outputs = _run_chaos(
+        2, "reset_legacy",
+        extra_env=dict(fault_env(1, "reset", after_frames=200),
+                       HVD_WIRE_RECONNECT_SEC="0"))
+    _assert_survivors_typed(codes, outputs, (0, 1))
+
+
 @pytest.mark.parametrize("np_,mode", [(2, "sigstop"), (3, "stall")])
 def test_chaos_forensics_names_culprit(tmp_path, np_, mode):
     """End-to-end forensics proof (docs/flightrec.md): a wedged rank —

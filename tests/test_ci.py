@@ -164,6 +164,18 @@ TIER2_COVERAGE = {
         "tests/test_wire.py::test_equality_pipelined_np2",
     "test_chaos_stall_pipelined_ring":
         "tests/test_wire.py::test_equality_pipelined_np2",
+    # Self-healing wire (ISSUE 15): the reconnect protocol math and the
+    # bit-equality-across-an-injected-RST matrix run fast at np=2/3 in
+    # test_wire.py; the 16 MB jax-path heal/storm drives and the
+    # escalation-path pin are the heavyweight variants.
+    "test_chaos_reset_heals_in_place":
+        "tests/test_wire.py::test_equality_survives_reset_np3_both_links",
+    "test_chaos_reconnect_storm_heals_repeatedly":
+        "tests/test_wire.py::"
+        "test_equality_survives_reset_mid_pipelined_chunk_np2",
+    "test_chaos_reset_reconnect_disabled_legacy_abort":
+        "tests/test_wire.py::"
+        "test_reset_with_reconnect_disabled_pins_legacy_abort",
 }
 
 

@@ -83,6 +83,9 @@ def test_fault_env_round_trip():
         "HVD_FAULT_PEER": "0",
         "HVD_FAULT_AFTER_FRAMES": "5",
         "HVD_FAULT_DELAY_MS": "0",
+        "HVD_FAULT_AFTER_SUBCHUNKS": "0",
+        "HVD_FAULT_EVERY_FRAMES": "1",
+        "HVD_FAULT_COUNT": "5",
     }
     assert fault_injection.is_armed(env)
     assert fault_injection.is_armed(env, rank=2)
@@ -92,6 +95,22 @@ def test_fault_env_round_trip():
     assert not fault_injection.is_armed({})
 
 
+def test_fault_env_reset_modes():
+    """ISSUE 15: the self-healing-wire chaos modes and their knobs."""
+    env = fault_injection.fault_env(1, "reset", after_subchunks=30)
+    assert env["HVD_FAULT_MODE"] == "reset"
+    assert env["HVD_FAULT_AFTER_SUBCHUNKS"] == "30"
+    storm = fault_injection.fault_env(1, "reconnect_storm",
+                                      every_frames=400, count=3)
+    assert storm["HVD_FAULT_EVERY_FRAMES"] == "400"
+    assert storm["HVD_FAULT_COUNT"] == "3"
+    assert fault_injection.is_armed(storm, rank=1)
+    # clear_fault_env scrubs the new keys too (stale storm knobs must
+    # not leak into the next hvd.init()).
+    fault_injection.clear_fault_env(storm)
+    assert storm == {}
+
+
 def test_fault_env_validation():
     with pytest.raises(ValueError):
         fault_injection.fault_env(0, "segfault")
@@ -99,6 +118,12 @@ def test_fault_env_validation():
         fault_injection.fault_env(-1, "drop")
     with pytest.raises(ValueError):
         fault_injection.fault_env(0, "delay", delay_ms=-5)
+    with pytest.raises(ValueError):
+        fault_injection.fault_env(0, "reset", after_subchunks=-1)
+    with pytest.raises(ValueError):
+        fault_injection.fault_env(0, "reconnect_storm", every_frames=0)
+    with pytest.raises(ValueError):
+        fault_injection.fault_env(0, "reconnect_storm", count=-2)
 
 
 # --- knob registry -----------------------------------------------------------

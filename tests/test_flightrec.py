@@ -277,7 +277,58 @@ def test_render_diagnosis_mentions_culprit(tmp_path):
     }, np_hint=2)
     text = trace.render_diagnosis(diag)
     assert "CULPRIT rank(s): [1]" in text
-    assert "timeout_peers" in text
+    assert diag["verdict"] == "wedged"
+    assert "VERDICT: wedged" in text
+
+
+def test_diagnosis_healed_verdict_distinct_from_wedged(tmp_path):
+    """ISSUE 15: break -> redial -> handshake -> resume with no abort
+    and no culprit is a HEALED transient blip — tools.trace must say so
+    instead of reading the break as a wedge."""
+    heal = [
+        _native_ev("WIRE_BREAK", a=1, b=0, c=4096,
+                   name="Connection reset by peer", seq=9),
+        _native_ev("WIRE_REDIAL", a=1, b=0, name="dial", ts=1),
+        _native_ev("WIRE_HANDSHAKE", a=1, b=1, c=4096, name="resume",
+                   ts=2),
+        _native_ev("WIRE_RESUME", a=1, b=1, c=2300, name="resume", ts=3),
+        _native_ev("RESP_BEGIN", name="doom.0", seq=9, ts=4),
+        _native_ev("RESP_END", seq=9, ts=5),
+    ]
+    diag = _diagnose(tmp_path, {
+        0: heal,
+        1: [_native_ev("RESP_BEGIN", name="doom.0", seq=9, ts=4),
+            _native_ev("RESP_END", seq=9, ts=5)],
+    }, np_hint=2)
+    assert diag["verdict"] == "healed"
+    assert diag["culprit_ranks"] == []
+    assert diag["wire_heals"] == [
+        {"rank": 0, "peer": 1, "epoch": 1, "duration_us": 2300,
+         "abs_us": diag["wire_heals"][0]["abs_us"]}]
+    text = trace.render_diagnosis(diag)
+    assert "VERDICT: healed" in text
+    assert "healed its link to peer 1 in 2.3 ms" in text
+
+
+def test_diagnosis_exhausted_heal_is_not_healed(tmp_path):
+    """A reconnect that exhausted its budget (or outgrew the retransmit
+    window) escalated to the typed abort: the verdict must NOT read
+    healed, and the failure is listed."""
+    diag = _diagnose(tmp_path, {
+        0: [_native_ev("WIRE_BREAK", a=1, b=0, c=4096,
+                       name="Connection reset by peer"),
+            _native_ev("WIRE_RESUME", a=1, b=1, c=900, ts=1),
+            _native_ev("WIRE_BREAK", a=1, b=-1, c=0,
+                       name="reconnect-exhausted", ts=2),
+            _native_ev("ABORT", a=3, name="reconnect failed", ts=3)],
+        1: [],
+    }, np_hint=2)
+    assert diag["verdict"] == "clean"  # no culprit ranking fired...
+    assert diag["wire_heal_failures"][0]["reason"] == \
+        "reconnect-exhausted"
+    text = trace.render_diagnosis(diag)
+    assert "FAILED to heal its link to peer 1" in text
+    assert "VERDICT: healed" not in text
 
 
 def test_merged_chrome_trace(tmp_path):

@@ -210,6 +210,154 @@ def test_client_errors_are_not_retried():
         rep.stop()
 
 
+# --- per-replica failure budget (the breaker) --------------------------------
+
+
+def test_breaker_trips_after_threshold_and_parks_replica(monkeypatch):
+    """ISSUE 15 satellite: consecutive forward failures past
+    HVD_SERVE_BREAKER_THRESHOLD park the replica in a cooling window —
+    it stops being picked at all (the retry-once policy kept feeding it
+    live traffic forever)."""
+    trips_before = _metrics.value("hvd_serve_breaker_trips_total") or 0
+    bad, good = _FakeReplica("bad", fail=True), _FakeReplica("good")
+    router = Router(port=0, monitor=False)
+    router.breaker_threshold = 3
+    router.breaker_cooldown_sec = 30.0  # long: must NOT expire in-test
+    port = router.start()
+    try:
+        router.admit("bad", bad.info())
+        router.admit("good", good.info())
+        for _ in range(8):
+            status, doc = _post(port, "/v1/predict", {"inputs": [[1.0]]})
+            assert status == 200 and doc["replica"] == "good"
+        # The breaker tripped after exactly threshold consecutive
+        # failures; once cooling, "bad" stops being picked entirely.
+        assert bad.hits == 3, bad.hits
+        assert (_metrics.value("hvd_serve_breaker_trips_total") or 0) \
+            == trips_before + 1
+        assert _metrics.value("hvd_serve_replicas_cooling") == 1
+        status, doc = _get(port, "/healthz")
+        assert doc["replicas"]["bad"]["cooling_sec_left"] > 0
+        assert doc["replicas"]["bad"]["consecutive_failures"] == 3
+    finally:
+        router.stop()
+        bad.stop()
+        good.stop()
+
+
+def test_breaker_cooldown_expiry_readmits_half_open():
+    """An expired cooldown re-enters rotation; the very next failure
+    re-trips immediately (half-open semantics) with a longer window."""
+    bad = _FakeReplica("bad", fail=True)
+    router = Router(port=0, monitor=False)
+    router.breaker_threshold = 2
+    router.breaker_cooldown_sec = 0.05
+    port = router.start()
+    try:
+        router.admit("bad", bad.info())
+        for _ in range(2):
+            _post(port, "/v1/predict", {"inputs": [[1.0]]})
+        hits_cooling = bad.hits
+        assert hits_cooling == 2  # tripped at the threshold
+        with router._lock:
+            assert "bad" in router._cooling_until
+        time.sleep(0.1)  # past the (jittered) 0.05s base window
+        status, _doc = _post(port, "/v1/predict", {"inputs": [[1.0]]})
+        assert status == 502
+        assert bad.hits == hits_cooling + 1  # exactly one half-open probe
+        with router._lock:
+            assert "bad" in router._cooling_until  # re-tripped at once
+            assert router._trip_streak["bad"] == 2
+    finally:
+        router.stop()
+        bad.stop()
+
+
+def test_breaker_success_resets_budget():
+    """A successful forward clears the consecutive-failure count: only
+    CONSECUTIVE failures trip, sporadic ones never accumulate."""
+
+    class _Flaky(_FakeReplica):
+        def _predict(self, body):
+            self.hits += 1
+            if self.hits % 2 == 1:  # fail, succeed, fail, succeed ...
+                return (500, "application/json", b"{}")
+            return (200, "application/json",
+                    json.dumps({"replica": self.tag}).encode())
+
+    rep = _Flaky("flaky")
+    router = Router(port=0, monitor=False)
+    router.breaker_threshold = 2
+    port = router.start()
+    try:
+        router.admit("flaky", rep.info())
+        # fail/success alternation: 6 requests = 3 fails, 3 successes,
+        # never two consecutive fails — the threshold-2 breaker must
+        # never trip, where a cumulative counter would have at fail 2.
+        statuses = [_post(port, "/v1/predict", {"inputs": [[1.0]]})[0]
+                    for _ in range(6)]
+        assert statuses == [502, 200] * 3, statuses
+        with router._lock:
+            assert "flaky" not in router._cooling_until
+            assert router._fail_count.get("flaky", 0) == 0
+            assert router._trip_streak.get("flaky", 0) == 0
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_breaker_closed_by_heartbeat_readmission(tmp_path):
+    """The PR 8 re-admission path closes the breaker: a culled replica
+    rediscovered through its heartbeat starts with a clean budget."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.breaker_threshold = 1
+    router.breaker_cooldown_sec = 3600.0
+    port = router.start()
+    try:
+        router.admit("rA", {"addr": "127.0.0.1", "port": 1,
+                            "pid": 1, "model": "m"})
+        _post(port, "/v1/predict", {"inputs": [[1.0]]})  # trips at once
+        with router._lock:
+            assert "rA" in router._cooling_until
+        router.cull("rA", reason="test")
+        payload = {"ts": time.time(), "pid": 2, "addr": "127.0.0.1",
+                   "port": 2, "model": "m"}
+        write_kv("127.0.0.1", port, "heartbeat", "rA",
+                 json.dumps(payload).encode())
+        with router._lock:
+            assert "rA" in router._table
+            assert "rA" not in router._cooling_until
+            assert router._fail_count.get("rA", 0) == 0
+            assert router._trip_streak.get("rA", 0) == 0
+    finally:
+        router.stop()
+
+
+def test_breaker_all_cooling_falls_back_to_trying():
+    """When EVERY live replica is cooling, the router still tries one
+    rather than 502ing a fleet that might have just recovered."""
+    rep = _FakeReplica("only")
+    router = Router(port=0, monitor=False)
+    router.breaker_threshold = 1
+    router.breaker_cooldown_sec = 3600.0
+    port = router.start()
+    try:
+        router.admit("only", {"addr": "127.0.0.1", "port": 1,
+                              "pid": 1, "model": "m"})  # dead port: fails
+        _post(port, "/v1/predict", {"inputs": [[1.0]]})
+        with router._lock:
+            assert "only" in router._cooling_until
+        # Replica comes back on a fresh endpoint — but WITHOUT a
+        # re-admission event the breaker still holds it; the fallback
+        # path must probe it anyway.
+        router.admit("only", rep.info())  # changed endpoint: admits
+        status, doc = _post(port, "/v1/predict", {"inputs": [[1.0]]})
+        assert status == 200 and doc["replica"] == "only"
+    finally:
+        router.stop()
+        rep.stop()
+
+
 # --- membership: registration, heartbeats, cull, re-admission ---------------
 
 

@@ -40,6 +40,27 @@ def lib():
     lib.hvd_ring_subchunk_count.restype = ctypes.c_longlong
     lib.hvd_ring_subchunk_count.argtypes = [
         ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong]
+    # Self-healing-wire protocol math (docs/wire.md#reconnect).
+    lib.hvd_wire_retx_gap.restype = ctypes.c_longlong
+    lib.hvd_wire_retx_gap.argtypes = [ctypes.c_longlong, ctypes.c_longlong]
+    lib.hvd_wire_agree_epoch.restype = ctypes.c_int
+    lib.hvd_wire_agree_epoch.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hvd_wire_frame_check.restype = ctypes.c_int
+    lib.hvd_wire_frame_check.argtypes = [
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_longlong]
+    lib.hvd_retx_test_reset.restype = ctypes.c_int
+    lib.hvd_retx_test_reset.argtypes = [ctypes.c_longlong]
+    lib.hvd_retx_test_append.restype = ctypes.c_int
+    lib.hvd_retx_test_append.argtypes = [ctypes.c_char_p,
+                                         ctypes.c_longlong]
+    lib.hvd_retx_test_begin.restype = ctypes.c_longlong
+    lib.hvd_retx_test_begin.argtypes = []
+    lib.hvd_retx_test_end.restype = ctypes.c_longlong
+    lib.hvd_retx_test_end.argtypes = []
+    lib.hvd_retx_test_read.restype = ctypes.c_int
+    lib.hvd_retx_test_read.argtypes = [
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_char_p]
     return lib
 
 
@@ -104,6 +125,86 @@ def test_subchunk_counts(lib):
     assert lib.hvd_ring_subchunk_count(4, 0, 64) == -1
 
 
+# --- self-healing wire: reconnect protocol math (ctypes) --------------------
+
+
+def test_retx_gap_math(lib):
+    # The bytes a reconnect handshake must replay: tx_total - peer_rx.
+    assert lib.hvd_wire_retx_gap(100, 100) == 0  # nothing in flight
+    assert lib.hvd_wire_retx_gap(100, 64) == 36
+    assert lib.hvd_wire_retx_gap(0, 0) == 0
+    # A peer claiming MORE than was ever sent is a protocol violation,
+    # not an underflow.
+    assert lib.hvd_wire_retx_gap(64, 100) == -1
+    assert lib.hvd_wire_retx_gap(-1, 0) == -1
+    assert lib.hvd_wire_retx_gap(0, -1) == -1
+
+
+def test_agree_epoch(lib):
+    # Both sides bump past their own view AND the dialer's proposal:
+    # the agreed epoch is strictly newer than any epoch either side
+    # ever stamped on a frame.
+    assert lib.hvd_wire_agree_epoch(1, 0) == 1  # symmetric first break
+    assert lib.hvd_wire_agree_epoch(1, 3) == 4  # acceptor saw more breaks
+    assert lib.hvd_wire_agree_epoch(5, 1) == 5  # dialer saw more breaks
+    assert lib.hvd_wire_agree_epoch(2, 1) == 2
+    for proposed in range(5):
+        for current in range(5):
+            agreed = lib.hvd_wire_agree_epoch(proposed, current)
+            assert agreed > current  # strictly newer for the acceptor
+            assert agreed >= proposed  # never behind the dialer
+
+
+def test_frame_check(lib):
+    OK, BAD_EPOCH, BAD_SEQ = 0, -1, -2
+    assert lib.hvd_wire_frame_check(0, 1, 0, 1) == OK
+    # A frame composed before a break and retransmitted after it
+    # legally carries an OLDER epoch.
+    assert lib.hvd_wire_frame_check(0, 7, 2, 7) == OK
+    # Epoch from the future = corruption.
+    assert lib.hvd_wire_frame_check(3, 7, 2, 7) == BAD_EPOCH
+    # A sequence gap (lost or duplicated frame across a resume) fails
+    # the link hard — the exact bug the retransmit ring prevents.
+    assert lib.hvd_wire_frame_check(1, 9, 1, 8) == BAD_SEQ
+    assert lib.hvd_wire_frame_check(1, 7, 1, 8) == BAD_SEQ
+
+
+def test_retx_ring_window(lib):
+    # 16-byte window over a 40-byte stream: only the newest 16 bytes
+    # stay retransmittable; older offsets report fallen-out (-1).
+    assert lib.hvd_retx_test_reset(16) == 0
+    stream = bytes(range(40))
+    for off in range(0, 40, 8):  # five 8-byte appends
+        assert lib.hvd_retx_test_append(stream[off:off + 8], 8) == 0
+    assert lib.hvd_retx_test_end() == 40
+    assert lib.hvd_retx_test_begin() == 24  # 40 - 16
+    out = ctypes.create_string_buffer(16)
+    assert lib.hvd_retx_test_read(24, 16, out) == 0
+    assert out.raw == stream[24:40]
+    # Partial window reads at arbitrary offsets.
+    out8 = ctypes.create_string_buffer(8)
+    assert lib.hvd_retx_test_read(30, 8, out8) == 0
+    assert out8.raw == stream[30:38]
+    # Fallen out of the window / beyond the stream: the abort-on-break
+    # fallback condition.
+    assert lib.hvd_retx_test_read(23, 8, out8) == -1
+    assert lib.hvd_retx_test_read(36, 8, out8) == -1
+
+
+def test_retx_ring_oversize_append_keeps_newest(lib):
+    # One append larger than the whole window: only its tail remains.
+    assert lib.hvd_retx_test_reset(8) == 0
+    stream = bytes(range(64, 64 + 20))
+    assert lib.hvd_retx_test_append(stream, 20) == 0
+    assert lib.hvd_retx_test_end() == 20
+    assert lib.hvd_retx_test_begin() == 12
+    out = ctypes.create_string_buffer(8)
+    assert lib.hvd_retx_test_read(12, 8, out) == 0
+    assert out.raw == stream[12:20]
+    # Zero-length read of an in-window (and even boundary) offset is ok.
+    assert lib.hvd_retx_test_read(20, 0, out) == 0
+
+
 # --- pipelined-vs-legacy equality (multi-process) ---------------------------
 
 def _eq_counters(outputs):
@@ -152,6 +253,69 @@ def test_equality_pipelined_np3_odd_world():
     so chunk boundaries and segment boundaries interleave."""
     c = _run_equality(3, {"HVD_RING_CHUNK_BYTES": "128"})
     assert c["ring_subchunk_steps"] > 0, c
+
+
+# --- self-healing wire: the matrix survives an injected RST -----------------
+# (docs/wire.md#reconnect) The SAME bit-equality matrix, with the
+# fault injector hard-resetting a link mid-run: the reconnect must be
+# transparent — every collective still bit-exact, zero aborts, and the
+# cross-rank seq pin still agreeing.
+
+def test_equality_survives_reset_np2():
+    from horovod_tpu.common.fault_injection import fault_env
+
+    c = _run_equality(2, dict(fault_env(1, "reset", after_frames=120),
+                              HVD_RING_CHUNK_BYTES="128"))
+    assert c["reconnects"] >= 1, c  # the wire actually broke and healed
+    assert c["reconnect_failures"] == 0, c
+
+
+def test_equality_survives_reset_mid_pipelined_chunk_np2():
+    """The RST fires BETWEEN pipelined sub-chunk reductions of a live
+    ring transfer (HVD_FAULT_AFTER_SUBCHUNKS): the resume must land at
+    the exact byte/chunk boundary or the reduce-scatter state would
+    corrupt — which the bit-equality matrix would catch."""
+    from horovod_tpu.common.fault_injection import fault_env
+
+    c = _run_equality(2, dict(fault_env(1, "reset", after_subchunks=40),
+                              HVD_RING_CHUNK_BYTES="64"))
+    assert c["reconnects"] >= 1, c
+    assert c["ring_subchunk_steps"] > 40, c  # pipeline resumed after it
+    assert c["reconnect_failures"] == 0, c
+
+
+def test_equality_survives_reset_np3_both_links():
+    """np=3 with the fault on the highest rank: BOTH of its links RST
+    at once, so it re-accepts two re-dials (including the out-of-order
+    adoption path) while each neighbor heals its own side."""
+    from horovod_tpu.common.fault_injection import fault_env
+
+    c = _run_equality(3, dict(fault_env(2, "reset", after_frames=150),
+                              HVD_RING_CHUNK_BYTES="128"))
+    assert c["reconnects"] >= 1, c
+    assert c["reconnect_failures"] == 0, c
+
+
+def test_reset_with_reconnect_disabled_pins_legacy_abort():
+    """HVD_WIRE_RECONNECT_SEC=0 is the regression pin for the
+    escalation path: the same injected RST must surface as the legacy
+    typed HorovodAbortedError — fast, no healing, no hang."""
+    import time
+
+    from horovod_tpu.common.fault_injection import fault_env
+
+    t0 = time.monotonic()
+    codes, outputs = _launch(
+        2, _WORKER,
+        extra_env=dict(fault_env(1, "reset", after_frames=120),
+                       HVD_WIRE_RECONNECT_SEC="0",
+                       HOROVOD_COMM_TIMEOUT_SEC="5"),
+        timeout=60)
+    elapsed = time.monotonic() - t0
+    assert all(c != 0 for c in codes), (codes, outputs)
+    assert any("HorovodAbortedError" in o for o in outputs), outputs
+    # Within 2x the progress deadline — the ISSUE 3 contract, unchanged.
+    assert elapsed < 2 * 5 + 15, elapsed  # generous slack for startup
 
 
 # --- heavyweight: np=4 busbw sweep (tier 2) ---------------------------------

@@ -101,7 +101,13 @@ def main():
             # there).
             "counters": {k: counters[k] for k in
                          ("tx_bytes", "rx_bytes", "ring_subchunk_steps",
-                          "allreduce_bytes") if k in counters},
+                          "allreduce_bytes", "reconnects",
+                          "frames_retransmitted", "reconnect_failures")
+                         if k in counters},
+            # Self-healing-wire recovery latency (docs/wire.md#reconnect):
+            # break detection -> handshake + retransmit complete, i.e.
+            # the stream is live again. bench_wire --fault reads these.
+            "reconnect": session.wire_reconnect_stats(),
         }))
     session.shutdown()
     print("WIRE_BENCH_OK rank %d" % topo.rank)

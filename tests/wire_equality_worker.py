@@ -115,7 +115,9 @@ def main():
         print("WIRE_EQ_COUNTERS " + json.dumps(
             {k: counters[k] for k in ("tx_bytes", "rx_bytes",
                                       "ring_subchunk_steps",
-                                      "fused_tensors")}))
+                                      "fused_tensors", "reconnects",
+                                      "frames_retransmitted",
+                                      "reconnect_failures")}))
 
     # Pin the cross-rank collective sequence number (docs/flightrec.md):
     # every rank dumps its native flight-recorder ring and reports the

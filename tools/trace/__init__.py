@@ -178,6 +178,14 @@ def diagnose(dumps: Dict[int, Dict[str, dict]],
     # native negotiation ever saw the tensor.
     pending_submits: List[dict] = []
 
+    # Self-healing wire (docs/wire.md#reconnect): completed in-place
+    # heals and explicit heal failures (budget exhausted / gap beyond
+    # the retransmit window). These drive the healed-vs-wedged verdict:
+    # a job whose only wire events are break→resume pairs and that
+    # never aborted was a transient blip, not a wedge.
+    wire_heals: List[dict] = []
+    wire_heal_failures: List[dict] = []
+
     for rank, sources in sorted(dumps.items()):
         python = sources.get("python")
         if python is not None:
@@ -233,6 +241,23 @@ def diagnose(dumps: Dict[int, Dict[str, dict]],
                         "op": begin.get("a"),
                         "status": _STATUS_NAMES.get(status, str(status)),
                     })
+            elif kind == "WIRE_RESUME":
+                wire_heals.append({
+                    "rank": rank,
+                    "peer": ev.get("a", -1),
+                    "epoch": ev.get("b", -1),
+                    "duration_us": ev.get("c", 0),
+                    "abs_us": ev.get("abs_us"),
+                })
+            elif kind == "WIRE_BREAK" and ev.get("name") in (
+                    "reconnect-exhausted",
+                    "gap-exceeds-retransmit-window"):
+                wire_heal_failures.append({
+                    "rank": rank,
+                    "peer": ev.get("a", -1),
+                    "reason": ev.get("name", ""),
+                    "abs_us": ev.get("abs_us"),
+                })
             elif kind == "NEG_READY":
                 name = ev.get("name", "")
                 peer = ev.get("a", -1)
@@ -305,6 +330,17 @@ def diagnose(dumps: Dict[int, Dict[str, dict]],
                 basis = "lowest_seq"
                 break
 
+    # Healed vs wedged (ISSUE 15): "healed" = the wire broke but every
+    # break resolved into an in-place resume, nothing aborted, and no
+    # culprit emerged — a transient blip the job rode through (zero
+    # restarts). "wedged" = a culprit stands. Anything else is "clean".
+    if culprits:
+        verdict = "wedged"
+    elif wire_heals and not aborts and not wire_heal_failures:
+        verdict = "healed"
+    else:
+        verdict = "clean"
+
     return {
         "world_size": world,
         "ranks_with_dumps": sorted(dumps),
@@ -318,6 +354,9 @@ def diagnose(dumps: Dict[int, Dict[str, dict]],
                             key=lambda f: (f["ps"], f["seq"])),
         "pending_submits": pending_submits,
         "stalled_tensors": stalled_tensors,
+        "wire_heals": wire_heals,
+        "wire_heal_failures": wire_heal_failures,
+        "verdict": verdict,
     }
 
 
@@ -326,6 +365,21 @@ def render_diagnosis(diag: dict) -> str:
     lines = []
     lines.append("flight-record diagnosis over %d/%d rank dump(s)"
                  % (len(diag["ranks_with_dumps"]), diag["world_size"]))
+    if diag.get("verdict") == "healed":
+        lines.append("  VERDICT: healed — %d transient wire break(s) "
+                     "reconnected in place (no abort, no culprit, zero "
+                     "restarts needed)" % len(diag["wire_heals"]))
+    elif diag.get("verdict") == "wedged":
+        lines.append("  VERDICT: wedged — see culprit ranking below")
+    for heal in diag.get("wire_heals", []):
+        lines.append("  rank %d healed its link to peer %s in %.1f ms "
+                     "(epoch %s)"
+                     % (heal["rank"], heal["peer"],
+                        float(heal["duration_us"]) / 1000.0,
+                        heal["epoch"]))
+    for fail in diag.get("wire_heal_failures", []):
+        lines.append("  rank %d FAILED to heal its link to peer %s (%s)"
+                     % (fail["rank"], fail["peer"], fail["reason"]))
     if diag["missing_ranks"]:
         lines.append("  no dump from rank(s) %s (died before any dump "
                      "trigger — SIGKILL/SIGSTOP shaped)"

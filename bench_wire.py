@@ -17,6 +17,7 @@ Examples:
     python bench_wire.py --out wire.json             # machine-readable
     python bench_wire.py --null-ab --trials 5        # A/A slot bias
     python bench_wire.py --ab chunk_bytes=0          # A/B with bias gate
+    python bench_wire.py --ab compress=bf16          # wire-codec A/B
 
 A/B discipline (docs/benchmarks.md): this box has ~2x run-to-run
 swings AND a paired-slot bias — an A/A null test (identical config in
@@ -55,11 +56,15 @@ def _free_port():
 
 
 def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
-              sockbuf=None, flightrec=None, fault=None, timeout=600):
+              sockbuf=None, flightrec=None, fault=None, compress=None,
+              timeout=600):
     """One np-wide sweep; returns the rank-0 JSON payload. ``fault``
     is an injector env dict (common.fault_injection.fault_env) exported
     to every worker — the self-healing-wire measurement hook
-    (docs/wire.md#reconnect)."""
+    (docs/wire.md#reconnect). ``compress`` is a wire-codec name
+    (none/bf16/fp16/int8) exported as HVD_WIRE_CODEC — the bench
+    worker relaxes its correctness floor to the shared tolerance table
+    under a lossy codec (docs/wire.md#compression)."""
     port = _free_port()
     procs = []
     for r in range(np_):
@@ -92,6 +97,8 @@ def run_sweep(np_, sizes, iters, warmup, chunk_bytes=None, sg=None,
             env["HOROVOD_SOCKET_BUF_BYTES"] = str(sockbuf)
         if flightrec is not None:
             env["HVD_FLIGHTREC"] = str(flightrec)
+        if compress is not None:
+            env["HVD_WIRE_CODEC"] = str(compress)
         if fault:
             env.update(fault)
         procs.append(subprocess.Popen(
@@ -128,13 +135,15 @@ def _busbw_by_size(payload):
 
 
 def _parse_overrides(spec):
-    """``--ab chunk_bytes=0,sg=1,sockbuf=...,flightrec=...`` ->
-    ``run_sweep`` kwargs (sockbuf = HOROVOD_SOCKET_BUF_BYTES, the
-    online tuner's other wire knob — docs/autotune.md; flightrec =
-    HVD_FLIGHTREC, the always-on recorder's overhead gate —
-    docs/flightrec.md)."""
+    """``--ab chunk_bytes=0,sg=1,sockbuf=...,flightrec=...,
+    compress=bf16`` -> ``run_sweep`` kwargs (sockbuf =
+    HOROVOD_SOCKET_BUF_BYTES, the online tuner's other wire knob —
+    docs/autotune.md; flightrec = HVD_FLIGHTREC, the always-on
+    recorder's overhead gate — docs/flightrec.md; compress =
+    HVD_WIRE_CODEC, the quantized-ring wire codec —
+    docs/wire.md#compression)."""
     allowed = {"chunk_bytes": int, "sg": int, "sockbuf": int,
-               "flightrec": int}
+               "flightrec": int, "compress": str}
     out = {}
     for part in spec.split(","):
         part = part.strip()
@@ -247,9 +256,9 @@ def main(argv=None):
     ap.add_argument("--ab", default=None, metavar="KEY=VAL[,KEY=VAL]",
                     help="interleaved A/B trials: slot B applies the "
                          "overrides (chunk_bytes=..., sg=..., "
-                         "sockbuf=...). The A/A null test runs "
-                         "alongside automatically and gates each "
-                         "delta's verdict")
+                         "sockbuf=..., compress=bf16). The A/A null "
+                         "test runs alongside automatically and gates "
+                         "each delta's verdict")
     ap.add_argument("--trials", type=int, default=5,
                     help="paired trials for --null-ab/--ab (default 5)")
     ap.add_argument("--fault", default=None,

@@ -95,6 +95,17 @@ run_tier1() {
     timeout "${HVD_CI_MFU_BUDGET:-240}" \
         python -m pytest tests/test_bucketing.py tests/test_block_tuner.py \
         -q -p no:cacheprovider
+    echo "=== tier 1: wire-compression fast-fail (codec math + lossy equality) ==="
+    # The quantized wire (docs/wire.md#compression) rewrites every fp32
+    # ring payload once a codec is staged; a broken codec corrupts
+    # gradients SILENTLY (training still runs, numbers are wrong), so
+    # the codec matrix fails in seconds before the full tier burns its
+    # wall budget: in-process codec math vs the shared tolerance table,
+    # the lossy np=2/3 equality runs, the codec=none bit-exact pin, the
+    # bf16 tx-bytes discount, and the heal-under-compression hash pin.
+    timeout "${HVD_CI_COMPRESS_BUDGET:-240}" \
+        python -m pytest tests/test_wire.py -q -p no:cacheprovider \
+        -k "codec"
     echo "=== tier 1: metrics subsystem fast-fail ==="
     # The metrics registry underpins scrape-based dashboards and the
     # /metrics route every runner HTTP server exposes; if it is broken,

@@ -37,6 +37,7 @@ if _os.environ.get("HOROVOD_WORKER_PLATFORM") == "cpu":
         pass           # already initialized; the import above is optional
 
 from horovod_tpu.common import (  # noqa: F401
+    Compression,
     HorovodAbortedError,
     HorovodInternalError,
     HostsUpdatedInterrupt,

@@ -16,6 +16,9 @@ from horovod_tpu.common.basics import (  # noqa: F401
     stop_metrics_server,
     stop_timeline,
 )
+from horovod_tpu.common.compression import (  # noqa: F401
+    Compression,
+)
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodAbortedError,
     HorovodInternalError,

@@ -1,0 +1,118 @@
+"""Shared wire-codec registry and gradient compression.
+
+Two related things live here so every layer agrees on one table:
+
+* The **wire codec** ids understood by the native TCP data plane
+  (core/src/codec.h ``WireCodecId``): what ``HVD_WIRE_CODEC`` and
+  ``CoreSession.stage_wire_codec`` accept, and the numeric tolerance
+  each codec guarantees for an fp32 allreduce (docs/wire.md#compression).
+  The equality harness (tests/wire_equality_worker.py), the planner cost
+  model (parallel/costmodel.py) and the docs all read this module
+  instead of keeping private copies.
+
+* A framework-agnostic ``Compression`` class (reference:
+  horovod/tensorflow/compression.py) — *tensor-level* cast compression
+  applied before submission, distinct from (and composable with) the
+  native wire codec which encodes blocks inside the ring itself. The
+  TensorFlow binding re-exports this class unchanged, keeping its
+  historical API surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# WireCodecId values — must match core/src/codec.h.
+CODEC_IDS = {"none": 0, "bf16": 1, "fp16": 2, "int8": 3}
+CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
+
+# Worst-case allreduce round-trip tolerance per codec for fp32 payloads
+# (the only dtype the wire compresses; every other dtype stays
+# bit-exact under every codec). Derivation in docs/wire.md#compression:
+# the encode error per hop is 2^-9 (bf16, 8-bit mantissa + RNE),
+# 2^-11 (fp16) or maxabs/254 (int8, symmetric 127-step scale), and a
+# ring reduce re-encodes partial sums on each of the n-1 hops, so the
+# bounds below carry headroom for small world sizes (np <= 8). ``rtol``
+# is relative to the reduced value, ``atol`` absorbs cancellation near
+# zero. codec "none" is asserted BIT-exact — no tolerance at all.
+WIRE_TOLERANCE = {
+    "none": {"atol": 0.0, "rtol": 0.0},
+    "bf16": {"atol": 1e-2, "rtol": 4e-2},
+    "fp16": {"atol": 1e-3, "rtol": 5e-3},
+    "int8": {"atol": 2e-1, "rtol": 6e-2},
+}
+
+
+def codec_id(codec) -> Optional[int]:
+    """Codec id for a name or id (``"bf16"``, ``2``, ``"3"``); None for
+    anything unknown. Mirrors the native HVD_WIRE_CODEC parser
+    (core/src/codec.cc CodecFromName)."""
+    if codec is None:
+        return None
+    if isinstance(codec, bool):  # bool is an int; reject it explicitly
+        return None
+    if isinstance(codec, int):
+        return codec if codec in CODEC_NAMES else None
+    name = str(codec).strip().lower()
+    if name in CODEC_IDS:
+        return CODEC_IDS[name]
+    try:
+        as_int = int(name, 10)
+    except ValueError:
+        return None
+    return as_int if as_int in CODEC_NAMES else None
+
+
+def codec_name(codec) -> Optional[str]:
+    """Canonical name for a codec id or name; None when unknown."""
+    cid = codec_id(codec)
+    return CODEC_NAMES[cid] if cid is not None else None
+
+
+def _cast(tensor, dtype):
+    """Cast across frameworks: numpy/JAX arrays carry ``astype``;
+    TensorFlow tensors go through ``tf.cast`` (imported lazily so this
+    module never drags TF in for numpy callers)."""
+    astype = getattr(tensor, "astype", None)
+    if astype is not None:
+        return astype(dtype)
+    import tensorflow as tf
+
+    return tf.cast(tensor, dtype)
+
+
+def _dtype_name(tensor) -> str:
+    dtype = getattr(tensor, "dtype", None)
+    return getattr(dtype, "name", str(dtype))
+
+
+class Compression:
+    """Tensor-level gradient compression (reference:
+    horovod/tensorflow/compression.py): ``compress`` returns the wire
+    tensor plus an opaque context, ``decompress`` undoes it. Framework
+    agnostic — works on numpy / JAX arrays and TensorFlow tensors."""
+
+    class none:
+        """Identity: no compression."""
+
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        """Cast float32/float64 gradients to float16 for transport;
+        everything else passes through untouched."""
+
+        @staticmethod
+        def compress(t):
+            if _dtype_name(t) in ("float32", "float64"):
+                return _cast(t, "float16"), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return _cast(t, ctx) if ctx is not None else t

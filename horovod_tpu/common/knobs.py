@@ -267,6 +267,13 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "bytes — bounds how much in-flight loss a reconnect can "
          "replay; a larger gap falls back to abort-on-break, recorded "
          "(default 8 MiB; 0 disables buffering)"),
+    Knob("HVD_WIRE_CODEC", HONORED,
+         "core/src/controller.cc + collectives.cc: wire codec for fp32 "
+         "ring allreduce payloads — none | bf16 | fp16 | int8 (scaled, "
+         "with error-feedback residuals). Staged through the "
+         "coordinator broadcast so every rank flips in the same cycle; "
+         "also read by parallel/costmodel.py as the planner's "
+         "bytes-per-step discount (docs/wire.md#compression)"),
     # Inference serving (horovod_tpu/serve/; docs/serving.md).
     Knob("HVD_SERVE_MAX_BATCH", HONORED,
          "serve/batching.py: micro-batch size trigger — a batch fires "
@@ -471,6 +478,14 @@ TUNABLE: Dict[str, TunableKnob] = {t.name: t for t in [
                 "HVD_SERVE_BATCH_DEADLINE_MS", 5.0, True,
                 "serving micro-batch deadline trigger "
                 "(MicroBatcher.set_tunables)"),
+    TunableKnob("wire_codec", 0.0, 3.0, 1.0, "native",
+                "HVD_WIRE_CODEC", 0.0, False,
+                "wire codec id for fp32 ring payloads (0=none 1=bf16 "
+                "2=fp16 3=int8; core/session.stage_wire_codec). NOT "
+                "live-safe: lossy codecs change gradient numerics "
+                "mid-run, so unsupervised search would fold codec "
+                "noise into its objective — stage between training "
+                "phases instead (docs/wire.md#compression)"),
     # Sharding-planner cost-model weights (parallel/costmodel.py,
     # docs/planner.md): searched OFFLINE only — plans are chosen at
     # setup time and per-rank divergence would pick divergent meshes,

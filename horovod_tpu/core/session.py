@@ -96,6 +96,23 @@ _M_CORE = {
         "In-place reconnect attempts that exhausted "
         "HVD_WIRE_RECONNECT_SEC (or an oversize in-flight gap) and "
         "escalated to the legacy typed abort."),
+    # Wire compression (docs/wire.md#compression).
+    "codec_saved_bytes": _metrics.counter(
+        "hvd_core_codec_saved_bytes_total",
+        "Payload bytes the negotiated wire codec kept OFF the wire "
+        "(raw minus encoded, summed over compressed ring sends)."),
+    # Metric names are digit-free by the hvd_[a-z_]+ convention, so
+    # the codec spellings are bfloat/half/qint for bf16/fp16/int8.
+    "codec_bf16_sends": _metrics.counter(
+        "hvd_core_codec_bfloat_sends_total",
+        "Ring block sends encoded as bf16 (bfloat16) on the wire."),
+    "codec_fp16_sends": _metrics.counter(
+        "hvd_core_codec_half_sends_total",
+        "Ring block sends encoded as fp16 (IEEE half) on the wire."),
+    "codec_int8_sends": _metrics.counter(
+        "hvd_core_codec_qint_sends_total",
+        "Ring block sends encoded as scaled int8 on the wire "
+        "(error-feedback residuals applied at submission)."),
 }
 
 # StatusType values that mean "a peer is dead or wedged and the abort
@@ -256,6 +273,10 @@ class CoreSession:
         lib.hvd_core_set_wire_params.restype = None
         lib.hvd_core_set_wire_params.argtypes = [
             ctypes.c_longlong, ctypes.c_longlong]
+        lib.hvd_core_stage_codec.restype = ctypes.c_int
+        lib.hvd_core_stage_codec.argtypes = [ctypes.c_int]
+        lib.hvd_core_wire_codec.restype = ctypes.c_int
+        lib.hvd_core_wire_codec.argtypes = []
         lib.hvd_core_autotune_start.restype = ctypes.c_int
         lib.hvd_core_autotune_start.argtypes = [ctypes.c_char_p]
         lib.hvd_core_autotune_state.restype = None
@@ -515,9 +536,9 @@ class CoreSession:
         bytes, comm timeouts, abort cascades, bootstrap retries, wire
         tx/rx bytes, pipelined ring sub-chunk steps, flight-recorder
         events/drops/dumps, self-healing-wire reconnects/retransmits/
-        failures)."""
-        buf = (ctypes.c_longlong * 17)()
-        self._lib.hvd_core_counters(buf, 17)
+        failures, wire-codec saved bytes and per-codec sends)."""
+        buf = (ctypes.c_longlong * 21)()
+        self._lib.hvd_core_counters(buf, 21)
         return {
             "responses": buf[0],
             "cached_responses": buf[1],
@@ -536,6 +557,10 @@ class CoreSession:
             "reconnects": buf[14],
             "frames_retransmitted": buf[15],
             "reconnect_failures": buf[16],
+            "codec_saved_bytes": buf[17],
+            "codec_bf16_sends": buf[18],
+            "codec_fp16_sends": buf[19],
+            "codec_int8_sends": buf[20],
         }
 
     def wire_reconnect_stats(self) -> Dict[str, int]:
@@ -575,6 +600,27 @@ class CoreSession:
         (utils/online_tuner.py) is the intended caller."""
         self._lib.hvd_core_set_wire_params(int(ring_chunk_bytes),
                                            int(socket_buf_bytes))
+
+    def stage_wire_codec(self, codec) -> bool:
+        """Stage a wire codec (id or name: none/bf16/fp16/int8) for the
+        coordinator to adopt and broadcast at its next slow-path round,
+        so every rank flips codecs in the same negotiation cycle
+        (docs/wire.md#compression). Lossy codecs trade gradient
+        precision for wire bytes — NOT live-safe; stage before or
+        between training phases. Returns False when the core is down
+        or the codec is unknown."""
+        from horovod_tpu.common.compression import codec_id
+
+        cid = codec_id(codec)
+        if cid is None:
+            return False
+        return self._lib.hvd_core_stage_codec(cid) == 0
+
+    def wire_codec(self) -> int:
+        """Currently *adopted* wire codec id (0=none 1=bf16 2=fp16
+        3=int8; -1 when the core is down). Staged values appear only
+        after the coordinator's broadcast."""
+        return self._lib.hvd_core_wire_codec()
 
     def add_process_set(self, ps_id: int, ranks: Sequence[int]):
         """Collective: all ranks must call in the same order."""

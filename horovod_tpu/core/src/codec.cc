@@ -1,0 +1,133 @@
+#include "codec.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+
+namespace hvd {
+
+const char* CodecName(int codec) {
+  switch (codec) {
+    case CODEC_NONE: return "none";
+    case CODEC_BF16: return "bf16";
+    case CODEC_FP16: return "fp16";
+    case CODEC_INT8: return "int8";
+  }
+  static thread_local char buf[24];
+  snprintf(buf, sizeof(buf), "codec?%d", codec);
+  return buf;
+}
+
+int CodecFromName(const char* name) {
+  if (name == nullptr || *name == '\0') return -1;
+  if (strcmp(name, "none") == 0) return CODEC_NONE;
+  if (strcmp(name, "bf16") == 0) return CODEC_BF16;
+  if (strcmp(name, "fp16") == 0) return CODEC_FP16;
+  if (strcmp(name, "int8") == 0) return CODEC_INT8;
+  char* end = nullptr;
+  long v = strtol(name, &end, 10);
+  if (end != name && *end == '\0' && v >= 0 && v <= kCodecMax)
+    return (int)v;
+  return -1;
+}
+
+int64_t CodecWireBytes(int codec, int64_t count) {
+  switch (codec) {
+    case CODEC_BF16:
+    case CODEC_FP16:
+      return 2 * count;
+    case CODEC_INT8:
+      return count > 0 ? 4 + count : 0;
+    default:
+      return 4 * count;
+  }
+}
+
+int64_t CodecElemsAvailable(int codec, int64_t wire_bytes, int64_t count) {
+  int64_t avail;
+  switch (codec) {
+    case CODEC_BF16:
+    case CODEC_FP16:
+      avail = wire_bytes / 2;
+      break;
+    case CODEC_INT8:
+      avail = wire_bytes < 4 ? 0 : wire_bytes - 4;
+      break;
+    default:
+      avail = wire_bytes / 4;
+      break;
+  }
+  return std::min(avail, count);
+}
+
+void CodecEncode(int codec, const float* src, int64_t count, uint8_t* dst) {
+  if (count <= 0) return;  // empty block = zero wire bytes, dst may be null
+  switch (codec) {
+    case CODEC_BF16: {
+      uint16_t* w = (uint16_t*)dst;
+      for (int64_t i = 0; i < count; ++i) w[i] = FloatToBf16(src[i]);
+      return;
+    }
+    case CODEC_FP16: {
+      uint16_t* w = (uint16_t*)dst;
+      for (int64_t i = 0; i < count; ++i) w[i] = FloatToHalf(src[i]);
+      return;
+    }
+    case CODEC_INT8: {
+      if (count <= 0) return;
+      float maxabs = 0.0f;
+      for (int64_t i = 0; i < count; ++i) {
+        float a = std::fabs(src[i]);
+        // NaN propagates into the scale; the decode side then yields
+        // NaN everywhere, which is the honest answer for a NaN input.
+        if (!(a <= maxabs)) maxabs = a;
+      }
+      float scale = maxabs > 0.0f ? maxabs / 127.0f : 1.0f;
+      memcpy(dst, &scale, 4);
+      int8_t* q = (int8_t*)(dst + 4);
+      float inv = 1.0f / scale;
+      for (int64_t i = 0; i < count; ++i) {
+        float v = src[i] * inv;
+        v = std::max(-127.0f, std::min(127.0f, v));
+        q[i] = (int8_t)lrintf(v);
+      }
+      return;
+    }
+    default:
+      memcpy(dst, src, (size_t)(4 * count));
+      return;
+  }
+}
+
+void CodecDecodeRange(int codec, const uint8_t* wire, int64_t count,
+                      int64_t begin, int64_t end, float* dst) {
+  (void)count;
+  // Empty ranges happen at zero-count ring chunks (count < world) and
+  // carry zero wire bytes — `wire` may be null, and int8 must not even
+  // read its scale header.
+  if (begin >= end) return;
+  switch (codec) {
+    case CODEC_BF16: {
+      const uint16_t* w = (const uint16_t*)wire;
+      for (int64_t i = begin; i < end; ++i) *dst++ = Bf16ToFloat(w[i]);
+      return;
+    }
+    case CODEC_FP16: {
+      const uint16_t* w = (const uint16_t*)wire;
+      for (int64_t i = begin; i < end; ++i) *dst++ = HalfToFloat(w[i]);
+      return;
+    }
+    case CODEC_INT8: {
+      float scale;
+      memcpy(&scale, wire, 4);
+      const int8_t* q = (const int8_t*)(wire + 4);
+      for (int64_t i = begin; i < end; ++i) *dst++ = (float)q[i] * scale;
+      return;
+    }
+    default:
+      memcpy(dst, wire + 4 * begin, (size_t)(4 * (end - begin)));
+      return;
+  }
+}
+
+}  // namespace hvd

@@ -1,0 +1,144 @@
+// Wire codecs for the native TCP data plane: lossy transport encodings
+// applied to fp32 ring-allreduce payloads at sub-chunk granularity
+// (reference: horovod/tensorflow/compression.py is the Python-level
+// analogue; here the encode/decode happens in the comm thread, below
+// the frame layer, so the retransmit ring naturally stores compressed
+// bytes and a mid-chunk heal replays exactly what was sent).
+//
+// Codec ids travel in three places and must agree: the FrameHeader
+// `codec` field (comm.cc), the coordinator's response-broadcast blob
+// (controller.cc), and the HVD_WIRE_CODEC knob / `wire_codec` tunable
+// (Python side, horovod_tpu/common/compression.py mirrors this table).
+//
+// Wire formats, per encoded block of `count` fp32 elements:
+//   none (0): raw little-endian fp32, 4*count bytes (pass-through).
+//   bf16 (1): round-to-nearest-even bfloat16, 2*count bytes.
+//   fp16 (2): IEEE binary16, 2*count bytes.
+//   int8 (3): 4-byte fp32 scale prefix (maxabs/127), then count bytes
+//             of signed int8 quantized values; 4 + count bytes total.
+// A "block" is one ring step's payload: the scale adapts per step, and
+// the decode cursor (CodecElemsAvailable) lets the pipelined receiver
+// decode whole elements as wire bytes stream in, across arbitrary
+// sub-chunk boundaries and reconnect heals.
+
+#ifndef HVD_TPU_CODEC_H
+#define HVD_TPU_CODEC_H
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+#include "common.h"
+
+namespace hvd {
+
+enum WireCodecId : int {
+  CODEC_NONE = 0,
+  CODEC_BF16 = 1,
+  CODEC_FP16 = 2,
+  CODEC_INT8 = 3,
+};
+constexpr int kCodecMax = CODEC_INT8;
+
+// Canonical lowercase name ("none", "bf16", "fp16", "int8");
+// "codec?<id>" for out-of-range ids (static buffer, diagnostics only).
+const char* CodecName(int codec);
+
+// Parse a codec name or decimal id string; -1 if unrecognized.
+int CodecFromName(const char* name);
+
+// --- half-precision scalar conversion (fp16 / bf16 via float) --------------
+// Shared by the dtype reduction kernels (collectives.cc) and the wire
+// codecs. The reference accelerates fp16 with AVX/F16C intrinsics
+// (reference: horovod/common/half.cc:1-80); portable scalar code is
+// used here — the CPU path is the control-plane / cross-host leg, not
+// the throughput-critical ICI path.
+
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ff;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ff;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000 | (mant << 13);
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToHalf(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 16) & 0x8000;
+  int32_t exp = (int32_t)((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffff;
+  if (exp <= 0) {
+    if (exp < -10) return (uint16_t)sign;
+    mant |= 0x800000;
+    uint32_t shift = (uint32_t)(14 - exp);
+    return (uint16_t)(sign | (mant >> shift));
+  }
+  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00);
+  return (uint16_t)(sign | ((uint32_t)exp << 10) | (mant >> 13));
+}
+
+inline float Bf16ToFloat(uint16_t h) {
+  uint32_t f = (uint32_t)h << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToBf16(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  // round-to-nearest-even
+  uint32_t rounding = 0x7fff + ((f >> 16) & 1);
+  return (uint16_t)((f + rounding) >> 16);
+}
+
+// Whether this (codec, dtype) pair actually compresses on the wire.
+// Only fp32 payloads compress; every other dtype rides raw even when a
+// codec is negotiated (bf16/fp16 tensors are already half-width, and
+// integer dtypes have exactness contracts).
+inline bool CodecActive(int codec, DataType dtype) {
+  return codec > CODEC_NONE && codec <= kCodecMax &&
+         dtype == DataType::FLOAT32;
+}
+
+// Encoded size of one block of `count` fp32 elements.
+int64_t CodecWireBytes(int codec, int64_t count);
+
+// Number of whole leading elements decodable from a `count`-element
+// block once `wire_bytes` bytes have arrived (int8's scale prefix
+// yields 0 until its 4 header bytes are in). Monotone in wire_bytes;
+// reaches `count` exactly at CodecWireBytes(codec, count).
+int64_t CodecElemsAvailable(int codec, int64_t wire_bytes, int64_t count);
+
+// Encode `count` floats into `dst` (CodecWireBytes(codec, count) bytes).
+void CodecEncode(int codec, const float* src, int64_t count, uint8_t* dst);
+
+// Decode elements [begin, end) of a `count`-element block from `wire`
+// into `dst` (receives end-begin floats). Requires the bytes covering
+// those elements — and, for int8, the scale prefix — to be present.
+void CodecDecodeRange(int codec, const uint8_t* wire, int64_t count,
+                      int64_t begin, int64_t end, float* dst);
+
+}  // namespace hvd
+
+#endif  // HVD_TPU_CODEC_H

@@ -1,5 +1,6 @@
 #include "collectives.h"
 
+#include "codec.h"
 #include "flightrec.h"
 
 #include <algorithm>
@@ -11,69 +12,8 @@ namespace hvd {
 
 namespace {
 
-// --- half-precision conversion (fp16 / bf16 via float) ---------------------
-// The reference accelerates fp16 reduction with AVX/F16C intrinsics
-// (reference: horovod/common/half.cc:1-80); here a portable scalar
-// conversion is used — the CPU path is the control-plane / cross-host leg,
-// not the throughput-critical ICI path.
-
-inline float HalfToFloat(uint16_t h) {
-  uint32_t sign = (uint32_t)(h & 0x8000) << 16;
-  uint32_t exp = (h >> 10) & 0x1f;
-  uint32_t mant = h & 0x3ff;
-  uint32_t f;
-  if (exp == 0) {
-    if (mant == 0) {
-      f = sign;
-    } else {
-      exp = 127 - 15 + 1;
-      while ((mant & 0x400) == 0) {
-        mant <<= 1;
-        exp--;
-      }
-      mant &= 0x3ff;
-      f = sign | (exp << 23) | (mant << 13);
-    }
-  } else if (exp == 0x1f) {
-    f = sign | 0x7f800000 | (mant << 13);
-  } else {
-    f = sign | ((exp - 15 + 127) << 23) | (mant << 13);
-  }
-  float out;
-  memcpy(&out, &f, 4);
-  return out;
-}
-
-inline uint16_t FloatToHalf(float v) {
-  uint32_t f;
-  memcpy(&f, &v, 4);
-  uint32_t sign = (f >> 16) & 0x8000;
-  int32_t exp = (int32_t)((f >> 23) & 0xff) - 127 + 15;
-  uint32_t mant = f & 0x7fffff;
-  if (exp <= 0) {
-    if (exp < -10) return (uint16_t)sign;
-    mant |= 0x800000;
-    uint32_t shift = (uint32_t)(14 - exp);
-    return (uint16_t)(sign | (mant >> shift));
-  }
-  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00);
-  return (uint16_t)(sign | ((uint32_t)exp << 10) | (mant >> 13));
-}
-
-inline float Bf16ToFloat(uint16_t h) {
-  uint32_t f = (uint32_t)h << 16;
-  float out;
-  memcpy(&out, &f, 4);
-  return out;
-}
-
-inline uint16_t FloatToBf16(float v) {
-  uint32_t f;
-  memcpy(&f, &v, 4);
-  // round-to-nearest-even
-  uint32_t rounding = 0x7fff + ((f >> 16) & 1);
-  return (uint16_t)((f + rounding) >> 16);
-}
+// fp16/bf16 scalar conversion lives in codec.h — shared with the wire
+// codecs, which transport fp32 payloads in the same half formats.
 
 template <typename T>
 void ReduceTyped(T* dst, const T* src, int64_t count, ReduceOp op) {
@@ -373,25 +313,205 @@ void ReduceIntoSegments(const std::vector<WireSegment>& segs,
   }
 }
 
+// Copy the logical byte range [byte_begin, byte_begin + nbytes) of a
+// segment list out into (CopyFromSegments) or in from (CopyIntoSegments)
+// a contiguous staging buffer. The wire codecs encode/decode over
+// contiguous fp32 blocks, so the compressed ring stages each step's
+// range through these instead of the zero-copy iovec path.
+void CopyFromSegments(const std::vector<WireSegment>& segs,
+                      int64_t byte_begin, char* dst, int64_t nbytes) {
+  int64_t pos = 0;
+  for (const auto& seg : segs) {
+    if (nbytes <= 0) break;
+    int64_t seg_end = pos + seg.bytes;
+    if (seg_end > byte_begin) {
+      int64_t off = std::max<int64_t>(byte_begin - pos, 0);
+      int64_t take = std::min(seg.bytes - off, nbytes);
+      memcpy(dst, seg.ptr + off, (size_t)take);
+      dst += take;
+      byte_begin += take;
+      nbytes -= take;
+    }
+    pos = seg_end;
+  }
+}
+
+void CopyIntoSegments(const std::vector<WireSegment>& segs,
+                      int64_t byte_begin, const char* src, int64_t nbytes) {
+  int64_t pos = 0;
+  for (const auto& seg : segs) {
+    if (nbytes <= 0) break;
+    int64_t seg_end = pos + seg.bytes;
+    if (seg_end > byte_begin) {
+      int64_t off = std::max<int64_t>(byte_begin - pos, 0);
+      int64_t take = std::min(seg.bytes - off, nbytes);
+      memcpy(seg.ptr + off, src, (size_t)take);
+      src += take;
+      byte_begin += take;
+      nbytes -= take;
+    }
+    pos = seg_end;
+  }
+}
+
+// Compressed segment ring (fp32 payloads under an active wire codec).
+// Same schedule as the raw path below, but each ring step's payload is
+// staged out of the segments, encoded, and moved as wire bytes:
+//
+//  - Reduce-scatter: the send range is encoded per step (int8's scale
+//    adapts to the partial sums each hop); the receive side decodes
+//    whole elements as wire bytes stream in (CodecElemsAvailable) and
+//    reduces them into the owning segments between poll rounds — the
+//    same sub-chunk pipeline as the raw path, on wire-byte cadence.
+//  - Allgather: the chunk owner encodes its fully-reduced chunk ONCE
+//    and round-trips the decode into its own segments; every other
+//    rank forwards the received wire bytes verbatim. All ranks
+//    therefore finish with bit-identical codec-rounded values, and no
+//    extra rounding accumulates hop to hop.
+//
+// Because encode happens before the kernel sees the bytes, the
+// retransmit ring records compressed bytes and a reconnect heal
+// replays exactly what was sent; the decode cursor survives the heal
+// untouched (RawSendRecvV preserves received-byte positions).
+Status RingCompressed(TcpComm& comm, const std::vector<WireSegment>& segs,
+                      int64_t count, ReduceOp op,
+                      const std::vector<int>& members, int idx, int codec) {
+  int n = (int)members.size();
+  const DataType dtype = DataType::FLOAT32;
+  const int64_t esize = 4;
+  std::vector<int64_t> counts, offsets;
+  RingPartition(count, n, &counts, &offsets);
+
+  int right = members[(size_t)((idx + 1) % n)];
+  int left = members[(size_t)((idx - 1 + n) % n)];
+  int64_t max_chunk = 0;
+  for (auto c : counts) max_chunk = std::max(max_chunk, c);
+  int64_t chunk_eff = RingEffectiveChunk(comm.ring_chunk_bytes(), esize);
+
+  std::vector<float> stage((size_t)max_chunk);  // raw gather staging
+  std::vector<float> dec((size_t)max_chunk);    // decode scratch
+  std::vector<uint8_t> txw((size_t)CodecWireBytes(codec, max_chunk));
+  std::vector<uint8_t> rxw((size_t)CodecWireBytes(codec, max_chunk));
+
+  FlightRec(FrKind::RING_CHUNKS, chunk_eff,
+            RingSubchunkCount(CodecWireBytes(codec, max_chunk), chunk_eff),
+            count * esize, nullptr);
+  // Codec decision for this ring op: id, raw payload bytes, wire bytes.
+  FlightRec(FrKind::WIRE_CODEC, codec, count * esize,
+            CodecWireBytes(codec, count), nullptr);
+
+  // Phase 1: reduce-scatter over encoded step payloads.
+  for (int s = 0; s < n - 1; ++s) {
+    int send_c = ((idx - s) % n + n) % n;
+    int recv_c = ((idx - s - 1) % n + n) % n;
+    int64_t send_cnt = counts[(size_t)send_c];
+    int64_t recv_cnt = counts[(size_t)recv_c];
+    int64_t sw = CodecWireBytes(codec, send_cnt);
+    int64_t rw = CodecWireBytes(codec, recv_cnt);
+    int64_t recv_base = offsets[(size_t)recv_c] * esize;
+    FlightRec(FrKind::RING_STEP, s, sw, rw, nullptr);
+    CopyFromSegments(segs, offsets[(size_t)send_c] * esize,
+                     (char*)stage.data(), send_cnt * esize);
+    CodecEncode(codec, stage.data(), send_cnt, txw.data());
+    CountCodecSend(codec, send_cnt * esize, sw);
+    struct iovec sv{txw.data(), (size_t)sw};
+    struct iovec rv{rxw.data(), (size_t)rw};
+    Status st;
+    int64_t decoded = 0;
+    if (RingSubchunkCount(rw, chunk_eff) > 1) {
+      st = comm.RawSendRecvV(
+          right, &sv, 1, left, &rv, 1, (size_t)chunk_eff,
+          [&](size_t b, size_t e) {
+            (void)b;
+            int64_t avail =
+                CodecElemsAvailable(codec, (int64_t)e, recv_cnt);
+            if (avail > decoded) {
+              CodecDecodeRange(codec, rxw.data(), recv_cnt, decoded, avail,
+                               dec.data());
+              ReduceIntoSegments(segs, recv_base + decoded * esize,
+                                 (const char*)dec.data(),
+                                 (avail - decoded) * esize, dtype, op);
+              decoded = avail;
+            }
+            CountRingSubchunkStep();
+          });
+    } else {
+      st = comm.RawSendRecvV(right, &sv, 1, left, &rv, 1);
+    }
+    if (!st.ok()) return st;
+    if (decoded < recv_cnt) {
+      // Serial fallback, or a tail the chunk cadence didn't cover.
+      CodecDecodeRange(codec, rxw.data(), recv_cnt, decoded, recv_cnt,
+                       dec.data());
+      ReduceIntoSegments(segs, recv_base + decoded * esize,
+                         (const char*)dec.data(),
+                         (recv_cnt - decoded) * esize, dtype, op);
+    }
+  }
+
+  // Phase 2: allgather of encoded chunks, forwarded verbatim. Chunk
+  // wire bytes live in one flat arena (slot c at c * wire_max): a slot
+  // fills exactly once — encoded by its owner at that rank's first
+  // send of it, or landed whole by a receive — and every later send of
+  // that chunk forwards the same bytes untouched.
+  int64_t wire_max = CodecWireBytes(codec, max_chunk);
+  std::vector<uint8_t> chunk_store((size_t)(n * wire_max));
+  std::vector<char> chunk_filled((size_t)n, 0);
+  for (int s = 0; s < n - 1; ++s) {
+    int send_c = ((idx + 1 - s) % n + n) % n;
+    int recv_c = ((idx - s) % n + n) % n;
+    int64_t send_cnt = counts[(size_t)send_c];
+    int64_t recv_cnt = counts[(size_t)recv_c];
+    int64_t sw = CodecWireBytes(codec, send_cnt);
+    int64_t rw = CodecWireBytes(codec, recv_cnt);
+    uint8_t* sbuf = chunk_store.data() + (size_t)send_c * (size_t)wire_max;
+    if (!chunk_filled[(size_t)send_c] && sw > 0) {
+      // This rank owns send_c fully reduced (s == 0): encode it once
+      // and adopt the codec-rounded values locally too.
+      CopyFromSegments(segs, offsets[(size_t)send_c] * esize,
+                       (char*)stage.data(), send_cnt * esize);
+      CodecEncode(codec, stage.data(), send_cnt, sbuf);
+      chunk_filled[(size_t)send_c] = 1;
+      CodecDecodeRange(codec, sbuf, send_cnt, 0, send_cnt, dec.data());
+      CopyIntoSegments(segs, offsets[(size_t)send_c] * esize,
+                       (const char*)dec.data(), send_cnt * esize);
+    }
+    uint8_t* rbuf = chunk_store.data() + (size_t)recv_c * (size_t)wire_max;
+    FlightRec(FrKind::RING_STEP, n - 1 + s, sw, rw, nullptr);
+    CountCodecSend(codec, send_cnt * esize, sw);
+    Status st = comm.RawSendRecv(right, sbuf, (size_t)sw, left,
+                                 rbuf, (size_t)rw);
+    if (!st.ok()) return st;
+    chunk_filled[(size_t)recv_c] = 1;
+    CodecDecodeRange(codec, rbuf, recv_cnt, 0, recv_cnt, dec.data());
+    CopyIntoSegments(segs, offsets[(size_t)recv_c] * esize,
+                     (const char*)dec.data(), recv_cnt * esize);
+  }
+  return Status::OK();
+}
+
 }  // namespace
 
 Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
-                     ReduceOp op, const std::vector<int>& members) {
+                     ReduceOp op, const std::vector<int>& members,
+                     int codec) {
   std::vector<WireSegment> segs{
       {(char*)data, count * (int64_t)DataTypeSize(dtype)}};
-  return RingAllreduceSegments(comm, segs, count, dtype, op, members);
+  return RingAllreduceSegments(comm, segs, count, dtype, op, members, codec);
 }
 
 Status RingAllreduceSegments(TcpComm& comm,
                              const std::vector<WireSegment>& segs,
                              int64_t count, DataType dtype, ReduceOp op,
-                             const std::vector<int>& members) {
+                             const std::vector<int>& members, int codec) {
   int n = (int)members.size();
   if (n <= 1 || count == 0) return Status::OK();
   int idx = -1;
   for (int i = 0; i < n; ++i)
     if (members[(size_t)i] == comm.rank()) idx = i;
   if (idx < 0) return Status::InvalidArgument("rank not in member list");
+  if (CodecActive(codec, dtype))
+    return RingCompressed(comm, segs, count, op, members, idx, codec);
 
   size_t esize = DataTypeSize(dtype);
   std::vector<int64_t> counts, offsets;

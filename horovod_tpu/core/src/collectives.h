@@ -42,8 +42,11 @@ struct WireSegment {
 
 // In-place ring allreduce over `members` (sorted global ranks).
 // AVERAGE is reduced as SUM; the caller applies the 1/n scale.
+// `codec` is a WireCodecId (codec.h): fp32 payloads are transported in
+// the encoded format; every other dtype ignores it and rides raw.
 Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
-                     ReduceOp op, const std::vector<int>& members);
+                     ReduceOp op, const std::vector<int>& members,
+                     int codec = 0);
 
 // Segment-list ring allreduce: same algorithm, but the logical buffer
 // is scattered across `segs` (total `count` elements). Reduce-scatter
@@ -52,10 +55,14 @@ Status RingAllreduce(TcpComm& comm, void* data, int64_t count, DataType dtype,
 // segment memory. When comm.ring_chunk_bytes() > 0, each ring step is
 // pipelined in sub-chunks: the reduce of sub-chunk k runs while the
 // wire moves sub-chunk k+1 (0 = serial legacy schedule).
+// When `codec` names an active wire codec for the dtype, each step's
+// payload moves encoded (codec.h) and the retransmit ring stores the
+// compressed bytes; the sub-chunk pipeline then decodes/reduces whole
+// elements as wire bytes arrive.
 Status RingAllreduceSegments(TcpComm& comm,
                              const std::vector<WireSegment>& segs,
                              int64_t count, DataType dtype, ReduceOp op,
-                             const std::vector<int>& members);
+                             const std::vector<int>& members, int codec = 0);
 
 // Allgather with per-member byte counts. `sendbuf` (my part) is copied
 // into `recvbuf` at my offset; parts ordered by member index.

@@ -35,7 +35,13 @@ struct FrameHeader {
   // an epoch from the future or a sequence gap is corruption and fails
   // the link hard (WireFrameCheck).
   uint32_t epoch;
-  uint32_t reserved;
+  // Wire codec (WireCodecId, codec.h) active on the sender when this
+  // frame was composed — diagnostic: the framed control plane itself is
+  // never compressed (compression applies to the raw ring payloads),
+  // but the field lets a capture or a peer sanity-check which codec a
+  // sender had negotiated. Was `reserved` (always 0 == CODEC_NONE)
+  // before compression landed, so old cores interop cleanly.
+  uint32_t codec;
   uint64_t seq;
   uint64_t len;
 };
@@ -188,6 +194,11 @@ std::atomic<long long> g_ring_subchunks{0};
 std::atomic<long long> g_comm_reconnects{0};
 std::atomic<long long> g_frames_retransmitted{0};
 std::atomic<long long> g_reconnect_failures{0};
+// Wire compression (docs/wire.md#compression): bytes kept off the wire
+// by the active codec (raw minus encoded, per ring step send) and
+// encoded step sends per codec id (1=bf16, 2=fp16, 3=int8).
+std::atomic<long long> g_codec_saved_bytes{0};
+std::atomic<long long> g_codec_sends[4] = {{0}, {0}, {0}, {0}};
 
 // ------------------------------------------------------- fault injection ---
 // Env-driven chaos hooks for the tier-2 failure-detection tests
@@ -305,6 +316,18 @@ long long CommFramesRetransmittedTotal() {
 }
 long long CommReconnectFailuresTotal() {
   return g_reconnect_failures.load();
+}
+long long CodecSavedBytesTotal() { return g_codec_saved_bytes.load(); }
+long long CodecSendsTotal(int codec) {
+  if (codec < 0 || codec > 3) return 0;
+  return g_codec_sends[codec].load();
+}
+void CountCodecSend(int codec, long long raw_bytes, long long wire_bytes) {
+  if (codec < 0 || codec > 3) return;
+  g_codec_sends[codec].fetch_add(1, std::memory_order_relaxed);
+  if (raw_bytes > wire_bytes)
+    g_codec_saved_bytes.fetch_add(raw_bytes - wire_bytes,
+                                  std::memory_order_relaxed);
 }
 void CountRingSubchunkStep() {
   g_ring_subchunks.fetch_add(1, std::memory_order_relaxed);
@@ -1532,7 +1555,9 @@ Status TcpComm::Sendv(int peer, const struct iovec* iov, int iovcnt) {
   // future and sequence gaps.
   PeerSlot& sl = peers_[(size_t)peer];
   MarkSegStart(peer);
-  FrameHeader h{kMagic, (uint32_t)rank_, sl.epoch, 0, ++sl.send_seq, len};
+  FrameHeader h{kMagic,   (uint32_t)rank_,
+                sl.epoch, (uint32_t)wire_codec_.load(),
+                ++sl.send_seq, len};
   // Header + payload in one gather list: a single vectored call per
   // frame (no Nagle-unfriendly header/payload split, no pack copy).
   std::vector<struct iovec> vec((size_t)iovcnt + 1);
@@ -1552,6 +1577,10 @@ Status TcpComm::Recv(int peer, std::string* out) {
   Status s = PeerRecv(peer, &h, sizeof(h));
   if (s.ok()) {
     if (h.magic != kMagic) return Status::Error("bad frame magic");
+    if (h.codec > 3)
+      return Status::Error("frame carries unknown wire codec " +
+                           std::to_string(h.codec) +
+                           " (corrupted header, or a newer peer?)");
     if (h.len > kMaxFrameLen)
       return Status::Error("frame length " + std::to_string(h.len) +
                            " exceeds sanity cap (corrupted header?)");
@@ -1585,6 +1614,10 @@ Status TcpComm::RecvInto(int peer, void* buf, size_t len) {
   Status s = PeerRecv(peer, &h, sizeof(h));
   if (s.ok()) {
     if (h.magic != kMagic) return Status::Error("bad frame magic");
+    if (h.codec > 3)
+      return Status::Error("frame carries unknown wire codec " +
+                           std::to_string(h.codec) +
+                           " (corrupted header, or a newer peer?)");
     if (h.len != len)
       return Status::Error("frame length mismatch: got " +
                            std::to_string(h.len) + " want " +

@@ -56,6 +56,13 @@ void CountRingSubchunkStep();
 long long CommReconnectsTotal();
 long long CommFramesRetransmittedTotal();
 long long CommReconnectFailuresTotal();
+// Wire-compression counters (docs/wire.md#compression): bytes the
+// active codec kept off the wire (raw minus encoded, summed over ring
+// step sends), and encoded step sends per codec. Incremented by the
+// compressed ring (collectives.cc) via CountCodecSend.
+long long CodecSavedBytesTotal();
+long long CodecSendsTotal(int codec);  // codec: 1=bf16, 2=fp16, 3=int8
+void CountCodecSend(int codec, long long raw_bytes, long long wire_bytes);
 
 // --- reconnect protocol math (pure; unit-tested via ctypes exports) --------
 
@@ -178,6 +185,12 @@ class TcpComm {
   void set_ring_chunk_bytes(int64_t v) {
     ring_chunk_bytes_.store(v < 0 ? 0 : v);
   }
+  // Negotiated wire codec (WireCodecId, codec.h), stamped into every
+  // outgoing FrameHeader's codec field. Set by the controller when a
+  // staged codec is adopted at a negotiation round; read per frame by
+  // the background loop and per ring op by the collectives.
+  int wire_codec() const { return wire_codec_.load(); }
+  void set_wire_codec(int v) { wire_codec_.store(v < 0 ? 0 : v); }
   // Resize SO_SNDBUF/SO_RCVBUF on every live peer socket and pin the
   // override for sockets connected later (elastic re-bootstrap). 0
   // hands buffer sizing back to the kernel for FUTURE sockets only —
@@ -321,6 +334,10 @@ class TcpComm {
   // 0 disables the pipelined sub-chunk schedule (serial fallback — see
   // docs/wire.md).
   std::atomic<int64_t> ring_chunk_bytes_{0};
+  // Negotiated wire codec (WireCodecId, codec.h), stamped into every
+  // outgoing FrameHeader. Atomic: the controller adopts a staged codec
+  // from the negotiation round while the background loop stamps frames.
+  std::atomic<int> wire_codec_{0};
 };
 
 }  // namespace hvd

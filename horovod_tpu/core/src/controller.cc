@@ -1,5 +1,7 @@
 #include "controller.h"
 
+#include "codec.h"
+
 #include <algorithm>
 #include <cstdlib>
 #include <unordered_set>
@@ -224,6 +226,19 @@ Controller::Controller(TcpComm& comm, int64_t fusion_bytes)
     cache_enabled_ = atoll(env) != 0;
   if (const char* env = getenv("HOROVOD_HIERARCHICAL_ALLREDUCE"))
     hierarchical_ = *env && *env != '0';
+  // HVD_WIRE_CODEC ("none"/"bf16"/"fp16"/"int8" or a decimal id): an
+  // env-pinned codec is STAGED, not applied — the coordinator adopts it
+  // at its first negotiation round and ships it in the response
+  // broadcast, so every rank (env-pinned or not) flips together.
+  if (const char* env = getenv("HVD_WIRE_CODEC")) {
+    int c = CodecFromName(env);
+    if (c >= 0) {
+      stage_wire_codec(c);
+    } else if (*env) {
+      HVD_LOG(LogLevel::WARN,
+              std::string("unknown HVD_WIRE_CODEC '") + env + "'; ignored");
+    }
+  }
 }
 
 bool Controller::IncrementTensorCount(ProcessSetState& ps,
@@ -558,7 +573,8 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
   // traffic no such round would ever run, so the coordinator forces
   // one when something is staged.
   bool force_sync =
-      coord && (pending_fusion_.load() > 0 || pending_cats_.load() >= 0);
+      coord && (pending_fusion_.load() > 0 || pending_cats_.load() >= 0 ||
+                pending_codec_.load() >= 0);
   flags[0] = (uncached.empty() && !force_sync) ? 0 : 1;
   flags[1] = ps.joined_locally ? 1 : 0;
   flags[2] = my_stalled.empty() ? 0 : 1;
@@ -731,12 +747,19 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
       int staged_cats = pending_cats_.exchange(-1);
       if (staged_cats >= 0)
         ApplyCategoricals(ps, staged_cats & 1, staged_cats & 2, me);
+      int staged_codec = pending_codec_.exchange(-1);
+      if (staged_codec >= 0) {
+        codec_.store(staged_codec);
+        comm_.set_wire_codec(staged_codec);
+      }
       FuseResponses(&negotiated);
       std::string resp_blob;
       int64_t ft = fusion_threshold_;
       resp_blob.append(reinterpret_cast<const char*>(&ft), sizeof(ft));
       uint8_t cats = (cache_enabled_ ? 1 : 0) | (hierarchical_ ? 2 : 0);
       resp_blob.append(reinterpret_cast<const char*>(&cats), 1);
+      uint8_t codec = (uint8_t)codec_.load();
+      resp_blob.append(reinterpret_cast<const char*>(&codec), 1);
       SerializeResponseList(negotiated, &resp_blob);
       s = comm_.Bcast(&resp_blob, root, ps.members);
       if (!s.ok()) return s;
@@ -746,15 +769,20 @@ Status Controller::ComputeResponseList(ProcessSetState& ps,
       std::string resp_blob;
       s = comm_.Bcast(&resp_blob, root, ps.members);
       if (!s.ok()) return s;
-      if (resp_blob.size() < sizeof(int64_t) + 1)
+      if (resp_blob.size() < sizeof(int64_t) + 2)
         return Status::Error("short response blob");
       int64_t ft;
       memcpy(&ft, resp_blob.data(), sizeof(ft));
       fusion_threshold_ = ft;
       uint8_t cats = (uint8_t)resp_blob[sizeof(ft)];
       ApplyCategoricals(ps, cats & 1, cats & 2, me);
-      negotiated = ParseResponseList(resp_blob.data() + sizeof(ft) + 1,
-                                     resp_blob.size() - sizeof(ft) - 1);
+      int codec = (uint8_t)resp_blob[sizeof(ft) + 1];
+      if (codec != codec_.load()) {
+        codec_.store(codec);
+        comm_.set_wire_codec(codec);
+      }
+      negotiated = ParseResponseList(resp_blob.data() + sizeof(ft) + 2,
+                                     resp_blob.size() - sizeof(ft) - 2);
     }
     // Timeline: negotiation over for every tensor in this cycle's
     // responses (on the coordinator AND on workers, whose list arrives

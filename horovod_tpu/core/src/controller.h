@@ -229,6 +229,18 @@ class Controller {
   bool cache_enabled() const { return cache_enabled_; }
   bool hierarchical() const { return hierarchical_; }
 
+  // Wire codec (WireCodecId, codec.h): staged exactly like the fusion
+  // threshold — the coordinator adopts at its next slow-path round and
+  // ships the id in the response broadcast, so every rank flips codecs
+  // in the same cycle and a ring step never mixes encodings. Mutating
+  // the codec per-rank would desynchronize wire byte counts mid-ring.
+  void stage_wire_codec(int codec) {
+    if (codec < 0) codec = 0;
+    if (codec > 3) codec = 3;
+    pending_codec_.store(codec);
+  }
+  int wire_codec() const { return codec_.load(); }
+
  private:
   // Coordinator: all members reported (joined ranks count implicitly)?
   bool IncrementTensorCount(ProcessSetState& ps, const Request& req);
@@ -247,6 +259,10 @@ class Controller {
   std::atomic<int> pending_cats_{-1};
   bool cache_enabled_ = true;
   bool hierarchical_ = false;
+  // Staged (-1 = none) and adopted wire codec. codec_ is atomic so the
+  // enqueue threads / C ABI can read it without entering the loop.
+  std::atomic<int> pending_codec_{-1};
+  std::atomic<int> codec_{0};
   // HOROVOD_DISABLE_GROUP_FUSION: explicit groups stay their own fusion
   // unit (reference: common.h knob; group_table semantics).
   bool disable_group_fusion_ = false;

@@ -149,6 +149,7 @@ const char* FrKindName(FrKind k) {
     case FrKind::WIRE_REDIAL: return "WIRE_REDIAL";
     case FrKind::WIRE_HANDSHAKE: return "WIRE_HANDSHAKE";
     case FrKind::WIRE_RESUME: return "WIRE_RESUME";
+    case FrKind::WIRE_CODEC: return "WIRE_CODEC";
   }
   return "UNKNOWN";
 }

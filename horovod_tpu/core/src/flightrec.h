@@ -44,6 +44,10 @@ enum class FrKind : int {
   WIRE_REDIAL = 11,    // reconnect attempt (a=peer, b=0 dial / 1 accept)
   WIRE_HANDSHAKE = 12, // handshake done (a=peer, b=epoch, c=retx bytes)
   WIRE_RESUME = 13,    // link healed (a=peer, b=epoch, c=duration us)
+  // Wire compression (docs/wire.md#compression): the codec a ring op
+  // moved its payload under. tools/trace attaches this to the
+  // in-flight transfer so a wedged-collective verdict names the codec.
+  WIRE_CODEC = 14,     // codec decision (a=codec id, b=raw bytes, c=wire)
 };
 
 const char* FrKindName(FrKind k);

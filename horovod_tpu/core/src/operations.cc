@@ -16,6 +16,7 @@
 #include <sched.h>
 #endif
 
+#include "codec.h"
 #include "controller.h"
 #include "flightrec.h"
 #include "perf.h"
@@ -58,6 +59,14 @@ struct Global {
   // fused allreduces; default is the scatter-gather ring straight over
   // tensor memory (docs/wire.md).
   bool wire_sg = true;
+  // int8 error-feedback residuals keyed by tensor name
+  // (docs/wire.md#compression): the quantization error of each
+  // submission is carried into the tensor's next submission, so the
+  // bias cancels over steps instead of accumulating. Touched only by
+  // the background thread's executor; flushed when the negotiated
+  // codec changes (stale residuals belong to another encoding).
+  std::unordered_map<std::string, std::vector<float>> ef_residuals;
+  int ef_codec = 0;
   // Removals are deferred to the end of the cycle: a "__ps_remove__"
   // barrier executes while the loop still holds pointers into the set
   // table, so the erase must not happen mid-iteration.
@@ -249,6 +258,42 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
     parts.push_back(std::move(p));
   }
 
+  // Negotiated wire codec for this cycle (adopted id — see
+  // Controller::stage_wire_codec for why it is never read per-rank
+  // from the environment here).
+  int codec = g->controller ? g->controller->wire_codec() : 0;
+  if (codec != g->ef_codec) {
+    // Residuals carry the quantization error of a specific encoding;
+    // after a codec flip they would inject garbage, so drop them.
+    g->ef_residuals.clear();
+    g->ef_codec = codec;
+  }
+  if (codec == CODEC_INT8 && resp.dtype == DataType::FLOAT32 &&
+      resp.reduce_op != ReduceOp::ADASUM) {
+    // int8 error feedback (docs/wire.md#compression): fold the previous
+    // round's quantization error into this submission, then replace the
+    // submission with its own quantized round-trip so every rank reduces
+    // values that survive the wire exactly, and bank the new error. The
+    // user buffer is mutated in place — safe, the allreduce overwrites
+    // it with the reduction anyway.
+    for (auto& p : parts) {
+      if (!p.present || p.count <= 0) continue;
+      float* x = (float*)p.entry.data;
+      int64_t cnt = p.count;
+      std::vector<float>& r = g->ef_residuals[p.entry.name];
+      r.resize((size_t)cnt, 0.0f);
+      for (int64_t i = 0; i < cnt; ++i) x[i] += r[i];
+      std::vector<uint8_t> wire((size_t)CodecWireBytes(CODEC_INT8, cnt));
+      std::vector<float> xq((size_t)cnt);
+      CodecEncode(CODEC_INT8, x, cnt, wire.data());
+      CodecDecodeRange(CODEC_INT8, wire.data(), cnt, 0, cnt, xq.data());
+      for (int64_t i = 0; i < cnt; ++i) {
+        r[i] = x[i] - xq[i];
+        x[i] = xq[i];
+      }
+    }
+  }
+
   Status st;
   if (resp.reduce_op == ReduceOp::ADASUM) {
     // Adasum coefficients are per-tensor: run the merge tree tensor by
@@ -278,7 +323,7 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
       ScaleBuffer(p.entry.data, p.count, resp.dtype, resp.prescale);
     TlAllBegin(resp, TlWireName(resp));
     st = RingAllreduce(g->comm, p.entry.data, p.count, resp.dtype,
-                       resp.reduce_op, ps.members);
+                       resp.reduce_op, ps.members, codec);
     TlAllEnd(resp);
     if (st.ok()) {
       double s = avg_scale * resp.postscale;
@@ -307,7 +352,7 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
     }
     TlAllBegin(resp, TlWireName(resp));
     st = RingAllreduceSegments(g->comm, segs, total, resp.dtype,
-                               resp.reduce_op, ps.members);
+                               resp.reduce_op, ps.members, codec);
     TlAllEnd(resp);
     if (st.ok()) {
       double s = avg_scale * resp.postscale;
@@ -336,7 +381,7 @@ Status ExecuteAllreduce(ProcessSetState& ps, const Response& resp) {
       ScaleBuffer(buf, total, resp.dtype, resp.prescale);
     TlAllBegin(resp, TlWireName(resp));
     st = RingAllreduce(g->comm, buf, total, resp.dtype, resp.reduce_op,
-                       ps.members);
+                       ps.members, codec);
     TlAllEnd(resp);
     if (st.ok()) {
       double s = avg_scale * resp.postscale;
@@ -463,7 +508,8 @@ Status ExecuteReducescatter(ProcessSetState& ps, const Response& resp) {
   }
   TlAllBegin(resp, TlWireName(resp));
   Status st = RingAllreduce(g->comm, data, count, resp.dtype, resp.reduce_op,
-                            ps.members);
+                            ps.members,
+                            g->controller ? g->controller->wire_codec() : 0);
   TlAllEnd(resp);
   if (st.ok() && resp.reduce_op == ReduceOp::AVERAGE)
     ScaleBuffer(data, count, resp.dtype, 1.0 / n);
@@ -1109,6 +1155,24 @@ int hvd_core_hierarchical() {
   return g && g->controller && g->controller->hierarchical() ? 1 : 0;
 }
 
+// Stage a wire codec (WireCodecId: 0=none 1=bf16 2=fp16 3=int8) for the
+// coordinator to adopt and broadcast at its next slow-path round — the
+// same staged discipline as hvd_core_set_fusion_bytes, so every rank
+// flips codecs in the same negotiation cycle. Returns 0, -1 without a
+// live core, -2 for an out-of-range id.
+int hvd_core_stage_codec(int codec) {
+  if (!g || !g->controller) return -1;
+  if (codec < 0 || codec > kCodecMax) return -2;
+  g->controller->stage_wire_codec(codec);
+  return 0;
+}
+
+// Currently *adopted* wire codec id (-1 without a live core). Staged
+// values do not show here until the coordinator broadcasts them.
+int hvd_core_wire_codec() {
+  return g && g->controller ? g->controller->wire_codec() : -1;
+}
+
 double hvd_core_cycle_ms() { return g ? g->cycle_ms : 0.0; }
 long long hvd_core_fusion_bytes() {
   return g ? (long long)g->fusion_bytes : 0;
@@ -1118,11 +1182,12 @@ long long hvd_core_fusion_bytes() {
 // allreduced_tensors, allreduce_bytes, comm_timeouts, aborts,
 // bootstrap_retries, tx_bytes, rx_bytes, ring_subchunk_steps,
 // flightrec_events, flightrec_dropped, flightrec_dumps, reconnects,
-// frames_retransmitted, reconnect_failures. Callers
+// frames_retransmitted, reconnect_failures, codec_saved_bytes,
+// codec_bf16_sends, codec_fp16_sends, codec_int8_sends. Callers
 // pass the slot count they know about, so the layout is append-only.
 void hvd_core_counters(long long* out, int n) {
   if (!g || !out) return;
-  long long vals[17] = {
+  long long vals[21] = {
       g->ctr_responses.load(), g->ctr_cached_responses.load(),
       g->ctr_fused_tensors.load(), g->ctr_allreduced_tensors.load(),
       g->ctr_allreduce_bytes.load(), CommTimeoutsTotal(),
@@ -1130,8 +1195,10 @@ void hvd_core_counters(long long* out, int n) {
       CommTxBytesTotal(), CommRxBytesTotal(), RingSubchunkStepsTotal(),
       FlightRecEventsTotal(), FlightRecDroppedTotal(),
       FlightRecDumpsTotal(), CommReconnectsTotal(),
-      CommFramesRetransmittedTotal(), CommReconnectFailuresTotal()};
-  for (int i = 0; i < n && i < 17; ++i) out[i] = vals[i];
+      CommFramesRetransmittedTotal(), CommReconnectFailuresTotal(),
+      CodecSavedBytesTotal(), CodecSendsTotal(CODEC_BF16),
+      CodecSendsTotal(CODEC_FP16), CodecSendsTotal(CODEC_INT8)};
+  for (int i = 0; i < n && i < 21; ++i) out[i] = vals[i];
 }
 
 // Self-healing-wire heal-duration stats (docs/wire.md#reconnect):
@@ -1245,6 +1312,39 @@ int hvd_retx_test_read(long long from, long long len, char* out) {
   return g_test_retx.read((unsigned long long)from, (size_t)len, out)
              ? 0
              : -1;
+}
+
+// --- wire-codec test hooks (tests/test_wire.py) -----------------------------
+// Pure functions over codec.cc, exported so the wire formats and the
+// quantization round-trip are unit-testable in-process via ctypes
+// without bootstrapping a mesh. Not part of the session API.
+
+// Codec id for a name ("none"/"bf16"/"fp16"/"int8" or a decimal id);
+// -1 for anything unknown. Mirrors the HVD_WIRE_CODEC parser.
+int hvd_codec_from_name(const char* name) {
+  return name ? CodecFromName(name) : -1;
+}
+
+// On-wire bytes for one block of `count` fp32 elements under `codec`;
+// -1 on invalid args.
+long long hvd_codec_wire_bytes(int codec, long long count) {
+  if (codec < 0 || codec > kCodecMax || count < 0) return -1;
+  return (long long)CodecWireBytes(codec, (int64_t)count);
+}
+
+// Encode `data[0..count)` then decode it back in place — the exact
+// transform payload bytes undergo on the wire. Returns the wire byte
+// count, or -1 on invalid args. Python asserts the round-trip error
+// against the documented tolerance table (docs/wire.md#compression).
+long long hvd_codec_roundtrip(int codec, float* data, long long count) {
+  if (codec < 0 || codec > kCodecMax || count < 0 || (!data && count > 0))
+    return -1;
+  int64_t wb = CodecWireBytes(codec, (int64_t)count);
+  std::vector<uint8_t> wire((size_t)wb);
+  CodecEncode(codec, data, (int64_t)count, wire.data());
+  CodecDecodeRange(codec, wire.data(), (int64_t)count, 0, (int64_t)count,
+                   data);
+  return (long long)wb;
 }
 
 }  // extern "C"

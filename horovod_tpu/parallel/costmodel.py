@@ -36,6 +36,7 @@ wins), so two hosts planning the same workload always agree.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from horovod_tpu.common.util import float_env
@@ -98,6 +99,23 @@ def grad_overlap() -> float:
     clamped to [0, 1]."""
     return min(max(float_env("HVD_PLAN_GRAD_OVERLAP",
                              DEFAULT_GRAD_OVERLAP), 0.0), 1.0)
+
+
+# On-wire bytes per raw fp32 payload byte under each wire codec
+# (docs/wire.md#compression): bf16/fp16 halve every block; int8 ships
+# 1 byte/elem plus a 4-byte scale per ring block, ~0.26x in practice.
+_CODEC_RATIO = {0: 1.0, 1: 0.5, 2: 0.5, 3: 0.26}
+
+
+def wire_codec_ratio() -> float:
+    """Gradient-sync bytes-per-step discount for the configured
+    ``HVD_WIRE_CODEC`` (the same knob the native core stages at init,
+    core/src/controller.cc — no second spelling to keep in sync).
+    Unknown or unset values price as uncompressed."""
+    from horovod_tpu.common.compression import codec_id
+
+    cid = codec_id(os.environ.get("HVD_WIRE_CODEC"))
+    return _CODEC_RATIO.get(cid if cid is not None else 0, 1.0)
 
 
 class Workload(NamedTuple):
@@ -284,13 +302,22 @@ def score(axes: Dict[str, int], workload: Workload,
     # makes expert parallelism pay: 1/e of the expert bytes per chip,
     # in memory AND on the wire.
     n_tok = d * s
-    dense_shard = dense_bytes / (m * p)
-    expert_shard = w.expert_param_bytes / (m * p * max(e, 1))
+    # Wire-codec discount (docs/wire.md#compression): the native ring
+    # compresses fp32 gradient payloads on the wire, so the sync terms
+    # price encoded bytes. Memory terms stay raw — only the wire
+    # shrinks. Non-fp32 workloads ship uncompressed under every codec.
+    codec_ratio = wire_codec_ratio() if w.dtype_bytes == 4 else 1.0
+    dense_shard = dense_bytes / (m * p) * codec_ratio
+    expert_shard = w.expert_param_bytes / (m * p * max(e, 1)) * codec_ratio
     g_payload = 0.0
     if n_tok > 1:
         g_payload += 2.0 * (n_tok - 1) / n_tok * \
             (dense_shard + expert_shard)
     if g_payload > 0:
+        if codec_ratio < 1.0:
+            terms.append((
+                "wire codec %s: grad-sync bytes priced at %.2fx raw"
+                % (os.environ.get("HVD_WIRE_CODEC"), codec_ratio), 0.0))
         if topology.dcn > 1 and s == 1:
             # Hierarchical ladder (parallel/hierarchical.py):
             # reduce_scatter(ici) + all_gather(ici) move ~2(i-1)/i of

@@ -448,28 +448,13 @@ from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: F401,E402
 )
 
 
-class Compression:
-    """(reference: horovod/tensorflow/compression.py)"""
-
-    class none:
-        @staticmethod
-        def compress(t):
-            return t, None
-
-        @staticmethod
-        def decompress(t, ctx):
-            return t
-
-    class fp16:
-        @staticmethod
-        def compress(t):
-            if t.dtype in (tf.float32, tf.float64):
-                return tf.cast(t, tf.float16), t.dtype
-            return t, None
-
-        @staticmethod
-        def decompress(t, ctx):
-            return tf.cast(t, ctx) if ctx is not None else t
+# Promoted to the shared framework-agnostic registry so numpy/JAX
+# callers get the same classes as hvd.Compression; the alias keeps this
+# binding's historical surface (Compression.none / Compression.fp16
+# with compress/decompress statics, reference:
+# horovod/tensorflow/compression.py) intact — pinned by
+# tests/test_tf_binding.py.
+from horovod_tpu.common.compression import Compression  # noqa: E402,F401
 
 
 def _allreduce_grad_list(grads, op, process_set, sparse_as_dense=False,

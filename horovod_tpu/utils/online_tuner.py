@@ -313,6 +313,12 @@ class KnobBinding:
             sess.set_wire_params(ring_chunk_bytes=int(value))
         elif self.knob.name == "socket_buf_bytes":
             sess.set_wire_params(socket_buf_bytes=int(value))
+        elif self.knob.name == "wire_codec":
+            # Staged, not applied: the coordinator broadcasts the codec
+            # at its next slow-path round so every rank flips together
+            # (the knob is live_safe=False — only a single-process
+            # world, or an explicit operator stage, reaches here).
+            sess.stage_wire_codec(int(value))
         else:
             raise ValueError("no native apply for knob %r" % self.knob.name)
 

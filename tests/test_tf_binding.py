@@ -66,6 +66,37 @@ def test_keras_callbacks_importable():
     assert callbacks.BestModelCheckpoint
 
 
+def test_compression_surface_pin():
+    """Pin the historical ``hvd.Compression`` surface across its
+    promotion to the shared registry (horovod_tpu.common.compression):
+    same attribute shape, same TF cast semantics — reference:
+    tensorflow/compression.py. The TF name must BE the shared class,
+    not a copy."""
+    from horovod_tpu.common.compression import Compression as shared
+
+    assert hvd.Compression is shared
+    import horovod_tpu as hvd_top
+
+    assert hvd_top.Compression is shared
+
+    x = tf.constant([1.0, 2.5, -3.0])
+    t, ctx = hvd.Compression.none.compress(x)
+    assert t is x and ctx is None
+    assert hvd.Compression.none.decompress(t, ctx) is x
+
+    t, ctx = hvd.Compression.fp16.compress(x)
+    assert t.dtype == tf.float16
+    assert ctx == tf.float32
+    back = hvd.Compression.fp16.decompress(t, ctx)
+    assert back.dtype == tf.float32
+    np.testing.assert_allclose(back.numpy(), [1.0, 2.5, -3.0])
+
+    # Non-float tensors pass through uncompressed, dtype untouched.
+    i = tf.constant([1, 2], dtype=tf.int64)
+    t, ctx = hvd.Compression.fp16.compress(i)
+    assert t is i and ctx is None
+
+
 def test_local_gradient_aggregation_size1():
     opt = hvd.DistributedOptimizer(
         tf.keras.optimizers.SGD(learning_rate=1.0),

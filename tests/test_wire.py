@@ -12,6 +12,12 @@ Two layers, mirroring how the schedule can break:
   pipelined chunked ring (tiny ``HVD_RING_CHUNK_BYTES`` forces many
   sub-chunks), the serial legacy schedule (``HVD_RING_CHUNK_BYTES=0``
   + ``HVD_WIRE_SG=0``), and at odd world sizes.
+- **Wire compression** (docs/wire.md#compression): codec math probed
+  in-process (ids, wire formats, one-hop round-trip error against the
+  SHARED tolerance table), then the same equality matrix under every
+  lossy codec — including a mid-compressed-chunk RST whose heal must
+  reproduce the unfaulted run's output bytes — plus the pure-fp32
+  tx-bytes discount the planner's cost model prices in.
 
 The np=4 busbw sweep is the heavyweight variant (tier2 + slow; its
 schedule/equality code paths are covered by the fast runs here).
@@ -21,8 +27,10 @@ import ctypes
 import json
 import os
 
+import numpy as np
 import pytest
 
+from horovod_tpu.common.compression import CODEC_IDS, WIRE_TOLERANCE
 from horovod_tpu.core.build import library_path
 from tests.test_native_core import _REPO, _launch
 
@@ -61,6 +69,14 @@ def lib():
     lib.hvd_retx_test_read.restype = ctypes.c_int
     lib.hvd_retx_test_read.argtypes = [
         ctypes.c_longlong, ctypes.c_longlong, ctypes.c_char_p]
+    # Wire-codec math (docs/wire.md#compression).
+    lib.hvd_codec_from_name.restype = ctypes.c_int
+    lib.hvd_codec_from_name.argtypes = [ctypes.c_char_p]
+    lib.hvd_codec_wire_bytes.restype = ctypes.c_longlong
+    lib.hvd_codec_wire_bytes.argtypes = [ctypes.c_int, ctypes.c_longlong]
+    lib.hvd_codec_roundtrip.restype = ctypes.c_longlong
+    lib.hvd_codec_roundtrip.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
     return lib
 
 
@@ -215,7 +231,15 @@ def _eq_counters(outputs):
     raise AssertionError("no WIRE_EQ_COUNTERS line:\n" + "\n".join(outputs))
 
 
-def _run_equality(np_, extra_env):
+def _run_equality_hashed(np_, extra_env):
+    """Run the equality worker fleet; returns (counters, output_hash).
+
+    The hash is the sha256 the worker computes over EVERY collective
+    output in submission order — asserted identical across ranks here
+    (the ring must leave all ranks with the same bytes, compressed or
+    not), and compared across whole runs by the codec pins below
+    (healed == unfaulted, codec=none == codec-unset).
+    """
     codes, outputs = _launch(np_, _WORKER, extra_env=extra_env, timeout=180)
     assert codes == [0] * np_, "\n".join(outputs)
     assert sum("WIRE_EQ_OK" in o for o in outputs) == np_
@@ -223,13 +247,23 @@ def _run_equality(np_, extra_env):
     # flight record must report the SAME highest executed seq — the
     # agreement tools/trace's cross-rank divergence detection relies on.
     seqs = []
+    hashes = []
     for out in outputs:
         for line in out.splitlines():
             if line.startswith("WIRE_EQ_SEQ "):
                 seqs.append(int(line.split()[1]))
+            elif line.startswith("WIRE_EQ_HASH "):
+                hashes.append(line.split()[3])
     assert len(seqs) == np_, "\n".join(outputs)
     assert len(set(seqs)) == 1 and seqs[0] > 0, seqs
-    return _eq_counters(outputs)
+    assert len(hashes) == np_, "\n".join(outputs)
+    assert len(set(hashes)) == 1, hashes
+    return _eq_counters(outputs), hashes[0]
+
+
+def _run_equality(np_, extra_env):
+    counters, _ = _run_equality_hashed(np_, extra_env)
+    return counters
 
 
 def test_equality_pipelined_np2():
@@ -316,6 +350,169 @@ def test_reset_with_reconnect_disabled_pins_legacy_abort():
     assert any("HorovodAbortedError" in o for o in outputs), outputs
     # Within 2x the progress deadline — the ISSUE 3 contract, unchanged.
     assert elapsed < 2 * 5 + 15, elapsed  # generous slack for startup
+
+
+# --- wire compression: codec math (in-process, ctypes) ----------------------
+# (docs/wire.md#compression) The quantized-ring codec layer, probed
+# through the native test hooks: id registry, on-wire block formats,
+# and the one-hop encode->decode error against the SHARED tolerance
+# table (horovod_tpu.common.compression.WIRE_TOLERANCE) that the
+# equality worker, the docs, and the bench worker all import.
+
+
+def test_codec_ids_match_native(lib):
+    # One registry, two languages: the Python name<->id map must agree
+    # with the native parser (core/src/codec.cc) byte for byte.
+    for name, cid in sorted(CODEC_IDS.items()):
+        assert lib.hvd_codec_from_name(name.encode()) == cid
+    assert lib.hvd_codec_from_name(b"gzip") == -1
+    assert lib.hvd_codec_from_name(b"") == -1
+
+
+def test_codec_wire_bytes(lib):
+    # none = raw fp32; bf16/fp16 halve it; int8 = 4-byte fp32 scale
+    # header + one byte per element.
+    assert lib.hvd_codec_wire_bytes(0, 1000) == 4000
+    assert lib.hvd_codec_wire_bytes(1, 1000) == 2000
+    assert lib.hvd_codec_wire_bytes(2, 1000) == 2000
+    assert lib.hvd_codec_wire_bytes(3, 1000) == 1004
+    # An empty block carries nothing — not even the int8 scale header
+    # (zero-count sub-chunks exist at ragged partitions).
+    for codec in range(4):
+        assert lib.hvd_codec_wire_bytes(codec, 0) == 0
+    assert lib.hvd_codec_wire_bytes(4, 8) == -1
+    assert lib.hvd_codec_wire_bytes(-1, 8) == -1
+    assert lib.hvd_codec_wire_bytes(1, -1) == -1
+
+
+def test_codec_roundtrip_within_shared_tolerance(lib):
+    """One encode->decode hop must sit inside the shared per-codec
+    tolerance at rtol alone — the table budgets np reduction hops of
+    accumulated error plus headroom; a single hop blowing it means the
+    table (or the codec) is wrong at the source."""
+    rng = np.random.default_rng(7)
+    for name, cid in sorted(CODEC_IDS.items()):
+        if name == "none":
+            continue
+        x = (rng.standard_normal(4099) * 3.0).astype(np.float32)
+        buf = x.copy()
+        wire = lib.hvd_codec_roundtrip(
+            cid, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            buf.size)
+        assert wire == lib.hvd_codec_wire_bytes(cid, buf.size)
+        tol = WIRE_TOLERANCE[name]
+        np.testing.assert_allclose(buf, x, atol=tol["atol"],
+                                   rtol=tol["rtol"], err_msg=name)
+
+
+def test_codec_roundtrip_edges(lib):
+    # codec=none round-trips bit-exactly; all-zero blocks stay exactly
+    # zero under int8 (scale guard for maxabs == 0); invalid args.
+    x = np.array([1.5, -2.25, 0.0, 3e-7], np.float32)
+    buf = x.copy()
+    p = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    assert lib.hvd_codec_roundtrip(0, p, buf.size) == 16
+    np.testing.assert_array_equal(buf, x)
+    z = np.zeros(33, np.float32)
+    assert lib.hvd_codec_roundtrip(
+        3, z.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), z.size) == 37
+    assert not z.any()
+    assert lib.hvd_codec_roundtrip(5, p, buf.size) == -1
+    assert lib.hvd_codec_roundtrip(1, p, -1) == -1
+
+
+# --- wire compression: the equality matrix under lossy codecs ---------------
+# (docs/wire.md#compression) HVD_WIRE_CODEC rides the coordinator's
+# negotiation response like the fusion threshold, so every rank
+# compresses the same blocks the same way. fp32 results are asserted
+# within the shared tolerance table by the worker; every other dtype
+# must stay bit-exact under every codec.
+
+
+def test_equality_codec_bf16_np2():
+    c = _run_equality(2, {"HVD_RING_CHUNK_BYTES": "64",
+                          "HVD_WIRE_CODEC": "bf16"})
+    assert c["ring_subchunk_steps"] > 0, c  # compression kept the pipeline
+    assert c["codec_bf16_sends"] > 0, c
+    assert c["codec_saved_bytes"] > 0, c
+    assert c["codec_fp16_sends"] == 0 and c["codec_int8_sends"] == 0, c
+
+
+def test_equality_codec_fp16_np3_odd_world():
+    # Odd world: compressed block boundaries hit every ragged
+    # partition in the matrix.
+    c = _run_equality(3, {"HVD_RING_CHUNK_BYTES": "128",
+                          "HVD_WIRE_CODEC": "fp16"})
+    assert c["codec_fp16_sends"] > 0, c
+    assert c["codec_saved_bytes"] > 0, c
+
+
+def test_equality_codec_int8_error_feedback_np2():
+    # int8 is the deep-quantization path: 4x smaller blocks, scale
+    # header per block, error-feedback residuals applied at submission
+    # (core/src/operations.cc) so the bias stays bounded.
+    c = _run_equality(2, {"HVD_RING_CHUNK_BYTES": "64",
+                          "HVD_WIRE_CODEC": "int8"})
+    assert c["codec_int8_sends"] > 0, c
+    assert c["codec_saved_bytes"] > 0, c
+
+
+def test_equality_codec_legacy_serial_np2():
+    # The serial (chunk=0) schedule compresses too — the codec hooks
+    # into the ring step, not the pipelining.
+    c = _run_equality(2, {"HVD_RING_CHUNK_BYTES": "0",
+                          "HVD_WIRE_CODEC": "bf16"})
+    assert c["ring_subchunk_steps"] == 0, c
+    assert c["codec_bf16_sends"] > 0, c
+
+
+def test_codec_none_is_bit_exact_vs_unset():
+    """codec=none must be byte-identical to not configuring a codec at
+    all — the acceptance pin that staging the knob never perturbs the
+    default wire."""
+    env = {"HVD_RING_CHUNK_BYTES": "64"}
+    _, h_unset = _run_equality_hashed(2, dict(env))
+    c, h_none = _run_equality_hashed(2, dict(env, HVD_WIRE_CODEC="none"))
+    assert h_none == h_unset, (h_none, h_unset)
+    assert c["codec_saved_bytes"] == 0, c
+    assert (c["codec_bf16_sends"] == c["codec_fp16_sends"]
+            == c["codec_int8_sends"] == 0), c
+
+
+def test_codec_bf16_tx_discount_np2():
+    """Acceptance: a pure-fp32 np=2 sweep under codec=bf16 moves
+    <= 0.55x the wire bytes of codec=none (0.5x payload + frame
+    headers + the uncompressed bootstrap/negotiation traffic)."""
+    import bench_wire
+
+    kw = dict(iters=3, warmup=1, chunk_bytes=65536, timeout=180)
+    plain = bench_wire.run_sweep(2, "1048576", **kw)
+    comp = bench_wire.run_sweep(2, "1048576", compress="bf16", **kw)
+    assert comp["counters"]["codec_bf16_sends"] > 0, comp["counters"]
+    assert comp["counters"]["codec_saved_bytes"] > 0, comp["counters"]
+    ratio = comp["counters"]["tx_bytes"] / plain["counters"]["tx_bytes"]
+    assert ratio <= 0.55, (ratio, comp["counters"], plain["counters"])
+
+
+# --- wire compression x self-healing wire ------------------------------------
+
+
+def test_equality_codec_survives_reset_mid_compressed_chunk_np2():
+    """The RST fires BETWEEN pipelined sub-chunk steps of a COMPRESSED
+    ring transfer. The RetxRing stores the encoded bytes as sent, so
+    the heal replays exactly those bytes and the decode cursor resumes
+    at the same block boundary — proven by the healed run hashing to
+    the SAME output bytes as an unfaulted run of the same config."""
+    from horovod_tpu.common.fault_injection import fault_env
+
+    env = {"HVD_RING_CHUNK_BYTES": "64", "HVD_WIRE_CODEC": "int8"}
+    _, h_clean = _run_equality_hashed(2, dict(env))
+    c, h_heal = _run_equality_hashed(
+        2, dict(fault_env(1, "reset", after_subchunks=40), **env))
+    assert c["reconnects"] >= 1, c  # the wire actually broke and healed
+    assert c["reconnect_failures"] == 0, c
+    assert c["codec_int8_sends"] > 0, c
+    assert h_heal == h_clean, (h_heal, h_clean)
 
 
 # --- heavyweight: np=4 busbw sweep (tier 2) ---------------------------------

@@ -39,6 +39,11 @@ _STATUS_NAMES = {0: "OK", 1: "UNKNOWN_ERROR", 2: "PRECONDITION_ERROR",
                  3: "ABORTED", 4: "INVALID_ARGUMENT", 5: "IN_PROGRESS",
                  6: "TIMED_OUT"}
 
+# Wire codec ids (core/src/codec.h WireCodecId): WIRE_CODEC events
+# stamp the codec a compressed transfer was using, so a wedged
+# mid-transfer op can be told apart from an uncompressed one.
+_CODEC_NAMES = {0: "none", 1: "bf16", 2: "fp16", 3: "int8"}
+
 
 def load_dump(path: str) -> Optional[dict]:
     """Parse one dump: ``{"header": {...}, "events": [...]}``. A torn
@@ -234,13 +239,24 @@ def diagnose(dumps: Dict[int, Dict[str, dict]],
                     # A response that ENDED with a non-OK status is the
                     # op the world died inside — the background loop
                     # records the failed end before it dumps.
-                    in_flight.append({
+                    entry = {
                         "rank": rank, "ps": int(begin.get("ps", 0)),
                         "seq": int(begin.get("seq", -1)),
                         "name": begin.get("name", ""),
                         "op": begin.get("a"),
                         "status": _STATUS_NAMES.get(status, str(status)),
-                    })
+                    }
+                    if "_codec" in begin:
+                        entry["codec"] = _CODEC_NAMES.get(
+                            begin["_codec"], str(begin["_codec"]))
+                    in_flight.append(entry)
+            elif kind == "WIRE_CODEC":
+                # Ring entered with a codec (a=id) inside the active
+                # response: remember it on the open RESP so a wedged
+                # transfer reports which encoding was on the wire.
+                begin = open_resp.get(int(ev.get("ps", 0)))
+                if begin is not None:
+                    begin["_codec"] = ev.get("a", 0)
             elif kind == "WIRE_RESUME":
                 wire_heals.append({
                     "rank": rank,
@@ -271,10 +287,14 @@ def diagnose(dumps: Dict[int, Dict[str, dict]],
                 if ev.get("name"):
                     negotiated_done.add(ev["name"])
         for ps, ev in open_resp.items():
-            in_flight.append({"rank": rank, "ps": ps,
-                              "seq": int(ev.get("seq", -1)),
-                              "name": ev.get("name", ""),
-                              "op": ev.get("a")})
+            entry = {"rank": rank, "ps": ps,
+                     "seq": int(ev.get("seq", -1)),
+                     "name": ev.get("name", ""),
+                     "op": ev.get("a")}
+            if "_codec" in ev:
+                entry["codec"] = _CODEC_NAMES.get(ev["_codec"],
+                                                  str(ev["_codec"]))
+            in_flight.append(entry)
 
     # Stalled tensors: announced by some member ranks, never by others,
     # and never emitted in a response (the post-hoc stall check).
@@ -394,8 +414,9 @@ def render_diagnosis(diag: dict) -> str:
         lines.append("  first divergent collective: seq %d "
                      "(process set %d)" % (seq, ps))
     for f in diag["in_flight"]:
-        lines.append("  in flight on rank %d: %r (seq %d, ps %d)"
-                     % (f["rank"], f["name"], f["seq"], f["ps"]))
+        codec = (", wire codec %s" % f["codec"]) if f.get("codec") else ""
+        lines.append("  in flight on rank %d: %r (seq %d, ps %d%s)"
+                     % (f["rank"], f["name"], f["seq"], f["ps"], codec))
     for name, info in diag["stalled_tensors"].items():
         lines.append("  tensor %r: ready on rank(s) %s, NEVER submitted "
                      "by rank(s) %s"

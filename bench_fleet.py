@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Fleet-at-cardinality scaling benchmark (docs/fleet.md).
+
+Stands up 25-500-rank stub worlds on this box (tools/fleet: real
+control-plane protocols, thread workers, no jax) and publishes the
+scaling curves as one JSON document (``BENCH_fleet.json`` by
+convention):
+
+- **bootstrap**: driver start -> full world up, per N;
+- **churn**: rolling SIGKILL waves -> recovery seconds and driver
+  cycle time, per N;
+- **kv**: rendezvous PUT fan-in throughput + shed behavior under a
+  client storm, per N (bounded server: typed 503s, never stalls);
+- **router**: request p99 through the serving front door under load,
+  reconnect-storm recovery, and the pick microbench — NEW O(1)
+  rotation pick vs the legacy O(N) scan (before/after curve #1);
+- **journal**: replay cost after heavy churn with compaction off vs
+  on (before/after curve #2: unbounded O(events x N) fold vs the
+  snapshot-bounded tail);
+- **memory**: harness resident bytes per N.
+
+Storm mode (``--storm``) is the acceptance drive: churn + reconnect +
+sustained load at the largest size at once, asserting correct final
+membership and ZERO lost requests.
+
+Examples:
+
+    python bench_fleet.py                          # full curve sweep
+    python bench_fleet.py --sizes 25,100 --quick   # fast look
+    python bench_fleet.py --storm --sizes 500      # the 500-rank drive
+    python bench_fleet.py --quick --sizes 64 --no-storm   # CI lane
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+
+from tools.fleet.rig import (  # noqa: E402
+    ElasticRig,
+    ServeRig,
+    journal_replay_bench,
+    pick_microbench,
+    rss_bytes,
+)
+from tools.fleet.topology import curve  # noqa: E402
+
+
+def bench_elastic(n: int, waves: int, beat_sec: float,
+                  storm_threads: int, storm_sec: float) -> dict:
+    with tempfile.TemporaryDirectory() as td:
+        rig = ElasticRig(n, beat_sec=beat_sec, journal_dir=td,
+                         poll_sec=0.02)
+        try:
+            bootstrap = rig.start(timeout=180.0)
+            recoveries = [rig.churn_wave(0.1) for _ in range(waves)]
+            storm = rig.kv_put_storm(threads=storm_threads,
+                                     duration=storm_sec)
+            cycles = rig.cycle_stats()
+            journal = rig.journal_stats()
+        finally:
+            rc = rig.stop()
+    return {
+        "n": n,
+        "bootstrap_sec": round(bootstrap, 3),
+        "churn_waves": waves,
+        "churn_recover_sec": [round(r, 3) for r in recoveries],
+        "driver_cycle": cycles,
+        "kv_storm": storm,
+        "journal": journal,
+        "driver_rc": rc,
+        "rss_bytes": rss_bytes(),
+    }
+
+
+def bench_serve(n: int, clients: int, per_client: int,
+                beat_sec: float) -> dict:
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(n, backends=4, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=beat_sec,
+                       monitor=False)
+        try:
+            reg_sec, boot_sec = rig.start()
+            load = rig.load(clients=clients,
+                            requests_per_client=per_client)
+            reconnect = rig.restart_router()
+            load2 = rig.load(clients=clients,
+                             requests_per_client=per_client)
+        finally:
+            rig.stop()
+    return {
+        "n": n,
+        "register_sec": round(reg_sec, 3),
+        "bootstrap_sec": round(boot_sec, 3),
+        "load": load,
+        "reconnect_storm": reconnect,
+        "load_after_reconnect": load2,
+    }
+
+
+def bench_storm(n: int, waves: int, clients: int,
+                per_client: int) -> dict:
+    """The acceptance drive: elastic churn + router reconnect + load,
+    all at once at size n. Zero lost requests, correct membership."""
+    out = {"n": n}
+    with tempfile.TemporaryDirectory() as etd, \
+            tempfile.TemporaryDirectory() as std:
+        erig = ElasticRig(n, beat_sec=0.5, journal_dir=etd,
+                          poll_sec=0.02)
+        srig = ServeRig(n, backends=4, journal_dir=std,
+                        liveness_sec=0.0, beat_sec=0.5, monitor=False)
+        try:
+            out["bootstrap_sec"] = round(erig.start(timeout=300.0), 3)
+            srig.start()
+            import threading
+
+            results = {}
+
+            def _drive_load():
+                results["load"] = srig.load(
+                    clients=clients, requests_per_client=per_client)
+
+            loader = threading.Thread(target=_drive_load, daemon=True)
+            loader.start()
+            recoveries = [erig.churn_wave(0.05) for _ in range(waves)]
+            out["churn_recover_sec"] = [round(r, 3)
+                                        for r in recoveries]
+            out["reconnect_storm"] = srig.restart_router()
+            loader.join(timeout=900.0)
+            out["load"] = results.get("load")
+            out["driver_cycle"] = erig.cycle_stats()
+            out["journal"] = erig.journal_stats()
+            out["final_membership"] = len(erig.driver.procs)
+            out["blacklisted"] = sorted(
+                erig.driver.host_manager.blacklist)
+            out["router_table"] = srig.router.stats()
+            # srig.lost accumulates every load() on this rig,
+            # including the threaded storm load joined above.
+            out["lost_requests"] = srig.lost
+        finally:
+            out["driver_rc"] = erig.stop()
+            srig.stop()
+    out["rss_bytes"] = rss_bytes()
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sizes", default="25,100,250,500",
+                    help="comma-separated world sizes")
+    ap.add_argument("--quick", action="store_true",
+                    help="short storms/loads (CI smoke budget)")
+    ap.add_argument("--storm", action="store_true",
+                    help="run ONLY the combined acceptance storm at "
+                         "the largest size")
+    ap.add_argument("--no-storm", action="store_true",
+                    help="skip the combined storm section")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON document here")
+    args = ap.parse_args(argv)
+
+    sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+    waves = 2 if args.quick else 3
+    clients = 4 if args.quick else 8
+    per_client = 25 if args.quick else 100
+    storm_sec = 1.0 if args.quick else 2.0
+    beat = 0.5
+
+    doc = {
+        "bench": "fleet",
+        "host": os.uname().nodename,
+        "ts": time.time(),
+        "sizes": sizes,
+        "quick": bool(args.quick),
+    }
+
+    if args.storm:
+        doc["storm"] = bench_storm(max(sizes), waves=waves,
+                                   clients=clients,
+                                   per_client=per_client)
+    else:
+        elastic = [bench_elastic(n, waves=waves, beat_sec=beat,
+                                 storm_threads=16,
+                                 storm_sec=storm_sec)
+                   for n in sizes]
+        serve = [bench_serve(n, clients=clients,
+                             per_client=per_client, beat_sec=beat)
+                 for n in sizes]
+        picks = [pick_microbench(n, picks=500 if args.quick else 2000)
+                 for n in sizes]
+        events = 100 if args.quick else 400
+        journal_off = [journal_replay_bench(n, events, 0)
+                       for n in sizes]
+        journal_on = [journal_replay_bench(n, events, 128)
+                      for n in sizes]
+
+        doc["elastic"] = elastic
+        doc["serve"] = serve
+        doc["router_pick"] = {"new": picks,
+                              "legacy_reference": "same entries, "
+                              "legacy_us_per_pick/steps fields"}
+        doc["journal_replay"] = {"events": events,
+                                 "compaction_off": journal_off,
+                                 "compaction_on": journal_on}
+        doc["curves"] = {
+            "bootstrap_sec": curve(
+                sizes, [e["bootstrap_sec"] for e in elastic], "s"),
+            "driver_cycle_mean_ms": curve(
+                sizes, [e["driver_cycle"]["mean_ms"]
+                        for e in elastic], "ms"),
+            "kv_puts_per_sec": curve(
+                sizes, [e["kv_storm"]["puts_per_sec"]
+                        for e in elastic], "puts/s"),
+            "router_p99_ms": curve(
+                sizes, [s["load"]["p99_ms"] for s in serve], "ms"),
+            "pick_new_us": curve(
+                sizes, [p["new_us_per_pick"] for p in picks], "us"),
+            "pick_legacy_us": curve(
+                sizes, [p["legacy_us_per_pick"] for p in picks],
+                "us"),
+            "journal_replay_off_ms": curve(
+                sizes, [j["replay_ms"] for j in journal_off], "ms"),
+            "journal_replay_on_ms": curve(
+                sizes, [j["replay_ms"] for j in journal_on], "ms"),
+            "rss_bytes": curve(
+                sizes, [e["rss_bytes"] or 0 for e in elastic],
+                "bytes"),
+        }
+        if not args.no_storm:
+            doc["storm"] = bench_storm(
+                max(sizes), waves=waves, clients=clients,
+                per_client=per_client)
+
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,7 @@
 # is the default `pytest tests/` run, tier 2 holds the heavyweight
 # integration jobs whose code paths tier 1 already covers.
 #
-# Usage: ci/run_tests.sh [analysis|tier1|tier2|all]
+# Usage: ci/run_tests.sh [analysis|flightrec|fleet|tier1|tier2|all]
 set -e
 cd "$(dirname "$0")/.."
 
@@ -172,7 +172,20 @@ run_tier1() {
 # heal drive is then deselected from the full tier, driver-kill
 # precedent), and the storm/legacy-pin chaos pair rides the full tier
 # (~8s combined warm) — absorbed by the existing headroom.
+# Fleet lane (ISSUE 18): one jax-free cardinality smoke through
+# bench_fleet.py — a 64-rank stub world bootstrapped, churned, KV-
+# stormed and served end-to-end with the scaling-curve extraction that
+# BENCH_fleet.json rides (docs/fleet.md). Minutes-cheap (thread
+# workers, no processes); the 500-rank acceptance storm lives in the
+# tier-2 pytest run as test_fleet_storm_500_zero_lost.
+run_fleet() {
+    echo "=== fleet: cardinality smoke (bench_fleet.py --quick, n=64) ==="
+    timeout "${HVD_CI_FLEET_BUDGET:-600}" \
+        python bench_fleet.py --quick --sizes 64 --no-storm > /dev/null
+}
+
 run_tier2() {
+    run_fleet
     echo "=== tier 2: serving smoke (bench_serve.py, jax-free fleet) ==="
     timeout "${HVD_CI_SERVE_BUDGET:-600}" \
         python bench_serve.py --np 2 --duration 2 --threads 4 \
@@ -216,8 +229,10 @@ run_tier2() {
 case "$TIER" in
     analysis) run_analysis ;;
     flightrec) run_flightrec ;;
+    fleet) run_fleet ;;
     tier1) run_tier1 ;;
     tier2) run_tier2 ;;
     all) run_analysis; run_tier1; run_tier2 ;;
-    *) echo "usage: $0 [analysis|flightrec|tier1|tier2|all]" >&2; exit 2 ;;
+    *) echo "usage: $0 [analysis|flightrec|fleet|tier1|tier2|all]" >&2
+       exit 2 ;;
 esac

@@ -115,7 +115,25 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HVD_HEARTBEAT_SEC", HONORED,
          "elastic/worker.py + serve/replica.py: liveness heartbeat "
          "PUT interval to the rendezvous/router KV (default 10; <=0 "
-         "disables)"),
+         "disables). Each sender starts at a random phase inside one "
+         "interval so a reset's worth of workers never beats in "
+         "lockstep (docs/fleet.md)"),
+    Knob("HVD_KV_MAX_INFLIGHT", HONORED,
+         "runner/http_server.py: max concurrent handler threads on "
+         "the KV/HTTP servers; excess connections are shed with a "
+         "typed 503 + Retry-After instead of spawning a thread storm "
+         "(default 64 on the driver's rendezvous KV, 0 = unbounded "
+         "on generic KV servers; docs/fleet.md)"),
+    Knob("HVD_KV_RETRY_AFTER_SEC", HONORED,
+         "runner/http_server.py: the Retry-After deferral a bounded "
+         "KV server attaches to shed 503s — heartbeat clients sleep "
+         "this long (plus jitter) before retrying (default 1.0)"),
+    Knob("HVD_JOURNAL_SNAPSHOT_EVERY", HONORED,
+         "runner/elastic_run.py + serve/router.py: fold the "
+         "membership journal down to one snapshot record once the "
+         "tail since the last snapshot exceeds this many records — "
+         "bounded replay under churn (default 512; 0 disables "
+         "compaction; docs/fleet.md)"),
     Knob("HOROVOD_DISABLE_GROUP_FUSION", HONORED,
          "core/src/controller.cc FuseResponses"),
     Knob("HOROVOD_DYNAMIC_PROCESS_SETS", HONORED,
@@ -267,6 +285,13 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "bytes — bounds how much in-flight loss a reconnect can "
          "replay; a larger gap falls back to abort-on-break, recorded "
          "(default 8 MiB; 0 disables buffering)"),
+    Knob("HVD_WIRE_RETRANSMIT_TOTAL_BYTES", HONORED,
+         "core/src/comm.cc: aggregate retransmit budget per rank — "
+         "divided across the size-1 peer rings and clamping the "
+         "per-peer window down when the division is smaller than "
+         "HVD_WIRE_RETRANSMIT_BUF_BYTES (each clamped ring counts in "
+         "hvd_wire_retx_rings_clamped_total). Default 512 MiB; 0 = "
+         "no aggregate bound (docs/fleet.md)"),
     Knob("HVD_WIRE_CODEC", HONORED,
          "core/src/controller.cc + collectives.cc: wire codec for fp32 "
          "ring allreduce payloads — none | bf16 | fp16 | int8 (scaled, "

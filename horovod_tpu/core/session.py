@@ -113,6 +113,12 @@ _M_CORE = {
         "hvd_core_codec_qint_sends_total",
         "Ring block sends encoded as scaled int8 on the wire "
         "(error-feedback residuals applied at submission)."),
+    "retx_rings_clamped": _metrics.counter(
+        "hvd_wire_retx_rings_clamped_total",
+        "Per-peer retransmit rings sized below HVD_WIRE_RETRANSMIT_"
+        "BUF_BYTES because the aggregate HVD_WIRE_RETRANSMIT_TOTAL_"
+        "BYTES budget divided across peers was smaller (docs/"
+        "fleet.md)."),
 }
 
 # StatusType values that mean "a peer is dead or wedged and the abort
@@ -536,9 +542,10 @@ class CoreSession:
         bytes, comm timeouts, abort cascades, bootstrap retries, wire
         tx/rx bytes, pipelined ring sub-chunk steps, flight-recorder
         events/drops/dumps, self-healing-wire reconnects/retransmits/
-        failures, wire-codec saved bytes and per-codec sends)."""
-        buf = (ctypes.c_longlong * 21)()
-        self._lib.hvd_core_counters(buf, 21)
+        failures, wire-codec saved bytes and per-codec sends, and
+        retransmit rings clamped by the aggregate budget)."""
+        buf = (ctypes.c_longlong * 22)()
+        self._lib.hvd_core_counters(buf, 22)
         return {
             "responses": buf[0],
             "cached_responses": buf[1],
@@ -561,6 +568,7 @@ class CoreSession:
             "codec_bf16_sends": buf[18],
             "codec_fp16_sends": buf[19],
             "codec_int8_sends": buf[20],
+            "retx_rings_clamped": buf[21],
         }
 
     def wire_reconnect_stats(self) -> Dict[str, int]:

@@ -194,6 +194,10 @@ std::atomic<long long> g_ring_subchunks{0};
 std::atomic<long long> g_comm_reconnects{0};
 std::atomic<long long> g_frames_retransmitted{0};
 std::atomic<long long> g_reconnect_failures{0};
+// Fleet-cardinality guard (docs/fleet.md): per-peer retransmit rings
+// whose requested capacity was clamped down by the aggregate budget
+// HVD_WIRE_RETRANSMIT_TOTAL_BYTES (divided across active peers).
+std::atomic<long long> g_retx_rings_clamped{0};
 // Wire compression (docs/wire.md#compression): bytes kept off the wire
 // by the active codec (raw minus encoded, per ring step send) and
 // encoded step sends per codec id (1=bf16, 2=fp16, 3=int8).
@@ -316,6 +320,9 @@ long long CommFramesRetransmittedTotal() {
 }
 long long CommReconnectFailuresTotal() {
   return g_reconnect_failures.load();
+}
+long long CommRetxRingsClampedTotal() {
+  return g_retx_rings_clamped.load();
 }
 long long CodecSavedBytesTotal() { return g_codec_saved_bytes.load(); }
 long long CodecSendsTotal(int codec) {
@@ -958,6 +965,23 @@ Status TcpComm::Init(int rank, int size, const std::string& controller_addr,
   if (reconnect_budget_sec_ < 0) reconnect_budget_sec_ = 0.0;
   retx_cap_bytes_ = EnvLL("HVD_WIRE_RETRANSMIT_BUF_BYTES", 8LL << 20);
   if (retx_cap_bytes_ < 0) retx_cap_bytes_ = 0;
+  // Aggregate retransmit budget (docs/fleet.md): at fleet cardinality
+  // per-peer windows multiply into size-1 rings per rank — 8 MiB x 499
+  // peers is ~4 GiB of ring alone. The total budget divides across
+  // active peers and clamps the per-peer window down when the division
+  // is smaller; each clamped ring is counted (retx_rings_clamped) so
+  // shrunken heal coverage is observable, not silent. 0 = no aggregate
+  // bound (legacy per-peer sizing only).
+  long long retx_total = EnvLL("HVD_WIRE_RETRANSMIT_TOTAL_BYTES", 512LL << 20);
+  if (retx_total < 0) retx_total = 0;
+  if (retx_total > 0 && size > 1) {
+    long long per_peer = retx_total / (long long)(size - 1);
+    if (per_peer < retx_cap_bytes_) {
+      g_retx_rings_clamped.fetch_add((long long)(size - 1),
+                                     std::memory_order_relaxed);
+      retx_cap_bytes_ = per_peer;
+    }
+  }
   // Progress deadline for every post-bootstrap blocking wait. Default
   // generous (300 s — far beyond any healthy collective, small enough
   // that a wedged peer becomes an error the same day); 0 keeps the

@@ -56,6 +56,9 @@ void CountRingSubchunkStep();
 long long CommReconnectsTotal();
 long long CommFramesRetransmittedTotal();
 long long CommReconnectFailuresTotal();
+// Retransmit rings clamped below HVD_WIRE_RETRANSMIT_BUF_BYTES by the
+// aggregate HVD_WIRE_RETRANSMIT_TOTAL_BYTES budget (docs/fleet.md).
+long long CommRetxRingsClampedTotal();
 // Wire-compression counters (docs/wire.md#compression): bytes the
 // active codec kept off the wire (raw minus encoded, summed over ring
 // step sends), and encoded step sends per codec. Incremented by the

@@ -1183,11 +1183,12 @@ long long hvd_core_fusion_bytes() {
 // bootstrap_retries, tx_bytes, rx_bytes, ring_subchunk_steps,
 // flightrec_events, flightrec_dropped, flightrec_dumps, reconnects,
 // frames_retransmitted, reconnect_failures, codec_saved_bytes,
-// codec_bf16_sends, codec_fp16_sends, codec_int8_sends. Callers
-// pass the slot count they know about, so the layout is append-only.
+// codec_bf16_sends, codec_fp16_sends, codec_int8_sends,
+// retx_rings_clamped. Callers pass the slot count they know about, so
+// the layout is append-only.
 void hvd_core_counters(long long* out, int n) {
   if (!g || !out) return;
-  long long vals[21] = {
+  long long vals[22] = {
       g->ctr_responses.load(), g->ctr_cached_responses.load(),
       g->ctr_fused_tensors.load(), g->ctr_allreduced_tensors.load(),
       g->ctr_allreduce_bytes.load(), CommTimeoutsTotal(),
@@ -1197,8 +1198,9 @@ void hvd_core_counters(long long* out, int n) {
       FlightRecDumpsTotal(), CommReconnectsTotal(),
       CommFramesRetransmittedTotal(), CommReconnectFailuresTotal(),
       CodecSavedBytesTotal(), CodecSendsTotal(CODEC_BF16),
-      CodecSendsTotal(CODEC_FP16), CodecSendsTotal(CODEC_INT8)};
-  for (int i = 0; i < n && i < 21; ++i) out[i] = vals[i];
+      CodecSendsTotal(CODEC_FP16), CodecSendsTotal(CODEC_INT8),
+      CommRetxRingsClampedTotal()};
+  for (int i = 0; i < n && i < 22; ++i) out[i] = vals[i];
 }
 
 // Self-healing-wire heal-duration stats (docs/wire.md#reconnect):

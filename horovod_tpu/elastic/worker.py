@@ -34,6 +34,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -58,6 +59,11 @@ _M_HEARTBEATS = _metrics.counter(
     "hvd_elastic_heartbeats_total",
     "Liveness heartbeats this worker PUT to the rendezvous KV "
     "(heartbeat/<slot_key>, every HVD_HEARTBEAT_SEC).")
+_M_HEARTBEATS_DEFERRED = _metrics.counter(
+    "hvd_elastic_heartbeats_deferred_total",
+    "Heartbeats the rendezvous KV shed with a typed 503 + Retry-After "
+    "(HVD_KV_MAX_INFLIGHT admission control): the worker deferred the "
+    "beat instead of treating the shed as a driver failure.")
 
 
 def _rendezvous():
@@ -186,19 +192,35 @@ def send_heartbeat() -> bool:
     """One best-effort heartbeat PUT; False when it could not be sent
     (no elastic env, or the rendezvous store is unreachable — e.g. the
     driver is mid-restart; never fatal)."""
-    from horovod_tpu.runner.http_server import write_kv
+    return send_heartbeat_ex()[0]
+
+
+def send_heartbeat_ex() -> Tuple[bool, float]:
+    """Like :func:`send_heartbeat` but returns ``(sent,
+    retry_after_sec)``. ``retry_after_sec`` > 0 means the bounded KV
+    shed the beat with a typed 503 (docs/fleet.md): the beat did not
+    land, but the driver is ALIVE — the loop should retry after the
+    server's requested deferral, not the full heartbeat interval."""
+    from horovod_tpu.runner.http_server import put_kv
+    from horovod_tpu.utils import flightrec
 
     ep = _rendezvous_or_none()
     slot_key = os.environ.get("HOROVOD_SLOT_KEY")
     if ep is None or not slot_key:
-        return False
+        return False, 0.0
     try:
-        write_kv(ep[0], ep[1], "heartbeat", slot_key,
-                 json.dumps(heartbeat_payload()).encode(), timeout=5)
+        status, retry_after = put_kv(
+            ep[0], ep[1], "heartbeat", slot_key,
+            json.dumps(heartbeat_payload()).encode(), timeout=5)
     except OSError:
-        return False
+        return False, 0.0
+    if status == 503:
+        _M_HEARTBEATS_DEFERRED.inc()
+        flightrec.record("heartbeat_deferred", name=slot_key,
+                         retry_after=retry_after)
+        return False, max(retry_after, 0.05)
     _M_HEARTBEATS.inc()
-    return True
+    return True, 0.0
 
 
 def start_heartbeats() -> Optional[threading.Thread]:
@@ -218,9 +240,20 @@ def start_heartbeats() -> Optional[threading.Thread]:
             return _heartbeat_thread
 
         def _loop():
+            # Per-worker random phase offset: a wave of workers spawned
+            # by the same reset would otherwise beat in lockstep every
+            # HVD_HEARTBEAT_SEC forever — at 500 ranks that is a
+            # thundering herd into the driver KV each interval. The
+            # offset spreads first beats (and therefore every later
+            # beat) uniformly across one interval; it stays well under
+            # any sane HOROVOD_WORKER_LIVENESS_SEC, which only engages
+            # after the first beat anyway.
+            time.sleep(random.uniform(
+                0.0, max(0.05, float_env("HVD_HEARTBEAT_SEC", 10.0))))
             while True:
+                retry_after = 0.0
                 try:
-                    send_heartbeat()
+                    _, retry_after = send_heartbeat_ex()
                 except Exception as e:  # analysis: allow-broad-except
                     # — heartbeating is best-effort: one garbled KV
                     # response (HTTPException, not OSError) must not
@@ -228,7 +261,15 @@ def start_heartbeats() -> Optional[threading.Thread]:
                     # replace a perfectly healthy worker as wedged.
                     sys.stderr.write(
                         "elastic: heartbeat attempt failed: %s\n" % e)
-                time.sleep(max(0.05, float_env("HVD_HEARTBEAT_SEC", 10.0)))
+                interval = max(0.05, float_env("HVD_HEARTBEAT_SEC", 10.0))
+                if retry_after > 0:
+                    # Shed beat: come back after the server's deferral
+                    # (jittered so the shed herd does not re-arrive as
+                    # a herd), not a full silent interval — the driver
+                    # must keep seeing this worker alive.
+                    interval = min(interval,
+                                   retry_after * random.uniform(1.0, 2.0))
+                time.sleep(interval)
 
         _heartbeat_thread = threading.Thread(
             target=_loop, daemon=True, name="hvd-heartbeat")

@@ -37,7 +37,11 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from horovod_tpu.common.util import failure_backoff_seconds, float_env
+from horovod_tpu.common.util import (
+    failure_backoff_seconds,
+    float_env,
+    int_env,
+)
 from horovod_tpu.utils import metrics as _metrics
 
 from horovod_tpu.runner.discovery import HostDiscoveryScript, HostManager
@@ -55,6 +59,11 @@ _M_JOURNAL_RECORDS = _metrics.counter(
     "hvd_driver_journal_records_total",
     "Records appended to the elastic driver's fsync'd journal "
     "(rendezvous snapshots plus worker exit/wedge events).")
+_G_CYCLE_MS = _metrics.gauge(
+    "hvd_driver_cycle_ms",
+    "Wall time of the elastic driver's last poll cycle (reap exits, "
+    "wedge scan, decay) — the control-plane latency floor for "
+    "noticing a dead or wedged worker.")
 _M_WEDGED = _metrics.counter(
     "hvd_worker_wedged_total",
     "Worker slots the liveness monitor declared wedged (alive by "
@@ -112,6 +121,11 @@ class ElasticDriver:
         # DRIVER's clock via the KV put callback, so worker clock skew
         # cannot fake or mask a wedge.
         self.liveness_sec = float_env("HOROVOD_WORKER_LIVENESS_SEC", 0.0)
+        # Journal compaction cadence: once the tail since the last
+        # snapshot exceeds this many records, the next rendezvous
+        # append folds the whole file down to one snapshot record
+        # (bounded replay under churn; docs/fleet.md). 0 disables.
+        self.snapshot_every = int_env("HVD_JOURNAL_SNAPSHOT_EVERY", 512)
         # _hb_seen is shared between the KV server's callback thread
         # (stamping arrivals) and the driver main loop (wedge checks,
         # respawn clears): every touch goes through _hb_lock. _hb_fence
@@ -181,12 +195,38 @@ class ElasticDriver:
                 "resuming at rendezvous version %d\n"
                 % (replayed.records, path, self.version + 1))
         self.journal = DriverJournal(path)
+        if replayed is not None:
+            # Seed the compaction counter with the replayed tail so a
+            # restarted driver inherits the cadence instead of letting
+            # an old, never-compacted history grow for another full
+            # HVD_JOURNAL_SNAPSHOT_EVERY records.
+            self.journal.records_since_snapshot = replayed.records
 
     def _journal_append(self, record: dict):
         if self.journal is None:
             return
         self.journal.append(record)
         _M_JOURNAL_RECORDS.inc()
+
+    def _maybe_compact_journal(self):
+        """Fold the journal down to one snapshot record once the tail
+        exceeds HVD_JOURNAL_SNAPSHOT_EVERY. Called ONLY right after a
+        rendezvous append: that record is itself a full state
+        snapshot, so every event the compaction erases is already
+        reflected in the state written here — the only point where
+        replacing history cannot lose an append-before-effect record
+        still waiting for its effect."""
+        j = self.journal
+        if (j is None or self.snapshot_every <= 0
+                or j.records_since_snapshot < self.snapshot_every):
+            return
+        j.compact({
+            "version": self.version,
+            "blacklist": sorted(self.host_manager.blacklist),
+            "fail_counts": dict(self.fail_counts),
+            "done": sorted(self.done),
+            "ts": time.time(),
+        })
 
     # --- assignment ---------------------------------------------------------
 
@@ -323,6 +363,7 @@ class ElasticDriver:
             "done": sorted(self.done),
             "ts": time.time(),
         })
+        self._maybe_compact_journal()
         controller_addr = self._publish(keyed)
 
         launcher_host = socket.gethostname()
@@ -346,14 +387,20 @@ class ElasticDriver:
             # stragglers from the old incarnation (version < current)
             # from re-stamping what this clear just removed.
             self._hb_clear(key, fence=self.version)
-            self.procs[key] = SlotProcess(
-                a.rank, self.command, env, hostname=a.hostname,
-                ssh_port=getattr(self.args, "ssh_port", None),
-                ssh_identity_file=getattr(self.args,
-                                          "ssh_identity_file", None),
-                prefix_timestamp=getattr(
-                    self.args, "prefix_output_with_timestamp", False))
+            self.procs[key] = self._spawn_slot(key, a, env)
         return True
+
+    def _spawn_slot(self, key: str, a: SlotInfo, env: dict):
+        """Spawn one worker slot. The fleet harness (tools/fleet)
+        overrides this to stand up stub in-process workers at
+        100-500-rank cardinality without 500 OS processes."""
+        return SlotProcess(
+            a.rank, self.command, env, hostname=a.hostname,
+            ssh_port=getattr(self.args, "ssh_port", None),
+            ssh_identity_file=getattr(self.args,
+                                      "ssh_identity_file", None),
+            prefix_timestamp=getattr(
+                self.args, "prefix_output_with_timestamp", False))
 
     def _backoff_before_failure_reset(self):
         """Jittered exponential wait between consecutive failure resets
@@ -538,6 +585,52 @@ class ElasticDriver:
 
     # --- main loop ----------------------------------------------------------
 
+    def _cycle(self) -> Tuple[bool, bool]:
+        """One poll cycle of the main loop: reap exited workers,
+        replace wedged ones, decay stale failure history. Returns
+        ``(needs_reset, worker_failed)``. Extracted from ``run()`` so
+        the fleet harness and the O(N)-guard tests can single-step the
+        driver at cardinality without the wall-clock poll sleep."""
+        t0 = time.monotonic()
+        needs_reset = False
+        worker_failed = False
+        for key, proc in list(self.procs.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            proc.wait()
+            rank = getattr(proc, "rank", None)
+            del self.procs[key]
+            self._hb_clear(key)
+            record = {"type": "exit", "slot": key,
+                      "rc": rc, "ts": time.time()}
+            if rc != 0:
+                # A worker that died on HorovodAbortedError
+                # auto-dumped its rings; the exit record names
+                # the evidence so the post-mortem starts from
+                # the journal (docs/flightrec.md).
+                dump = self._slot_dump_path(rank)
+                if dump:
+                    record["dump"] = dump
+            self._journal_append(record)
+            if rc == 0:
+                self.done[key] = True
+            else:
+                self._record_slot_failure(key)
+                sys.stderr.write(
+                    "elastic: worker %s exited with code %d "
+                    "(failure %d)\n"
+                    % (key, rc, self.fail_counts[key]))
+                needs_reset = True
+                worker_failed = True
+
+        if self._replace_wedged():
+            needs_reset = True
+            worker_failed = True
+        self._decay_fail_counts()
+        _G_CYCLE_MS.set((time.monotonic() - t0) * 1000.0)
+        return needs_reset, worker_failed
+
     def run(self) -> int:
         self.rendezvous.start()
         try:
@@ -560,42 +653,7 @@ class ElasticDriver:
             resets = 0
             while True:
                 time.sleep(self.POLL_SEC)
-                needs_reset = False
-                worker_failed = False
-                for key, proc in list(self.procs.items()):
-                    rc = proc.poll()
-                    if rc is None:
-                        continue
-                    proc.wait()
-                    rank = getattr(proc, "rank", None)
-                    del self.procs[key]
-                    self._hb_clear(key)
-                    record = {"type": "exit", "slot": key,
-                              "rc": rc, "ts": time.time()}
-                    if rc != 0:
-                        # A worker that died on HorovodAbortedError
-                        # auto-dumped its rings; the exit record names
-                        # the evidence so the post-mortem starts from
-                        # the journal (docs/flightrec.md).
-                        dump = self._slot_dump_path(rank)
-                        if dump:
-                            record["dump"] = dump
-                    self._journal_append(record)
-                    if rc == 0:
-                        self.done[key] = True
-                    else:
-                        self._record_slot_failure(key)
-                        sys.stderr.write(
-                            "elastic: worker %s exited with code %d "
-                            "(failure %d)\n"
-                            % (key, rc, self.fail_counts[key]))
-                        needs_reset = True
-                        worker_failed = True
-
-                if self._replace_wedged():
-                    needs_reset = True
-                    worker_failed = True
-                self._decay_fail_counts()
+                needs_reset, worker_failed = self._cycle()
 
                 if not self.procs and self.done and not needs_reset:
                     return 0

@@ -23,13 +23,39 @@ Custom endpoints mount via ``get_routes`` / ``post_routes`` (exact-path
 handlers, matched ahead of the KV scopes) instead of subclassing the
 handler — the serve router adds ``POST /v1/predict`` and
 ``GET /healthz`` this way.
+
+Admission control (the fleet-cardinality fix, docs/fleet.md): one
+daemon thread per connection is a thread STORM at 500 workers beating
+every HVD_HEARTBEAT_SEC. With ``HVD_KV_MAX_INFLIGHT`` > 0 the server
+bounds concurrent handler threads; excess connections are shed on the
+accept thread with a typed ``503`` + ``Retry-After:
+HVD_KV_RETRY_AFTER_SEC`` response (never a silent drop), counted in
+``hvd_kv_requests_shed_total`` and recorded as ``kv_shed`` flightrec
+events. Clients with a deferral path (``put_kv``; the elastic worker's
+heartbeat loop) honor the Retry-After instead of treating it as an
+error. 0 keeps the legacy unbounded behavior.
 """
 
 from __future__ import annotations
 
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+
+from horovod_tpu.common.util import float_env, int_env
+from horovod_tpu.utils import metrics as _metrics
+
+_M_KV_SHED = _metrics.counter(
+    "hvd_kv_requests_shed_total",
+    "KV/HTTP connections shed with a typed 503 + Retry-After because "
+    "HVD_KV_MAX_INFLIGHT handler threads were already busy (heartbeat "
+    "fan-in admission control; docs/fleet.md).")
+_G_KV_INFLIGHT = _metrics.gauge(
+    "hvd_kv_inflight_requests",
+    "Handler threads currently serving KV/HTTP requests on a bounded "
+    "server (HVD_KV_MAX_INFLIGHT > 0) — the KV queue-depth signal; "
+    "pinned at the limit means the server is saturated and shedding.")
 
 # A mounted route returns (status, content_type, body_bytes).
 RouteResult = Tuple[int, str, bytes]
@@ -45,6 +71,13 @@ def json_route_result(status: int, payload: dict) -> RouteResult:
 
 class _KVHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+
+    def _count_request(self):
+        """Bump the server's served-request counter (the fleet O(N)
+        guards count KV traffic per driver cycle against it)."""
+        server = self.server
+        with server.count_lock:  # type: ignore[attr-defined]
+            server.requests_total += 1  # type: ignore[attr-defined]
 
     def _split(self) -> Tuple[str, str]:
         parts = self.path.strip("/").split("/", 1)
@@ -105,6 +138,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
+        self._count_request()
         path = self.path.split("?", 1)[0].rstrip("/")
         route = getattr(self.server, "get_routes", {}).get(path or "/")
         if route is not None:
@@ -146,6 +180,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         return True
 
     def do_POST(self):
+        self._count_request()
         path = self.path.split("?", 1)[0].rstrip("/")
         route = getattr(self.server, "post_routes", {}).get(path or "/")
         length = int(self.headers.get("Content-Length", 0))
@@ -158,6 +193,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         self._run_route(route, body)
 
     def do_PUT(self):
+        self._count_request()
         if self._reject_write_if_metrics_only():
             return
         scope, key = self._split()
@@ -177,6 +213,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        self._count_request()
         if self._reject_write_if_metrics_only():
             return
         scope, key = self._split()
@@ -190,12 +227,101 @@ class _KVHandler(BaseHTTPRequestHandler):
         pass
 
 
+class _BoundedHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a bounded handler pool.
+
+    ``max_inflight`` <= 0 is the legacy thread-per-connection server.
+    Above 0, a connection arriving while ``max_inflight`` handler
+    threads are busy is shed ON THE ACCEPT THREAD with a canned
+    ``503`` + ``Retry-After`` — a tiny fixed-cost write, so admission
+    stays O(1) no matter how deep the storm — instead of spawning a
+    thread that will fight 499 others for the callback lock."""
+
+    max_inflight = 0
+    retry_after_sec = 1.0
+    # socketserver's default listen backlog is 5: at fleet cardinality
+    # (hundreds of heartbeat connections per second) the SYN queue
+    # overflows and clients eat kernel SYN-retransmit stalls — a ~1s
+    # p99 cliff with no server-side signal at all. A deep backlog
+    # keeps admission decisions OURS (shed with a typed 503), not the
+    # kernel's (silent retransmit).
+    request_queue_size = 128
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # Served (not shed) HTTP requests, all verbs. Exposed as
+        # KVStoreServer.requests_total for the fleet O(N) guards.
+        self.requests_total = 0
+        self.count_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        if self.max_inflight > 0:
+            with self._inflight_lock:
+                shed = self._inflight >= self.max_inflight
+                if not shed:
+                    self._inflight += 1
+                    _G_KV_INFLIGHT.set(self._inflight)
+            if shed:
+                self._shed_request(request)
+                return
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            if self.max_inflight > 0:
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    _G_KV_INFLIGHT.set(self._inflight)
+
+    def _shed_request(self, request):
+        from horovod_tpu.utils import flightrec
+
+        _M_KV_SHED.inc()
+        flightrec.record("kv_shed", limit=self.max_inflight)
+        try:
+            request.sendall(
+                ("HTTP/1.1 503 Service Unavailable\r\n"
+                 "Retry-After: %g\r\n"
+                 "Content-Length: 0\r\n"
+                 "Connection: close\r\n\r\n"
+                 % self.retry_after_sec).encode())
+            # Lingering close: the peer is mid-sendall on its request
+            # body, and close() with unread inbound bytes turns into an
+            # RST that destroys the 503 sitting in the peer's receive
+            # buffer (it sees EPIPE/ECONNRESET, not the typed shed).
+            # Half-close our write side so the response + FIN land,
+            # then drain what the peer sends until EOF — bounded in
+            # both time and bytes so a wedged peer cannot hold the
+            # accept thread.
+            request.shutdown(socket.SHUT_WR)
+            request.settimeout(0.25)
+            drained = 0
+            while drained < 65536:
+                chunk = request.recv(8192)
+                if not chunk:
+                    break
+                drained += len(chunk)
+        except OSError:
+            pass  # the storm peer vanished first; the shed still counts
+        self.shutdown_request(request)
+
+
 class KVStoreServer:
     """In-process threaded HTTP KV store."""
 
     def __init__(self, port: int = 0, put_callback=None,
-                 metrics_only: bool = False):
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+                 metrics_only: bool = False,
+                 max_inflight: Optional[int] = None):
+        self._httpd = _BoundedHTTPServer(("0.0.0.0", port), _KVHandler)
+        if max_inflight is None:
+            max_inflight = int_env("HVD_KV_MAX_INFLIGHT", 0)
+        self._httpd.max_inflight = int(max_inflight)
+        self._httpd.retry_after_sec = max(
+            0.05, float_env("HVD_KV_RETRY_AFTER_SEC", 1.0))
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.put_callback = put_callback  # type: ignore[attr-defined]
@@ -241,6 +367,13 @@ class KVStoreServer:
             self._thread.join(timeout=5)
         self._httpd.server_close()
 
+    @property
+    def requests_total(self) -> int:
+        """HTTP requests this server actually handled (shed
+        connections excluded — those never reach a handler)."""
+        with self._httpd.count_lock:
+            return self._httpd.requests_total
+
     # Direct access helpers for in-process users (the driver).
     def get(self, scope: str, key: str) -> Optional[bytes]:
         with self._httpd.lock:  # type: ignore[attr-defined]
@@ -268,6 +401,17 @@ class RendezvousServer(KVStoreServer):
 
     SCOPE = "rendezvous"
 
+    def __init__(self, port: int = 0, put_callback=None,
+                 max_inflight: Optional[int] = None):
+        # The driver's KV eats the whole world's heartbeat fan-in, so
+        # it is bounded BY DEFAULT (HVD_KV_MAX_INFLIGHT, default 64
+        # here): a shed beat costs one deferred liveness stamp, a
+        # thread storm costs the control plane (docs/fleet.md).
+        if max_inflight is None:
+            max_inflight = int_env("HVD_KV_MAX_INFLIGHT", 64)
+        super().__init__(port=port, put_callback=put_callback,
+                         max_inflight=max_inflight)
+
     def publish(self, assignments):
         """Publish SlotInfo assignments keyed by host:local_rank."""
         for a in assignments:
@@ -293,12 +437,39 @@ def read_kv(addr: str, port: int, scope: str, key: str,
 
 
 def write_kv(addr: str, port: int, scope: str, key: str, value: bytes,
-             timeout: float = 10.0):
+             timeout: float = 10.0) -> int:
+    """PUT one key; returns the HTTP status (200, or 503 when a
+    bounded server shed the request)."""
+    return put_kv(addr, port, scope, key, value, timeout=timeout)[0]
+
+
+def put_kv(addr: str, port: int, scope: str, key: str, value: bytes,
+           timeout: float = 10.0) -> Tuple[int, float]:
+    """PUT one key against a possibly-bounded server: returns
+    ``(status, retry_after_sec)``. ``retry_after_sec`` is 0 unless the
+    server shed the request with a typed 503 — then it is the server's
+    requested deferral, and heartbeat-shaped clients should wait that
+    long (plus jitter) instead of retrying into the same storm."""
     import http.client
 
     conn = http.client.HTTPConnection(addr, port, timeout=timeout)
     try:
-        conn.request("PUT", "/%s/%s" % (scope, key), body=value)
-        conn.getresponse().read()
+        try:
+            conn.request("PUT", "/%s/%s" % (scope, key), body=value)
+        except (BrokenPipeError, ConnectionResetError):
+            # A bounded server shedding us half-closes its write side
+            # as soon as it decides — our body sendall can lose that
+            # race. The typed 503 is (usually) already in our receive
+            # buffer; read it instead of surfacing a transport error.
+            pass
+        resp = conn.getresponse()
+        resp.read()
+        retry_after = 0.0
+        if resp.status == 503:
+            try:
+                retry_after = float(resp.getheader("Retry-After") or 0.0)
+            except ValueError:
+                retry_after = 0.0
+        return resp.status, retry_after
     finally:
         conn.close()

@@ -28,11 +28,19 @@ Record types (one JSON object per line):
 - ``decay``: slots whose fail counts the stable-period decay forgot
   (HOROVOD_ELASTIC_STABLE_SEC with no new failure); replay forgets
   them too instead of resurrecting them.
+- ``snapshot``: a compaction point — the full driver state at the
+  moment the journal was folded down (same fields as ``rendezvous``).
+  Written by ``compact()``, which atomically replaces the whole file
+  with this one record, so replay cost is bounded by the records
+  appended SINCE the last compaction instead of the job's entire
+  churn history (the 500-rank fleet harness showed replay growing
+  without bound under rolling kill waves; docs/fleet.md).
 
-Replay is snapshot + event fold: the last ``rendezvous`` record seeds
-the state and later ``exit``/``wedged`` events update it, so the
-recovered driver sees exactly the bookkeeping the dead one had. A torn
-final line (the crash landed mid-append) is tolerated and dropped.
+Replay is snapshot + event fold: the last ``rendezvous``/``snapshot``
+record seeds the state and later ``exit``/``wedged`` events update it,
+so the recovered driver sees exactly the bookkeeping the dead one had.
+A torn final line (the crash landed mid-append) is tolerated and
+dropped.
 """
 
 from __future__ import annotations
@@ -44,7 +52,15 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Set
 
+from horovod_tpu.utils import metrics as _metrics
+
 logger = logging.getLogger("horovod_tpu")
+
+_M_SNAPSHOTS = _metrics.counter(
+    "hvd_journal_snapshots_total",
+    "Journal compactions: the whole file was atomically replaced by "
+    "one snapshot record, bounding replay time to the tail appended "
+    "since (HVD_JOURNAL_SNAPSHOT_EVERY).")
 
 # Default blacklist threshold for standalone replay() calls; the
 # driver passes its own ElasticDriver.MAX_SLOT_FAILURES so the two
@@ -95,6 +111,11 @@ class DriverJournal:
         # into one unparsable MID-file line, and replay stops at the
         # first bad line.
         self._append_lock = threading.Lock()
+        # Appends since the last compaction (seeded by the owner from
+        # the replayed record count at attach): when it crosses the
+        # owner's HVD_JOURNAL_SNAPSHOT_EVERY budget, the owner calls
+        # compact() with a full-state snapshot record.
+        self.records_since_snapshot = 0
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._truncate_torn_tail(path)
@@ -151,6 +172,50 @@ class DriverJournal:
             self._fh.write(json.dumps(record, sort_keys=True) + "\n")
             self._fh.flush()
             os.fsync(self._fh.fileno())
+            self.records_since_snapshot += 1
+
+    def compact(self, snapshot_record: dict) -> None:
+        """Atomically replace the whole journal with one ``snapshot``
+        record carrying the owner's full current state, so replay folds
+        snapshot + tail instead of the job's entire churn history.
+
+        Crash-safe at every point: the snapshot is written to a
+        sidecar file, fsync'd, then ``os.replace``d over the journal
+        (atomic on POSIX) and the directory entry fsync'd — a crash
+        leaves either the complete old history or the complete new
+        snapshot, never a torn mix. The owner must call this only at a
+        consistent point (the state in ``snapshot_record`` must
+        already include every effect of previously appended records —
+        the same append-before-effect discipline as ``append``)."""
+        with self._append_lock:
+            if self._fh.closed:
+                if self._drop_after_close:
+                    logger.warning(
+                        "journal %s: dropping compaction after close",
+                        self.path)
+                    return
+                raise ValueError("compact() on a closed journal")
+            rec = dict(snapshot_record)
+            rec["type"] = "snapshot"
+            tmp = self.path + ".compact"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+            parent = os.path.dirname(os.path.abspath(self.path))
+            try:
+                dfd = os.open(parent, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass  # platform without directory fsync: best effort
+            self._fh.close()
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self.records_since_snapshot = 1
+        _M_SNAPSHOTS.inc()
 
     def close(self) -> None:
         with self._append_lock:
@@ -182,7 +247,7 @@ class DriverJournal:
                     break  # torn tail: the crash landed mid-append
                 state.records += 1
                 rtype = rec.get("type")
-                if rtype == "rendezvous":
+                if rtype in ("rendezvous", "snapshot"):
                     state.version = max(state.version,
                                         int(rec.get("version", 0)))
                     state.done = set(rec.get("done", []))

@@ -73,19 +73,21 @@ class ReplicaMonitor:
         refresh the gauges."""
         router = self.router
         if router.liveness_sec > 0:
-            for rid in list(router.replicas()):
-                age = router.heartbeat_age(rid)
-                if age is not None and age > router.liveness_sec:
-                    logger.warning(
-                        "serve: replica %s wedged — no heartbeat for "
-                        "%.1fs (> HOROVOD_WORKER_LIVENESS_SEC=%.1fs); "
-                        "culling from rotation", rid, age,
-                        router.liveness_sec)
-                    router.cull(rid, reason="no heartbeat %.1fs" % age,
-                                silence_sec=age,
-                                dump=self._dump_path(rid))
-                    _C_CULLED.inc()
-        _G_REPLICAS.set(len(router.replicas()))
+            # Heap-driven sweep (the fleet-cardinality fix): only
+            # replicas whose deadline actually passed are surfaced —
+            # O(expired · log N) per tick, not a full-table scan with
+            # a lock hop per replica.
+            for rid, age in router.liveness_sweep():
+                logger.warning(
+                    "serve: replica %s wedged — no heartbeat for "
+                    "%.1fs (> HOROVOD_WORKER_LIVENESS_SEC=%.1fs); "
+                    "culling from rotation", rid, age,
+                    router.liveness_sec)
+                router.cull(rid, reason="no heartbeat %.1fs" % age,
+                            silence_sec=age,
+                            dump=self._dump_path(rid))
+                _C_CULLED.inc()
+        _G_REPLICAS.set(router.stats()["replicas"])
         now = time.monotonic()
         done = router.requests_done()
         if self._last_ts is not None and now > self._last_ts:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import sys
 import threading
 import time
@@ -337,6 +338,11 @@ class Replica:
 
     def _heartbeat_loop(self):
         ep = self._router_endpoint()
+        # Same phase jitter as the elastic worker (docs/fleet.md): a
+        # fleet of replicas started by one scale-up would otherwise
+        # beat the router in lockstep every HVD_HEARTBEAT_SEC.
+        self._stop.wait(random.uniform(
+            0.0, max(0.05, float_env("HVD_HEARTBEAT_SEC", 10.0))))
         while not self._stop.is_set():
             try:
                 write_kv(ep[0], ep[1], "heartbeat", self.replica_id,

@@ -33,6 +33,7 @@ alone — no re-registration round-trip needed.
 
 from __future__ import annotations
 
+import heapq
 import http.client
 import json
 import os
@@ -41,7 +42,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
-from horovod_tpu.common.util import float_env
+from horovod_tpu.common.util import float_env, int_env
 from horovod_tpu.runner.http_server import (
     KVStoreServer,
     json_route_result,
@@ -101,6 +102,16 @@ def replay_routing(path: str) -> Dict[str, dict]:
             except ValueError:
                 break  # torn tail
             rtype = rec.get("type")
+            if rtype == "snapshot":
+                # Compaction point (DriverJournal.compact): the full
+                # table at that moment replaces everything folded so
+                # far; later records are the tail.
+                table = {
+                    str(rid): {k: info.get(k)
+                               for k in ("addr", "port", "pid", "model")}
+                    for rid, info in (rec.get("table") or {}).items()
+                    if isinstance(info, dict)}
+                continue
             rid = rec.get("id")
             if rid is None:
                 continue
@@ -129,6 +140,25 @@ class Router:
         self._order: List[str] = []
         self._rr = 0
         self._hb_seen: Dict[str, float] = {}
+        # O(1) pick bookkeeping (the fleet-cardinality fix): _rotation
+        # is _order minus the cooling set, maintained incrementally on
+        # admit/cull/trip/expiry so _pick indexes into it instead of
+        # rebuilding an O(N) candidate list per request. _cool_heap and
+        # _hb_heap are lazy-invalidation expiry heaps (deadline, rid):
+        # stale entries are discarded when popped, so expiry checks are
+        # amortized O(events) instead of O(N) scans per request/tick.
+        self._rotation: List[str] = []
+        self._rotation_set: Set[str] = set()
+        self._cool_heap: List[Tuple[float, str]] = []
+        self._hb_heap: List[Tuple[float, str]] = []
+        # Monotonic count of rotation slots examined by _pick — the
+        # O(N)-guard tests (tests/test_fleet.py) assert this grows
+        # ~O(1) per request as the table grows.
+        self.pick_scan_steps = 0
+        # Serve-journal compaction cadence (shared knob with the
+        # elastic driver; docs/fleet.md): fold the journal down to one
+        # snapshot record once the tail exceeds this. 0 disables.
+        self.snapshot_every = int_env("HVD_JOURNAL_SNAPSHOT_EVERY", 512)
         # Replicas THIS incarnation has heard from (registration or
         # heartbeat). Journal-replayed entries stay unconfirmed until
         # their first live beat — readiness checks must not count a
@@ -163,11 +193,25 @@ class Router:
             for rid, info in replayed.items():
                 self._table[rid] = info
                 self._order.append(rid)
+                self._rotation.append(rid)
+                self._rotation_set.add(rid)
                 # Fresh liveness clock: a replica that died with the
                 # old router is culled liveness_sec from NOW; a live
                 # one re-beats long before that.
                 self._hb_seen[rid] = now
+                if self.liveness_sec > 0:
+                    heapq.heappush(self._hb_heap,
+                                   (now + self.liveness_sec, rid))
             self._replayed = len(replayed)
+            # Seed the compaction counter with the existing tail so a
+            # restarted router inherits the cadence instead of letting
+            # an uncompacted history grow for another full budget.
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    self._journal.records_since_snapshot = \
+                        sum(1 for _ in fh)
+            except OSError:
+                pass
         self._kv = KVStoreServer(port=port, put_callback=self._on_put)
         self._kv.register_post_route("/v1/predict", self._handle_predict)
         self._kv.register_get_route("/healthz", self._handle_healthz)
@@ -217,6 +261,55 @@ class Router:
             with self._lock:
                 self._confirmed.add(key)
 
+    def _rotation_add(self, rid: str):
+        """(lock held) Restore the rotation invariant for ``rid``: in
+        rotation iff admitted and not cooling."""
+        # analysis: holds-lock(_lock) — every caller (admit, expire,
+        # _note_success) already holds _lock.
+        if (rid in self._table and rid not in self._cooling_until
+                and rid not in self._rotation_set):
+            self._rotation.append(rid)
+            self._rotation_set.add(rid)
+
+    def _rotation_remove(self, rid: str):
+        """(lock held) Drop ``rid`` from rotation (trip or cull). The
+        list remove is O(N) but runs only on membership/breaker
+        events, never per request."""
+        # analysis: holds-lock(_lock) — every caller (cull, trip)
+        # already holds _lock.
+        if rid in self._rotation_set:
+            self._rotation_set.discard(rid)
+            self._rotation.remove(rid)
+
+    def _hb_stamp_new(self, rid: str):
+        """(lock held) First liveness stamp for ``rid``: set the clock
+        and arm its expiry-heap entry."""
+        # analysis: holds-lock(_lock) — only admit() calls this, under
+        # its lock.
+        if rid not in self._hb_seen:
+            now = time.monotonic()
+            self._hb_seen[rid] = now
+            if self.liveness_sec > 0:
+                heapq.heappush(self._hb_heap,
+                               (now + self.liveness_sec, rid))
+
+    def _maybe_compact_locked(self):
+        """(lock held) Fold the serve journal down to one snapshot of
+        the current table once the tail exceeds the cadence. Called
+        only AFTER an append's effect is applied, so the snapshot can
+        never miss an event it just erased (append-before-effect is
+        preserved: the snapshot IS the effect)."""
+        # analysis: holds-lock(_lock) — only admit()/cull() call this,
+        # at the end of their locked blocks.
+        if (self._journal is None or self.snapshot_every <= 0
+                or self._journal.records_since_snapshot
+                < self.snapshot_every):
+            return
+        self._journal.compact({
+            "table": {rid: dict(e) for rid, e in self._table.items()},
+            "ts": time.time(),
+        })
+
     def admit(self, replica_id: str, info: dict):
         """Add (or update) a replica; journaled before it takes effect
         so a router restart cannot forget a member it already routed
@@ -225,7 +318,7 @@ class Router:
         with self._lock:
             known = self._table.get(replica_id)
             if known == entry:
-                self._hb_seen.setdefault(replica_id, time.monotonic())
+                self._hb_stamp_new(replica_id)
                 return
             if self._journal is not None:
                 rec = dict(entry)
@@ -235,7 +328,7 @@ class Router:
             self._table[replica_id] = entry
             if replica_id not in self._order:
                 self._order.append(replica_id)
-            self._hb_seen.setdefault(replica_id, time.monotonic())
+            self._hb_stamp_new(replica_id)
             # (Re-)admission closes the breaker: a culled-then-
             # rediscovered replica, or one respawned on a new endpoint,
             # starts with a clean failure budget (the PR 8 heartbeat
@@ -243,7 +336,9 @@ class Router:
             self._fail_count.pop(replica_id, None)
             self._cooling_until.pop(replica_id, None)
             self._trip_streak.pop(replica_id, None)
+            self._rotation_add(replica_id)
             _G_COOLING.set(len(self._cooling_until))
+            self._maybe_compact_locked()
 
     def cull(self, replica_id: str, reason: str = "silent",
              silence_sec: Optional[float] = None,
@@ -271,12 +366,14 @@ class Router:
             self._table.pop(replica_id, None)
             if replica_id in self._order:
                 self._order.remove(replica_id)
+            self._rotation_remove(replica_id)
             self._hb_seen.pop(replica_id, None)
             self._confirmed.discard(replica_id)
             self._fail_count.pop(replica_id, None)
             self._cooling_until.pop(replica_id, None)
             self._trip_streak.pop(replica_id, None)
             _G_COOLING.set(len(self._cooling_until))
+            self._maybe_compact_locked()
         flightrec.record_failure("cull", "replica %s: %s"
                                  % (replica_id, reason))
 
@@ -289,31 +386,132 @@ class Router:
             last = self._hb_seen.get(replica_id)
         return None if last is None else time.monotonic() - last
 
-    def _pick(self, exclude: Set[str]) -> Optional[Tuple[str, dict]]:
-        with self._lock:
+    def liveness_sweep(self, now: Optional[float] = None) \
+            -> List[Tuple[str, float]]:
+        """Pop replicas whose heartbeat deadline passed off the expiry
+        heap and return them as ``(replica_id, silence_sec)`` pairs for
+        the monitor to cull. Replaces the monitor's per-tick full-table
+        scan: cost is O(expired · log N) per tick, not O(N). Lazy
+        invalidation as for cooldowns — a fresh beat just re-arms the
+        entry at its real deadline."""
+        if self.liveness_sec <= 0:
+            return []
+        if now is None:
             now = time.monotonic()
-            # Expired cooldowns re-enter rotation (half-open: the fail
+        overdue: List[Tuple[str, float]] = []
+        with self._lock:
+            while self._hb_heap and self._hb_heap[0][0] <= now:
+                _, rid = heapq.heappop(self._hb_heap)
+                last = self._hb_seen.get(rid)
+                if last is None:
+                    continue  # stale: culled since this entry was armed
+                deadline = last + self.liveness_sec
+                if deadline > now:
+                    # Beat since the entry was armed — re-arm at the
+                    # real deadline.
+                    heapq.heappush(self._hb_heap, (deadline, rid))
+                    continue
+                overdue.append((rid, now - last))
+                # Re-arm so a replica the monitor declines to cull
+                # (or one that beats again before the cull lands) is
+                # re-checked next window instead of falling off the
+                # heap forever.
+                heapq.heappush(self._hb_heap,
+                               (now + self.liveness_sec, rid))
+        return overdue
+
+    def stats(self) -> Dict[str, int]:
+        """O(1) size counters in one lock hop — what the monitor and
+        the fleet gauges need without copying the whole table."""
+        with self._lock:
+            return {
+                "replicas": len(self._table),
+                "confirmed": len(self._confirmed),
+                "cooling": len(self._cooling_until),
+                "rotation": len(self._rotation),
+            }
+
+    def _expire_cooldowns(self, now: float):
+        """(lock held) Pop every cooldown whose deadline has passed.
+        Heap entries are lazily invalidated: an entry whose rid is no
+        longer cooling (success/cull/re-admit cleared it) or whose
+        actual deadline moved later (re-trip) is discarded/re-pushed
+        instead of scanned for. Amortized O(log N) per breaker event —
+        never an O(N) sweep per request."""
+        # analysis: holds-lock(_lock) — only _pick/_pick_legacy call
+        # this, under their lock.
+        expired = False
+        while self._cool_heap and self._cool_heap[0][0] <= now:
+            _, rid = heapq.heappop(self._cool_heap)
+            until = self._cooling_until.get(rid)
+            if until is None:
+                continue  # stale: breaker already closed
+            if until > now:
+                # Re-tripped with a later deadline; this entry is the
+                # old one. Re-arm at the real deadline.
+                heapq.heappush(self._cool_heap, (until, rid))
+                continue
+            # Expired cooldown re-enters rotation (half-open: the fail
             # count is still at/over the threshold, so one more failure
             # re-trips immediately with a doubled cooldown).
-            expired = [rid for rid, until in self._cooling_until.items()
-                       if until <= now]
-            for rid in expired:
-                self._cooling_until.pop(rid, None)
-            if expired:
-                _G_COOLING.set(len(self._cooling_until))
+            self._cooling_until.pop(rid, None)
+            self._rotation_add(rid)
+            expired = True
+        if expired:
+            _G_COOLING.set(len(self._cooling_until))
+
+    def _pick(self, exclude: Set[str]) -> Optional[Tuple[str, dict]]:
+        """O(1)-per-request pick: index round-robin into the
+        incrementally-maintained rotation list instead of rebuilding a
+        candidate list from the full table (the pre-fleet
+        implementation, kept as ``_pick_legacy`` for the equivalence
+        tests and the before/after scaling curve in BENCH_fleet.json).
+        The loop advances past excluded entries; a request excludes
+        only replicas it already tried, so the expected cost stays O(1
+        + retries), not O(N)."""
+        with self._lock:
+            self._expire_cooldowns(time.monotonic())
+            n = len(self._rotation)
+            for _ in range(n):
+                rid = self._rotation[self._rr % n]
+                self._rr += 1
+                self.pick_scan_steps += 1
+                if rid not in exclude:
+                    return rid, dict(self._table[rid])
+            # Rotation empty or fully excluded. Every live replica is
+            # cooling (or already tried): serving nothing is strictly
+            # worse than trying a suspect — fall back to an O(N) scan
+            # of the full order rather than 502 a healthy fleet. Rare:
+            # only under whole-fleet breaker trips.
+            candidates = [rid for rid in self._order
+                          if rid not in exclude]
+            if not candidates:
+                return None
+            rid = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            self.pick_scan_steps += len(candidates)
+            return rid, dict(self._table[rid])
+
+    def _pick_legacy(self, exclude: Set[str]) -> Optional[Tuple[str, dict]]:
+        """The pre-fleet O(N)-per-request pick, kept verbatim (modulo
+        popping expired cooldowns, which _expire_cooldowns now owns) as
+        the reference implementation: the equivalence tests check _pick
+        agrees with it, and bench_fleet graphs both to show the
+        scaling fix."""
+        with self._lock:
+            now = time.monotonic()
+            self._expire_cooldowns(now)
             candidates = [rid for rid in self._order
                           if rid not in exclude
                           and rid not in self._cooling_until]
             if not candidates:
-                # Every live replica is cooling: serving nothing is
-                # strictly worse than trying a suspect — fall back to
-                # the cooling set rather than 502 a healthy fleet.
                 candidates = [rid for rid in self._order
                               if rid not in exclude]
             if not candidates:
                 return None
             rid = candidates[self._rr % len(candidates)]
             self._rr += 1
+            self.pick_scan_steps += len(candidates)
             return rid, dict(self._table[rid])
 
     def _note_failure(self, rid: str):
@@ -333,7 +531,10 @@ class Router:
                 self._trip_streak[rid] = streak
                 base = self.breaker_cooldown_sec * min(2 ** (streak - 1), 8)
                 cooldown = base * random.uniform(0.5, 1.5)  # jittered
-                self._cooling_until[rid] = time.monotonic() + cooldown
+                until = time.monotonic() + cooldown
+                self._cooling_until[rid] = until
+                self._rotation_remove(rid)
+                heapq.heappush(self._cool_heap, (until, rid))
                 _G_COOLING.set(len(self._cooling_until))
                 tripped = (self._fail_count[rid], cooldown)
         if tripped is not None:
@@ -348,6 +549,7 @@ class Router:
             self._trip_streak.pop(rid, None)
             if self._cooling_until.pop(rid, None) is not None:
                 _G_COOLING.set(len(self._cooling_until))
+            self._rotation_add(rid)
 
     # --- predict proxy ------------------------------------------------------
 
@@ -414,22 +616,25 @@ class Router:
         return self._json(502, {"error": last_err, "tried": sorted(tried)})
 
     def _handle_healthz(self):
+        # One lock hop, one pass: heartbeat ages are computed from the
+        # _hb_seen snapshot inside the same critical section instead of
+        # N heartbeat_age() calls each taking the lock again (at fleet
+        # cardinality the old shape made /healthz an O(N) lock storm
+        # that starved the predict path).
         with self._lock:
-            table = {k: dict(v) for k, v in self._table.items()}
-            confirmed = set(self._confirmed)
             now = time.monotonic()
-            cooling = {rid: round(until - now, 3)
-                       for rid, until in self._cooling_until.items()
-                       if until > now}
-            fail_counts = dict(self._fail_count)
-        for rid, info in table.items():
-            age = self.heartbeat_age(rid)
-            info["heartbeat_age_sec"] = None if age is None \
-                else round(age, 3)
-            info["confirmed"] = rid in confirmed
-            info["consecutive_failures"] = fail_counts.get(rid, 0)
-            if rid in cooling:
-                info["cooling_sec_left"] = cooling[rid]
+            table = {}
+            for rid, entry in self._table.items():
+                info = dict(entry)
+                last = self._hb_seen.get(rid)
+                info["heartbeat_age_sec"] = None if last is None \
+                    else round(now - last, 3)
+                info["confirmed"] = rid in self._confirmed
+                info["consecutive_failures"] = self._fail_count.get(rid, 0)
+                until = self._cooling_until.get(rid)
+                if until is not None and until > now:
+                    info["cooling_sec_left"] = round(until - now, 3)
+                table[rid] = info
         from horovod_tpu.utils import flightrec
 
         return self._json(200, {

@@ -176,6 +176,16 @@ TIER2_COVERAGE = {
     "test_chaos_reset_reconnect_disabled_legacy_abort":
         "tests/test_wire.py::"
         "test_reset_with_reconnect_disabled_pins_legacy_abort",
+    # Fleet at cardinality (ISSUE 18): the rig mechanics, the O(N)
+    # guards and the same-port reconnect storm all run fast at N<=32
+    # in test_fleet.py; the 64-rank live-heartbeat smoke and the
+    # 500-rank churn+reconnect+load acceptance storm are the
+    # heavyweight variants.
+    "test_fleet_smoke_n64":
+        "tests/test_fleet.py::test_elastic_rig_bootstrap_churn_drain",
+    "test_fleet_storm_500_zero_lost":
+        "tests/test_fleet.py::"
+        "test_serve_rig_same_port_restart_zero_lost",
 }
 
 

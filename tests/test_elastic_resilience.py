@@ -344,7 +344,7 @@ def test_heartbeat_thread_survives_exceptions(monkeypatch):
         monkeypatch.setenv("HOROVOD_SLOT_KEY", "localhost:9")
         monkeypatch.setenv("HVD_HEARTBEAT_SEC", "0.05")
         calls = {"n": 0}
-        real = ew.send_heartbeat
+        real = ew.send_heartbeat_ex
 
         def flaky():
             calls["n"] += 1
@@ -352,7 +352,7 @@ def test_heartbeat_thread_survives_exceptions(monkeypatch):
                 raise http.client.HTTPException("garbled KV response")
             return real()
 
-        monkeypatch.setattr(ew, "send_heartbeat", flaky)
+        monkeypatch.setattr(ew, "send_heartbeat_ex", flaky)
         thread = ew.start_heartbeats()
         assert thread is not None
         deadline = time.time() + 10

@@ -1,0 +1,408 @@
+"""Fleet-at-cardinality harness (tools/fleet; docs/fleet.md): topology
+builder, curve extraction, stub worker lifecycle, the elastic and
+serving rigs at small N, and the O(N) guards that pin the
+control-plane hotpaths to constant-or-linear cost as the fleet grows.
+
+Everything here is jax-free and thread-backed — a "32-rank world" is
+32 heartbeat threads against the real rendezvous KV, not 32
+processes — so the tier-1 cases run in seconds. The 64-rank smoke and
+the 500-rank acceptance storm are the tier-2 variants.
+"""
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.http_server import KVStoreServer, put_kv
+from horovod_tpu.serve.autoscale import ReplicaMonitor
+from horovod_tpu.serve.router import Router
+
+from tools.fleet.rig import (
+    ElasticRig,
+    ServeRig,
+    journal_replay_bench,
+    pick_microbench,
+)
+from tools.fleet.stub import StubSlotProcess
+from tools.fleet.topology import (
+    StaticDiscovery,
+    build_topology,
+    curve,
+    fit_growth_exponent,
+    percentile,
+    slot_keys,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- topology + curve math ---------------------------------------------------
+
+
+def test_topology_packs_ranks_onto_hosts():
+    hosts = build_topology(20, slots_per_host=8)
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("fleet-h0", 8), ("fleet-h1", 8), ("fleet-h2", 4)]
+    keys = slot_keys(hosts)
+    assert len(keys) == 20
+    assert keys[0] == "fleet-h0:0" and keys[-1] == "fleet-h2:3"
+    assert len(set(keys)) == 20
+    with pytest.raises(ValueError):
+        build_topology(0)
+    with pytest.raises(ValueError):
+        build_topology(8, slots_per_host=0)
+
+
+def test_static_discovery_is_mutable_and_counts_refreshes():
+    disc = StaticDiscovery(build_topology(16, 8))
+    first = disc.find_available_hosts()
+    assert len(first) == 2 and disc.refreshes == 1
+    disc.hosts = disc.hosts[:1]
+    assert len(disc.find_available_hosts()) == 1
+    assert disc.refreshes == 2
+
+
+def test_growth_exponent_recovers_known_powers():
+    ns = [25, 100, 250, 500]
+    linear = fit_growth_exponent([(n, 3.0 * n) for n in ns])
+    quad = fit_growth_exponent([(n, 0.01 * n * n) for n in ns])
+    flat = fit_growth_exponent([(n, 7.5) for n in ns])
+    assert abs(linear - 1.0) < 1e-6
+    assert abs(quad - 2.0) < 1e-6
+    assert abs(flat) < 1e-6
+    assert fit_growth_exponent([(100, 5.0)]) is None
+    assert fit_growth_exponent([(100, 0.0), (200, 0.0)]) is None
+
+
+def test_curve_schema_and_arity_guard():
+    doc = curve([32, 128], [1.0, 4.0], "ms")
+    assert doc["unit"] == "ms"
+    assert doc["points"] == [{"n": 32, "value": 1.0},
+                             {"n": 128, "value": 4.0}]
+    assert abs(doc["growth_exponent"] - 1.0) < 0.01
+    json.dumps(doc)  # BENCH_fleet.json serializability
+    with pytest.raises(ValueError):
+        curve([1, 2], [1.0], "ms")
+
+
+def test_percentile_nearest_rank():
+    xs = list(range(1, 101))
+    assert percentile(xs, 50) == 50
+    assert percentile(xs, 99) == 99
+    assert percentile(xs, 0) == 1
+    assert percentile(xs, 100) == 100
+    assert percentile([], 99) is None
+
+
+# --- stub worker lifecycle ---------------------------------------------------
+
+
+def test_stub_lifecycle_finish_wedge_terminate():
+    # beat_sec=0: no heartbeat thread, pure lifecycle surface.
+    s = StubSlotProcess("fleet-h0:0", 0, 1, 0, beat_sec=0.0)
+    assert s.poll() is None and s.wait() is None
+    s.finish(1)
+    assert s.poll() == 1 and s.wait() == 1
+    s.terminate()  # idempotent after exit: rc must not change
+    assert s.poll() == 1
+
+    wedged = StubSlotProcess("fleet-h0:1", 1, 1, 0, beat_sec=0.0)
+    wedged.wedge()
+    assert wedged.poll() is None  # looks alive; only liveness sees it
+
+    killed = StubSlotProcess("fleet-h0:2", 2, 1, 0, beat_sec=0.0)
+    killed.terminate()
+    assert killed.poll() == -15
+
+
+def test_stub_heartbeats_reach_kv_with_version_fence():
+    kv = KVStoreServer(port=0)
+    port = kv.start()
+    try:
+        stub = StubSlotProcess("fleet-h0:0", 3, 7, port, beat_sec=0.05)
+        deadline = time.monotonic() + 10.0
+        while stub.beats_sent < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        stub.finish(0)
+        assert stub.beats_sent >= 2
+        doc = json.loads(kv.get("heartbeat", "fleet-h0:0").decode())
+        assert doc["version"] == 7
+        assert doc["pid"] == 100003
+    finally:
+        kv.stop()
+
+
+def test_kv_shed_returns_typed_503_with_retry_after():
+    release = threading.Event()
+
+    def _slow_put(scope, key, value):
+        release.wait(5.0)
+
+    kv = KVStoreServer(port=0, put_callback=_slow_put, max_inflight=1)
+    port = kv.start()
+    try:
+        statuses = []
+
+        def _put(i):
+            status, retry_after = put_kv(
+                "127.0.0.1", port, "s", "k%d" % i, b"v", timeout=10.0)
+            statuses.append((status, retry_after))
+
+        t1 = threading.Thread(target=_put, args=(0,), daemon=True)
+        t1.start()
+        time.sleep(0.2)  # let the first PUT occupy the only slot
+        _put(1)
+        release.set()
+        t1.join(timeout=10.0)
+        by_status = dict(statuses)
+        assert 200 in by_status and 503 in by_status
+        assert by_status[503] > 0  # Retry-After header parsed through
+    finally:
+        release.set()
+        kv.stop()
+
+
+# --- elastic rig + O(N) guards -----------------------------------------------
+
+
+def test_elastic_rig_bootstrap_churn_drain():
+    with tempfile.TemporaryDirectory() as td:
+        rig = ElasticRig(32, beat_sec=0.0, journal_dir=td,
+                         poll_sec=0.02)
+        try:
+            bootstrap = rig.start(timeout=60.0)
+            assert bootstrap < 30.0
+            assert len(rig.driver.live_stubs()) == 32
+            v0 = rig.driver.version
+            recover = rig.churn_wave(0.1)
+            assert rig.driver.version > v0
+            assert recover < 30.0
+            assert len(rig.driver.live_stubs()) == 32
+            stats = rig.journal_stats()
+            assert stats["records"] >= 2  # both rendezvous journaled
+            assert stats["replayed_version"] == rig.driver.version
+        finally:
+            rc = rig.stop()
+    assert rc == 0
+
+
+def test_driver_cycle_work_is_linear_in_fleet_size():
+    """O(N) guard: each driver cycle polls every live stub exactly
+    once — total poll count grows as cycles x N, never N^2."""
+    rig = ElasticRig(16, beat_sec=0.0, poll_sec=0.01)
+    try:
+        rig.start(timeout=60.0)
+        stubs = list(rig.driver.live_stubs().values())
+        c0 = len(rig.driver.cycle_times_ms)
+        p0 = sum(s.polls for s in stubs)
+        time.sleep(0.3)
+        c1 = len(rig.driver.cycle_times_ms)
+        p1 = sum(s.polls for s in stubs)
+        cycles = c1 - c0
+        polls = p1 - p0
+        assert cycles >= 3
+        # One poll per stub per cycle, +-one boundary cycle of slack
+        # for the racy snapshot.
+        assert polls <= (cycles + 1) * 16
+        assert polls >= (cycles - 1) * 16
+    finally:
+        rig.stop()
+
+
+def test_idle_driver_cycles_issue_no_kv_requests():
+    """O(N) guard: the driver's poll loop must never touch the KV —
+    heartbeats are worker-push, not driver-pull. A regression here
+    multiplies every cycle by N requests."""
+    rig = ElasticRig(8, beat_sec=0.0, poll_sec=0.01)
+    try:
+        rig.start(timeout=60.0)
+        r0 = rig.driver.rendezvous.requests_total
+        c0 = len(rig.driver.cycle_times_ms)
+        time.sleep(0.3)
+        assert len(rig.driver.cycle_times_ms) - c0 >= 3
+        assert rig.driver.rendezvous.requests_total == r0
+    finally:
+        rig.stop()
+
+
+def _filled_router(td, n):
+    router = Router(port=0, journal_dir=td, liveness_sec=0.0,
+                    monitor=False)
+    for i in range(n):
+        router.admit("r%04d" % i, {"addr": "127.0.0.1",
+                                   "port": 9000 + i, "pid": i})
+    return router
+
+
+def test_pick_scan_steps_stay_constant_as_table_grows():
+    """THE O(N) guard for the router hotpath: steps examined per pick
+    must not grow with table size (the legacy scan rebuilt an O(N)
+    candidate list per request)."""
+    per_pick = {}
+    legacy_per_pick = {}
+    picks = 200
+    for n in (32, 128):
+        with tempfile.TemporaryDirectory() as td:
+            router = _filled_router(td, n)
+            router.pick_scan_steps = 0
+            for _ in range(picks):
+                assert router._pick(set()) is not None
+            per_pick[n] = router.pick_scan_steps / picks
+            router.pick_scan_steps = 0
+            for _ in range(picks):
+                assert router._pick_legacy(set()) is not None
+            legacy_per_pick[n] = router.pick_scan_steps / picks
+    # New pick: ~1 step regardless of N (no exclusions, no cooling).
+    assert per_pick[32] <= 1.5
+    assert per_pick[128] <= 1.5 * per_pick[32]
+    # The guard detects the regression: the legacy path DOES grow.
+    assert legacy_per_pick[128] >= 3 * legacy_per_pick[32]
+
+
+def test_pick_new_equivalent_to_legacy_reference():
+    """Same admitted set, same exclusion/cooldown behavior: both picks
+    return only live candidates and cover the whole rotation."""
+    with tempfile.TemporaryDirectory() as td:
+        router = _filled_router(td, 6)
+        exclude = {"r0001"}
+        # Trip r0002's breaker into cooldown.
+        for _ in range(router.breaker_threshold):
+            router._note_failure("r0002")
+        eligible = {"r%04d" % i for i in range(6)} - {"r0002"}
+        # Separate loops: both picks advance the shared _rr cursor, so
+        # interleaving them would alias the rotation coverage.
+        seen_new, seen_legacy = set(), set()
+        for _ in range(30):
+            rid, entry = router._pick(exclude)
+            assert rid in eligible - exclude
+            assert entry["port"] == 9000 + int(rid[1:])
+            seen_new.add(rid)
+        for _ in range(30):
+            rid2, _ = router._pick_legacy(exclude)
+            assert rid2 in eligible - exclude
+            seen_legacy.add(rid2)
+        assert seen_new == eligible - exclude
+        assert seen_legacy == eligible - exclude
+        # Exhausted rotation: every candidate excluded -> None.
+        assert router._pick(set(router.replicas())) is None
+
+
+def test_monitor_tick_never_walks_the_full_table():
+    """O(N) guard: the liveness tick must ride the expiry heap
+    (liveness_sweep + stats), not copy the table via replicas()."""
+    with tempfile.TemporaryDirectory() as td:
+        router = Router(port=0, journal_dir=td, liveness_sec=30.0,
+                        monitor=False)
+        for i in range(8):
+            router.admit("r%d" % i, {"addr": "127.0.0.1",
+                                     "port": 9100 + i, "pid": i})
+        monitor = ReplicaMonitor(router, interval=1.0)
+
+        def _forbidden():
+            raise AssertionError(
+                "monitor tick walked the full table via replicas()")
+
+        router.replicas = _forbidden
+        monitor.tick()  # raises if the tick regresses to a full scan
+        assert router.stats()["replicas"] == 8
+
+
+# --- journal + serve rig -----------------------------------------------------
+
+
+def test_journal_snapshot_bounds_replay_records():
+    off = journal_replay_bench(16, events=60, snapshot_every=0)
+    on = journal_replay_bench(16, events=60, snapshot_every=16)
+    # Same world state replayed either way...
+    assert on["replayed_version"] == off["replayed_version"]
+    # ...but the compacted journal is bounded by the cadence, not the
+    # event history.
+    assert off["journal_records"] >= 60
+    assert on["journal_records"] <= 2 * 16
+    assert on["journal_bytes"] < off["journal_bytes"]
+
+
+def test_pick_microbench_schema():
+    out = pick_microbench(16, picks=50)
+    assert out["n"] == 16 and out["picks"] == 50
+    assert out["new_us_per_pick"] > 0
+    assert out["legacy_us_per_pick"] > 0
+    assert out["new_steps_per_pick"] <= 1.5
+    assert out["legacy_steps_per_pick"] >= 15
+
+
+def test_serve_rig_same_port_restart_zero_lost():
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(12, backends=2, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.0, monitor=False)
+        try:
+            rig.start()
+            port_before = rig.router.port
+            first = rig.load(clients=2, requests_per_client=10)
+            storm = rig.restart_router()
+            assert rig.router.port == port_before  # production shape
+            assert storm["replayed"] == 12
+            second = rig.load(clients=2, requests_per_client=10)
+        finally:
+            rig.stop()
+        assert first["lost"] == 0 and second["lost"] == 0
+        assert rig.lost == 0
+        assert first["ok"] == 20 and second["ok"] == 20
+        # Traffic actually hit the real backends.
+        assert sum(b.requests for b in rig.backends) == 40
+
+
+# --- tier-2: cardinality smokes ---------------------------------------------
+
+
+@pytest.mark.tier2
+def test_fleet_smoke_n64():
+    """The CI fleet lane's shape at N=64: bootstrap, one churn wave,
+    a KV PUT storm, and a served load burst — all with live
+    heartbeats."""
+    with tempfile.TemporaryDirectory() as td:
+        rig = ElasticRig(64, beat_sec=0.5, journal_dir=td,
+                         poll_sec=0.02)
+        try:
+            rig.start(timeout=120.0)
+            rig.churn_wave(0.1)
+            storm = rig.kv_put_storm(threads=8, duration=1.0)
+            assert len(rig.driver.live_stubs()) == 64
+        finally:
+            rc = rig.stop()
+    assert rc == 0
+    assert storm["puts_ok"] > 0
+    assert storm["put_errors"] == 0
+    with tempfile.TemporaryDirectory() as td:
+        srig = ServeRig(64, backends=4, journal_dir=td,
+                        liveness_sec=0.0, beat_sec=0.5, monitor=False)
+        try:
+            srig.start()
+            load = srig.load(clients=4, requests_per_client=25)
+        finally:
+            srig.stop()
+    assert load["lost"] == 0 and load["ok"] == 100
+
+
+@pytest.mark.tier2
+@pytest.mark.slow
+def test_fleet_storm_500_zero_lost():
+    """The acceptance drive (ISSUE 18): 500 ranks, churn + router
+    restart + sustained load at once — correct final membership, ZERO
+    lost requests, bounded journal replay."""
+    import bench_fleet
+
+    out = bench_fleet.bench_storm(500, waves=2, clients=4,
+                                  per_client=50)
+    assert out["driver_rc"] == 0
+    assert out["lost_requests"] == 0
+    assert out["final_membership"] == 500
+    assert out["router_table"]["replicas"] == 500
+    assert out["load"]["ok"] == 200
+    # Bounded replay: the compacted journal stays a fraction of the
+    # churn history (the snapshot cadence, not the event count).
+    assert out["journal"]["records"] < 520

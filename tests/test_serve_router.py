@@ -394,7 +394,11 @@ def test_monitor_culls_silent_replica_and_journal_remembers(tmp_path):
                             "model": "m"})
         monitor.tick()
         assert set(router.replicas()) == {"rA"}  # fresh clock: kept
-        router._hb_seen["rA"] = time.monotonic() - 1.0  # silent 1s
+        # Fall genuinely silent past the 0.2s liveness window. (The
+        # heap-based sweep assumes _hb_seen only moves forward, as it
+        # does in production — backdating the clock directly would
+        # bypass the expiry heap.)
+        time.sleep(0.35)
         monitor.tick()
         assert router.replicas() == {}
         assert (_metrics.value("hvd_serve_culled_total") or 0) \

@@ -1,0 +1,23 @@
+"""Fleet-at-cardinality harness (docs/fleet.md).
+
+Stands up 100-500-rank worlds on one box with STUB workers and
+replicas — jax-free threads that speak the real control-plane
+protocols (HTTP heartbeat PUTs against the rendezvous KV, replica
+registration/liveness against the serving router) without 500 OS
+processes or any accelerator — and drives them through elastic churn,
+reconnect storms and sustained request load. ``bench_fleet.py`` at the
+repo root is the CLI; it publishes the scaling curves (bootstrap time,
+driver cycle time, router pick cost, journal replay, KV PUT
+throughput, resident memory vs N) as ``BENCH_fleet.json``.
+
+Layout:
+
+- ``topology``: synthetic host topologies, the static discovery stub,
+  and the curve-extraction helpers (growth-exponent fits).
+- ``stub``: ``StubSlotProcess``/stub heartbeat workers and the
+  ``FleetDriver`` (an ``ElasticDriver`` whose ``_spawn_slot`` makes
+  threads, not processes).
+- ``rig``: the storm rigs — ``ElasticRig`` (driver plane: churn waves,
+  bootstrap, journal replay) and ``ServeRig`` (router plane: replica
+  herds, request load, reconnect storms).
+"""

@@ -1,0 +1,593 @@
+"""Storm rigs: elastic churn, reconnect herds, request load.
+
+``ElasticRig`` owns one ``FleetDriver`` world: bootstrap it, roll
+SIGKILL-shaped churn waves through it, storm the rendezvous KV with
+PUT fan-in, and read back the control-plane numbers (driver cycle
+time, journal size/replay, shed counts, resident memory).
+
+``ServeRig`` owns one serving plane: a ``Router`` with N stub replica
+identities mapped onto a few REAL identity backends (the jax-free
+``KVStoreServer`` answering ``POST /v1/predict``), client threads
+driving closed-loop request load, and reconnect storms (router
+restart from its journal + the whole herd re-beating at once).
+
+Both publish ``hvd_fleet_*`` gauges (docs/metrics.md) so a live
+``/metrics`` scrape of the harness shows the storm as it runs.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.runner.http_server import KVStoreServer, put_kv, \
+    write_kv
+from horovod_tpu.runner.journal import DriverJournal
+from horovod_tpu.serve.router import Router
+from horovod_tpu.utils import metrics as _metrics
+
+from tools.fleet.stub import FleetDriver
+from tools.fleet.topology import percentile
+
+_G_FLEET_WORKERS = _metrics.gauge(
+    "hvd_fleet_workers_live",
+    "Stub workers the fleet harness currently tracks as live "
+    "(tools/fleet; docs/fleet.md).")
+_C_FLEET_KILLS = _metrics.counter(
+    "hvd_fleet_churn_kills_total",
+    "SIGKILL-shaped churn events the fleet harness injected "
+    "(tools/fleet).")
+_C_FLEET_LOST = _metrics.counter(
+    "hvd_fleet_requests_lost_total",
+    "Fleet-harness predict requests that came back non-2xx or died on "
+    "a transport error — the zero-lost storm acceptance counter "
+    "(tools/fleet).")
+
+
+def rss_bytes() -> Optional[int]:
+    """Resident set size of THIS process (the whole stub fleet lives
+    in it) from /proc; None where /proc is absent."""
+    try:
+        with open("/proc/self/status", "r", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+class ElasticRig:
+    """One elastic control plane at stub cardinality."""
+
+    def __init__(self, n: int, slots_per_host: int = 8,
+                 beat_sec: float = 0.5, liveness_sec: float = 0.0,
+                 journal_dir: Optional[str] = None,
+                 poll_sec: float = 0.05,
+                 start_timeout: float = 120.0):
+        self.n = n
+        self.driver = FleetDriver(
+            n, slots_per_host=slots_per_host, beat_sec=beat_sec,
+            liveness_sec=liveness_sec, journal_dir=journal_dir,
+            poll_sec=poll_sec, start_timeout=start_timeout)
+        self.journal_dir = journal_dir
+        self._thread: Optional[threading.Thread] = None
+        self._rc: Optional[int] = None
+        self.bootstrap_sec: Optional[float] = None
+        self.kills = 0
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self, timeout: float = 120.0) -> float:
+        """Run the driver and block until the whole world is up
+        (version >= 1, all N slots spawned). Returns bootstrap
+        seconds."""
+        t0 = time.monotonic()
+
+        def _run():
+            self._rc = self.driver.run()
+
+        self._thread = threading.Thread(
+            target=_run, daemon=True, name="fleet-driver")
+        self._thread.start()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if self.driver.version >= 1 \
+                    and len(self.driver.procs) >= self.n:
+                self.bootstrap_sec = time.monotonic() - t0
+                _G_FLEET_WORKERS.set(len(self.driver.procs))
+                return self.bootstrap_sec
+            if self._rc is not None:
+                raise RuntimeError(
+                    "fleet driver exited rc=%s during bootstrap"
+                    % self._rc)
+            time.sleep(0.01)
+        raise RuntimeError(
+            "fleet bootstrap timed out at n=%d (%d/%d slots up)"
+            % (self.n, len(self.driver.procs), self.n))
+
+    def stop(self, timeout: float = 60.0) -> int:
+        """Graceful drain: every live stub exits 0, the driver reaps
+        them all as done and returns."""
+        self.driver.finish_all(0)
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                raise RuntimeError("fleet driver failed to drain")
+        _G_FLEET_WORKERS.set(0)
+        return self._rc if self._rc is not None else -1
+
+    # --- storms --------------------------------------------------------------
+
+    def churn_wave(self, fraction: float = 0.1,
+                   timeout: float = 60.0) -> float:
+        """Kill ``fraction`` of the live world (rc=1, SIGKILL shape)
+        and block until the driver has respawned back to full size at
+        a new rendezvous version. Returns the recovery seconds.
+
+        Victims rotate across the LEAST-killed slots so repeated waves
+        spread failures instead of marching one slot into the
+        MAX_SLOT_FAILURES blacklist."""
+        live = self.driver.live_stubs()
+        count = max(1, int(len(live) * fraction))
+        victims = sorted(
+            live,
+            key=lambda k: self.driver.fail_counts.get(k, 0))[:count]
+        want_version = self.driver.version + 1
+        t0 = time.monotonic()
+        for key in victims:
+            live[key].finish(1)
+            self.kills += 1
+            _C_FLEET_KILLS.inc()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if self.driver.version >= want_version \
+                    and len(self.driver.live_stubs()) >= self.n - \
+                    len(self.driver.host_manager.blacklist):
+                _G_FLEET_WORKERS.set(len(self.driver.procs))
+                return time.monotonic() - t0
+            time.sleep(0.01)
+        raise RuntimeError(
+            "churn wave at n=%d did not recover within %.0fs "
+            "(version %d want %d, live %d)"
+            % (self.n, timeout, self.driver.version, want_version,
+               len(self.driver.live_stubs())))
+
+    def kv_put_storm(self, threads: int = 32,
+                     duration: float = 2.0) -> Dict[str, float]:
+        """Closed-loop PUT fan-in against the rendezvous KV from
+        ``threads`` clients for ``duration`` seconds: the heartbeat
+        storm distilled. Returns throughput plus the shed/deferral
+        picture (bounded server: sheds are typed 503s, not stalls)."""
+        port = self.driver.rendezvous.port
+        stop = time.monotonic() + duration
+        ok = [0] * threads
+        shed = [0] * threads
+        errors = [0] * threads
+
+        def _client(i: int):
+            while time.monotonic() < stop:
+                try:
+                    status, _ = put_kv(
+                        "127.0.0.1", port, "storm", "k%d" % i,
+                        b'{"storm": 1}', timeout=5.0)
+                except OSError:
+                    errors[i] += 1
+                    continue
+                if status == 503:
+                    shed[i] += 1
+                elif status == 200:
+                    ok[i] += 1
+                else:
+                    errors[i] += 1
+
+        workers = [threading.Thread(target=_client, args=(i,),
+                                    daemon=True)
+                   for i in range(threads)]
+        t0 = time.monotonic()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=duration + 30.0)
+        elapsed = max(1e-6, time.monotonic() - t0)
+        self.driver.rendezvous.clear_scope("storm")
+        return {
+            "threads": threads,
+            "duration_sec": round(elapsed, 3),
+            "puts_ok": sum(ok),
+            "puts_shed": sum(shed),
+            "put_errors": sum(errors),
+            "puts_per_sec": round(sum(ok) / elapsed, 1),
+        }
+
+    # --- readouts ------------------------------------------------------------
+
+    def cycle_stats(self) -> Dict[str, Optional[float]]:
+        times = self.driver.cycle_times_ms
+        return {
+            "cycles": len(times),
+            "mean_ms": (round(sum(times) / len(times), 3)
+                        if times else None),
+            "p99_ms": (round(percentile(times, 99), 3)
+                       if times else None),
+        }
+
+    def journal_stats(self) -> Dict[str, Optional[float]]:
+        """Size and replay cost of the driver journal as it stands —
+        the bounded-replay acceptance numbers."""
+        if not self.journal_dir:
+            return {}
+        from horovod_tpu.runner.journal import journal_path
+
+        path = journal_path(self.journal_dir)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return {}
+        with open(path, "r", encoding="utf-8") as fh:
+            records = sum(1 for _ in fh)
+        t0 = time.monotonic()
+        replayed = DriverJournal.replay(
+            path, self.driver.MAX_SLOT_FAILURES)
+        replay_ms = (time.monotonic() - t0) * 1000.0
+        return {
+            "bytes": size,
+            "records": records,
+            "replay_ms": round(replay_ms, 3),
+            "replayed_version": (replayed.version
+                                 if replayed is not None else None),
+        }
+
+
+class _IdentityBackend:
+    """One real jax-free predict backend: echoes the request body back
+    with 200 (the identity model's serving contract), counting
+    requests so the rigs can prove traffic actually flowed."""
+
+    def __init__(self):
+        self.server = KVStoreServer(port=0)
+        self.requests = 0
+        self._lock = threading.Lock()
+        self.server.register_post_route("/v1/predict", self._predict)
+
+    def _predict(self, body: bytes):
+        with self._lock:
+            self.requests += 1
+        return (200, "application/json", body or b"{}")
+
+    def start(self) -> int:
+        return self.server.start()
+
+    def stop(self):
+        self.server.stop()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+
+class StubReplicaHerd:
+    """N replica identities beating against one router, mapped onto K
+    real backends round-robin. Each identity gets its own heartbeat
+    thread (real HTTP PUTs carrying the endpoint payload, so cull ->
+    re-admission works exactly as in production)."""
+
+    def __init__(self, router_port: int, n: int,
+                 backend_ports: List[int], beat_sec: float = 0.5):
+        self.router_port = router_port
+        self.n = n
+        self.beat_sec = beat_sec
+        self.backend_ports = backend_ports
+        self._stops: Dict[str, threading.Event] = {}
+        self._threads: List[threading.Thread] = []
+
+    def rid(self, i: int) -> str:
+        return "fleet-r%04d" % i
+
+    def info(self, i: int) -> dict:
+        port = self.backend_ports[i % len(self.backend_ports)]
+        return {"addr": "127.0.0.1", "port": port,
+                "pid": 200000 + i, "model": "identity"}
+
+    def register_all(self) -> float:
+        """The registration herd: every identity PUTs ``replica/<id>``
+        (real HTTP) as fast as the box allows. Returns seconds until
+        all N were accepted."""
+        t0 = time.monotonic()
+        for i in range(self.n):
+            write_kv("127.0.0.1", self.router_port, "replica",
+                     self.rid(i), json.dumps(self.info(i)).encode(),
+                     timeout=10.0)
+        return time.monotonic() - t0
+
+    def start_beats(self):
+        import random
+
+        def _loop(i: int, stop: threading.Event):
+            if stop.wait(random.uniform(0.0, self.beat_sec)):
+                return
+            payload = json.dumps(
+                dict(self.info(i), ts=time.time())).encode()
+            while not stop.is_set():
+                delay = self.beat_sec
+                try:
+                    status, retry_after = put_kv(
+                        "127.0.0.1", self.router_port, "heartbeat",
+                        self.rid(i), payload, timeout=5.0)
+                    if status == 503 and retry_after > 0:
+                        delay = min(self.beat_sec,
+                                    retry_after
+                                    * random.uniform(1.0, 2.0))
+                except OSError:
+                    pass  # router restarting; next beat re-admits
+                if stop.wait(delay):
+                    return
+
+        for i in range(self.n):
+            stop = threading.Event()
+            self._stops[self.rid(i)] = stop
+            t = threading.Thread(target=_loop, args=(i, stop),
+                                 daemon=True,
+                                 name="fleet-replica-%d" % i)
+            self._threads.append(t)
+            t.start()
+
+    def silence(self, rids: List[str]):
+        """Stop the named identities' beats (replica death shape)."""
+        for rid in rids:
+            stop = self._stops.get(rid)
+            if stop is not None:
+                stop.set()
+
+    def stop(self):
+        for stop in self._stops.values():
+            stop.set()
+
+
+class ServeRig:
+    """One serving plane at stub-replica cardinality."""
+
+    def __init__(self, n: int, backends: int = 4,
+                 journal_dir: Optional[str] = None,
+                 liveness_sec: float = 0.0,
+                 beat_sec: float = 0.5, monitor: bool = False):
+        self.n = n
+        self.journal_dir = journal_dir
+        self.liveness_sec = liveness_sec
+        self.monitor = monitor
+        self.backends = [_IdentityBackend() for _ in range(backends)]
+        self.beat_sec = beat_sec
+        self.router: Optional[Router] = None
+        self.herd: Optional[StubReplicaHerd] = None
+        self.lost = 0
+
+    def start(self) -> Tuple[float, float]:
+        """Stand the plane up. Returns (registration herd seconds,
+        total bootstrap seconds)."""
+        t0 = time.monotonic()
+        ports = [b.start() for b in self.backends]
+        self.router = Router(port=0, journal_dir=self.journal_dir,
+                             liveness_sec=self.liveness_sec,
+                             monitor=self.monitor)
+        router_port = self.router.start()
+        self.herd = StubReplicaHerd(router_port, self.n, ports,
+                                    beat_sec=self.beat_sec)
+        reg_sec = self.herd.register_all()
+        if self.beat_sec > 0:
+            self.herd.start_beats()
+        return reg_sec, time.monotonic() - t0
+
+    def restart_router(self) -> Dict[str, float]:
+        """The reconnect storm: SIGKILL-shaped router restart (no
+        graceful cull) + journal replay + the whole herd re-beating.
+        Returns replay time and seconds until the table is full
+        again."""
+        assert self.router is not None and self.herd is not None
+        old = self.router
+        old_port = old.port
+        old.stop()
+        t0 = time.monotonic()
+        # Same-port restart (the production shape: clients keep the
+        # one address they know); SO_REUSEADDR makes the rebind
+        # race-free against TIME_WAIT.
+        self.router = Router(port=old_port,
+                             journal_dir=self.journal_dir,
+                             liveness_sec=self.liveness_sec,
+                             monitor=self.monitor)
+        replay_ms = (time.monotonic() - t0) * 1000.0
+        replayed = self.router._replayed
+        router_port = self.router.start()
+        self.herd.stop()
+        self.herd = StubReplicaHerd(router_port, self.n,
+                                    [b.port for b in self.backends],
+                                    beat_sec=self.beat_sec)
+        reg_sec = self.herd.register_all()
+        if self.beat_sec > 0:
+            self.herd.start_beats()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if self.router.stats()["replicas"] >= self.n:
+                break
+            time.sleep(0.01)
+        return {
+            "replay_ms": round(replay_ms, 3),
+            "replayed": replayed,
+            "reregister_sec": round(reg_sec, 3),
+            "recover_sec": round(time.monotonic() - t0, 3),
+        }
+
+    def load(self, clients: int = 8, requests_per_client: int = 50,
+             body: bytes = b'{"inputs": [1, 2, 3]}',
+             request_deadline: float = 30.0) -> Dict[str, object]:
+        """Closed-loop predict load. A transport error retries (with
+        backoff, against the CURRENT router port — the router may be
+        mid-restart) until ``request_deadline``; a request is LOST
+        only when the deadline exhausts or the router answers an
+        error status. The storm acceptance is zero lost."""
+        assert self.router is not None
+        lats: List[List[float]] = [[] for _ in range(clients)]
+        lost = [0] * clients
+        retries = [0] * clients
+
+        def _one(i: int) -> int:
+            t0 = time.monotonic()
+            backoff = 0.05
+            while True:
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", self.router.port, timeout=30.0)
+                    try:
+                        conn.request(
+                            "POST", "/v1/predict", body=body,
+                            headers={"Content-Type":
+                                     "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        return resp.status
+                    finally:
+                        conn.close()
+                except (OSError, http.client.HTTPException):
+                    if time.monotonic() - t0 > request_deadline:
+                        return -1
+                    retries[i] += 1
+                    time.sleep(backoff)
+                    backoff = min(0.5, backoff * 2)
+
+        def _client(i: int):
+            for _ in range(requests_per_client):
+                t0 = time.monotonic()
+                status = _one(i)
+                if 200 <= status < 300:
+                    lats[i].append(
+                        (time.monotonic() - t0) * 1000.0)
+                else:
+                    lost[i] += 1
+                    _C_FLEET_LOST.inc()
+
+        threads = [threading.Thread(target=_client, args=(i,),
+                                    daemon=True)
+                   for i in range(clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600.0)
+        elapsed = max(1e-6, time.monotonic() - t0)
+        flat = [x for per in lats for x in per]
+        self.lost += sum(lost)
+        return {
+            "clients": clients,
+            "requests": clients * requests_per_client,
+            "ok": len(flat),
+            "lost": sum(lost),
+            "transport_retries": sum(retries),
+            "qps": round(len(flat) / elapsed, 1),
+            "p50_ms": (round(percentile(flat, 50), 3)
+                       if flat else None),
+            "p99_ms": (round(percentile(flat, 99), 3)
+                       if flat else None),
+        }
+
+    def stop(self):
+        if self.herd is not None:
+            self.herd.stop()
+        if self.router is not None:
+            self.router.stop()
+        for b in self.backends:
+            b.stop()
+
+
+def pick_microbench(n: int, picks: int = 2000) -> Dict[str, float]:
+    """Offline router pick cost, new vs legacy, at table size n — the
+    before/after half of the O(N) fix. No sockets: the Router is
+    built unstarted and fed directly."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        router = Router(port=0, journal_dir=td, monitor=False)
+        try:
+            for i in range(n):
+                router.admit("fleet-r%04d" % i,
+                             {"addr": "127.0.0.1", "port": 1,
+                              "pid": i, "model": "identity"})
+            empty = set()
+            router.pick_scan_steps = 0
+            t0 = time.monotonic()
+            for _ in range(picks):
+                router._pick(empty)
+            new_us = (time.monotonic() - t0) * 1e6 / picks
+            new_steps = router.pick_scan_steps / picks
+            router.pick_scan_steps = 0
+            t0 = time.monotonic()
+            for _ in range(picks):
+                router._pick_legacy(empty)
+            legacy_us = (time.monotonic() - t0) * 1e6 / picks
+            legacy_steps = router.pick_scan_steps / picks
+        finally:
+            router.stop()
+    return {
+        "n": n,
+        "picks": picks,
+        "new_us_per_pick": round(new_us, 3),
+        "legacy_us_per_pick": round(legacy_us, 3),
+        "new_steps_per_pick": round(new_steps, 3),
+        "legacy_steps_per_pick": round(legacy_steps, 3),
+    }
+
+
+def journal_replay_bench(n: int, events: int,
+                         snapshot_every: int) -> Dict[str, float]:
+    """Bounded-replay before/after: synthesize ``events`` churn
+    records for an n-rank world into a DriverJournal with the given
+    compaction cadence (0 = legacy unbounded), then measure replay.
+    Each rendezvous record carries O(n) assignments — exactly the
+    O(events x n) replay the snapshot bounds."""
+    import tempfile
+
+    from horovod_tpu.runner.journal import journal_path
+
+    assignments = {"fleet-h%d:%d" % (i // 8, i % 8):
+                   "%d,%d,0,1,0,1" % (i, n) for i in range(n)}
+    with tempfile.TemporaryDirectory() as td:
+        path = journal_path(td)
+        journal = DriverJournal(path)
+        try:
+            for e in range(events):
+                version = e + 1
+                journal.append({
+                    "type": "rendezvous", "version": version,
+                    "assignments": assignments, "size": n,
+                    "blacklist": [], "fail_counts": {},
+                    "done": [], "ts": float(e)})
+                if snapshot_every > 0 and \
+                        journal.records_since_snapshot >= snapshot_every:
+                    journal.compact({
+                        "version": version, "blacklist": [],
+                        "fail_counts": {}, "done": [],
+                        "ts": float(e)})
+                journal.append({
+                    "type": "exit",
+                    "slot": "fleet-h0:%d" % (e % 8),
+                    "rc": 1, "ts": float(e)})
+        finally:
+            journal.close()
+        size = os.path.getsize(path)
+        with open(path, "r", encoding="utf-8") as fh:
+            records = sum(1 for _ in fh)
+        t0 = time.monotonic()
+        replayed = DriverJournal.replay(path, 3)
+        replay_ms = (time.monotonic() - t0) * 1000.0
+    return {
+        "n": n,
+        "events": events,
+        "snapshot_every": snapshot_every,
+        "journal_bytes": size,
+        "journal_records": records,
+        "replay_ms": round(replay_ms, 3),
+        "replayed_version": (replayed.version
+                             if replayed is not None else None),
+    }

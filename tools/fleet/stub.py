@@ -1,0 +1,212 @@
+"""Stub workers and the thread-spawning elastic driver.
+
+``StubSlotProcess`` duck-types ``runner.exec_util.SlotProcess`` for the
+driver's reap/terminate surface (``poll``/``wait``/``terminate``,
+``rank``, ``is_remote``) but backs it with a daemon thread that speaks
+the REAL worker liveness protocol: HTTP heartbeat PUTs against the
+driver's rendezvous KV every beat (random initial phase, version-fenced
+payloads, Retry-After deferral on a 503 shed) — so 500 of them exercise
+the same control-plane hotpaths 500 real workers would, without 500
+processes or any accelerator.
+
+Fault injection the rigs use:
+
+- ``finish(rc)``: the worker "exits" with ``rc`` (beats stop, ``poll``
+  reports the code) — ``rc != 0`` is the SIGKILL-shaped churn event;
+- ``wedge()``: beats stop but ``poll`` stays None — the SIGSTOP shape
+  the liveness monitor must catch.
+
+``FleetDriver`` subclasses ``ElasticDriver``: discovery is swapped for
+an in-memory ``StaticDiscovery`` and ``_spawn_slot`` returns stubs.
+Everything else — rendezvous KV, journaling, wedge detection, failure
+bookkeeping, blacklist — is the production code under test.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner.discovery import HostManager
+from horovod_tpu.runner.elastic_run import ElasticDriver
+from horovod_tpu.runner.hosts import HostInfo
+from horovod_tpu.runner.http_server import put_kv
+
+from tools.fleet.topology import StaticDiscovery, build_topology
+
+
+class StubSlotProcess:
+    """One in-process stand-in worker: a heartbeat thread plus the
+    ``SlotProcess`` lifecycle surface the elastic driver drives."""
+
+    is_remote = False
+
+    def __init__(self, key: str, rank: int, version: int,
+                 kv_port: int, beat_sec: float):
+        self.key = key
+        self.rank = rank
+        self.version = version
+        self.kv_port = kv_port
+        self.beat_sec = beat_sec
+        self.polls = 0              # O(N)-guard instrumentation
+        self.beats_sent = 0
+        self.beats_deferred = 0
+        self._rc: Optional[int] = None
+        self._rc_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if beat_sec > 0:
+            self._thread = threading.Thread(
+                target=self._beat_loop, daemon=True,
+                name="fleet-stub-%s" % key)
+            self._thread.start()
+
+    # --- the worker side: real heartbeat PUTs --------------------------------
+
+    def _beat_loop(self):
+        # Phase jitter, same discipline as elastic/worker.py: N workers
+        # spawned by one reset must not beat in lockstep forever.
+        if self._stop.wait(random.uniform(0.0, self.beat_sec)):
+            return
+        while not self._stop.is_set():
+            payload = ('{"pid": %d, "version": %d, "ts": %.3f}'
+                       % (100000 + self.rank, self.version,
+                          time.time())).encode()
+            delay = self.beat_sec
+            try:
+                status, retry_after = put_kv(
+                    "127.0.0.1", self.kv_port, "heartbeat", self.key,
+                    payload, timeout=5.0)
+                self.beats_sent += 1
+                if status == 503 and retry_after > 0:
+                    self.beats_deferred += 1
+                    delay = min(self.beat_sec,
+                                retry_after * random.uniform(1.0, 2.0))
+            except OSError:
+                pass  # KV restarting mid-storm; next beat retries
+            if self._stop.wait(delay):
+                return
+
+    # --- the driver side: SlotProcess surface --------------------------------
+
+    def poll(self) -> Optional[int]:
+        self.polls += 1
+        with self._rc_lock:
+            return self._rc
+
+    def wait(self) -> Optional[int]:
+        with self._rc_lock:
+            return self._rc
+
+    def terminate(self, grace_sec: float = None):
+        self._stop.set()
+        with self._rc_lock:
+            if self._rc is None:
+                self._rc = -15
+
+    # --- fault injection ------------------------------------------------------
+
+    def finish(self, rc: int = 0):
+        """Worker exit: beats stop, the driver reaps ``rc``."""
+        self._stop.set()
+        with self._rc_lock:
+            if self._rc is None:
+                self._rc = rc
+
+    def wedge(self):
+        """SIGSTOP shape: the process looks alive (poll None) but the
+        beats stop — only the liveness monitor can catch this."""
+        self._stop.set()
+
+
+class _FleetArgs:
+    """The argparse-shaped namespace ``ElasticDriver`` expects, with
+    fleet defaults (no SSH, no tuning flags, in-memory discovery swaps
+    in right after construction)."""
+
+    def __init__(self, n: int, journal_dir: Optional[str],
+                 start_timeout: float):
+        self.discovery_script = "<fleet-static>"  # replaced post-init
+        self.slots_per_host = 1
+        self.np = n
+        self.min_np = 1      # storms shrink the world; never stall on it
+        self.max_np = n
+        self.command = ["<fleet-stub>"]
+        self.start_timeout = start_timeout
+        self.elastic_timeout = start_timeout
+        self.reset_limit = 0
+        self.journal_dir = journal_dir
+        self.platform = "cpu"
+
+    def __getattr__(self, name):
+        # Every optional launcher flag (_tuning_env reads ~25 of them)
+        # reads as unset. Raising for dunders keeps pickling/copy sane.
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return None
+
+
+class FleetDriver(ElasticDriver):
+    """ElasticDriver at stub cardinality: thread workers, in-memory
+    discovery, per-cycle timing capture for the scaling curves."""
+
+    def __init__(self, n: int, slots_per_host: int = 8,
+                 beat_sec: float = 0.5,
+                 liveness_sec: float = 0.0,
+                 journal_dir: Optional[str] = None,
+                 poll_sec: float = 0.05,
+                 start_timeout: float = 60.0,
+                 hosts: Optional[List[HostInfo]] = None):
+        super().__init__(_FleetArgs(n, journal_dir, start_timeout))
+        self.discovery = StaticDiscovery(
+            hosts if hosts is not None
+            else build_topology(n, slots_per_host))
+        self.host_manager = HostManager(self.discovery)
+        self.beat_sec = beat_sec
+        # Fleet overrides of the env-tuned policies: no failure-reset
+        # backoff (storm waves must re-rendezvous immediately), caller-
+        # chosen liveness, a tight poll so churn turnaround measures
+        # the control plane rather than the sleep.
+        self.POLL_SEC = poll_sec
+        self.backoff_base = 0.0
+        self.backoff_max = 0.0
+        self.liveness_sec = liveness_sec
+        self.stubs: Dict[str, StubSlotProcess] = {}
+        self.cycle_times_ms: List[float] = []
+        self.reset_times_ms: List[float] = []
+        self.spawned = 0
+
+    def _spawn_slot(self, key, a, env):
+        stub = StubSlotProcess(
+            key, a.rank, self.version, self.rendezvous.port,
+            self.beat_sec)
+        self.stubs[key] = stub
+        self.spawned += 1
+        return stub
+
+    def _cycle(self):
+        t0 = time.monotonic()
+        out = super()._cycle()
+        self.cycle_times_ms.append((time.monotonic() - t0) * 1000.0)
+        return out
+
+    def _reset(self):
+        t0 = time.monotonic()
+        out = super()._reset()
+        self.reset_times_ms.append((time.monotonic() - t0) * 1000.0)
+        return out
+
+    # --- harness controls -----------------------------------------------------
+
+    def live_stubs(self) -> Dict[str, StubSlotProcess]:
+        """Stubs the driver currently tracks as running."""
+        return {k: s for k, s in self.stubs.items()
+                if k in self.procs and s.poll() is None}
+
+    def finish_all(self, rc: int = 0):
+        for key in list(self.procs):
+            stub = self.stubs.get(key)
+            if stub is not None:
+                stub.finish(rc)

@@ -1,0 +1,115 @@
+"""Synthetic fleet topologies and scaling-curve extraction.
+
+The topology builder maps N ranks onto a plausible host layout
+(``slots_per_host`` ranks per synthetic host, hosts named ``fleet-h<i>``)
+so slot keys, host grouping and blacklist semantics exercise the same
+code paths a real multi-host world does. ``StaticDiscovery`` duck-types
+``runner.discovery.HostDiscoveryScript`` (only
+``find_available_hosts()`` is called through ``HostManager``) with an
+in-memory host list the rigs can shrink/grow to simulate hosts leaving
+and re-entering discovery.
+
+Curve extraction: each measured quantity vs N is summarized with a
+log-log least-squares growth exponent (``exponent``: ~1 linear, ~2
+quadratic) so BENCH_fleet.json carries the verdict, not just points.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from horovod_tpu.runner.hosts import HostInfo
+
+
+def build_topology(n: int, slots_per_host: int = 8) -> List[HostInfo]:
+    """N ranks packed onto ceil(N / slots_per_host) synthetic hosts;
+    the last host carries the remainder."""
+    if n <= 0:
+        raise ValueError("fleet size must be positive, got %d" % n)
+    if slots_per_host <= 0:
+        raise ValueError("slots_per_host must be positive, got %d"
+                         % slots_per_host)
+    hosts = []
+    remaining = n
+    i = 0
+    while remaining > 0:
+        slots = min(slots_per_host, remaining)
+        hosts.append(HostInfo("fleet-h%d" % i, slots))
+        remaining -= slots
+        i += 1
+    return hosts
+
+
+def slot_keys(hosts: Sequence[HostInfo]) -> List[str]:
+    """The host:slot keys a topology exposes, in host order (the same
+    order ``HostManager.available_slot_keys`` yields)."""
+    keys = []
+    for h in hosts:
+        for s in range(h.slots):
+            keys.append("%s:%d" % (h.hostname, s))
+    return keys
+
+
+class StaticDiscovery:
+    """In-memory stand-in for ``HostDiscoveryScript``: the rigs mutate
+    ``hosts`` to simulate discovery changes (host loss, re-entry)
+    without forking a script per refresh."""
+
+    def __init__(self, hosts: Sequence[HostInfo]):
+        self.hosts: List[HostInfo] = list(hosts)
+        self.refreshes = 0
+
+    def find_available_hosts(self) -> List[HostInfo]:
+        self.refreshes += 1
+        return list(self.hosts)
+
+
+def fit_growth_exponent(
+        points: Sequence[Tuple[float, float]]) -> Optional[float]:
+    """Least-squares slope of log(y) vs log(x): the growth exponent of
+    y ~ x^k over the measured sizes. None when fewer than two usable
+    (positive) points exist — a flat/zero-cost curve has no exponent."""
+    logs = [(math.log(x), math.log(y))
+            for x, y in points if x > 0 and y > 0]
+    if len(logs) < 2:
+        return None
+    mx = sum(lx for lx, _ in logs) / len(logs)
+    my = sum(ly for _, ly in logs) / len(logs)
+    denom = sum((lx - mx) ** 2 for lx, _ in logs)
+    if denom == 0:
+        return None
+    slope = sum((lx - mx) * (ly - my) for lx, ly in logs) / denom
+    return slope
+
+
+def curve(sizes: Sequence[int], values: Sequence[float],
+          unit: str) -> Dict[str, object]:
+    """One BENCH_fleet.json curve: points plus the fitted growth
+    exponent. ``values[i]`` is the measurement at ``sizes[i]``."""
+    if len(sizes) != len(values):
+        raise ValueError("curve arity mismatch: %d sizes, %d values"
+                         % (len(sizes), len(values)))
+    pts = [{"n": int(n), "value": float(v)}
+           for n, v in zip(sizes, values)]
+    exp = fit_growth_exponent([(float(n), float(v))
+                               for n, v in zip(sizes, values)])
+    return {
+        "unit": unit,
+        "points": pts,
+        "growth_exponent": None if exp is None else round(exp, 3),
+    }
+
+
+def percentile(samples: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]); None on no samples."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    if q <= 0:
+        return ordered[0]
+    if q >= 100:
+        return ordered[-1]
+    rank = max(0, min(len(ordered) - 1,
+                      int(math.ceil(q / 100.0 * len(ordered))) - 1))
+    return ordered[rank]

@@ -29,7 +29,8 @@ run_analysis() {
     # this loop just guarantees the attribution shows up as the LAST
     # lane header even if the combined run is skipped or wrapped.
     for checker in knobs counters ctypes metrics excepts \
-                   locks journal jaxcompat testtier spmd; do
+                   locks journal jaxcompat testtier spmd \
+                   deadlock blocking; do
         echo "--- checker: $checker"
         timeout 60 python -m tools.analysis --checker "$checker"
     done

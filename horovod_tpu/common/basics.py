@@ -199,6 +199,11 @@ def init(process_sets=None):
                     negotiate_controller_port,
                 )
 
+                # analysis: blocking-ok(once-per-process bootstrap:
+                # init() must be atomic under _ctx.lock — a second
+                # thread calling init()/shutdown() mid-negotiation has
+                # to wait for a fully built core either way, and the
+                # rendezvous poll IS the init work)
                 negotiate_controller_port(_ctx.topology.rank)
             _ctx.core = CoreSession.start(_ctx.topology)
         _ctx.generation += 1

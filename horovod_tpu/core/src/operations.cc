@@ -847,22 +847,39 @@ void BackgroundLoop() {
       }
     }
     {
-      std::lock_guard<std::mutex> lk(g->ps_mutex);
-      for (int dead : g->pending_removals) {
-        auto it = g->process_sets.find(dead);
-        if (it != g->process_sets.end()) {
-          it->second->queue.AbortAll(
-              Status::Aborted("process set removed"));
-          g->process_sets.erase(it);
+      // Snapshot-then-act: move the dead sets OUT under ps_mutex,
+      // abort them after it is released. AbortAll fires the enqueuers'
+      // done callbacks (the ctypes trampoline — arbitrary Python that
+      // may call right back into hvd_core_enqueue, which takes
+      // ps_mutex); firing them under ps_mutex is a self-deadlock on a
+      // non-recursive mutex.
+      std::vector<std::unique_ptr<ProcessSetState>> dead_sets;
+      {
+        std::lock_guard<std::mutex> lk(g->ps_mutex);
+        for (int dead : g->pending_removals) {
+          auto it = g->process_sets.find(dead);
+          if (it != g->process_sets.end()) {
+            dead_sets.push_back(std::move(it->second));
+            g->process_sets.erase(it);
+          }
         }
+        g->pending_removals.clear();
       }
-      g->pending_removals.clear();
+      for (auto& ps : dead_sets)
+        ps->queue.AbortAll(Status::Aborted("process set removed"));
     }
   }
-  // Drain: fail anything still pending.
-  std::lock_guard<std::mutex> lk(g->ps_mutex);
-  for (auto& kv : g->process_sets)
-    kv.second->queue.AbortAll(Status::Aborted("horovod_tpu core shut down"));
+  // Drain: fail anything still pending (outside ps_mutex, same
+  // callback-reentrancy hazard as above).
+  std::vector<std::unique_ptr<ProcessSetState>> remaining;
+  {
+    std::lock_guard<std::mutex> lk(g->ps_mutex);
+    for (auto& kv : g->process_sets)
+      remaining.push_back(std::move(kv.second));
+    g->process_sets.clear();
+  }
+  for (auto& ps : remaining)
+    ps->queue.AbortAll(Status::Aborted("horovod_tpu core shut down"));
 }
 
 }  // namespace
@@ -908,6 +925,11 @@ int hvd_core_init(int rank, int size, const char* ctrl_addr, int ctrl_port,
   if (fusion_bytes > 0) g->fusion_bytes = fusion_bytes;
   if (cache_cap >= 0) g->cache_cap = cache_cap;
 
+  // analysis: blocking-ok(init-time bootstrap: the socket dial/accept
+  // must complete under g_wire_params_mutex — releasing it earlier
+  // would let the tuner thread walk fds_ mid-reallocation. Nothing
+  // else contends: the only other taker is set_wire_params, which is
+  // exactly the caller being excluded)
   Status s = g->comm.Init(rank, size, ctrl_addr ? ctrl_addr : "127.0.0.1",
                           ctrl_port);
   if (!s.ok()) {
@@ -945,11 +967,18 @@ void hvd_core_shutdown() {
   // join cannot deadlock.
   std::lock_guard<std::mutex> lk(g_wire_params_mutex);
   if (!g) return;
+  // analysis: blocking-ok(teardown: the writer-thread join inside
+  // timeline_stop and the background join below must both complete
+  // under g_wire_params_mutex so a concurrent set_wire_params cannot
+  // touch the comm being closed; neither joined thread ever takes
+  // this mutex, so the join cannot deadlock)
   hvd_core_timeline_stop();
   g->shut_down.store(true);
   // Unblock the background thread if it is parked in a socket op (e.g. a
   // peer died mid-negotiation) so the join below cannot deadlock.
   g->comm.Abort();
+  // analysis: blocking-ok(see teardown note above — the background
+  // thread never takes g_wire_params_mutex)
   if (g->background.joinable()) g->background.join();
   g->comm.Close();
   delete g;

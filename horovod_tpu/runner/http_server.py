@@ -207,6 +207,12 @@ class _KVHandler(BaseHTTPRequestHandler):
             # here means consumers (driver heartbeat stamping, serve
             # router admission journaling) need no locking of their own.
             with self.server.callback_lock:  # type: ignore[attr-defined]
+                # analysis: blocking-ok(callback_lock IS the
+                # serialization contract — it exists to run exactly
+                # this callback one thread at a time, and handler
+                # threads are the only takers. Consumers must keep the
+                # callback short; the blocking checker audits what
+                # they do inside it)
                 callback(scope, key, value)
         self.send_response(200)
         self.send_header("Content-Length", "0")

@@ -171,6 +171,11 @@ class DriverJournal:
                 return
             self._fh.write(json.dumps(record, sort_keys=True) + "\n")
             self._fh.flush()
+            # analysis: blocking-ok(_append_lock EXISTS to serialize
+            # this fsync'd write — record ordering on disk is the
+            # journal's whole contract. Owners must not call append
+            # while holding their own hot-path locks; the blocking
+            # checker holds them to that at their call sites)
             os.fsync(self._fh.fileno())
             self.records_since_snapshot += 1
 
@@ -201,12 +206,18 @@ class DriverJournal:
             with open(tmp, "w", encoding="utf-8") as fh:
                 fh.write(json.dumps(rec, sort_keys=True) + "\n")
                 fh.flush()
+                # analysis: blocking-ok(the atomic-replace fold must
+                # be serialized against appends — _append_lock is the
+                # journal's own serialization lock, see append())
                 os.fsync(fh.fileno())
             os.replace(tmp, self.path)
             parent = os.path.dirname(os.path.abspath(self.path))
             try:
                 dfd = os.open(parent, os.O_RDONLY)
                 try:
+                    # analysis: blocking-ok(directory-entry durability
+                    # for the rename, under the journal's own
+                    # serialization lock — see append())
                     os.fsync(dfd)
                 finally:
                     os.close(dfd)

@@ -136,6 +136,14 @@ class Router:
             liveness_sec = float_env("HOROVOD_WORKER_LIVENESS_SEC", 30.0)
         self.liveness_sec = float(liveness_sec)
         self._lock = threading.RLock()
+        # Membership-transition lock: admit()/cull()/stop() serialize
+        # here for the journal append -> table effect -> compaction
+        # sequence, so the fsync'd journal writes happen OUTSIDE _lock
+        # and the request/heartbeat paths (which take only _lock) keep
+        # flowing while a record hits disk. Always acquired BEFORE
+        # _lock, never inside it:
+        # analysis: lock-order(_journal_lock before _lock)
+        self._journal_lock = threading.Lock()
         self._table: Dict[str, dict] = {}
         self._order: List[str] = []
         self._rr = 0
@@ -293,52 +301,76 @@ class Router:
                 heapq.heappush(self._hb_heap,
                                (now + self.liveness_sec, rid))
 
-    def _maybe_compact_locked(self):
-        """(lock held) Fold the serve journal down to one snapshot of
-        the current table once the tail exceeds the cadence. Called
-        only AFTER an append's effect is applied, so the snapshot can
-        never miss an event it just erased (append-before-effect is
-        preserved: the snapshot IS the effect)."""
-        # analysis: holds-lock(_lock) — only admit()/cull() call this,
-        # at the end of their locked blocks.
-        if (self._journal is None or self.snapshot_every <= 0
-                or self._journal.records_since_snapshot
+    def _maybe_compact(self):
+        """(journal lock held, _lock NOT held) Fold the serve journal
+        down to one snapshot of the current table once the tail
+        exceeds the cadence. Called only AFTER an append's effect is
+        applied, and membership cannot move while _journal_lock is
+        held, so the _lock-scoped snapshot can never miss an event it
+        just erased (append-before-effect is preserved: the snapshot
+        IS the effect)."""
+        # analysis: holds-lock(_journal_lock) — only admit()/cull()
+        # call this, after their effect commits.
+        journal = self._journal
+        if (journal is None or self.snapshot_every <= 0
+                or journal.records_since_snapshot
                 < self.snapshot_every):
             return
-        self._journal.compact({
-            "table": {rid: dict(e) for rid, e in self._table.items()},
-            "ts": time.time(),
-        })
+        with self._lock:
+            table = {rid: dict(e) for rid, e in self._table.items()}
+        # analysis: blocking-ok(fsync'd fold under the dedicated
+        # journal lock; the hot paths take only _lock and keep
+        # flowing while the snapshot hits disk)
+        journal.compact({"table": table, "ts": time.time()})
 
     def admit(self, replica_id: str, info: dict):
         """Add (or update) a replica; journaled before it takes effect
         so a router restart cannot forget a member it already routed
-        to."""
+        to. The fsync'd append runs under _journal_lock but OUTSIDE
+        _lock — the no-op heartbeat fast path below never even takes
+        the journal lock, and the request paths never wait on a disk
+        write (the blocking-under-lock fix,
+        docs/static_analysis.md#blocking)."""
         entry = {k: info.get(k) for k in ("addr", "port", "pid", "model")}
         with self._lock:
-            known = self._table.get(replica_id)
-            if known == entry:
+            # Fast path: an unchanged endpoint (every steady-state
+            # heartbeat) is a liveness stamp, nothing more.
+            if self._table.get(replica_id) == entry:
                 self._hb_stamp_new(replica_id)
                 return
-            if self._journal is not None:
+        with self._journal_lock:
+            with self._lock:
+                # Re-check: another admit/cull may have won the race
+                # for the journal lock and already applied this entry.
+                if self._table.get(replica_id) == entry:
+                    self._hb_stamp_new(replica_id)
+                    return
+                journal = self._journal
+            if journal is not None:
                 rec = dict(entry)
                 rec.update({"type": "replica", "id": replica_id,
                             "ts": time.time()})
-                self._journal.append(rec)
-            self._table[replica_id] = entry
-            if replica_id not in self._order:
-                self._order.append(replica_id)
-            self._hb_stamp_new(replica_id)
-            # (Re-)admission closes the breaker: a culled-then-
-            # rediscovered replica, or one respawned on a new endpoint,
-            # starts with a clean failure budget (the PR 8 heartbeat
-            # re-admission path lands here).
-            self._fail_count.pop(replica_id, None)
-            self._cooling_until.pop(replica_id, None)
-            self._trip_streak.pop(replica_id, None)
-            self._rotation_add(replica_id)
-            _G_COOLING.set(len(self._cooling_until))
-            self._maybe_compact_locked()
+                # analysis: blocking-ok(fsync under the dedicated
+                # membership lock: admit/cull serialize here so
+                # append-before-effect holds, while _lock — the lock
+                # the request and heartbeat paths contend on — stays
+                # free during the disk write)
+                journal.append(rec)
+            with self._lock:
+                self._table[replica_id] = entry
+                if replica_id not in self._order:
+                    self._order.append(replica_id)
+                self._hb_stamp_new(replica_id)
+                # (Re-)admission closes the breaker: a culled-then-
+                # rediscovered replica, or one respawned on a new
+                # endpoint, starts with a clean failure budget (the
+                # PR 8 heartbeat re-admission path lands here).
+                self._fail_count.pop(replica_id, None)
+                self._cooling_until.pop(replica_id, None)
+                self._trip_streak.pop(replica_id, None)
+                self._rotation_add(replica_id)
+                _G_COOLING.set(len(self._cooling_until))
+            self._maybe_compact()
 
     def cull(self, replica_id: str, reason: str = "silent",
              silence_sec: Optional[float] = None,
@@ -350,30 +382,36 @@ class Router:
         (docs/flightrec.md)."""
         from horovod_tpu.utils import flightrec
 
-        with self._lock:
-            if replica_id not in self._table:
-                return
-            if self._journal is not None:
+        with self._journal_lock:
+            with self._lock:
+                if replica_id not in self._table:
+                    return
+                pid = self._table[replica_id].get("pid")
+                journal = self._journal
+            if journal is not None:
                 rec = {"type": "cull", "id": replica_id,
                        "reason": reason,
-                       "pid": self._table[replica_id].get("pid"),
+                       "pid": pid,
                        "ts": time.time()}
                 if silence_sec is not None:
                     rec["silence_sec"] = round(silence_sec, 3)
                 if dump:
                     rec["dump"] = dump
-                self._journal.append(rec)
-            self._table.pop(replica_id, None)
-            if replica_id in self._order:
-                self._order.remove(replica_id)
-            self._rotation_remove(replica_id)
-            self._hb_seen.pop(replica_id, None)
-            self._confirmed.discard(replica_id)
-            self._fail_count.pop(replica_id, None)
-            self._cooling_until.pop(replica_id, None)
-            self._trip_streak.pop(replica_id, None)
-            _G_COOLING.set(len(self._cooling_until))
-            self._maybe_compact_locked()
+                # analysis: blocking-ok(fsync under the dedicated
+                # membership lock, outside _lock — see admit())
+                journal.append(rec)
+            with self._lock:
+                self._table.pop(replica_id, None)
+                if replica_id in self._order:
+                    self._order.remove(replica_id)
+                self._rotation_remove(replica_id)
+                self._hb_seen.pop(replica_id, None)
+                self._confirmed.discard(replica_id)
+                self._fail_count.pop(replica_id, None)
+                self._cooling_until.pop(replica_id, None)
+                self._trip_streak.pop(replica_id, None)
+                _G_COOLING.set(len(self._cooling_until))
+            self._maybe_compact()
         flightrec.record_failure("cull", "replica %s: %s"
                                  % (replica_id, reason))
 
@@ -675,10 +713,12 @@ class Router:
         if self._monitor is not None:
             self._monitor.stop()
         self._kv.stop()
-        # Detach under the lock: a KV callback mid-flight when stop()
-        # was called must observe either a usable journal or None —
-        # never append to a closed file handle.
-        with self._lock:
-            journal, self._journal = self._journal, None
+        # Detach under the journal lock: an admit/cull mid-append when
+        # stop() was called must finish against the open handle before
+        # the detach — never append to a closed file. The _lock hop
+        # keeps the attribute write visible to the fast-path readers.
+        with self._journal_lock:
+            with self._lock:
+                journal, self._journal = self._journal, None
         if journal is not None:
             journal.close()

@@ -619,6 +619,11 @@ class OnlineTuner:
         under a concurrently built proposal. The lock just serializes
         the two callers at that hand-off."""
         with self._prune_lock:
+            # analysis: blocking-ok(_prune_lock is a cold hand-off
+            # serializer — two callers, at most once per world change;
+            # no hot path ever takes it, and the journaled freeze/
+            # prune record must stay atomic with the binding swap it
+            # describes)
             self._prune_live_unsafe_locked()
 
     def _prune_live_unsafe_locked(self) -> None:

@@ -450,7 +450,7 @@ def test_every_checker_ran_against_fixture(tree):
     """Guard against a checker silently dropping out of run_all."""
     assert set(CHECKERS) == {"knobs", "counters", "ctypes", "metrics",
                              "excepts", "locks", "journal", "jaxcompat",
-                             "testtier", "spmd"}
+                             "testtier", "spmd", "deadlock", "blocking"}
 
 
 def test_build_refuses_any_sanitizer_preload(monkeypatch, tmp_path):
@@ -952,7 +952,7 @@ def test_crashing_checker_dies_with_its_name(tree, monkeypatch):
 # root-collective stubs below stand in for ops/eager.py; each seeded
 # violation fails under --checker spmd, tags suppress, the machinery
 # baselines, the real tree stays clean (test_real_tree_is_clean runs
-# all ten checkers).
+# all twelve checkers).
 
 SPMD_EAGER_STUB = '''
 def allreduce(x, **kw):
@@ -1281,13 +1281,14 @@ def main():
 
 
 def test_analysis_runtime_stays_in_seconds():
-    """Deflake guard (ISSUE 14 ridealong): the whole ten-checker run
-    over the REAL tree must stay interactive — the spmd call graph
-    rides the same per-run AST memoization as the other checkers (one
-    parse per file per Project), so the full run is a few seconds of
-    pure-Python AST work. 60 s is ~10x headroom for a loaded CI host;
-    breaching it means a second parse pass or quadratic propagation
-    crept in."""
+    """Deflake guard (ISSUE 14 ridealong; re-pinned for the twelve-
+    checker run in ISSUE 19): the whole twelve-checker run over the
+    REAL tree must stay interactive — the spmd call graph and the
+    deadlock/blocking model ride the same per-run AST memoization as
+    the other checkers (one parse per file per Project), so the full
+    run is a few seconds of pure-Python AST work. 60 s is ~10x
+    headroom for a loaded CI host; breaching it means a second parse
+    pass or quadratic propagation crept in."""
     import time as _time
 
     t0 = _time.monotonic()
@@ -1424,3 +1425,420 @@ class Driver:
             shutdown()
 ''')
     assert _keys(run_all(project(tree)), "spmd") == []
+
+
+# ================ deadlock/blocking checkers (ISSUE 19) ======================
+# Lock-order inversions and blocking-under-lock, Python and C++ lanes
+# (tools/analysis/check_deadlock.py): each seeded violation fails,
+# consistent nesting passes, tags suppress, the machinery baselines,
+# and the SARIF emitter keeps the one-document contract.
+
+
+def test_deadlock_two_lock_cycle_caught(tree):
+    _seed(tree, "horovod_tpu/inverted.py", '''
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def grow(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def shrink(self):
+        with self._b:
+            with self._a:
+                pass
+''')
+    findings = [f for f in run_all(project(tree))
+                if f.checker == "deadlock"]
+    assert len(findings) == 1, findings
+    [f] = findings
+    assert f.key.startswith("inversion:"), f.key
+    # Both paths are printed: each direction's witness names its
+    # function.
+    assert "Pool.grow" in f.message and "Pool.shrink" in f.message
+
+
+def test_deadlock_transitive_cycle_caught(tree):
+    """The inversion hides behind a method call: grow nests a->b
+    directly, shrink holds b and CALLS a helper that takes a."""
+    _seed(tree, "horovod_tpu/transitive.py", '''
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def _take_a(self):
+        with self._a:
+            pass
+
+    def grow(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def shrink(self):
+        with self._b:
+            self._take_a()
+''')
+    keys = _keys(run_all(project(tree)), "deadlock")
+    assert any(k.startswith("inversion:") for k in keys), keys
+
+
+def test_deadlock_consistent_nesting_passes(tree):
+    _seed(tree, "horovod_tpu/nested_ok.py", '''
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def grow(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def shrink(self):
+        with self._a:
+            with self._b:
+                pass
+''')
+    assert _keys(run_all(project(tree)), "deadlock") == []
+
+
+def test_deadlock_declared_order_violation(tree):
+    """lock-order(a before b) converts a lone b->a edge into a
+    finding even without a full cycle."""
+    _seed(tree, "horovod_tpu/ordered.py", '''
+import threading
+
+
+class Pool:
+    def __init__(self):
+        # analysis: lock-order(_a before _b)
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def backwards(self):
+        with self._b:
+            with self._a:
+                pass
+''')
+    keys = _keys(run_all(project(tree)), "deadlock")
+    assert any(k.startswith("order-violation:_a-before-_b") for k in keys), keys
+
+
+def test_blocking_fsync_under_lock_caught(tree):
+    _seed(tree, "horovod_tpu/fsyncy.py", '''
+import os
+import threading
+
+
+class Table:
+    def __init__(self, fh):
+        self._lock = threading.Lock()
+        self._fh = fh
+
+    def write(self, rec):
+        with self._lock:
+            self._fh.write(rec)
+            os.fsync(self._fh.fileno())
+''')
+    findings = [f for f in run_all(project(tree))
+                if f.checker == "blocking"]
+    assert len(findings) == 1, findings
+    assert "os.fsync()" in findings[0].message
+    assert "Table._lock" in findings[0].message
+
+
+def test_blocking_transitive_reach_caught(tree):
+    """The blocking op hides one call away: the locked method calls a
+    helper whose body sleeps."""
+    _seed(tree, "horovod_tpu/sleepy.py", '''
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def _backoff():
+    time.sleep(1.0)
+
+
+def update():
+    with _lock:
+        _backoff()
+''')
+    findings = [f for f in run_all(project(tree))
+                if f.checker == "blocking"]
+    assert len(findings) == 1, findings
+    assert "time.sleep()" in findings[0].message
+    assert "_backoff" in findings[0].message
+
+
+def test_blocking_journal_append_under_lock_caught(tree):
+    _seed(tree, "horovod_tpu/journaling.py", '''
+import threading
+
+
+class Router:
+    def __init__(self, journal):
+        self._lock = threading.Lock()
+        self._journal = journal
+
+    def admit(self, rec):
+        with self._lock:
+            self._journal.append(rec)
+''')
+    findings = [f for f in run_all(project(tree))
+                if f.checker == "blocking"]
+    assert len(findings) == 1, findings
+    assert "journal append() (fsync)" in findings[0].message
+
+
+def test_blocking_ok_tag_suppresses(tree):
+    _seed(tree, "horovod_tpu/tagged.py", '''
+import os
+import threading
+
+
+class Table:
+    def __init__(self, fh):
+        self._lock = threading.Lock()
+        self._fh = fh
+
+    def write(self, rec):
+        with self._lock:
+            self._fh.write(rec)
+            # analysis: blocking-ok(this lock exists to serialize
+            # exactly this durable write)
+            os.fsync(self._fh.fileno())
+''')
+    assert _keys(run_all(project(tree)), "blocking") == []
+
+
+def test_blocking_str_join_not_flagged(tree):
+    """Precision pin: str.join under a lock is not a thread join."""
+    _seed(tree, "horovod_tpu/strjoin.py", '''
+import threading
+
+_lock = threading.Lock()
+
+
+def render(parts, sep):
+    with _lock:
+        return ", ".join(parts) + sep.join(parts)
+''')
+    assert _keys(run_all(project(tree)), "blocking") == []
+
+
+def test_blocking_thread_join_under_lock_caught(tree):
+    _seed(tree, "horovod_tpu/threadjoin.py", '''
+import threading
+
+
+class Owner:
+    def __init__(self, worker):
+        self._lock = threading.Lock()
+        self._worker = worker
+
+    def stop(self):
+        with self._lock:
+            self._worker.join(timeout=5)
+''')
+    findings = [f for f in run_all(project(tree))
+                if f.checker == "blocking"]
+    assert len(findings) == 1, findings
+    assert ".join() (thread join)" in findings[0].message
+
+
+def test_cpp_lock_order_inversion_caught(tree):
+    _seed(tree, "horovod_tpu/core/src/inverted.cc", '''
+#include <mutex>
+
+struct State {
+  std::mutex ps_mutex;
+  std::mutex tl_mutex;
+  int table;  // GUARDED_BY(ps_mutex)
+
+  void Grow() {
+    std::lock_guard<std::mutex> a(ps_mutex);
+    std::lock_guard<std::mutex> b(tl_mutex);
+    table = 1;
+  }
+
+  void Shrink() {
+    std::lock_guard<std::mutex> b(tl_mutex);
+    std::lock_guard<std::mutex> a(ps_mutex);
+    table = 0;
+  }
+};
+''')
+    findings = [f for f in run_all(project(tree))
+                if f.checker == "deadlock"]
+    assert len(findings) == 1, findings
+    [f] = findings
+    assert f.key.startswith("inversion:"), f.key
+    assert "Grow" in f.message and "Shrink" in f.message
+
+
+def test_cpp_blocking_under_lock_caught_and_tag_suppresses(tree):
+    _seed(tree, "horovod_tpu/core/src/blocky.cc", '''
+#include <mutex>
+
+struct Comm {
+  std::mutex send_mutex;
+  std::mutex init_mutex;
+  int fd;
+
+  void Flush(const void* p, long n) {
+    std::lock_guard<std::mutex> lk(send_mutex);
+    ::send(fd, p, n, 0);
+  }
+
+  void Bootstrap(const void* p, long n) {
+    std::lock_guard<std::mutex> lk(init_mutex);
+    // analysis: blocking-ok(init-time handshake; nothing else ever
+    // takes init_mutex)
+    ::send(fd, p, n, 0);
+  }
+};
+''')
+    findings = [f for f in run_all(project(tree))
+                if f.checker == "blocking"]
+    assert len(findings) == 1, findings
+    assert "::send()" in findings[0].message
+    assert "Flush" in findings[0].message
+
+
+def test_deadlock_findings_are_baselinable(tree, tmp_path, capsys):
+    """The new lanes ride the same baseline machinery as the rest:
+    --update-baseline accepts a seeded inversion, the next run is
+    clean, and the justification slot is present."""
+    _seed(tree, "horovod_tpu/inverted.py", '''
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def grow(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def shrink(self):
+        with self._b:
+            with self._a:
+                pass
+''')
+    baseline = str(tmp_path / "baseline.json")
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "deadlock"]) == 1
+    capsys.readouterr()
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "deadlock",
+                          "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "deadlock"]) == 0
+    assert load_baseline(baseline)
+
+
+def test_deadlock_shares_the_ast_memoization():
+    """The deadlock/blocking model parses through the SAME per-run
+    Project cache as every other checker — no second parse pass over
+    the lock surface."""
+    from tools.analysis.common import Project as _P
+
+    p = _P(_REPO)
+    run_all(p)
+    missing = [rel for rel in p.lock_files() if rel not in p._ast_cache]
+    assert not missing, missing[:5]
+
+
+# --- SARIF output (ISSUE 19 satellite) ---------------------------------------
+
+def test_sarif_format_schema_and_exit_codes(tree, capsys):
+    """Pin the SARIF 2.1.0 shape CI and editors ingest: version,
+    schema URI, one rule per checker that ran, one result per finding
+    with ruleId/level/message/location/fingerprint — and the exit-code
+    contract unchanged (1 with a new finding, 0 clean)."""
+    _seed(tree, "horovod_tpu/fsyncy.py", '''
+import os
+import threading
+
+_lock = threading.Lock()
+
+
+def write(fh, rec):
+    with _lock:
+        fh.write(rec)
+        os.fsync(fh.fileno())
+''')
+    rc = analysis_main(["--root", tree, "--checker", "blocking",
+                        "--no-baseline", "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    [run] = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "tools.analysis"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] \
+        == ["blocking"]
+    [res] = run["results"]
+    assert res["ruleId"] == "blocking"
+    assert res["level"] == "error"
+    assert "os.fsync()" in res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "horovod_tpu/fsyncy.py"
+    assert loc["region"]["startLine"] > 0
+    assert res["partialFingerprints"]["fingerprint/v1"].startswith(
+        "blocking::horovod_tpu/fsyncy.py::")
+
+
+def test_sarif_clean_tree_is_empty_run(tree, capsys):
+    rc = analysis_main(["--root", tree, "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    [run] = doc["runs"]
+    assert run["results"] == []
+    assert len(run["tool"]["driver"]["rules"]) == len(CHECKERS)
+
+
+def test_sarif_baselined_finding_is_note_level(tree, tmp_path, capsys):
+    _seed(tree, "horovod_tpu/fsyncy.py", '''
+import os
+import threading
+
+_lock = threading.Lock()
+
+
+def write(fh, rec):
+    with _lock:
+        fh.write(rec)
+        os.fsync(fh.fileno())
+''')
+    baseline = str(tmp_path / "baseline.json")
+    assert analysis_main(["--root", tree, "--baseline", baseline,
+                          "--checker", "blocking",
+                          "--update-baseline"]) == 0
+    capsys.readouterr()
+    rc = analysis_main(["--root", tree, "--baseline", baseline,
+                        "--checker", "blocking", "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    [res] = doc["runs"][0]["results"]
+    assert res["level"] == "note"

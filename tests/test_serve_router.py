@@ -561,3 +561,81 @@ def test_garbage_heartbeat_keys_leave_no_bookkeeping():
         assert router._hb_seen == {}
     finally:
         router.stop()
+
+
+# --- journal writes never run under _lock (ISSUE 19) ------------------------
+
+
+def test_journal_append_runs_outside_router_lock(tmp_path):
+    """The blocking-under-lock fix: the fsync'd membership append
+    holds _journal_lock but must NOT hold _lock (the lock the request
+    and heartbeat paths contend on). Pinned from inside a patched
+    append so a regression re-nesting the locks fails loudly."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.start()
+    try:
+        real_append = router._journal.append
+        seen = []
+
+        def checked_append(rec):
+            seen.append((rec["type"],
+                         router._lock._is_owned(),
+                         router._journal_lock.locked()))
+            return real_append(rec)
+
+        router._journal.append = checked_append
+        router.admit("rX", {"addr": "127.0.0.1", "port": 1, "pid": 1,
+                            "model": "m"})
+        router.cull("rX", reason="test")
+        assert [t for t, _, _ in seen] == ["replica", "cull"]
+        for rec_type, lock_owned, journal_held in seen:
+            assert not lock_owned, \
+                "%s append ran under _lock" % rec_type
+            assert journal_held, \
+                "%s append ran outside _journal_lock" % rec_type
+    finally:
+        router.stop()
+
+
+def test_steady_state_heartbeat_skips_the_journal(tmp_path):
+    """An unchanged-endpoint admit (every steady-state heartbeat) is a
+    pure liveness stamp: it must not take _journal_lock or write a
+    duplicate membership record."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.start()
+    try:
+        info = {"addr": "127.0.0.1", "port": 1, "pid": 1, "model": "m"}
+        router.admit("rX", info)
+        appends = []
+        router._journal.append = lambda rec: appends.append(rec)
+        for _ in range(3):
+            router.admit("rX", dict(info))
+        assert appends == []
+        assert "rX" in router.replicas()
+    finally:
+        router.stop()
+
+
+def test_append_failure_leaves_table_unchanged(tmp_path):
+    """Append-before-effect survives the lock split: if the journal
+    write fails, membership must not change — otherwise a restart
+    forgets a replica the live router was routing to."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.start()
+    try:
+        info = {"addr": "127.0.0.1", "port": 1, "pid": 1, "model": "m"}
+        router.admit("rOld", info)
+
+        def boom(rec):
+            raise OSError("disk full")
+
+        router._journal.append = boom
+        with pytest.raises(OSError):
+            router.admit("rNew", {"addr": "127.0.0.1", "port": 2,
+                                  "pid": 2, "model": "m"})
+        assert "rNew" not in router.replicas()
+        with pytest.raises(OSError):
+            router.cull("rOld", reason="test")
+        assert "rOld" in router.replicas()
+    finally:
+        router.stop()

@@ -1,6 +1,6 @@
 """Cross-language static-analysis gate (docs/static_analysis.md).
 
-Ten contract checkers keep the hand-maintained bridges between the
+Twelve contract checkers keep the hand-maintained bridges between the
 C++ core, the ctypes layer, the knob registry, the docs, and the
 concurrency/persistence/SPMD disciplines honest:
 
@@ -19,6 +19,12 @@ concurrency/persistence/SPMD disciplines honest:
             no collective under a rank-divergent branch/loop, no
             blocking collective from callback/daemon threads, no
             live tuner search over live_safe=False knobs
+  deadlock  the interprocedural lock-acquisition graph (py with-scopes
+            + C++ guard scopes, across calls) has no cycles and obeys
+            declared lock-order(a before b) annotations
+  blocking  no blocking operation (socket/http I/O, sleep, subprocess,
+            thread join, fsync'd journal writes, registered callbacks,
+            blocking collectives) reachable while a lock is held
 
 Run ``python -m tools.analysis`` (CI does, before the test lanes);
 pre-existing accepted findings live in ``baseline.json``.
@@ -31,6 +37,7 @@ from typing import Callable, Dict, List
 from tools.analysis import (
     check_counters,
     check_ctypes,
+    check_deadlock,
     check_excepts,
     check_jaxcompat,
     check_journal,
@@ -53,6 +60,8 @@ CHECKERS: Dict[str, Callable[[Project], List[Finding]]] = {
     "jaxcompat": check_jaxcompat.check,
     "testtier": check_testtier.check,
     "spmd": check_spmd.check,
+    "deadlock": check_deadlock.check_order,
+    "blocking": check_deadlock.check_blocking,
 }
 
 

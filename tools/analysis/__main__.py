@@ -36,10 +36,12 @@ def main(argv=None) -> int:
                     help="accept the current finding set as the baseline")
     ap.add_argument("--checker", action="append", choices=sorted(CHECKERS),
                     help="run only this checker (repeatable)")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
-                    help="findings output: human text (default) or a "
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="findings output: human text (default), a "
                          "machine-readable JSON document for CI and "
-                         "tools/trace consumers")
+                         "tools/trace consumers, or SARIF 2.1.0 for "
+                         "editors and code-scanning ingestion")
     args = ap.parse_args(argv)
 
     root = os.path.abspath(args.root)
@@ -59,7 +61,7 @@ def main(argv=None) -> int:
             preserved = {fp: j for fp, j in old.items()
                          if fp.split("::", 1)[0] not in args.checker}
         save_baseline(args.baseline, findings, old, extra=preserved)
-        if args.format == "json":
+        if args.format in ("json", "sarif"):
             # The one-JSON-document-on-stdout contract holds for every
             # mode a consumer can invoke (docs/static_analysis.md).
             print(json.dumps({
@@ -80,6 +82,49 @@ def main(argv=None) -> int:
     stale = sorted(
         fp for fp in set(baseline) - {f.fingerprint for f in findings}
         if not args.checker or fp.split("::", 1)[0] in args.checker)
+
+    if args.format == "sarif":
+        # SARIF 2.1.0 (the code-scanning interchange format): one run,
+        # one rule per checker, one result per finding. Baselined
+        # findings are emitted at level "note" so editors show them
+        # without failing ingestion gates; the exit-code contract is
+        # unchanged (schema pinned in tests/test_analysis.py).
+        ran = sorted(args.checker or CHECKERS)
+        doc = {
+            "version": "2.1.0",
+            "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "tools.analysis",
+                    "informationUri":
+                        "docs/static_analysis.md",
+                    "rules": [{
+                        "id": name,
+                        "shortDescription": {
+                            "text": (CHECKERS[name].__doc__ or name)
+                            .strip().splitlines()[0],
+                        },
+                    } for name in ran],
+                }},
+                "results": [{
+                    "ruleId": f.checker,
+                    "level": ("note" if f.fingerprint in baseline
+                              else "error"),
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": max(f.line, 1)},
+                        },
+                    }],
+                    "partialFingerprints": {
+                        "fingerprint/v1": f.fingerprint,
+                    },
+                } for f in findings],
+            }],
+        }
+        print(json.dumps(doc, indent=2, sort_keys=False))
+        return 1 if new else 0
 
     if args.format == "json":
         # One self-contained document on stdout; the exit-code

@@ -1,0 +1,796 @@
+"""Deadlock & latency lanes: lock ORDER and blocking UNDER locks.
+
+PR 17 proved the control plane at 500 ranks, but only dynamically: the
+router, KV server, driver, monitor and tuner share dozens of locks
+across threads, and the ``locks`` checker (PR 9) only verifies that
+guarded *attributes* are accessed under *a* lock. It says nothing about
+lock **ordering** (inversion deadlocks between two locks) or about
+**what runs while a lock is held** (an fsync'd journal append or a
+socket write under the routing lock is a p99 cliff at cardinality —
+exactly the stall ``tools.trace`` can only diagnose post-mortem).
+
+Two lanes in one module, sharing one interprocedural model per run:
+
+``deadlock`` — **lock order.** An interprocedural lock-acquisition
+graph: every acquisition reached while other locks are held adds
+``held -> acquired`` edges, both directly (nested ``with`` /
+brace-scoped guards) and transitively through same-module/class calls
+(the PR 14 call-graph machinery for Python; a name-indexed function
+table across TUs for C++). A cycle in the graph is a lock-order
+inversion: two threads taking the same pair of locks in opposite
+orders deadlock. Both paths are printed. An intended global order can
+be declared with ``# analysis: lock-order(<a> before <b>)`` (or the
+``//`` comment form in C++): any observed ``<b> -> <a>`` edge then
+becomes a finding even without a full cycle.
+
+``blocking`` — **blocking under lock.** A taint set of blocking
+operations — socket send/recv/connect/accept, ``urlopen``/http
+clients, ``time.sleep``, ``subprocess.*``, thread ``join``,
+``os.fsync`` and the journal's ``append``/``compact``, invoking a
+registered ``*callback*`` (arbitrary user code), and blocking eager
+collectives (reusing check_spmd's issues-collective property) — must
+not be reachable, directly or transitively, from inside a held-lock
+scope. ``# analysis: blocking-ok(<why>)`` on the call (or the
+contiguous comment block above it) escapes deliberate cases, e.g. the
+KV ``callback_lock`` contract or a journal's own serialization lock;
+a tagged site also stops propagating to its callers.
+
+Lock identities are class-qualified (``Router._lock``,
+``TcpComm::heal_mu_``) so same-named locks in unrelated classes never
+merge into a false cycle. Known limits (precision over recall — the
+shipped baseline stays EMPTY): Python ``lock.acquire()``/``release()``
+pairs and C++ ``unique_lock.unlock()`` windows are not modeled (the
+PR 9 precedent); condition-variable ``wait`` is excluded from the
+taint set because it releases the lock it waits on; dynamic dispatch
+is resolved only as far as check_spmd's name/import resolution goes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis import cpp
+from tools.analysis.check_locks import _lock_call, _self_attr
+from tools.analysis.check_spmd import (
+    _build_graph,
+    _CallSite,
+    _dotted,
+    _Index,
+    _index_module,
+    _resolve_call,
+    _tag_near,
+)
+from tools.analysis.common import Finding, Project
+
+BLOCKING_OK_TAG_RE = re.compile(r"analysis:\s*blocking-ok\(")
+LOCK_ORDER_TAG_RE = re.compile(
+    r"analysis:\s*lock-order\(\s*([^()]+?)\s+before\s+([^()]+?)\s*\)")
+
+# --- Python blocking taint set ----------------------------------------------
+
+# Attribute calls that block on the network/disk no matter the receiver.
+# Deliberately narrow: `send`/`read`/`wait` are too generic (str/file/
+# condvar methods), and Condition.wait RELEASES the lock it waits on.
+_BLOCKING_METHODS = {
+    "connect", "connect_ex", "accept", "recv", "recv_into", "recvfrom",
+    "sendall", "sendto", "getresponse", "communicate",
+}
+_BLOCKING_BARE = {"urlopen", "create_connection"}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
+
+# Receiver chains containing one of these name-fragments make append/
+# compact a journal write (an fsync per call — runner/journal.py).
+_JOURNAL_FRAGMENT = "journal"
+
+# Lock-ish attribute names for acquisitions of *foreign* locks
+# (``with self.server.callback_lock:``).
+_LOCKISH_RE = re.compile(r"(lock|mutex|cond)", re.IGNORECASE)
+
+# --- C++ scanning ------------------------------------------------------------
+
+_CPP_LOCK_ACQ_RE = re.compile(
+    r"\b(?:std::)?(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"(?:<[^>]*>)?\s+\w+\s*[({]([^;]*?)[)}]")
+_CPP_MUTEX_NAME_RE = re.compile(r"\w+")
+# Direct blocking operations: raw socket syscalls, fsync, sleeps, and
+# thread joins (member access only — `hvd_core_join(` must not match).
+_CPP_BLOCKING_RES = [
+    (re.compile(r"::\s*(send|recv|poll|connect|accept|select)\s*\("),
+     "::%s()"),
+    (re.compile(r"\b(fsync|fdatasync|usleep|nanosleep)\s*\("), "%s()"),
+    (re.compile(r"\bsleep_(for|until)\s*\("), "sleep_%s()"),
+    (re.compile(r"(?:\.|->)\s*(join)\s*\("), ".%s()"),
+]
+# Invoking a stored callback: arbitrary user code (the ctypes
+# trampoline acquires the GIL) — blocking for lock purposes.
+_CPP_CALLBACK_RE = re.compile(r"(?:\.|->)\s*(\w*callback|cb)\s*\(")
+_CPP_KEYWORDS = {
+    "if", "for", "while", "switch", "catch", "return", "sizeof", "do",
+    "else", "new", "delete", "case", "default", "throw", "alignof",
+    "decltype", "static_assert", "defined", "assert",
+}
+_FUNC_HDR_RE = re.compile(
+    r"(?:(\w+)\s*::\s*)?([~\w]+)\s*"
+    r"\(([^()]*(?:\([^()]*\)[^()]*)*)\)\s*"
+    r"(?:const)?\s*(?:noexcept)?\s*(?::[^{;]*)?$")
+_CLASS_HDR_RE = re.compile(r"\b(?:class|struct)\s+(\w+)\s*(?::[^{;]*)?$")
+_CPP_CALL_RE = re.compile(r"\b(\w+)\s*\(")
+
+
+# =============================== model =======================================
+
+
+class _Edge:
+    """One observed ``held -> acquired`` ordering with its witness."""
+
+    __slots__ = ("src", "dst", "rel", "line", "fn", "via")
+
+    def __init__(self, src, dst, rel, line, fn, via=""):
+        self.src = src
+        self.dst = dst
+        self.rel = rel
+        self.line = line
+        self.fn = fn
+        self.via = via
+
+    def witness(self) -> str:
+        w = "%s -> %s at %s:%d in %s" % (self.src, self.dst, self.rel,
+                                         self.line, self.fn)
+        return w + (" (%s)" % self.via if self.via else "")
+
+
+class _Block:
+    """One blocking operation site inside a function."""
+
+    __slots__ = ("kind", "detail", "line", "tagged")
+
+    def __init__(self, kind, detail, line, tagged):
+        self.kind = kind      # "direct" | "call"
+        self.detail = detail  # human description of the operation
+        self.line = line
+        self.tagged = tagged  # blocking-ok near the site
+
+
+class _Model:
+    def __init__(self):
+        self.edges: List[_Edge] = []
+        # funckey -> [(held tuple, lockid, line)] raw acquisition sites
+        # funckey -> lock ids acquired anywhere inside (transitive set
+        # computed by _propagate)
+        self.fn_acquires: Dict[str, Set[str]] = {}
+        self.fn_acquire_via: Dict[str, Dict[str, str]] = {}
+        # funckey -> [_Block] direct blocking sites
+        self.fn_blocks_direct: Dict[str, List[_Block]] = {}
+        # funckey -> (detail, via) once known to block (untagged only)
+        self.fn_may_block: Dict[str, Tuple[str, str]] = {}
+        # funckey -> [(held tuple, callee key, line, site name)]
+        self.calls_under: Dict[str, List[tuple]] = {}
+        # funckey -> [(held tuple, _Block)] direct ops under a lock
+        self.blocks_under: Dict[str, List[tuple]] = {}
+        # funckey -> (rel, qual) for messages
+        self.fn_where: Dict[str, Tuple[str, str]] = {}
+        # call edges for propagation: callee -> [caller]
+        self.rev_calls: Dict[str, List[Tuple[str, str]]] = {}
+        # declared intended orders: (a, b, rel, line) meaning a BEFORE b
+        self.declared: List[Tuple[str, str, str, int]] = []
+        # every lock id seen (for tag-name resolution)
+        self.lock_ids: Set[str] = set()
+
+
+def _get_model(project: Project) -> _Model:
+    model = getattr(project, "_deadlock_model", None)
+    if model is None:
+        model = _Model()
+        _scan_python(project, model)
+        _scan_native(project, model)
+        _propagate(model)
+        project._deadlock_model = model
+    return model
+
+
+def _propagate(model: _Model) -> None:
+    """Fixpoint transitive lock-acquisition sets and may-block flags
+    over the (reverse) call graph."""
+    pending = [k for k in model.fn_acquires if model.fn_acquires[k]]
+    while pending:
+        key = pending.pop()
+        acq = model.fn_acquires.get(key, set())
+        via_map = model.fn_acquire_via.setdefault(key, {})
+        for caller, qual in model.rev_calls.get(key, ()):  # noqa: B007
+            cacq = model.fn_acquires.setdefault(caller, set())
+            cvia = model.fn_acquire_via.setdefault(caller, {})
+            changed = False
+            for lock in acq:
+                if lock not in cacq:
+                    cacq.add(lock)
+                    cvia[lock] = "via %s" % (via_map.get(lock) or qual)
+                    changed = True
+            if changed:
+                pending.append(caller)
+    # may-block: untagged direct sites seed; propagate to callers.
+    pending = []
+    for key, blocks in model.fn_blocks_direct.items():
+        for b in blocks:
+            if not b.tagged and key not in model.fn_may_block:
+                model.fn_may_block[key] = (b.detail, "")
+                pending.append(key)
+    while pending:
+        key = pending.pop()
+        detail, _ = model.fn_may_block[key]
+        for caller, qual in model.rev_calls.get(key, ()):
+            if caller not in model.fn_may_block:
+                model.fn_may_block[caller] = (detail, "via %s()" % qual)
+                pending.append(caller)
+
+
+# ============================ Python lane ====================================
+
+
+def _module_locks(tree: ast.Module) -> Set[str]:
+    """Names bound to Lock/RLock/Condition at module top level."""
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _lock_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _class_locks(tree: ast.Module) -> Dict[str, Set[str]]:
+    """class name -> lock attribute names (constructed, or used as a
+    bare ``with self.X:`` context — the borrowed-lock idiom)."""
+    out: Dict[str, Set[str]] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs = out.setdefault(cls.name, set())
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None and _lock_call(node.value):
+                        attrs.add(attr)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and item.optional_vars is None:
+                        attrs.add(attr)
+    return out
+
+
+def _py_lock_id(mod: str, cls: Optional[str], expr: ast.AST,
+                class_locks: Dict[str, Set[str]],
+                module_locks: Set[str]) -> Optional[str]:
+    """Lock identity acquired by ``with <expr>:``, or None."""
+    attr = _self_attr(expr)
+    if attr is not None:
+        if cls and attr in class_locks.get(cls, ()):
+            return "%s.%s" % (cls, attr)
+        return None
+    if isinstance(expr, ast.Name):
+        if expr.id in module_locks:
+            return "%s:%s" % (mod.rsplit(".", 1)[-1], expr.id)
+        return None
+    parts = _dotted(expr)
+    if parts and len(parts) >= 2 and _LOCKISH_RE.search(parts[-1]):
+        # Foreign lock (``self.server.callback_lock``): identified by
+        # its attribute name alone — lock attribute names are unique
+        # across the tree by convention (callback_lock, _append_lock).
+        return parts[-1]
+    return None
+
+
+def _py_blocking_direct(site: _CallSite, index: _Index,
+                        fn) -> Optional[str]:
+    """Description when this call site is a DIRECT blocking operation
+    (no resolution needed), else None."""
+    name = site.name
+    parts = site.parts
+    node = site.node
+    if name is None:
+        return None
+    # journal append/compact: an fsync per call.
+    if name in ("append", "compact") and parts and len(parts) >= 2 \
+            and any(_JOURNAL_FRAGMENT in p.lower() for p in parts[:-1]):
+        return "journal %s() (fsync)" % name
+    if name == "fsync" and parts and parts[0] in ("os", "fsync"):
+        return "os.fsync()"
+    if name == "sleep":
+        if parts == ["sleep"] or (parts and parts[-2:] == ["time",
+                                                           "sleep"]):
+            return "time.sleep()"
+    if name in _SUBPROCESS_FNS and parts and parts[0] == "subprocess":
+        return "subprocess.%s()" % name
+    if name in _BLOCKING_BARE:
+        return "%s()" % name
+    if name in _BLOCKING_METHODS and isinstance(node.func, ast.Attribute):
+        return ".%s() (socket/http)" % name
+    if name == "join" and isinstance(node.func, ast.Attribute):
+        # Thread.join, not str.join: receiver is not a string literal
+        # and the args are empty / a numeric timeout / timeout= only.
+        recv = node.func.value
+        if isinstance(recv, ast.Constant) and isinstance(recv.value, str):
+            return None
+        if node.args and not (
+                len(node.args) == 1
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, (int, float))):
+            return None
+        if any(kw.arg != "timeout" for kw in node.keywords):
+            return None
+        return ".join() (thread join)"
+    if isinstance(node.func, (ast.Attribute, ast.Name)) \
+            and (name in ("callback", "cb") or name.endswith("_callback")) \
+            and not name.startswith(("add_", "register_", "set_",
+                                     "remove_", "clear_", "on_")):
+        # add_done_callback/register_*_callback REGISTER a callback —
+        # only the invocation runs arbitrary code.
+        # Invoking a REGISTERED callback (arbitrary consumer code under
+        # our lock — the KV put_callback shape). Callers run this only
+        # after _resolve_call failed, so real same-class methods that
+        # happen to end in _callback resolve through the graph instead.
+        return "registered callback %s()" % name
+    return None
+
+
+def _scan_python(project: Project, model: _Model) -> None:
+    index = _Index()
+    mod_locks: Dict[str, Set[str]] = {}
+    cls_locks: Dict[str, Dict[str, Set[str]]] = {}
+    for rel in project.lock_files():
+        try:
+            tree = project.parsed(rel)
+            lines = project.read(rel).splitlines()
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        _index_module(index, rel, tree, lines)
+        mod = rel[:-3].replace("/", ".").replace("\\", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[:-len(".__init__")]
+        mod_locks[mod] = _module_locks(tree)
+        cls_locks[mod] = _class_locks(tree)
+        for lineno, line in enumerate(lines, 1):
+            for m in LOCK_ORDER_TAG_RE.finditer(line):
+                model.declared.append((m.group(1).strip(),
+                                       m.group(2).strip(), rel, lineno))
+    _build_graph(index)  # check_spmd's issues/blocks propagation
+    model._py_index = index  # noqa: SLF001 — shared with the lanes
+
+    for key, fn in index.funcs.items():
+        lines = index.lines[fn.rel]
+        model.fn_where[key] = (fn.rel, fn.qual)
+        acquires = model.fn_acquires.setdefault(key, set())
+        model.fn_acquire_via.setdefault(key, {})
+        direct_blocks = model.fn_blocks_direct.setdefault(key, [])
+        calls_under = model.calls_under.setdefault(key, [])
+        blocks_under = model.blocks_under.setdefault(key, [])
+        clocks = cls_locks.get(fn.module, {})
+        mlocks = mod_locks.get(fn.module, set())
+
+        def visit(node: ast.AST, held: Tuple[str, ...]):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                newly = list(held)
+                for item in node.items:
+                    lock = _py_lock_id(fn.module, fn.cls,
+                                       item.context_expr, clocks, mlocks)
+                    if lock is not None:
+                        model.lock_ids.add(lock)
+                        acquires.add(lock)
+                        for h in newly:
+                            if h != lock:
+                                model.edges.append(_Edge(
+                                    h, lock, fn.rel, node.lineno,
+                                    fn.qual))
+                        newly.append(lock)
+                    else:
+                        visit(item.context_expr, held)
+                for stmt in node.body:
+                    visit(stmt, tuple(newly))
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested defs are indexed as their own functions; their
+                # bodies run only when CALLED (the call resolves through
+                # the graph), never merely because the def executed.
+                return
+            if isinstance(node, ast.Lambda):
+                visit(node.body, ())  # closures escape the lock scope
+                return
+            if isinstance(node, ast.Call):
+                site = _CallSite(node)
+                r = _resolve_call(index, fn, site)
+                if r is not None and r[0] == "func":
+                    model.rev_calls.setdefault(r[1], []).append(
+                        (key, index.funcs[r[1]].qual))
+                    if held:
+                        calls_under.append(
+                            (held, r[1], node.lineno, site.name or ""))
+                elif r is not None and r[0] == "root" and r[2] and held:
+                    # Blocking eager collective under a lock: the
+                    # completing thread may be the one parked on this
+                    # very lock (check_spmd's thread lane, now with the
+                    # lock made explicit).
+                    tagged = _tag_near(lines, node.lineno,
+                                       BLOCKING_OK_TAG_RE)
+                    b = _Block("direct",
+                               "blocking collective %s" % r[1],
+                               node.lineno, tagged)
+                    blocks_under.append((held, b))
+                else:
+                    blocked = _py_blocking_direct(site, index, fn)
+                    if blocked is not None:
+                        tagged = _tag_near(lines, node.lineno,
+                                           BLOCKING_OK_TAG_RE)
+                        b = _Block("direct", blocked, node.lineno,
+                                   tagged)
+                        direct_blocks.append(b)
+                        if held:
+                            blocks_under.append((held, b))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.node.body:
+            visit(stmt, ())
+
+
+# ============================= C++ lane ======================================
+
+
+def _cpp_functions(code: str) -> List[dict]:
+    """Function definitions (name, class, [start, end) offsets) via
+    brace tracking + header classification. Nested braces inside a
+    function (control flow, init lists) stay inside it; lambdas are
+    attributed to their enclosing function."""
+    funcs: List[dict] = []
+    stack: List[tuple] = []  # (kind, name, cls, start_offset)
+    classes: List[str] = []
+    header_start = 0
+    in_func = 0
+    for i, c in enumerate(code):
+        if c in ";}":
+            if c == "}" and stack:
+                kind, name, cls, start = stack.pop()
+                if kind == "func":
+                    in_func -= 1
+                    funcs.append({"name": name, "cls": cls,
+                                  "start": start, "end": i})
+                elif kind == "class" and classes:
+                    classes.pop()
+            header_start = i + 1
+        elif c == "{":
+            header = code[header_start:i].strip()
+            kind, name, cls = "other", None, None
+            cm = _CLASS_HDR_RE.search(header)
+            if cm is not None:
+                kind, name = "class", cm.group(1)
+                classes.append(name)
+            elif not in_func and not header.endswith("="):
+                fm = _FUNC_HDR_RE.search(header)
+                if fm is not None and fm.group(2) not in _CPP_KEYWORDS:
+                    kind, name = "func", fm.group(2)
+                    cls = fm.group(1) or (classes[-1] if classes
+                                          else None)
+                    in_func += 1
+            stack.append((kind, name, cls, i + 1))
+            header_start = i + 1
+    return funcs
+
+
+def _cpp_mutex_id(arg_text: str, cls: Optional[str],
+                  known: Set[str]) -> Optional[str]:
+    """Identity of the mutex named in a lock_guard argument list."""
+    names = _CPP_MUTEX_NAME_RE.findall(arg_text)
+    for name in reversed(names):
+        looks = (name.endswith("mutex") or name.endswith("mu_")
+                 or name == "mu" or name.endswith("mtx")
+                 or name in known)
+        if not looks:
+            continue
+        member = name.endswith("_") and "::" not in name
+        qualified_via_ptr = "->" in arg_text or "." in arg_text
+        if member and cls and not qualified_via_ptr:
+            return "%s::%s" % (cls, name)
+        return name
+    return None
+
+
+def _cpp_tag_near(lines: Sequence[str], lineno: int, tag_re) -> bool:
+    """Tag on the line or in the contiguous ``//`` block above."""
+    if 1 <= lineno <= len(lines) and tag_re.search(lines[lineno - 1]):
+        return True
+    ln = lineno - 1
+    while 1 <= ln <= len(lines):
+        stripped = lines[ln - 1].strip()
+        if not stripped.startswith("//"):
+            break
+        if tag_re.search(stripped):
+            return True
+        ln -= 1
+    return False
+
+
+def _scan_native(project: Project, model: _Model) -> None:
+    from tools.analysis.check_locks import GUARDED_BY_RE
+
+    texts: Dict[str, str] = {}
+    known_mutexes: Set[str] = set()
+    for rel in project.native_files():
+        try:
+            texts[rel] = project.read(rel)
+        except (OSError, UnicodeDecodeError):
+            continue
+        for m in GUARDED_BY_RE.finditer(texts[rel]):
+            known_mutexes.add(m.group(1))
+        for lineno, line in enumerate(texts[rel].splitlines(), 1):
+            for t in LOCK_ORDER_TAG_RE.finditer(line):
+                model.declared.append((t.group(1).strip(),
+                                       t.group(2).strip(), rel, lineno))
+
+    # Pass 1: index every function definition across TUs.
+    fn_table: Dict[str, List[str]] = {}  # bare name -> [funckey]
+    spans: Dict[str, tuple] = {}         # funckey -> (rel, code, f)
+    for rel, text in sorted(texts.items()):
+        code = cpp.strip_comments(text, blank_strings=True)
+        for f in _cpp_functions(code):
+            qual = ("%s::%s" % (f["cls"], f["name"])) if f["cls"] \
+                else f["name"]
+            key = "cpp:%s::%s:%d" % (rel, qual, f["start"])
+            fn_table.setdefault(f["name"], []).append(key)
+            spans[key] = (rel, code, f)
+            model.fn_where[key] = (rel, qual)
+
+    # Pass 2: per-function acquisitions, blocking ops and call sites
+    # with brace-scoped held sets.
+    for key, (rel, code, f) in spans.items():
+        lines = texts[rel].splitlines()
+        body = code[f["start"]:f["end"]]
+        base = f["start"]
+        acquires = model.fn_acquires.setdefault(key, set())
+        model.fn_acquire_via.setdefault(key, {})
+        direct_blocks = model.fn_blocks_direct.setdefault(key, [])
+        calls_under = model.calls_under.setdefault(key, [])
+        blocks_under = model.blocks_under.setdefault(key, [])
+        qual = model.fn_where[key][1]
+
+        events: List[tuple] = []
+        for m in _CPP_LOCK_ACQ_RE.finditer(body):
+            lock = _cpp_mutex_id(m.group(1), f["cls"], known_mutexes)
+            if lock is not None:
+                events.append((m.start(), "acq", lock))
+        for pat, fmt in _CPP_BLOCKING_RES:
+            for m in pat.finditer(body):
+                events.append((m.start(), "block", fmt % m.group(1)))
+        for m in _CPP_CALLBACK_RE.finditer(body):
+            events.append((m.start(), "block",
+                           "registered callback %s()" % m.group(1)))
+        for m in _CPP_CALL_RE.finditer(body):
+            name = m.group(1)
+            if name in _CPP_KEYWORDS or name == f["name"]:
+                continue
+            if name in fn_table:
+                events.append((m.start(), "call", (name, m.start())))
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        depth = 0
+        held: List[tuple] = []  # (depth, lockid)
+        ei = 0
+        for i, c in enumerate(body):
+            while ei < len(events) and events[ei][0] == i:
+                off, kind, payload = events[ei]
+                ei += 1
+                line = code.count("\n", 0, base + off) + 1
+                held_ids = tuple(lk for _, lk in held)
+                if kind == "acq":
+                    model.lock_ids.add(payload)
+                    acquires.add(payload)
+                    for h in held_ids:
+                        if h != payload:
+                            model.edges.append(_Edge(
+                                h, payload, rel, line, qual))
+                    held.append((depth, payload))
+                elif kind == "block":
+                    tagged = _cpp_tag_near(lines, line,
+                                           BLOCKING_OK_TAG_RE)
+                    b = _Block("direct", payload, line, tagged)
+                    direct_blocks.append(b)
+                    if held_ids:
+                        blocks_under.append((held_ids, b))
+                else:
+                    name, _ = payload
+                    for callee in fn_table[name]:
+                        if callee == key:
+                            continue
+                        model.rev_calls.setdefault(callee, []).append(
+                            (key, name))
+                        if held_ids:
+                            calls_under.append(
+                                (held_ids, callee, line, name))
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                held = [(d, lk) for d, lk in held if d <= depth]
+
+
+# ============================ lane: deadlock =================================
+
+
+def _match_lock_name(name: str, lock_ids: Set[str]) -> Set[str]:
+    """Resolve a lock name from a lock-order tag to the observed lock
+    id(s): exact, or by its final component."""
+    if name in lock_ids:
+        return {name}
+    return {lid for lid in lock_ids
+            if lid.rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+            .rsplit("::" if "::" in lid else ".", 1)[-1] == name
+            or lid.endswith("." + name) or lid.endswith(":" + name)}
+
+
+def _sccs(nodes: Set[str],
+          adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs (iterative)."""
+    indexes: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str):
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        indexes[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in indexes:
+                    indexes[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], indexes[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == indexes[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+
+    for node in sorted(nodes):
+        if node not in indexes:
+            strongconnect(node)
+    return out
+
+
+def check_order(project: Project) -> List[Finding]:
+    """Lane 1: lock-order inversions + declared-order violations."""
+    model = _get_model(project)
+    findings: List[Finding] = []
+
+    # Expand transitive edges: a call made while locks are held adds
+    # held -> (everything the callee may transitively acquire).
+    edges = list(model.edges)
+    for key, sites in model.calls_under.items():
+        rel, qual = model.fn_where[key]
+        for held, callee, line, name in sites:
+            for lock in sorted(model.fn_acquires.get(callee, ())):
+                for h in held:
+                    if h != lock:
+                        via = model.fn_acquire_via.get(
+                            callee, {}).get(lock, "")
+                        edges.append(_Edge(
+                            h, lock, rel, line, qual,
+                            ("%s() acquires it %s" % (name, via)).strip()))
+
+    adj: Dict[str, Set[str]] = {}
+    by_pair: Dict[Tuple[str, str], _Edge] = {}
+    nodes: Set[str] = set()
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+        by_pair.setdefault((e.src, e.dst), e)
+        nodes.add(e.src)
+        nodes.add(e.dst)
+
+    for comp in _sccs(nodes, adj):
+        if len(comp) < 2:
+            continue
+        comp = sorted(comp)
+        witnesses = [by_pair[(a, b)].witness()
+                     for a in comp for b in comp
+                     if (a, b) in by_pair]
+        first = min((by_pair[(a, b)] for a in comp for b in comp
+                     if (a, b) in by_pair),
+                    key=lambda e: (e.rel, e.line))
+        findings.append(Finding(
+            "deadlock", first.rel, first.line,
+            "inversion:%s" % "<>".join(comp),
+            "lock-order inversion between {%s}: two threads taking "
+            "these locks in opposite orders deadlock. Paths: %s. Fix "
+            "by imposing one order (then declare it with "
+            "'# analysis: lock-order(<a> before <b>)') or by merging/"
+            "splitting the locks" % (", ".join(comp),
+                                     "; ".join(witnesses))))
+
+    for a, b, tag_rel, tag_line in model.declared:
+        a_ids = _match_lock_name(a, model.lock_ids)
+        b_ids = _match_lock_name(b, model.lock_ids)
+        for (src, dst), e in sorted(by_pair.items()):
+            if src in b_ids and dst in a_ids:
+                findings.append(Finding(
+                    "deadlock", e.rel, e.line,
+                    "order-violation:%s-before-%s:%s" % (a, b, src),
+                    "acquisition order %s -> %s violates the declared "
+                    "order 'lock-order(%s before %s)' (%s:%d): %s"
+                    % (src, dst, a, b, tag_rel, tag_line,
+                       e.witness())))
+    return findings
+
+
+# ============================ lane: blocking =================================
+
+
+def check_blocking(project: Project) -> List[Finding]:
+    """Lane 2: blocking operations reachable while a lock is held."""
+    model = _get_model(project)
+    findings: List[Finding] = []
+    per_key: Dict[str, int] = {}
+
+    def emit(rel, qual, line, lock, desc):
+        base = "blocking:%s:%s:%s" % (
+            qual, lock, re.sub(r"[^A-Za-z0-9_.()-]+", "_", desc))
+        n = per_key.get(base, 0)
+        per_key[base] = n + 1
+        findings.append(Finding(
+            "blocking", rel, line, "%s:%d" % (base, n),
+            "%s while holding %s in %s — a blocking operation inside "
+            "a critical section stalls every thread contending on the "
+            "lock (the p99 cliff at cardinality; docs/static_analysis"
+            ".md#blocking). Move it outside the lock (snapshot-then-"
+            "act), or tag the call with "
+            "'# analysis: blocking-ok(<why>)'" % (desc, lock, qual)))
+
+    for key in sorted(model.blocks_under):
+        rel, qual = model.fn_where[key]
+        for held, b in model.blocks_under[key]:
+            if b.tagged:
+                continue
+            emit(rel, qual, b.line, held[-1], b.detail)
+    for key in sorted(model.calls_under):
+        rel, qual = model.fn_where[key]
+        lines = None
+        for held, callee, line, name in model.calls_under[key]:
+            info = model.fn_may_block.get(callee)
+            if info is None:
+                continue
+            if lines is None:
+                try:
+                    lines = project.read(rel).splitlines()
+                except (OSError, UnicodeDecodeError):
+                    lines = []
+            tag = _cpp_tag_near if key.startswith("cpp:") else _tag_near
+            if tag(lines, line, BLOCKING_OK_TAG_RE):
+                continue
+            detail, via = info
+            cqual = model.fn_where[callee][1]
+            desc = "call to %s() which reaches %s%s" % (
+                name or cqual, detail, (" " + via) if via else "")
+            emit(rel, qual, line, held[-1], desc)
+    return findings

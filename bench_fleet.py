@@ -29,6 +29,8 @@ Examples:
     python bench_fleet.py --sizes 25,100 --quick   # fast look
     python bench_fleet.py --storm --sizes 500      # the 500-rank drive
     python bench_fleet.py --quick --sizes 64 --no-storm   # CI lane
+    python bench_fleet.py --ops --sizes 64,250     # rolling upgrade +
+                                                   # router failover
 """
 
 import argparse
@@ -149,6 +151,91 @@ def bench_storm(n: int, waves: int, clients: int,
     return out
 
 
+def bench_ops(n: int, clients: int, per_client: int) -> dict:
+    """Fleet-operations timings at size n (docs/serving.md runbook):
+    a full rolling checkpoint upgrade under closed-loop load, then an
+    in-process kill -9 of the router with a hot standby taking over
+    the port and the journal. Zero lost requests through both."""
+    import threading
+
+    from horovod_tpu.serve.standby import Standby
+
+    out = {"n": n}
+    prior_lease = os.environ.get("HVD_SERVE_LEASE_SEC")
+    os.environ["HVD_SERVE_LEASE_SEC"] = "0.1"
+    standby = None
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            rig = ServeRig(n, backends=4, journal_dir=td,
+                           liveness_sec=0.0, beat_sec=0.2,
+                           monitor=False)
+            try:
+                rig.start()
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    steps = rig.router.replica_steps()
+                    if len(steps) == n and all(
+                            v is not None for v in steps.values()):
+                        break
+                    time.sleep(0.05)
+                results = {}
+
+                def _drive_load():
+                    results["load"] = rig.load(
+                        clients=clients,
+                        requests_per_client=per_client)
+
+                loader = threading.Thread(target=_drive_load,
+                                          daemon=True)
+                loader.start()
+                t0 = time.monotonic()
+                assert rig.router.start_roll(
+                    1, wave_size=max(1, n // 8),
+                    settle_sec=0.1)["ok"]
+                while rig.router.roll_status().get("outcome") is None:
+                    time.sleep(0.05)
+                status = rig.router.roll_status()
+                out["roll"] = {
+                    "sec": round(time.monotonic() - t0, 3),
+                    "waves": status.get("waves"),
+                    "outcome": status.get("outcome"),
+                }
+                loader.join(timeout=600.0)
+                out["load_during_roll"] = results.get("load")
+                standby = Standby(td, rig.router.port,
+                                  takeover_sec=0.5, poll_sec=0.05,
+                                  monitor=False)
+                standby.start()
+                time.sleep(0.3)  # the standby warms its journal fold
+                t0 = time.monotonic()
+                rig.kill_router()
+                took = standby.wait_takeover(60.0)
+                out["failover"] = {
+                    "took_over": took,
+                    "kill_to_takeover_sec": round(
+                        time.monotonic() - t0, 3),
+                    "replayed": (standby.router._replayed
+                                 if took else None),
+                }
+                if took:
+                    rig.adopt_router(standby.router)
+                    out["load_after_failover"] = rig.load(
+                        clients=clients,
+                        requests_per_client=per_client)
+                out["lost_requests"] = rig.lost
+            finally:
+                if standby is not None \
+                        and not standby.took_over.is_set():
+                    standby.stop()
+                rig.stop()
+    finally:
+        if prior_lease is None:
+            os.environ.pop("HVD_SERVE_LEASE_SEC", None)
+        else:
+            os.environ["HVD_SERVE_LEASE_SEC"] = prior_lease
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--sizes", default="25,100,250,500",
@@ -158,6 +245,10 @@ def main(argv=None) -> int:
     ap.add_argument("--storm", action="store_true",
                     help="run ONLY the combined acceptance storm at "
                          "the largest size")
+    ap.add_argument("--ops", action="store_true",
+                    help="run ONLY the fleet-operations section "
+                         "(rolling upgrade + router failover timings) "
+                         "at each size")
     ap.add_argument("--no-storm", action="store_true",
                     help="skip the combined storm section")
     ap.add_argument("--out", default=None,
@@ -179,7 +270,11 @@ def main(argv=None) -> int:
         "quick": bool(args.quick),
     }
 
-    if args.storm:
+    if args.ops:
+        doc["ops"] = [bench_ops(n, clients=clients,
+                                per_client=per_client)
+                      for n in sizes]
+    elif args.storm:
         doc["storm"] = bench_storm(max(sizes), waves=waves,
                                    clients=clients,
                                    per_client=per_client)

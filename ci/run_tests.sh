@@ -7,7 +7,7 @@
 # is the default `pytest tests/` run, tier 2 holds the heavyweight
 # integration jobs whose code paths tier 1 already covers.
 #
-# Usage: ci/run_tests.sh [analysis|flightrec|fleet|tier1|tier2|all]
+# Usage: ci/run_tests.sh [analysis|flightrec|fleet|ops|tier1|tier2|all]
 set -e
 cd "$(dirname "$0")/.."
 
@@ -185,8 +185,26 @@ run_fleet() {
         python bench_fleet.py --quick --sizes 64 --no-storm > /dev/null
 }
 
+# Ops lane (ISSUE 20): the zero-downtime fleet operations — a rolling
+# checkpoint upgrade over a 64-identity stub fleet under closed-loop
+# load (zero lost requests) and a kill -9 of the active router
+# MID-ROLL with a hot standby resuming the upgrade from the journal.
+# Fail-fast: a broken drain/roll/failover path turns every planned
+# operation into an outage, which is cheaper to catch here than during
+# one. Jax-free (thread-stub replicas, real sockets/journal) — tens of
+# seconds warm; the SIGTERM-storm and kill-mid-drain chaos variants
+# carry tier2+slow and ride the full tier run.
+run_ops() {
+    echo "=== ops: rolling upgrade + router failover (tests/test_ops.py, n=64) ==="
+    timeout "${HVD_CI_OPS_BUDGET:-600}" python -m pytest \
+        tests/test_ops.py::test_ops_rolling_upgrade_n64_zero_lost \
+        tests/test_ops.py::test_ops_router_failover_resumes_roll_n64 \
+        -q -p no:cacheprovider --override-ini 'addopts='
+}
+
 run_tier2() {
     run_fleet
+    run_ops
     echo "=== tier 2: serving smoke (bench_serve.py, jax-free fleet) ==="
     timeout "${HVD_CI_SERVE_BUDGET:-600}" \
         python bench_serve.py --np 2 --duration 2 --threads 4 \
@@ -224,16 +242,19 @@ run_tier2() {
         --override-ini 'addopts=' -m tier2 \
         --deselect tests/test_chaos_elastic.py::test_driver_kill9_journal_resume \
         --deselect tests/test_chaos_serve.py::test_serve_chaos_replica_kill9_then_router_sigkill \
-        --deselect tests/test_chaos.py::test_chaos_reset_heals_in_place
+        --deselect tests/test_chaos.py::test_chaos_reset_heals_in_place \
+        --deselect tests/test_ops.py::test_ops_rolling_upgrade_n64_zero_lost \
+        --deselect tests/test_ops.py::test_ops_router_failover_resumes_roll_n64
 }
 
 case "$TIER" in
     analysis) run_analysis ;;
     flightrec) run_flightrec ;;
     fleet) run_fleet ;;
+    ops) run_ops ;;
     tier1) run_tier1 ;;
     tier2) run_tier2 ;;
     all) run_analysis; run_tier1; run_tier2 ;;
-    *) echo "usage: $0 [analysis|flightrec|fleet|tier1|tier2|all]" >&2
+    *) echo "usage: $0 [analysis|flightrec|fleet|ops|tier1|tier2|all]" >&2
        exit 2 ;;
 esac

@@ -356,7 +356,9 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     Knob("HVD_FLIGHTREC_DIR", HONORED,
          "directory flight-record dumps land in (default cwd; the "
          "elastic driver and serve fleet point workers at the journal "
-         "dir so evidence survives the process)"),
+         "dir so evidence survives the process, and launcher-spawned "
+         "workers without an operator-chosen dir dump into a per-"
+         "launcher temp dir instead of littering the cwd)"),
     Knob("HVD_FLIGHTREC_SIGNAL", HONORED,
          "utils/flightrec.py: 0 disables the SIGTERM dump handler "
          "(the wedge-cull SIGTERM->SIGKILL grace window is the dump "
@@ -415,6 +417,29 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
          "serve/router.py: base cooldown for a tripped replica "
          "breaker, jittered +/-50% and doubled per consecutive trip "
          "(capped at 8x; default 5)"),
+    # Fleet operations: drain / rolling upgrade / router failover
+    # (serve/replica.py, serve/rollout.py, serve/standby.py;
+    # docs/serving.md#fleet-operations-runbook).
+    Knob("HVD_SERVE_DRAIN_GRACE_SEC", HONORED,
+         "serve/replica.py + serve/server.py: how long a draining "
+         "replica waits for its queued micro-batches before the "
+         "goodbye beat and exit; Server.stop() waits this plus slack "
+         "before killing stragglers (default 30)"),
+    Knob("HVD_SERVE_ROLL_WAVE", HONORED,
+         "serve/rollout.py: replicas upgraded per rolling-upgrade "
+         "wave — the blast radius of a bad checkpoint (default 1)"),
+    Knob("HVD_SERVE_ROLL_SETTLE_SEC", HONORED,
+         "serve/rollout.py: per-wave health-gate window after "
+         "re-admission — any new breaker charge inside it aborts and "
+         "rolls the upgrade back (default 1.0)"),
+    Knob("HVD_SERVE_LEASE_SEC", HONORED,
+         "serve/router.py: how often the active router refreshes its "
+         "leader lease next to the journal (default 1.0; <=0 disables "
+         "the lease, and with it standby failover)"),
+    Knob("HVD_SERVE_TAKEOVER_SEC", HONORED,
+         "serve/standby.py: lease silence after which a hot standby "
+         "takes over the service port and journal (default 3.0; keep "
+         "well above HVD_SERVE_LEASE_SEC)"),
 ]}
 
 

@@ -41,6 +41,14 @@ record seeds the state and later ``exit``/``wedged`` events update it,
 so the recovered driver sees exactly the bookkeeping the dead one had.
 A torn final line (the crash landed mid-append) is tolerated and
 dropped.
+
+The serving router journals through this same class with its own
+record kinds (``serve/router.py`` replays them): ``replica``/``cull``
+(membership), ``drain``/``undrain`` (graceful-drain lifecycle),
+``roll`` (rolling-upgrade progress — ``serve/rollout.py`` documents
+the event shapes), and ``takeover`` (a standby router adopted the
+journal). Both replayers skip unknown kinds, so the two record
+families stay forward-compatible with each other.
 """
 
 from __future__ import annotations
@@ -234,6 +242,19 @@ class DriverJournal:
                 self._fh.close()
             except OSError:
                 pass
+
+    @staticmethod
+    def count_records(path: str) -> int:
+        """Line count of an existing journal — what a re-attaching
+        owner seeds ``records_since_snapshot`` with so the compaction
+        cadence survives restarts (every line is one record; a torn
+        tail overcounts by at most one, which only compacts a record
+        early)."""
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                return sum(1 for _ in fh)
+        except OSError:
+            return 0
 
     @staticmethod
     def replay(path: str,

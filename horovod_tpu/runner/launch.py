@@ -38,6 +38,35 @@ def free_port() -> int:
     return port
 
 
+_flightrec_fallback_dir: Optional[str] = None
+
+
+def flightrec_default_dir() -> str:
+    """Where spawned workers auto-dump flight records when the
+    operator didn't pin ``HVD_FLIGHTREC_DIR``: one temp dir per
+    launcher process (memoized so every rank of a job dumps into the
+    same place). Without this, an aborting worker drops
+    ``flightrec.rank*.jsonl`` files into the LAUNCHING process's cwd —
+    test- and bench-spawned fleets were littering the repo root."""
+    global _flightrec_fallback_dir
+    if _flightrec_fallback_dir is None:
+        import tempfile
+
+        _flightrec_fallback_dir = tempfile.mkdtemp(
+            prefix="hvd_flightrec_")
+    return _flightrec_fallback_dir
+
+
+def _flightrec_env(env: Dict[str, str]) -> Dict[str, str]:
+    """Add the flightrec dump-dir default to a worker env — unless the
+    operator chose one (in the worker's extra env, or inherited: the
+    spawn paths overlay ``env`` on ``os.environ``)."""
+    if "HVD_FLIGHTREC_DIR" not in env \
+            and "HVD_FLIGHTREC_DIR" not in os.environ:
+        env["HVD_FLIGHTREC_DIR"] = flightrec_default_dir()
+    return env
+
+
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     import horovod_tpu
 
@@ -353,7 +382,7 @@ def slot_env(a, controller_addr: str, controller_port: int,
                          if "PYTHONPATH" in os.environ else []))
     env["PYTHONPATH"] = pythonpath
     env.update(extra)
-    return env
+    return _flightrec_env(env)
 
 
 def _run_static(args) -> int:
@@ -471,6 +500,7 @@ def _run_mpi(args) -> int:
         "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
         "PYTHONUNBUFFERED": "1",
     })
+    _flightrec_env(env)
     try:
         return run_mpi(np_, hosts_str, args.command, env,
                        nics=args.nics.split(",") if args.nics else None,
@@ -509,6 +539,7 @@ def _run_jsrun(args) -> int:
         "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
         "PYTHONUNBUFFERED": "1",
     })
+    _flightrec_env(env)
     try:
         return js_run(np_, args.command, env,
                       extra_args=args.binding_args)

@@ -16,11 +16,28 @@ Run one replica by hand (what the default topology spawns)::
 
     python -m horovod_tpu.serve --role replica --ckpt-dir /ckpts \
         --model mnist_mlp --router 127.0.0.1:8000 --replica-id r0
+
+Fleet operations (docs/serving.md#fleet-operations-runbook)::
+
+    # hot standby: takes over port 8000 when the active router's
+    # lease goes silent, replaying the shared journal
+    python -m horovod_tpu.serve --role standby --port 8000 \
+        --journal-dir /ckpts/serve
+
+    # rolling checkpoint upgrade to step 1200, two replicas per wave
+    python -m horovod_tpu.serve --role roll --port 8000 \
+        --step 1200 --wave-size 2
+
+    # gracefully drain one replica out of the fleet
+    python -m horovod_tpu.serve --role drain --port 8000 \
+        --replica-id r0
 """
 
 from __future__ import annotations
 
 import argparse
+import http.client
+import json
 import logging
 import os
 import signal
@@ -47,16 +64,89 @@ def _default_port() -> int:
         return 8000
 
 
+def _router_addr(args):
+    if args.router:
+        addr, _, port = args.router.rpartition(":")
+        return addr, int(port)
+    return "127.0.0.1", args.port
+
+
+def _post_json(addr, port, path, doc, timeout=30.0):
+    conn = http.client.HTTPConnection(addr, port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(doc).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode() or "{}")
+    finally:
+        conn.close()
+
+
+def _roll_main(args) -> int:
+    """Operator CLI for the rolling upgrade: POST /v1/roll to the
+    ACTIVE router (the controller must run in the journal-owning
+    process so a failover can resume it), then poll status until the
+    roll finishes. Exit 0 on ok, 1 on abort."""
+    if args.step is None:
+        print("--role roll needs --step (the target committed "
+              "checkpoint step)", file=sys.stderr)
+        return 2
+    addr, port = _router_addr(args)
+    doc = {"step": args.step}
+    if args.wave_size is not None:
+        doc["wave_size"] = args.wave_size
+    if args.settle_sec is not None:
+        doc["settle_sec"] = args.settle_sec
+    status, payload = _post_json(addr, port, "/v1/roll", doc)
+    if status != 202:
+        print("roll refused (%d): %s" % (status, payload),
+              file=sys.stderr)
+        return 1
+    from horovod_tpu.serve.server import http_get_json
+
+    while True:
+        time.sleep(0.5)
+        try:
+            roll = http_get_json(addr, port, "/v1/roll", timeout=10)
+        except OSError:
+            # Router died mid-roll: a standby (if any) resumes from
+            # the journal on the SAME port — keep polling.
+            continue
+        print("roll: wave=%s/%s outcome=%s"
+              % (roll.get("wave"), roll.get("waves"),
+                 roll.get("outcome")), flush=True)
+        if roll.get("outcome") is not None:
+            if roll.get("outcome") == "ok":
+                return 0
+            print("roll aborted: %s" % roll.get("reason"),
+                  file=sys.stderr)
+            return 1
+
+
+def _drain_main(args) -> int:
+    """Operator CLI: gracefully drain one replica via the router."""
+    addr, port = _router_addr(args)
+    status, payload = _post_json(addr, port, "/v1/drain",
+                                 {"replica": args.replica_id})
+    print(json.dumps(payload), flush=True)
+    return 0 if status == 200 else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m horovod_tpu.serve",
         description="Crash-safe micro-batching inference serving "
                     "(docs/serving.md)")
-    ap.add_argument("--role", choices=("serve", "router", "replica"),
+    ap.add_argument("--role",
+                    choices=("serve", "router", "replica", "standby",
+                             "roll", "drain"),
                     default="serve",
                     help="serve = router + --np replica subprocesses "
                          "(default); router = front door only (the "
-                         "crash-restart path); replica = one worker")
+                         "crash-restart path); replica = one worker; "
+                         "standby = hot-standby router failover; "
+                         "roll = rolling checkpoint upgrade to --step; "
+                         "drain = gracefully drain --replica-id")
     ap.add_argument("--ckpt-dir", default=None,
                     help="Checkpointer directory holding the committed "
                          "steps to serve")
@@ -79,7 +169,17 @@ def main(argv=None) -> int:
     ap.add_argument("--router", default=None,
                     help="[replica] router addr:port to register with")
     ap.add_argument("--replica-id", default="r0",
-                    help="[replica] stable replica identity")
+                    help="[replica] stable replica identity; "
+                         "[drain] the replica to drain")
+    # fleet-operations flags
+    ap.add_argument("--step", type=int, default=None,
+                    help="[roll] target committed checkpoint step")
+    ap.add_argument("--wave-size", type=int, default=None,
+                    help="[roll] replicas upgraded per wave (default "
+                         "HVD_SERVE_ROLL_WAVE or 1)")
+    ap.add_argument("--settle-sec", type=float, default=None,
+                    help="[roll] per-wave health-gate window (default "
+                         "HVD_SERVE_ROLL_SETTLE_SEC or 1)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -94,6 +194,45 @@ def main(argv=None) -> int:
         args.port = _default_port()
     if args.journal_dir is None and args.ckpt_dir:
         args.journal_dir = os.path.join(args.ckpt_dir, "serve_journal")
+    if args.journal_dir and "HVD_FLIGHTREC_DIR" not in os.environ:
+        # Keep the control-plane process's own flight-record dumps
+        # next to the journal instead of littering the cwd (the
+        # replica children get their per-replica dirs from Server).
+        os.environ["HVD_FLIGHTREC_DIR"] = os.path.join(
+            args.journal_dir, "flightrec", args.role)
+
+    if args.role == "roll":
+        return _roll_main(args)
+    if args.role == "drain":
+        return _drain_main(args)
+
+    if args.role == "standby":
+        if not args.journal_dir:
+            ap.error("--role standby needs --journal-dir (or "
+                     "--ckpt-dir) — the shared journal IS the state "
+                     "it takes over")
+        from horovod_tpu.serve.standby import Standby
+
+        standby = Standby(journal_dir=args.journal_dir, port=args.port,
+                          liveness_sec=args.liveness_sec)
+        standby.start()
+        _exit_gracefully_on_sigterm(standby.stop)
+        print("SERVE_STANDBY_READY port=%d pid=%d"
+              % (args.port, os.getpid()), flush=True)
+        try:
+            while True:
+                if standby.wait_takeover(3600):
+                    if standby.router is not None:
+                        print("SERVE_STANDBY_TOOK_OVER port=%d pid=%d "
+                              "replayed=%d"
+                              % (args.port, os.getpid(),
+                                 standby.router._replayed), flush=True)
+                    break
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            standby.stop()
+        return 0
 
     if args.role == "router":
         from horovod_tpu.serve.router import Router
@@ -104,6 +243,12 @@ def main(argv=None) -> int:
         _exit_gracefully_on_sigterm(router.stop)
         print("SERVE_ROUTER_READY port=%d pid=%d replayed=%d"
               % (port, os.getpid(), router._replayed), flush=True)
+        # A restarted router picks an interrupted rolling upgrade back
+        # up from the journal — same resume path the standby uses.
+        resumed = router.resume_roll_if_pending()
+        if resumed is not None:
+            print("SERVE_ROLL_RESUMED %s"
+                  % json.dumps(resumed.get("status") or {}), flush=True)
         try:
             while True:
                 time.sleep(3600)

@@ -87,7 +87,15 @@ class ReplicaMonitor:
                             silence_sec=age,
                             dump=self._dump_path(rid))
                 _C_CULLED.inc()
-        _G_REPLICAS.set(router.stats()["replicas"])
+        stats = router.stats()
+        _G_REPLICAS.set(stats["replicas"])
+        # Refresh the lifecycle gauge from stats too (one lock hop for
+        # the whole tick): the mutation sites keep it live, but a
+        # restarted router's journal-REPLAYED drains never passed
+        # through drain() in this process.
+        from horovod_tpu.serve.router import _G_DRAINING
+
+        _G_DRAINING.set(stats["draining"])
         now = time.monotonic()
         done = router.requests_done()
         if self._last_ts is not None and now > self._last_ts:

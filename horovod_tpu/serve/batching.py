@@ -184,6 +184,7 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._pending: deque = deque()
         self._pending_rows = 0
+        self._busy = False
         self._stopped = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="hvd-serve-batcher-%s" % name)
@@ -232,6 +233,23 @@ class MicroBatcher:
             if deadline_ms is not None:
                 self.deadline_s = max(0.0, float(deadline_ms) / 1000.0)
             self._cond.notify_all()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every queued request has executed and resolved
+        its future — the graceful-drain contract (docs/serving.md):
+        callers stop accepting NEW work first (the replica 503s new
+        predicts once draining), then wait here for the queue to run
+        dry, batch currently executing included. Returns ``False``
+        when ``timeout`` expired with work still in flight; the queue
+        keeps running either way — ``stop()`` is still the teardown."""
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        with self._cond:
+            while self._pending or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            return True
 
     def stop(self):
         """Drain nothing further: fail queued requests and stop the
@@ -284,6 +302,10 @@ class MicroBatcher:
                 self._pending_rows -= req.rows.shape[0]
                 batch.append(req)
             _G_QUEUE_DEPTH.set(self._pending_rows)
+            # Flagged inside the same critical section as the pop:
+            # drain() must never observe "queue empty, nothing busy"
+            # while a popped batch is still on its way to run_batch.
+            self._busy = bool(batch)
             return batch
 
     def _loop(self):
@@ -321,6 +343,7 @@ class MicroBatcher:
                 for req in batch:
                     if not req.future.cancelled():
                         req.future.set_exception(e)
+                self._batch_done()
                 continue
             _C_BATCHES.inc()
             _H_BATCH_SIZE.observe(n)
@@ -334,3 +357,9 @@ class MicroBatcher:
                 if not req.future.cancelled():
                     req.future.set_result(out[off:off + k])
                 off += k
+            self._batch_done()
+
+    def _batch_done(self):
+        with self._cond:
+            self._busy = False
+            self._cond.notify_all()

@@ -21,7 +21,18 @@ Crash-safety wiring (PR 5 machinery, reused):
 Checkpoint hot-reload: every ``HVD_SERVE_CKPT_POLL_SEC`` the replica
 polls ``Checkpointer.latest_step()`` and atomically swaps in a newer
 committed step — a training job can keep publishing checkpoints into
-the directory a live serving fleet reads from.
+the directory a live serving fleet reads from. ``POST /v1/reload
+{"step": N}`` is the directed form the rolling-upgrade controller
+uses (serve/rollout.py): restore EXACTLY step N — downgrades included,
+that is the rollback path — re-run the bucket self-check (compile
+warmup), swap.
+
+Graceful drain (``begin_drain``: SIGTERM, ``POST /v1/drain``, or the
+router relaying an operator drain): flag ``draining`` in the
+heartbeat payload immediately (the router benches this replica), 503
+NEW predicts (the router retries them elsewhere — zero client-visible
+loss), finish every queued micro-batch, send one final *goodbye* beat
+(the router culls without waiting out the liveness window), exit 0.
 """
 
 from __future__ import annotations
@@ -137,6 +148,12 @@ class Replica:
         self._server: Optional[KVStoreServer] = None
         self._stop = threading.Event()
         self._threads = []
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        # Serializes directed reloads (/v1/reload) against each other
+        # and the poller's own restores; the apply swap itself stays
+        # under _apply_lock as before.
+        self._reload_lock = threading.Lock()
 
     # --- model loading ------------------------------------------------------
 
@@ -243,6 +260,15 @@ class Replica:
     _json = staticmethod(json_route_result)
 
     def _handle_predict(self, body: bytes):
+        with self._drain_lock:
+            draining = self._draining
+        if draining:
+            # New work is refused the moment drain begins; the router
+            # already benched us and retries this forward elsewhere
+            # (503 is a 5xx: it charges our breaker budget, which is
+            # moot — we are leaving). Queued work keeps finishing.
+            return self._json(503, {"error": "draining",
+                                    "replica": self.replica_id})
         try:
             doc = json.loads(body.decode() or "{}")
             inputs = np.asarray(doc["inputs"], dtype=np.float32)
@@ -286,15 +312,69 @@ class Replica:
 
     def _handle_healthz(self):
         apply, step = self._loaded_state()
+        with self._drain_lock:
+            draining = self._draining
         return self._json(200, {
             "ok": apply is not None,
             "role": "replica",
             "replica": self.replica_id,
             "model": self.model,
             "step": step,
+            "state": "draining" if draining else "serving",
             "pid": os.getpid(),
             "port": self.port,
         })
+
+    def _handle_reload(self, body: bytes):
+        """``POST /v1/reload {"step": N}``: restore exactly step N —
+        the rolling-upgrade controller's directed reload (and its
+        rollback: N may be LOWER than the serving step, which the
+        latest-only poller would never do). The bucket self-check
+        inside _restore_step re-runs before the swap, so a reloaded
+        replica re-enters rotation with warm compiled buckets. A bad
+        checkpoint maps to a 500 (the roll gate aborts on it) and the
+        currently loaded step keeps serving."""
+        try:
+            doc = json.loads(body.decode() or "{}")
+            step = int(doc["step"])
+        except (ValueError, TypeError, KeyError):
+            return self._json(400, {"error":
+                                    "body must be JSON with int 'step'"})
+        if self._ckpt is None:
+            return self._json(400, {
+                "error": "replica has no checkpoint directory to "
+                         "reload from",
+                "replica": self.replica_id})
+        _, loaded = self._loaded_state()
+        with self._reload_lock:
+            if loaded != step:
+                try:
+                    self._restore_step(step)
+                    _C_RELOADS.inc()
+                except Exception as e:  # analysis: allow-broad-except
+                    # — a half-written/GC'd/poisoned step must answer
+                    # 500, not kill the handler thread; the loaded
+                    # step keeps serving.
+                    logger.warning(
+                        "serve replica %s directed reload to step %s "
+                        "failed: %s", self.replica_id, step, e)
+                    _, still = self._loaded_state()
+                    return self._json(500, {
+                        "error": "reload to step %d failed: %s"
+                                 % (step, e),
+                        "step": still,
+                        "replica": self.replica_id})
+        _, now_step = self._loaded_state()
+        logger.info("serve replica %s serving step %s (directed reload)",
+                    self.replica_id, now_step)
+        return self._json(200, {"ok": True, "step": now_step,
+                                "replica": self.replica_id})
+
+    def _handle_drain(self, body: bytes):
+        """``POST /v1/drain``: enter graceful drain (idempotent)."""
+        self.begin_drain(reason="http")
+        return self._json(200, {"ok": True, "replica": self.replica_id,
+                                "draining": True})
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -304,9 +384,12 @@ class Replica:
 
     def endpoint_payload(self) -> dict:
         """What registration and every heartbeat carry: enough for a
-        router (fresh or journal-replayed) to route to this replica."""
+        router (fresh or journal-replayed) to route to this replica,
+        plus the lifecycle flag — a ``draining`` beat benches this
+        replica at the router within one heartbeat period even if the
+        immediate drain beat was lost."""
         _, step = self._loaded_state()
-        return {
+        payload = {
             "ts": time.time(),
             "pid": os.getpid(),
             "addr": self.advertise_addr,
@@ -314,6 +397,29 @@ class Replica:
             "model": self.model,
             "step": step,
         }
+        with self._drain_lock:
+            if self._draining:
+                payload["draining"] = True
+        return payload
+
+    def _send_beat(self, goodbye: bool = False) -> bool:
+        """One immediate best-effort heartbeat PUT, outside the loop's
+        cadence: the drain-entry beat (router benches us NOW) and the
+        goodbye beat (router culls us NOW)."""
+        ep = self._router_endpoint()
+        if ep is None:
+            return False
+        payload = self.endpoint_payload()
+        if goodbye:
+            payload["draining"] = True
+            payload["goodbye"] = True
+        try:
+            write_kv(ep[0], ep[1], "heartbeat", self.replica_id,
+                     json.dumps(payload).encode(), timeout=5)
+            _C_HEARTBEATS.inc()
+            return True
+        except OSError:
+            return False
 
     def _router_endpoint(self) -> Optional[Tuple[str, int]]:
         if not self.router:
@@ -395,6 +501,45 @@ class Replica:
                     lambda v: batcher.set_tunables(deadline_ms=v),
             })
 
+    def begin_drain(self, reason: str = "signal"):
+        """Enter graceful drain (idempotent): flag the beats, refuse
+        new predicts, finish queued micro-batches on a background
+        thread, goodbye-beat, release serve_forever. Never blocks the
+        caller — SIGTERM handlers and HTTP threads both land here."""
+        with self._drain_lock:
+            if self._draining:
+                return
+            self._draining = True
+        from horovod_tpu.utils import flightrec
+
+        flightrec.record("serve_drain", replica=self.replica_id,
+                         reason=reason)
+        logger.info("serve replica %s draining (%s)",
+                    self.replica_id, reason)
+        # Immediate draining beat: the router benches us before the
+        # next scheduled heartbeat would.
+        self._send_beat()
+        t = threading.Thread(target=self._drain_and_exit, daemon=True,
+                             name="hvd-serve-drain")
+        t.start()
+        self._threads.append(t)
+
+    def _drain_and_exit(self):
+        grace = float_env("HVD_SERVE_DRAIN_GRACE_SEC", 30.0)
+        drained = True
+        if self._batcher is not None:
+            drained = self._batcher.drain(timeout=grace)
+        if not drained:
+            logger.warning(
+                "serve replica %s drain grace (%.1fs) expired with "
+                "work still queued; exiting anyway", self.replica_id,
+                grace)
+        # Goodbye: the router culls us now instead of after the
+        # liveness window; best-effort — a down router sweeps us by
+        # silence soon enough.
+        self._send_beat(goodbye=True)
+        self._stop.set()
+
     def start(self):
         """Load the model, bind the HTTP server, start heartbeats and
         the checkpoint poller. Returns the bound port."""
@@ -403,6 +548,8 @@ class Replica:
         self._server = KVStoreServer(port=self._requested_port)
         self._server.register_post_route("/v1/predict",
                                          self._handle_predict)
+        self._server.register_post_route("/v1/reload", self._handle_reload)
+        self._server.register_post_route("/v1/drain", self._handle_drain)
         self._server.register_get_route("/healthz", self._handle_healthz)
         self._server.start()
         self.register()
@@ -441,14 +588,35 @@ class Replica:
             self.stop()
 
 
+def _install_drain_on_sigterm(replica: Replica):
+    """First SIGTERM = graceful drain (finish the queue, goodbye-beat,
+    exit 0 — Server.stop's terminate() lands here). A second SIGTERM
+    escalates to the default immediate kill, so a wedged drain can
+    still be stopped by hand."""
+    import signal
+
+    def handler(signum, frame):
+        if replica._draining:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        replica.begin_drain(reason="SIGTERM")
+
+    signal.signal(signal.SIGTERM, handler)
+
+
 def main(args) -> int:
     logging.basicConfig(level=logging.INFO)
     replica = Replica(model=args.model, ckpt_dir=args.ckpt_dir,
                       router=args.router, replica_id=args.replica_id,
                       port=args.port)
     port = replica.start()
+    _install_drain_on_sigterm(replica)
     sys.stdout.write("SERVE_REPLICA_READY %s port=%d pid=%d\n"
                      % (args.replica_id, port, os.getpid()))
     sys.stdout.flush()
     replica.serve_forever()
+    # serve_forever returned: a drain ran to completion (the goodbye
+    # beat is already out) — tear down the batcher/server cleanly.
+    replica.stop()
     return 0

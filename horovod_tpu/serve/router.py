@@ -29,6 +29,21 @@ heartbeating and never notice the restart.
 Re-admission: heartbeat payloads carry the replica's endpoint, so a
 culled (or never-journaled) replica is re-admitted from its next beat
 alone — no re-registration round-trip needed.
+
+Zero-downtime operations (docs/serving.md#fleet-operations-runbook):
+
+- **drain**: a replica leaving on purpose (SIGTERM, ``POST
+  /v1/drain``, a rolling upgrade) is journaled out of the pick
+  rotation immediately — in-flight forwards complete, new picks skip
+  it — and its final *goodbye* beat culls it without waiting out the
+  liveness window;
+- **rolling upgrade**: ``start_roll`` drives the fleet to a target
+  checkpoint step in drained waves (serve/rollout.py), every wave
+  transition journaled so a router crash mid-roll resumes instead of
+  stranding a mixed-step fleet;
+- **failover**: the active router renews a lease file next to the
+  journal; a hot standby (serve/standby.py) tails both and takes over
+  the service port on leader silence.
 """
 
 from __future__ import annotations
@@ -77,6 +92,16 @@ _G_COOLING = _metrics.gauge(
     "hvd_serve_replicas_cooling",
     "Replicas currently parked by a tripped breaker (out of the "
     "round-robin rotation until their cooldown expires).")
+_G_DRAINING = _metrics.gauge(
+    "hvd_serve_draining_replicas",
+    "Replicas currently draining (journaled out of the pick rotation "
+    "by a SIGTERM/operator/rolling-upgrade drain while their queued "
+    "work finishes).")
+_C_UPGRADES = _metrics.counter(
+    "hvd_serve_upgrades_total",
+    "Rolling checkpoint upgrades driven by the roll controller, by "
+    "outcome (ok / abort — an abort rolled every touched wave back "
+    "to its prior step).", labelnames=("outcome",))
 
 
 def serve_journal_path(journal_dir: str) -> str:
@@ -86,9 +111,12 @@ def serve_journal_path(journal_dir: str) -> str:
 def replay_routing(path: str) -> Dict[str, dict]:
     """Fold a serve journal into the routing table it described:
     ``replica`` records admit (last endpoint wins), ``cull`` records
-    remove. Unknown record types are skipped (forward compatibility);
-    a torn trailing line ends the replay (the DriverJournal attach
-    truncates it before this incarnation appends)."""
+    remove, ``drain``/``undrain`` toggle the entry's ``draining``
+    marker (the drain source string) — a fresh ``replica`` record
+    clears it, matching live re-admission. Roll/takeover records (and
+    any future kind) are skipped (forward compatibility); a torn
+    trailing line ends the replay (the DriverJournal attach truncates
+    it before this incarnation appends)."""
     table: Dict[str, dict] = {}
     if not os.path.exists(path):
         return table
@@ -106,11 +134,15 @@ def replay_routing(path: str) -> Dict[str, dict]:
                 # Compaction point (DriverJournal.compact): the full
                 # table at that moment replaces everything folded so
                 # far; later records are the tail.
-                table = {
-                    str(rid): {k: info.get(k)
-                               for k in ("addr", "port", "pid", "model")}
-                    for rid, info in (rec.get("table") or {}).items()
-                    if isinstance(info, dict)}
+                table = {}
+                for rid, info in (rec.get("table") or {}).items():
+                    if not isinstance(info, dict):
+                        continue
+                    entry = {k: info.get(k)
+                             for k in ("addr", "port", "pid", "model")}
+                    if info.get("draining"):
+                        entry["draining"] = info.get("draining")
+                    table[str(rid)] = entry
                 continue
             rid = rec.get("id")
             if rid is None:
@@ -120,6 +152,13 @@ def replay_routing(path: str) -> Dict[str, dict]:
                               for k in ("addr", "port", "pid", "model")}
             elif rtype == "cull":
                 table.pop(rid, None)
+            elif rtype == "drain":
+                if rid in table:
+                    table[rid]["draining"] = \
+                        rec.get("source") or "operator"
+            elif rtype == "undrain":
+                if rid in table:
+                    table[rid].pop("draining", None)
     return table
 
 
@@ -159,6 +198,28 @@ class Router:
         self._rotation_set: Set[str] = set()
         self._cool_heap: List[Tuple[float, str]] = []
         self._hb_heap: List[Tuple[float, str]] = []
+        # Draining replicas (rid -> drain source: "heartbeat" when the
+        # replica asked, "operator"/"roll" when the router was told).
+        # Out of the rotation but still admitted: in-flight forwards
+        # complete, new picks skip them. The source gates auto-undrain
+        # — a heartbeat without the flag lifts only a heartbeat-
+        # sourced drain, so a roll-drained replica cannot beat itself
+        # back into rotation mid-reload.
+        self._draining: Dict[str, str] = {}
+        # Last serving checkpoint step each replica reported in its
+        # beats (observability + the roll controller's prior-step map;
+        # deliberately NOT journaled — beats refresh it within one
+        # heartbeat period of any restart).
+        self._steps: Dict[str, object] = {}
+        # Active rolling-upgrade controller (serve/rollout.py), if any.
+        self._roll = None
+        # Set by abrupt_stop(): the chaos rigs' in-process stand-in
+        # for kill -9. Journal/lease writers check it so a "dead"
+        # router can never append after a standby adopted the file.
+        self._dead = False
+        self._journal_dir = journal_dir
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
         # Monotonic count of rotation slots examined by _pick — the
         # O(N)-guard tests (tests/test_fleet.py) assert this grows
         # ~O(1) per request as the table grows.
@@ -199,10 +260,19 @@ class Router:
             self._journal = DriverJournal(path)
             now = time.monotonic()
             for rid, info in replayed.items():
-                self._table[rid] = info
+                drain_src = info.pop("draining", None)
+                self._table[rid] = {k: info.get(k)
+                                    for k in ("addr", "port", "pid",
+                                              "model")}
                 self._order.append(rid)
-                self._rotation.append(rid)
-                self._rotation_set.add(rid)
+                if drain_src:
+                    # Mid-drain at the old router's death: stay out of
+                    # rotation — the goodbye beat (or liveness cull)
+                    # finishes the job, an undrain re-admits.
+                    self._draining[rid] = str(drain_src)
+                else:
+                    self._rotation.append(rid)
+                    self._rotation_set.add(rid)
                 # Fresh liveness clock: a replica that died with the
                 # old router is culled liveness_sec from NOW; a live
                 # one re-beats long before that.
@@ -210,19 +280,19 @@ class Router:
                 if self.liveness_sec > 0:
                     heapq.heappush(self._hb_heap,
                                    (now + self.liveness_sec, rid))
+            _G_DRAINING.set(len(self._draining))
             self._replayed = len(replayed)
             # Seed the compaction counter with the existing tail so a
             # restarted router inherits the cadence instead of letting
             # an uncompacted history grow for another full budget.
-            try:
-                with open(path, "r", encoding="utf-8") as fh:
-                    self._journal.records_since_snapshot = \
-                        sum(1 for _ in fh)
-            except OSError:
-                pass
+            self._journal.records_since_snapshot = \
+                DriverJournal.count_records(path)
         self._kv = KVStoreServer(port=port, put_callback=self._on_put)
         self._kv.register_post_route("/v1/predict", self._handle_predict)
         self._kv.register_get_route("/healthz", self._handle_healthz)
+        self._kv.register_post_route("/v1/drain", self._handle_drain)
+        self._kv.register_post_route("/v1/roll", self._handle_roll)
+        self._kv.register_get_route("/v1/roll", self._handle_roll_status)
         self._monitor = ReplicaMonitor(self) if monitor else None
 
     # --- membership ---------------------------------------------------------
@@ -241,6 +311,8 @@ class Router:
                 if known:
                     self._hb_seen[key] = time.monotonic()
                     self._confirmed.add(key)
+                    if info is not None and "step" in info:
+                        self._steps[key] = info.get("step")
             if info is None or not (info.get("addr") and info.get("port")):
                 # No usable endpoint: a known replica's beat already
                 # stamped above; an unknown key is dropped without
@@ -248,6 +320,15 @@ class Router:
                 # PR 5 hazard), and stamping arbitrary keys into
                 # _hb_seen would grow it unboundedly since cull only
                 # ever pops admitted keys.
+                return
+            if info.get("goodbye"):
+                # The drain farewell: the replica finished its queued
+                # micro-batches and is about to exit — cull it NOW
+                # (journaled) instead of letting it eat forwards until
+                # the liveness window expires. An unknown goodbye has
+                # nothing to cull (and must not admit-then-cull).
+                if known:
+                    self.cull(key, reason="drained (goodbye beat)")
                 return
             # admit() is a no-op for an unchanged endpoint; for an
             # unknown key it is the re-admission path (rediscovery of
@@ -260,6 +341,16 @@ class Router:
             with self._lock:
                 if key in self._table:
                     self._confirmed.add(key)
+                    if "step" in info:
+                        self._steps[key] = info.get("step")
+            if info.get("draining"):
+                self.drain(key, source="heartbeat")
+            else:
+                # A flag-less beat lifts only the replica's OWN drain:
+                # operator/roll drains stay until explicitly undrained
+                # (the replica doesn't know the router benched it).
+                self.undrain(key, source="heartbeat",
+                             expect_source="heartbeat")
         elif scope == "replica":
             try:
                 info = json.loads(value.decode())
@@ -268,13 +359,16 @@ class Router:
             self.admit(key, info)
             with self._lock:
                 self._confirmed.add(key)
+                if key in self._table and "step" in info:
+                    self._steps[key] = info.get("step")
 
     def _rotation_add(self, rid: str):
         """(lock held) Restore the rotation invariant for ``rid``: in
-        rotation iff admitted and not cooling."""
+        rotation iff admitted, not cooling, and not draining."""
         # analysis: holds-lock(_lock) — every caller (admit, expire,
-        # _note_success) already holds _lock.
+        # _note_success, undrain) already holds _lock.
         if (rid in self._table and rid not in self._cooling_until
+                and rid not in self._draining
                 and rid not in self._rotation_set):
             self._rotation.append(rid)
             self._rotation_set.add(rid)
@@ -309,19 +403,36 @@ class Router:
         held, so the _lock-scoped snapshot can never miss an event it
         just erased (append-before-effect is preserved: the snapshot
         IS the effect)."""
-        # analysis: holds-lock(_journal_lock) — only admit()/cull()
-        # call this, after their effect commits.
+        # analysis: holds-lock(_journal_lock) — only admit()/cull()/
+        # drain()/undrain()/_journal_append() call this, after their
+        # effect commits.
         journal = self._journal
         if (journal is None or self.snapshot_every <= 0
                 or journal.records_since_snapshot
                 < self.snapshot_every):
             return
         with self._lock:
-            table = {rid: dict(e) for rid, e in self._table.items()}
+            table = {}
+            for rid, e in self._table.items():
+                row = dict(e)
+                src = self._draining.get(rid)
+                if src:
+                    row["draining"] = src
+                table[rid] = row
+            roll = self._roll
+        snapshot = {"table": table, "ts": time.time()}
+        if roll is not None:
+            # An active roll's progress must survive the fold: its
+            # begin/wave records are about to be erased, and the
+            # post-failover resume reads them (rollout.replay_roll
+            # reads this field back out of snapshot records).
+            view = roll.snapshot_view()
+            if view is not None:
+                snapshot["roll"] = view
         # analysis: blocking-ok(fsync'd fold under the dedicated
         # journal lock; the hot paths take only _lock and keep
         # flowing while the snapshot hits disk)
-        journal.compact({"table": table, "ts": time.time()})
+        journal.compact(snapshot)
 
     def admit(self, replica_id: str, info: dict):
         """Add (or update) a replica; journaled before it takes effect
@@ -364,12 +475,16 @@ class Router:
                 # (Re-)admission closes the breaker: a culled-then-
                 # rediscovered replica, or one respawned on a new
                 # endpoint, starts with a clean failure budget (the
-                # PR 8 heartbeat re-admission path lands here).
+                # PR 8 heartbeat re-admission path lands here). It
+                # also clears a stale drain — a respawned replica is
+                # a new lifecycle, matching the replay fold.
                 self._fail_count.pop(replica_id, None)
                 self._cooling_until.pop(replica_id, None)
                 self._trip_streak.pop(replica_id, None)
+                self._draining.pop(replica_id, None)
                 self._rotation_add(replica_id)
                 _G_COOLING.set(len(self._cooling_until))
+                _G_DRAINING.set(len(self._draining))
             self._maybe_compact()
 
     def cull(self, replica_id: str, reason: str = "silent",
@@ -410,10 +525,112 @@ class Router:
                 self._fail_count.pop(replica_id, None)
                 self._cooling_until.pop(replica_id, None)
                 self._trip_streak.pop(replica_id, None)
+                self._draining.pop(replica_id, None)
+                self._steps.pop(replica_id, None)
                 _G_COOLING.set(len(self._cooling_until))
+                _G_DRAINING.set(len(self._draining))
             self._maybe_compact()
         flightrec.record_failure("cull", "replica %s: %s"
                                  % (replica_id, reason))
+
+    def drain(self, replica_id: str, source: str = "operator") -> bool:
+        """Take ``replica_id`` out of the pick rotation NOW, journaled
+        first (the admit/cull append-before-effect discipline): new
+        picks skip it immediately while in-flight forwards complete,
+        and a router restart replays it still benched. ``source``
+        records who asked — ``heartbeat`` (the replica flagged its own
+        beat), ``operator`` (/v1/drain or the CLI), or ``roll`` (the
+        upgrade controller) — and gates who may auto-undrain it.
+        Returns False for an unknown replica; True otherwise
+        (idempotent — a steady stream of draining beats journals
+        once)."""
+        with self._lock:
+            # Fast path: already draining (every subsequent draining
+            # beat) or unknown — no journal-lock hop, no fsync.
+            if replica_id in self._draining:
+                return True
+            if replica_id not in self._table:
+                return False
+        with self._journal_lock:
+            with self._lock:
+                if replica_id in self._draining:
+                    return True
+                if replica_id not in self._table:
+                    return False
+                journal = None if self._dead else self._journal
+            if journal is not None:
+                # analysis: blocking-ok(fsync under the dedicated
+                # membership lock, outside _lock — see admit())
+                journal.append({"type": "drain", "id": replica_id,
+                                "source": source, "ts": time.time()})
+            with self._lock:
+                self._draining[replica_id] = source
+                self._rotation_remove(replica_id)
+                _G_DRAINING.set(len(self._draining))
+            self._maybe_compact()
+        return True
+
+    def undrain(self, replica_id: str, source: str = "operator",
+                expect_source: Optional[str] = None) -> bool:
+        """Lift a drain and restore ``replica_id`` to rotation
+        (journaled first). With ``expect_source`` set, only a drain of
+        that source is lifted — the heartbeat auto-undrain passes
+        ``"heartbeat"`` so it can never resurrect a replica the roll
+        controller or an operator benched on purpose."""
+        with self._lock:
+            src = self._draining.get(replica_id)
+            if src is None or (expect_source is not None
+                               and src != expect_source):
+                return False
+        with self._journal_lock:
+            with self._lock:
+                src = self._draining.get(replica_id)
+                if src is None or (expect_source is not None
+                                   and src != expect_source):
+                    return False
+                journal = None if self._dead else self._journal
+            if journal is not None:
+                # analysis: blocking-ok(fsync under the dedicated
+                # membership lock, outside _lock — see admit())
+                journal.append({"type": "undrain", "id": replica_id,
+                                "source": source, "ts": time.time()})
+            with self._lock:
+                self._draining.pop(replica_id, None)
+                self._rotation_add(replica_id)
+                _G_DRAINING.set(len(self._draining))
+            self._maybe_compact()
+        return True
+
+    def replica_steps(self) -> Dict[str, object]:
+        """Last serving checkpoint step each replica reported (None
+        for a replica that never reported one)."""
+        with self._lock:
+            return {rid: self._steps.get(rid) for rid in self._table}
+
+    def breaker_view(self, rids) -> Dict[str, Tuple[int, bool]]:
+        """``rid -> (consecutive_failures, cooling)`` for the given
+        replicas, one lock hop — the roll controller's per-wave health
+        gate reads this instead of poking router internals."""
+        with self._lock:
+            now = time.monotonic()
+            return {
+                rid: (self._fail_count.get(rid, 0),
+                      self._cooling_until.get(rid, 0.0) > now)
+                for rid in rids}
+
+    def _journal_append(self, record: dict):
+        """Append a non-membership record (roll progress, takeover)
+        under the same journal discipline as admit/cull: fsync'd under
+        _journal_lock, never under _lock, dropped once abrupt_stop()
+        declared this incarnation dead."""
+        with self._journal_lock:
+            with self._lock:
+                journal = None if self._dead else self._journal
+            if journal is not None:
+                # analysis: blocking-ok(fsync under the dedicated
+                # membership lock, outside _lock — see admit())
+                journal.append(record)
+            self._maybe_compact()
 
     def replicas(self) -> Dict[str, dict]:
         with self._lock:
@@ -466,6 +683,7 @@ class Router:
                 "replicas": len(self._table),
                 "confirmed": len(self._confirmed),
                 "cooling": len(self._cooling_until),
+                "draining": len(self._draining),
                 "rotation": len(self._rotation),
             }
 
@@ -520,9 +738,12 @@ class Router:
             # cooling (or already tried): serving nothing is strictly
             # worse than trying a suspect — fall back to an O(N) scan
             # of the full order rather than 502 a healthy fleet. Rare:
-            # only under whole-fleet breaker trips.
+            # only under whole-fleet breaker trips. Draining replicas
+            # stay excluded even here: they are LEAVING (mid-exit or
+            # mid-reload), not suspects worth one more try.
             candidates = [rid for rid in self._order
-                          if rid not in exclude]
+                          if rid not in exclude
+                          and rid not in self._draining]
             if not candidates:
                 return None
             rid = candidates[self._rr % len(candidates)]
@@ -541,10 +762,12 @@ class Router:
             self._expire_cooldowns(now)
             candidates = [rid for rid in self._order
                           if rid not in exclude
-                          and rid not in self._cooling_until]
+                          and rid not in self._cooling_until
+                          and rid not in self._draining]
             if not candidates:
                 candidates = [rid for rid in self._order
-                              if rid not in exclude]
+                              if rid not in exclude
+                              and rid not in self._draining]
             if not candidates:
                 return None
             rid = candidates[self._rr % len(candidates)]
@@ -670,9 +893,20 @@ class Router:
                 info["confirmed"] = rid in self._confirmed
                 info["consecutive_failures"] = self._fail_count.get(rid, 0)
                 until = self._cooling_until.get(rid)
-                if until is not None and until > now:
+                cooling = until is not None and until > now
+                if cooling:
                     info["cooling_sec_left"] = round(until - now, 3)
+                # Serving step + lifecycle state: a mixed-step fleet
+                # mid-roll is visible per row (drain wins over cooling
+                # — a draining replica is leaving regardless of its
+                # breaker).
+                info["step"] = self._steps.get(rid)
+                info["state"] = ("draining" if rid in self._draining
+                                 else "cooling" if cooling
+                                 else "serving")
                 table[rid] = info
+            draining = len(self._draining)
+            roll = self._roll
         from horovod_tpu.utils import flightrec
 
         return self._json(200, {
@@ -680,6 +914,8 @@ class Router:
             "role": "router",
             "replicas": table,
             "replayed": self._replayed,
+            "draining": draining,
+            "roll": roll.status() if roll is not None else None,
             "liveness_sec": self.liveness_sec,
             "pid": os.getpid(),
             "port": self.port,
@@ -688,6 +924,134 @@ class Router:
             # that reports capacity.
             "recent_failures": flightrec.recent_failures(),
         })
+
+    # --- fleet operations ---------------------------------------------------
+
+    def _handle_drain(self, body: bytes):
+        """``POST /v1/drain {"replica": rid}``: operator drain. The
+        router benches the replica immediately (journaled) and
+        best-effort forwards the drain to the replica itself so it
+        finishes its queue, goodbye-beats, and exits. With
+        ``"undrain": true`` it instead lifts a previous OPERATOR drain
+        (roll/heartbeat drains keep their own lifecycles)."""
+        try:
+            doc = json.loads(body.decode() or "{}")
+        except ValueError:
+            return self._json(400, {"error": "body must be JSON"})
+        rid = doc.get("replica")
+        if not rid:
+            return self._json(400, {"error": "missing 'replica'"})
+        with self._lock:
+            info = dict(self._table[rid]) if rid in self._table else None
+        if info is None:
+            return self._json(404, {"error": "unknown replica %s" % rid})
+        if doc.get("undrain"):
+            lifted = self.undrain(rid, source="operator",
+                                  expect_source="operator")
+            with self._lock:
+                still_draining = rid in self._draining
+            return self._json(200, {"ok": lifted, "replica": rid,
+                                    "draining": still_draining})
+        self.drain(rid, source="operator")
+        forwarded = False
+        if info.get("addr") and info.get("port"):
+            try:
+                conn = http.client.HTTPConnection(
+                    info["addr"], int(info["port"]),
+                    timeout=float_env("HVD_SERVE_PROXY_TIMEOUT_SEC", 30.0))
+                try:
+                    conn.request("POST", "/v1/drain", body=b"{}",
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    forwarded = conn.getresponse().status == 200
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException):
+                # Unreachable replica: it is benched either way, and
+                # the liveness sweep will finish the cull.
+                forwarded = False
+        return self._json(200, {"ok": True, "replica": rid,
+                                "draining": True,
+                                "replica_notified": forwarded})
+
+    def _handle_roll(self, body: bytes):
+        """``POST /v1/roll {"step": N[, "wave_size", "settle_sec"]}``:
+        start a rolling checkpoint upgrade in THIS router process (the
+        journal owner), so every wave transition lands in the journal
+        a failed-over standby replays."""
+        try:
+            doc = json.loads(body.decode() or "{}")
+            step = int(doc["step"])
+        except (ValueError, TypeError, KeyError):
+            return self._json(400, {"error":
+                                    "body must be JSON with int 'step'"})
+        wave_size = doc.get("wave_size")
+        settle_sec = doc.get("settle_sec")
+        result = self.start_roll(step, wave_size=wave_size,
+                                 settle_sec=settle_sec)
+        return self._json(202 if result.get("ok") else 409, result)
+
+    def _handle_roll_status(self):
+        return self._json(200, self.roll_status())
+
+    def start_roll(self, target_step: int, wave_size=None,
+                   settle_sec=None, resume_state=None) -> dict:
+        """Start (or resume) a rolling upgrade to ``target_step``.
+        Refuses while one is active — two controllers interleaving
+        drain/undrain on the same fleet would thrash it."""
+        from horovod_tpu.serve.rollout import RollController
+
+        if self._dead:
+            return {"ok": False, "error": "router stopped"}
+        ctl = RollController(self, target_step, wave_size=wave_size,
+                             settle_sec=settle_sec,
+                             resume_state=resume_state)
+        with self._lock:
+            if self._roll is not None and self._roll.active:
+                return {"ok": False,
+                        "error": "upgrade already in progress",
+                        "status": self._roll.status()}
+            self._roll = ctl
+        ctl.start()
+        return {"ok": True, "status": ctl.status()}
+
+    def roll_status(self) -> dict:
+        with self._lock:
+            ctl = self._roll
+        if ctl is None:
+            return {"active": False}
+        return ctl.status()
+
+    def resume_roll_if_pending(self) -> Optional[dict]:
+        """Resume an upgrade the previous router incarnation left
+        unfinished in the journal (crash or failover mid-roll):
+        completed waves are skipped, the interrupted wave re-runs
+        idempotently. Returns the start_roll result, or None when the
+        journal holds no pending roll."""
+        from horovod_tpu.serve import rollout
+
+        with self._lock:
+            journal = self._journal
+        if journal is None:
+            return None
+        state = rollout.replay_roll(journal.path)
+        if state is None or state.outcome is not None:
+            return None
+        return self.start_roll(state.target_step,
+                               wave_size=state.wave_size,
+                               resume_state=state)
+
+    def _lease_loop(self, period: float):
+        from horovod_tpu.serve import standby as _standby
+
+        while True:
+            if not self._dead:
+                try:
+                    _standby.write_lease(self._journal_dir, self.port)
+                except OSError:
+                    pass  # full disk etc.: standby takeover is the net
+            if self._lease_stop.wait(period):
+                return
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -707,11 +1071,26 @@ class Router:
         port = self._kv.start()
         if self._monitor is not None:
             self._monitor.start()
+        # Leader lease for hot-standby failover: refreshed next to the
+        # journal so a standby tailing the same directory can tell
+        # "leader alive" from "leader silent" (serve/standby.py).
+        # HVD_SERVE_LEASE_SEC=0 disables (journal-less routers never
+        # lease — there is nothing for a standby to adopt).
+        lease_sec = float_env("HVD_SERVE_LEASE_SEC", 1.0)
+        if self._journal_dir and lease_sec > 0:
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, args=(lease_sec,),
+                daemon=True, name="hvd-serve-lease")
+            self._lease_thread.start()
         return port
 
     def stop(self):
         if self._monitor is not None:
             self._monitor.stop()
+        self._lease_stop.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=5)
+            self._lease_thread = None
         self._kv.stop()
         # Detach under the journal lock: an admit/cull mid-append when
         # stop() was called must finish against the open handle before
@@ -722,3 +1101,26 @@ class Router:
                 journal, self._journal = self._journal, None
         if journal is not None:
             journal.close()
+        # Graceful retirement clears the lease so a standby takes over
+        # immediately instead of waiting out the silence window. After
+        # the journal detach: the standby's Router() attach must find
+        # the file quiescent.
+        if self._journal_dir and not self._dead:
+            from horovod_tpu.serve import standby as _standby
+
+            _standby.clear_lease(self._journal_dir)
+
+    def abrupt_stop(self):
+        """kill -9, in process form (the chaos rigs' stand-in for a
+        dead router box): stop answering the port and freeze every
+        writer WITHOUT closing the journal handle, clearing the lease
+        file, or finishing the roll controller — exactly the on-disk
+        state a SIGKILLed router leaves for the standby to adopt. The
+        _dead flag fences the threads that cannot be killed in
+        process (lease refresher, roll controller, late admits) from
+        writing after the standby owns the journal."""
+        self._dead = True
+        self._lease_stop.set()
+        if self._monitor is not None:
+            self._monitor.stop()
+        self._kv.stop()

@@ -19,9 +19,11 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from typing import List, Optional
 
+from horovod_tpu.common.util import float_env
 from horovod_tpu.serve.router import Router
 
 
@@ -73,6 +75,7 @@ class Server:
         self.router = Router(port=port, journal_dir=journal_dir,
                              liveness_sec=liveness_sec)
         self._procs: List[subprocess.Popen] = []
+        self._flightrec_tmp: Optional[str] = None
 
     @property
     def port(self) -> int:
@@ -97,15 +100,27 @@ class Server:
         if self.journal_dir:
             fr_dir = os.path.join(self.journal_dir, "flightrec",
                                   "r%d" % index)
-            try:
-                # The replica's native abort auto-dump may be the
-                # first writer; fopen does not mkdir.
-                os.makedirs(fr_dir, exist_ok=True)
-            except OSError:
-                fr_dir = None
-            if fr_dir:
-                env.setdefault("HVD_FLIGHTREC_DIR", fr_dir)
+        else:
+            # Journal-less fleet (tests, benches): dumps land in a
+            # shared temp dir instead of littering the launching
+            # process's cwd with flightrec.rank*.jsonl files.
+            fr_dir = os.path.join(self._flightrec_fallback(),
+                                  "r%d" % index)
+        try:
+            # The replica's native abort auto-dump may be the
+            # first writer; fopen does not mkdir.
+            os.makedirs(fr_dir, exist_ok=True)
+        except OSError:
+            fr_dir = None
+        if fr_dir:
+            env.setdefault("HVD_FLIGHTREC_DIR", fr_dir)
         return subprocess.Popen(cmd, env=env)
+
+    def _flightrec_fallback(self) -> str:
+        if self._flightrec_tmp is None:
+            self._flightrec_tmp = tempfile.mkdtemp(
+                prefix="hvd_serve_flightrec_")
+        return self._flightrec_tmp
 
     def start(self) -> int:
         port = self.router.start()
@@ -141,7 +156,17 @@ class Server:
             "serve fleet not ready after %.0fs (last healthz: %s)"
             % (timeout, doc))
 
-    def stop(self, replica_grace: float = 5.0):
+    def stop(self, replica_grace: Optional[float] = None):
+        """Graceful fleet stop: SIGTERM asks each replica to DRAIN —
+        finish queued micro-batches, goodbye-beat the router (an
+        immediate journaled cull), exit 0 (serve/replica.py). The
+        grace window caps a wedged drain (HVD_SERVE_DRAIN_GRACE_SEC
+        plus slack, not a sleep — an idle fleet exits in well under a
+        second); stragglers are killed. The router stops LAST so the
+        goodbye beats land in its journal."""
+        if replica_grace is None:
+            replica_grace = max(
+                5.0, float_env("HVD_SERVE_DRAIN_GRACE_SEC", 30.0) + 5.0)
         for p in self._procs:
             if p.poll() is None:
                 p.terminate()

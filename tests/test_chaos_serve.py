@@ -287,3 +287,210 @@ def test_serve_chaos_replica_kill9_then_router_sigkill(tmp_path):
                 os.kill(pid, signal.SIGKILL)
             except OSError:
                 pass
+
+
+class _RetryingLoad(_LoadGenerator):
+    """Closed-loop load with the documented client contract for fleet
+    operations: the port is the address, so a transport error retries
+    against it (the router may be failing over) until a 30s deadline.
+    A request is LOST only on an error status or deadline exhaustion —
+    the zero-downtime acceptance counter."""
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            row = self.xs[i % len(self.xs)]
+            i += 1
+            deadline = time.monotonic() + 30.0
+            backoff = 0.05
+            while True:
+                try:
+                    status, doc = _predict(self.port, [row.tolist()],
+                                           timeout=30.0)
+                except OSError:
+                    if time.monotonic() > deadline:
+                        status, doc = -1, {"error": "deadline"}
+                    else:
+                        time.sleep(backoff)
+                        backoff = min(0.5, backoff * 2)
+                        continue
+                break
+            with self._lock:
+                if status == 200:
+                    self.ok += 1
+                else:
+                    self.failed.append("status %d: %s" % (status, doc))
+
+
+def test_serve_ops_rolling_upgrade_and_standby_failover(tmp_path):
+    """Zero-downtime fleet operations over a REAL np=2 mnist_mlp fleet
+    (ISSUE 20 acceptance): commit step 1 behind a fleet serving step 0,
+    roll the fleet to it wave by wave (each replica drained,
+    hot-reloaded, re-admitted), SIGKILL the router with a hot standby
+    tailing the journal (same-port takeover), then drain one replica
+    through the operator endpoint (goodbye-cull, no liveness wait) —
+    closed-loop load runs through ALL of it with zero lost requests."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import MnistMLP
+    from horovod_tpu.utils.checkpoint import Checkpointer
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    journal_dir = str(tmp_path / "journal")
+    model = MnistMLP()
+    params0 = model.init(jax.random.PRNGKey(0), jnp.ones((1, 28, 28)))
+    params1 = model.init(jax.random.PRNGKey(7), jnp.ones((1, 28, 28)))
+    ck = Checkpointer(ckpt_dir, max_to_keep=2)
+    assert ck.save(0, {"params": params0})
+    ck.close()
+
+    rng = np.random.RandomState(13)
+    xs = rng.standard_normal((6, 28, 28)).astype(np.float32)
+    ref1 = np.asarray(jax.jit(
+        lambda x: model.apply(params1, x, train=False))(jnp.asarray(xs)))
+
+    port = _free_port()
+    env = _serve_env()
+    # Tight-but-real failover cadence so the takeover fits the test
+    # budget (production defaults are 1s lease / 3s takeover).
+    env["HVD_SERVE_LEASE_SEC"] = "0.5"
+    env["HVD_SERVE_TAKEOVER_SEC"] = "2"
+    serve = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.serve",
+         "--ckpt-dir", ckpt_dir, "--model", "mnist_mlp",
+         "--np", "2", "--port", str(port),
+         "--journal-dir", journal_dir,
+         "--liveness-sec", str(LIVENESS_SEC)],
+        cwd=_REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    serve_log = []
+    _drain(serve, serve_log)
+    load = None
+    standby = None
+    standby_log = []
+    replica_pids = []
+    try:
+        doc = _wait_replicas(port, 2, timeout=180, alive_proc=serve)
+        replica_pids = [info["pid"] for info in doc["replicas"].values()]
+
+        # The hot standby tails the lease + journal from here on.
+        standby = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.serve",
+             "--role", "standby", "--port", str(port),
+             "--journal-dir", journal_dir,
+             "--liveness-sec", str(LIVENESS_SEC)],
+            cwd=_REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        _drain(standby, standby_log)
+
+        # --- phase 1: commit step 1, roll the fleet to it -------------------
+        ck = Checkpointer(ckpt_dir, max_to_keep=2)
+        assert ck.save(1, {"params": params1})
+        ck.close()
+        # Roll planning reads per-replica steps from their beats.
+        deadline = time.monotonic() + 60
+        while True:
+            doc = _get_json(port, "/healthz") or {}
+            rows = doc.get("replicas", {})
+            if len(rows) == 2 and all(r.get("step") == 0
+                                      for r in rows.values()):
+                break
+            assert time.monotonic() < deadline, \
+                "replicas never reported step 0 (last: %s)" % rows
+            time.sleep(0.3)
+
+        load = _RetryingLoad(port, xs)
+        load.start()
+        deadline = time.monotonic() + 60
+        while load.snapshot()[0] < 10:
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("POST", "/v1/roll",
+                         body=json.dumps({"step": 1, "wave_size": 1,
+                                          "settle_sec": 1.0}))
+            assert conn.getresponse().status == 202
+        finally:
+            conn.close()
+        deadline = time.monotonic() + 180
+        while True:
+            roll = _get_json(port, "/v1/roll") or {}
+            if roll.get("outcome") is not None:
+                break
+            assert time.monotonic() < deadline, \
+                "roll never finished (last: %s)" % roll
+            time.sleep(0.5)
+        assert roll["outcome"] == "ok", roll
+        doc = _wait_replicas(port, 2, timeout=30, alive_proc=serve)
+        assert all(r["step"] == 1 and r["state"] == "serving"
+                   for r in doc["replicas"].values()), doc["replicas"]
+        # The fleet really serves the NEW checkpoint.
+        status, doc = _predict(port, xs[:3].tolist())
+        assert status == 200
+        got = np.asarray(doc["outputs"], dtype=np.float32)
+        np.testing.assert_allclose(got, ref1[:3], rtol=0, atol=5e-6)
+        ok_after_roll, failed_after_roll = load.snapshot()
+        assert not failed_after_roll, failed_after_roll
+
+        # --- phase 2: SIGKILL the router; the standby takes the port --------
+        serve.send_signal(signal.SIGKILL)
+        serve.wait(timeout=30)
+        deadline = time.monotonic() + 60
+        while True:
+            doc = _get_json(port, "/healthz")
+            if doc is not None and doc.get("pid") == standby.pid \
+                    and len(doc.get("replicas", {})) == 2:
+                break
+            assert time.monotonic() < deadline, \
+                "standby never took over (log: %s)" % standby_log[-5:]
+            time.sleep(0.3)
+        assert any("SERVE_STANDBY_TOOK_OVER" in line
+                   for line in standby_log)
+        status, doc = _predict(port, xs[:2].tolist())
+        assert status == 200
+        got = np.asarray(doc["outputs"], dtype=np.float32)
+        np.testing.assert_allclose(got, ref1[:2], rtol=0, atol=5e-6)
+
+        # --- phase 3: operator drain -> goodbye cull, no liveness wait ------
+        rid = sorted(_get_json(port, "/healthz")["replicas"])[0]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=35)
+        try:
+            conn.request("POST", "/v1/drain",
+                         body=json.dumps({"replica": rid}))
+            resp = conn.getresponse()
+            drain_doc = json.loads(resp.read().decode())
+            assert resp.status == 200
+        finally:
+            conn.close()
+        assert drain_doc["replica_notified"] is True, drain_doc
+        t_drain = time.monotonic()
+        _wait_replicas(port, 1, timeout=LIVENESS_SEC, alive_proc=standby)
+        # Inside the liveness window: the goodbye beat culled it, not
+        # the silence sweep.
+        assert time.monotonic() - t_drain < LIVENESS_SEC
+
+        load.stop()
+        ok_final, failed_final = load.snapshot()
+        assert not failed_final, failed_final
+        assert ok_final > ok_after_roll
+        status, doc = _predict(port, xs[:2].tolist())
+        assert status == 200
+        got = np.asarray(doc["outputs"], dtype=np.float32)
+        np.testing.assert_allclose(got, ref1[:2], rtol=0, atol=5e-6)
+    finally:
+        if load is not None:
+            load.stop()
+        for proc in (serve, standby):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        for pid in replica_pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass

@@ -186,6 +186,22 @@ TIER2_COVERAGE = {
     "test_fleet_storm_500_zero_lost":
         "tests/test_fleet.py::"
         "test_serve_rig_same_port_restart_zero_lost",
+    # Zero-downtime fleet operations (ISSUE 20): drain, rolling
+    # upgrade (ok + poisoned abort), replay_roll, and in-process
+    # standby takeover all run fast at n<=6 in test_ops.py; the n=64
+    # under-load drives (the CI ops lane), the SIGTERM-storm chaos
+    # variant, and the real np=2 checkpointed roll+failover are the
+    # heavyweight variants.
+    "test_ops_rolling_upgrade_n64_zero_lost":
+        "tests/test_ops.py::"
+        "test_rolling_upgrade_moves_every_wave_and_journals",
+    "test_ops_router_failover_resumes_roll_n64":
+        "tests/test_ops.py::test_standby_takes_over_on_leader_silence",
+    "test_ops_sigterm_storm_and_kill_mid_drain_n64":
+        "tests/test_ops.py::test_drain_beats_bench_and_goodbye_culls",
+    "test_serve_ops_rolling_upgrade_and_standby_failover":
+        "tests/test_ops.py::"
+        "test_bad_checkpoint_aborts_after_one_wave_and_rolls_back",
 }
 
 

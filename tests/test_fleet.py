@@ -291,6 +291,103 @@ def test_pick_new_equivalent_to_legacy_reference():
         assert router._pick(set(router.replicas())) is None
 
 
+def _rotation_invariant(router):
+    """The incrementally-maintained rotation must always equal the
+    from-scratch definition: admitted, not cooling, not draining — and
+    carry no duplicates."""
+    with router._lock:
+        want = (set(router._table) - set(router._cooling_until)
+                - set(router._draining))
+        assert router._rotation_set == want
+        assert set(router._rotation) == want
+        assert len(router._rotation) == len(router._rotation_set)
+
+
+def test_pick_equivalence_under_drain_and_readmit():
+    """Drain (ISSUE 20) rides the same rotation bookkeeping as the
+    breaker: under any mix of drained + cooling + excluded replicas,
+    _pick must agree with the legacy reference — and re-admission
+    (undrain) restores full coverage."""
+    with tempfile.TemporaryDirectory() as td:
+        router = _filled_router(td, 8)
+        exclude = {"r0001"}
+        for _ in range(router.breaker_threshold):
+            router._note_failure("r0002")
+        assert router.drain("r0003", source="roll")
+        assert router.drain("r0004", source="operator")
+        _rotation_invariant(router)
+        eligible = {"r%04d" % i for i in range(8)} \
+            - {"r0002", "r0003", "r0004"} - exclude
+        seen_new, seen_legacy = set(), set()
+        for _ in range(40):
+            rid, _ = router._pick(exclude)
+            assert rid in eligible
+            seen_new.add(rid)
+        for _ in range(40):
+            rid, _ = router._pick_legacy(exclude)
+            assert rid in eligible
+            seen_legacy.add(rid)
+        assert seen_new == eligible
+        assert seen_legacy == eligible
+        # All-cooling fallback tries suspects; draining stays excluded
+        # in BOTH implementations even then (a leaving replica is not
+        # a suspect worth one more try).
+        everyone_else = {"r%04d" % i for i in range(8)} \
+            - {"r0003", "r0004"}
+        assert router._pick(everyone_else) is None
+        assert router._pick_legacy(everyone_else) is None
+        # Undrain restores coverage incrementally (no rebuild).
+        assert router.undrain("r0003", source="roll",
+                              expect_source="roll")
+        _rotation_invariant(router)
+        seen = set()
+        for _ in range(40):
+            rid, _ = router._pick(set())
+            seen.add(rid)
+        assert "r0003" in seen and "r0004" not in seen
+
+
+def test_rotation_stays_o1_and_consistent_through_drain_lifecycle():
+    """The O(1) hotpath guarantee survives fleet operations: picks
+    stay ~1 step while waves drain/undrain around them, and the
+    rotation invariant holds after every transition (admit, drain,
+    undrain, trip, cull, goodbye-shaped cull)."""
+    with tempfile.TemporaryDirectory() as td:
+        router = _filled_router(td, 64)
+        _rotation_invariant(router)
+        wave = ["r%04d" % i for i in range(8)]
+        for rid in wave:
+            assert router.drain(rid, source="roll")
+            _rotation_invariant(router)
+        # Drained replicas are REMOVED from rotation, not skipped per
+        # pick: cost stays ~1 step even with an entire wave benched.
+        picks = 200
+        router.pick_scan_steps = 0
+        for _ in range(picks):
+            rid, _ = router._pick(set())
+            assert rid not in wave
+        assert router.pick_scan_steps / picks <= 1.5
+        for rid in wave:
+            assert router.undrain(rid, source="roll",
+                                  expect_source="roll")
+            _rotation_invariant(router)
+        # Mixed transitions: trip one, cull one (goodbye shape), drain
+        # one — the invariant holds through each and drain is
+        # idempotent (second call journals nothing, changes nothing).
+        for _ in range(router.breaker_threshold):
+            router._note_failure("r0010")
+        _rotation_invariant(router)
+        router.cull("r0011", reason="drained (goodbye beat)")
+        _rotation_invariant(router)
+        assert router.drain("r0012")
+        assert router.drain("r0012")  # idempotent
+        _rotation_invariant(router)
+        # Culling a DRAINING replica clears its drain bookkeeping.
+        router.cull("r0012", reason="no heartbeat 9.9s")
+        _rotation_invariant(router)
+        assert router.stats()["draining"] == 0
+
+
 def test_monitor_tick_never_walks_the_full_table():
     """O(N) guard: the liveness tick must ride the expiry heap
     (liveness_sweep + stats), not copy the table via replicas()."""

@@ -1,0 +1,460 @@
+"""Zero-downtime fleet operations (ISSUE 20): graceful drain,
+coordinated rolling checkpoint upgrades with abort-and-rollback, and
+hot-standby router failover — all jax-free (thread-stub replica herds
+against real sockets and a real journal, tools/fleet/rig.py).
+
+Tier-1 cases prove each mechanism at small N in seconds. The tier-2
+cases are the CI ops lane (ci/run_tests.sh run_ops): the n=64 rolling
+upgrade and the kill -9-router-mid-roll failover, each under
+closed-loop load asserting ZERO lost requests. The SIGTERM-storm /
+kill-mid-drain chaos variant carries tier2+slow and rides the full
+tier run. The real-checkpoint (np=2 mnist_mlp) upgrade and failover
+live in tests/test_chaos_serve.py.
+"""
+
+import json
+import tempfile
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.journal import DriverJournal
+from horovod_tpu.serve.rollout import RollState, replay_roll
+from horovod_tpu.serve.router import serve_journal_path
+from horovod_tpu.serve.standby import Standby, read_lease
+from horovod_tpu.utils import metrics as _metrics
+
+from tools.fleet.rig import ServeRig
+
+
+def _wait(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _wait_steps_known(rig):
+    """Roll planning reads each replica's last-reported step from its
+    beats; wait until every identity has reported one."""
+    def _known():
+        steps = rig.router.replica_steps()
+        return (len(steps) == rig.n
+                and all(v is not None for v in steps.values()))
+    _wait(_known, 30.0, "all %d replicas to report a step" % rig.n)
+
+
+def _journal_events(journal_dir, rtype):
+    events = []
+    with open(serve_journal_path(journal_dir), "r") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("type") == rtype:
+                events.append(rec)
+    return events
+
+
+# --- replay_roll: the journal fold the resume path rides ---------------------
+
+
+def test_replay_roll_folds_begin_wave_done_abort(tmp_path):
+    path = serve_journal_path(str(tmp_path))
+    j = DriverJournal(path)
+    j.append({"type": "roll", "event": "begin", "roll_id": "roll-1",
+              "target_step": 5, "wave_size": 2,
+              "waves": [["r0", "r1"], ["r2"]],
+              "prior_steps": {"r0": 0, "r1": 0, "r2": 1}})
+    j.append({"type": "roll", "event": "wave", "roll_id": "roll-1",
+              "wave": 0})
+    j.append({"type": "roll", "event": "wave_done", "roll_id": "roll-1",
+              "wave": 0})
+    j.append({"type": "roll", "event": "wave", "roll_id": "roll-1",
+              "wave": 1})
+    j.close()
+    state = replay_roll(path)
+    assert state is not None and state.outcome is None  # pending
+    assert state.roll_id == "roll-1" and state.target_step == 5
+    assert state.waves_done == {0} and state.last_wave == 1
+    assert state.prior_steps["r2"] == 1
+    # A terminal record ends it: nothing to resume.
+    j2 = DriverJournal(path)
+    j2.append({"type": "roll", "event": "abort", "roll_id": "roll-1",
+               "wave": 1, "reason": "test"})
+    j2.close()
+    state = replay_roll(path)
+    assert state.outcome == "abort" and state.reason == "test"
+
+
+def test_replay_roll_survives_compaction_snapshot(tmp_path):
+    """Compaction erases the roll's own records; the snapshot's
+    embedded ``roll`` view must carry the pending state across — and a
+    snapshot WITHOUT one clears it (a finished roll is folded away on
+    purpose)."""
+    path = serve_journal_path(str(tmp_path))
+    pending = RollState(roll_id="roll-2", target_step=7, wave_size=1,
+                        waves=[["r0"], ["r1"]], prior_steps={"r0": 0},
+                        waves_done={0}, last_wave=1)
+    j = DriverJournal(path)
+    j.append({"type": "roll", "event": "begin", "roll_id": "roll-2",
+              "target_step": 7, "wave_size": 1,
+              "waves": [["r0"], ["r1"]], "prior_steps": {"r0": 0}})
+    j.compact({"table": {}, "roll": pending.view()})
+    j.close()
+    state = replay_roll(path)
+    assert state is not None and state.roll_id == "roll-2"
+    assert state.waves_done == {0} and state.target_step == 7
+    j = DriverJournal(path)
+    j.compact({"table": {}})  # no roll field: finished + folded
+    j.close()
+    assert replay_roll(path) is None
+
+
+# --- graceful drain (stub herd, real beats) ----------------------------------
+
+
+def test_drain_beats_bench_and_goodbye_culls():
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(6, backends=2, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.05, monitor=False)
+        try:
+            rig.start()
+            router, herd = rig.router, rig.herd
+            drained = [herd.rid(0), herd.rid(1)]
+            herd.drain_ids(drained)
+            _wait(lambda: router.stats()["draining"] == 2, 10.0,
+                  "draining beats to bench 2 replicas")
+            # Benched immediately: picks never land on them while the
+            # rest of the fleet keeps serving.
+            for _ in range(20):
+                rid, _entry = router._pick(set())
+                assert rid not in drained
+            load = rig.load(clients=2, requests_per_client=10)
+            assert load["lost"] == 0
+            # The drain journaled (append-before-effect).
+            assert {r["id"] for r in _journal_events(td, "drain")} \
+                == set(drained)
+            # A flag-less beat lifts the replica's OWN drain...
+            herd.undrain_ids([herd.rid(1)])
+            _wait(lambda: router.stats()["draining"] == 1, 10.0,
+                  "flag-less beat to undrain r1")
+            # ...and the goodbye beat culls instantly, no liveness wait
+            # (liveness is OFF in this rig).
+            herd.goodbye([herd.rid(0)])
+            _wait(lambda: router.stats()["replicas"] == 5, 10.0,
+                  "goodbye beat to cull r0")
+            assert router.stats()["draining"] == 0
+            culls = _journal_events(td, "cull")
+            assert culls and culls[-1]["id"] == herd.rid(0)
+            assert "goodbye" in culls[-1]["reason"]
+        finally:
+            rig.stop()
+
+
+def test_operator_drain_not_lifted_by_plain_beats():
+    """Router-side drains outlive the replica's ordinary beats: only
+    the source that benched a replica may un-bench it."""
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(4, backends=2, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.05, monitor=False)
+        try:
+            rig.start()
+            router, herd = rig.router, rig.herd
+            rid = herd.rid(0)
+            assert router.drain(rid, source="operator")
+            # Plenty of flag-less beats arrive; none lift the bench.
+            time.sleep(0.3)
+            assert router.stats()["draining"] == 1
+            assert not router.undrain(rid, source="heartbeat",
+                                      expect_source="heartbeat")
+            assert router.undrain(rid, source="operator",
+                                  expect_source="operator")
+            assert router.stats()["draining"] == 0
+        finally:
+            rig.stop()
+
+
+# --- rolling checkpoint upgrade (stub herd) ----------------------------------
+
+
+def _finished(router):
+    return router.roll_status().get("outcome") is not None
+
+
+def test_rolling_upgrade_moves_every_wave_and_journals(tmp_path):
+    ok_before = _metrics.value("hvd_serve_upgrades_total",
+                               outcome="ok") or 0
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(6, backends=2, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.05, monitor=False)
+        try:
+            rig.start()
+            _wait_steps_known(rig)
+            result = rig.router.start_roll(1, wave_size=2,
+                                           settle_sec=0.05)
+            assert result["ok"] is True
+            # One at a time: a second roll is refused while active.
+            assert rig.router.start_roll(2)["ok"] is False
+            _wait(lambda: _finished(rig.router), 30.0, "roll to finish")
+            status = rig.router.roll_status()
+            assert status["outcome"] == "ok", status
+            assert status["waves"] == 3
+            with rig.herd._state_lock:
+                assert all(s == 1 for s in rig.herd.steps.values())
+            # Fleet fully restored to rotation.
+            assert rig.router.stats()["draining"] == 0
+            assert rig.router.stats()["replicas"] == 6
+            rolls = _journal_events(td, "roll")
+            events = [r["event"] for r in rolls]
+            assert events[0] == "begin" and events[-1] == "done"
+            assert events.count("wave") == 3
+            assert events.count("wave_done") == 3
+            assert replay_roll(
+                serve_journal_path(td)).outcome == "ok"
+        finally:
+            rig.stop()
+    assert (_metrics.value("hvd_serve_upgrades_total", outcome="ok")
+            or 0) == ok_before + 1
+
+
+def test_bad_checkpoint_aborts_after_one_wave_and_rolls_back():
+    abort_before = _metrics.value("hvd_serve_upgrades_total",
+                                  outcome="abort") or 0
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(6, backends=2, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.05, monitor=False)
+        try:
+            rig.start()
+            _wait_steps_known(rig)
+            with rig.herd._state_lock:
+                rig.herd.poison_steps.add(2)
+            result = rig.router.start_roll(2, wave_size=2,
+                                           settle_sec=0.05)
+            assert result["ok"] is True
+            _wait(lambda: _finished(rig.router), 30.0, "roll to abort")
+            status = rig.router.roll_status()
+            assert status["outcome"] == "abort"
+            assert "failed reload" in status["reason"]
+            # Blast radius: the first wave's failure stopped the roll —
+            # no replica is left on the bad step, the fleet converged
+            # back on the old one.
+            with rig.herd._state_lock:
+                assert all(s == 0 for s in rig.herd.steps.values())
+            # Everything back in rotation, nothing stuck draining.
+            assert rig.router.stats()["draining"] == 0
+            assert rig.router.stats()["replicas"] == 6
+            load = rig.load(clients=2, requests_per_client=10)
+            assert load["lost"] == 0
+            rolls = _journal_events(td, "roll")
+            assert [r["event"] for r in rolls][-1] == "abort"
+            assert sum(1 for r in rolls if r["event"] == "wave") == 1
+        finally:
+            rig.stop()
+    assert (_metrics.value("hvd_serve_upgrades_total", outcome="abort")
+            or 0) == abort_before + 1
+
+
+# --- hot-standby failover (in-process kill -9) -------------------------------
+
+
+def test_standby_takes_over_on_leader_silence(monkeypatch):
+    monkeypatch.setenv("HVD_SERVE_LEASE_SEC", "0.05")
+    failovers_before = _metrics.value(
+        "hvd_serve_router_failovers_total") or 0
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(6, backends=2, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.05, monitor=False)
+        standby = None
+        try:
+            rig.start()
+            _wait(lambda: read_lease(td) is not None, 10.0,
+                  "leader lease to appear")
+            standby = Standby(td, rig.router.port, takeover_sec=0.3,
+                              poll_sec=0.05, monitor=False)
+            standby.start()
+            # The standby waits while the leader leases...
+            assert not standby.wait_takeover(0.5)
+            port = rig.kill_router()  # kill -9 shape: lease goes stale
+            assert standby.wait_takeover(20.0), "standby never took over"
+            assert standby.router is not None
+            assert standby.router.port == port  # same address contract
+            rig.adopt_router(standby.router)
+            # The takeover replayed the full membership and serves.
+            _wait(lambda: rig.router.stats()["replicas"] == 6, 10.0,
+                  "replayed table to fill")
+            load = rig.load(clients=2, requests_per_client=10)
+            assert load["lost"] == 0
+            takeovers = _journal_events(td, "takeover")
+            assert takeovers and takeovers[-1]["port"] == port
+        finally:
+            if standby is not None and not standby.took_over.is_set():
+                standby.stop()
+            rig.stop()
+    assert (_metrics.value("hvd_serve_router_failovers_total") or 0) \
+        == failovers_before + 1
+
+
+def test_dead_router_threads_cannot_write_after_takeover():
+    """The in-process kill -9 fence: once abrupt_stop() declared the
+    incarnation dead, its surviving threads' drains/appends must not
+    reach the journal a standby now owns."""
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(3, backends=1, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.0, monitor=False)
+        try:
+            rig.start()
+            dead = rig.router
+            records_before = sum(
+                1 for _ in open(serve_journal_path(td)))
+            rig.kill_router()
+            # A late drain on the dead incarnation mutates only its own
+            # memory — nothing lands in the journal.
+            assert dead.drain(rig.herd.rid(0), source="operator")
+            dead._journal_append({"type": "roll", "event": "wave",
+                                  "roll_id": "ghost", "wave": 0})
+            assert sum(1 for _ in open(serve_journal_path(td))) \
+                == records_before
+            assert dead.start_roll(1)["ok"] is False
+        finally:
+            rig.stop()
+
+
+# --- tier-2: the CI ops lane (n=64, zero lost) -------------------------------
+
+
+def _load_async(rig, clients, per_client):
+    out = {}
+
+    def _run():
+        out.update(rig.load(clients=clients,
+                            requests_per_client=per_client))
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    return t, out
+
+
+@pytest.mark.tier2
+def test_ops_rolling_upgrade_n64_zero_lost():
+    """The acceptance drive: a 64-replica fleet rolls to a new step in
+    waves under sustained closed-loop load — every wave drains,
+    reloads, re-admits, and NOT ONE request is lost."""
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(64, backends=4, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.2, monitor=False)
+        try:
+            rig.start()
+            _wait_steps_known(rig)
+            loader, load = _load_async(rig, clients=4, per_client=60)
+            result = rig.router.start_roll(1, wave_size=8,
+                                           settle_sec=0.1)
+            assert result["ok"] is True
+            _wait(lambda: _finished(rig.router), 120.0,
+                  "n=64 roll to finish")
+            status = rig.router.roll_status()
+            assert status["outcome"] == "ok", status
+            loader.join(timeout=120.0)
+            assert not loader.is_alive()
+            assert load["lost"] == 0, load
+            assert load["ok"] == 240
+            with rig.herd._state_lock:
+                assert all(s == 1 for s in rig.herd.steps.values())
+            assert rig.router.stats()["replicas"] == 64
+            assert rig.router.stats()["draining"] == 0
+            rolls = _journal_events(td, "roll")
+            assert sum(1 for r in rolls if r["event"] == "wave_done") \
+                == 8
+        finally:
+            rig.stop()
+
+
+@pytest.mark.tier2
+def test_ops_router_failover_resumes_roll_n64(monkeypatch):
+    """kill -9 the active router MID-ROLL: the hot standby takes over
+    the port, replays the journal, resumes the upgrade from the last
+    journaled wave, and finishes it — zero lost requests throughout."""
+    monkeypatch.setenv("HVD_SERVE_LEASE_SEC", "0.1")
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(64, backends=4, journal_dir=td,
+                       liveness_sec=0.0, beat_sec=0.2, monitor=False)
+        standby = None
+        try:
+            rig.start()
+            _wait_steps_known(rig)
+            standby = Standby(td, rig.router.port, takeover_sec=0.5,
+                              poll_sec=0.05, monitor=False)
+            standby.start()
+            loader, load = _load_async(rig, clients=4, per_client=80)
+            result = rig.router.start_roll(1, wave_size=8,
+                                           settle_sec=0.3)
+            assert result["ok"] is True
+            # Let at least one wave complete, then kill mid-roll.
+            _wait(lambda: len(_journal_events(td, "roll")) >= 4
+                  and any(r["event"] == "wave_done"
+                          for r in _journal_events(td, "roll")),
+                  60.0, "first wave_done before the kill")
+            assert not _finished(rig.router), \
+                "roll finished before the kill — slow the cadence down"
+            rig.kill_router()
+            assert standby.wait_takeover(30.0), "standby never took over"
+            rig.adopt_router(standby.router)
+            _wait(lambda: _finished(rig.router), 120.0,
+                  "resumed roll to finish on the standby")
+            status = rig.router.roll_status()
+            assert status["outcome"] == "ok", status
+            assert status["resumed"] is True
+            loader.join(timeout=120.0)
+            assert not loader.is_alive()
+            assert load["lost"] == 0, load
+            with rig.herd._state_lock:
+                assert all(s == 1 for s in rig.herd.steps.values())
+            rolls = _journal_events(td, "roll")
+            assert rolls[-1]["event"] == "done"
+            assert _journal_events(td, "takeover")
+            assert replay_roll(
+                serve_journal_path(td)).outcome == "ok"
+        finally:
+            if standby is not None and not standby.took_over.is_set():
+                standby.stop()
+            rig.stop()
+
+
+@pytest.mark.tier2
+@pytest.mark.slow
+def test_ops_sigterm_storm_and_kill_mid_drain_n64():
+    """Chaos shape: a SIGTERM storm drains a quarter of the fleet at
+    once; half of those finish gracefully (goodbye-cull), the rest are
+    kill -9ed MID-DRAIN (silence, no goodbye) and the liveness monitor
+    reaps them — all under closed-loop load with zero lost requests."""
+    with tempfile.TemporaryDirectory() as td:
+        rig = ServeRig(64, backends=4, journal_dir=td,
+                       liveness_sec=1.0, beat_sec=0.2, monitor=True)
+        try:
+            rig.start()
+            herd = rig.herd
+            _wait(lambda: rig.router.stats()["replicas"] == 64, 30.0,
+                  "fleet to register")
+            loader, load = _load_async(rig, clients=4, per_client=80)
+            storm = [herd.rid(i) for i in range(16)]
+            graceful, killed = storm[:8], storm[8:]
+            herd.drain_ids(storm)  # the SIGTERM storm: all flag beats
+            _wait(lambda: rig.router.stats()["draining"] == 16, 30.0,
+                  "storm beats to bench 16 replicas")
+            herd.goodbye(graceful)   # finished their queues, exited 0
+            herd.silence(killed)     # kill -9 mid-drain: no goodbye
+            _wait(lambda: rig.router.stats()["replicas"] == 48, 30.0,
+                  "goodbyes + liveness culls to land")
+            assert rig.router.stats()["draining"] == 0
+            loader.join(timeout=300.0)
+            assert not loader.is_alive()
+            assert load["lost"] == 0, load
+            culls = _journal_events(td, "cull")
+            by_id = {r["id"]: r for r in culls}
+            for rid in graceful:
+                assert "goodbye" in by_id[rid]["reason"]
+            for rid in killed:
+                assert "no heartbeat" in by_id[rid]["reason"]
+        finally:
+            rig.stop()

@@ -63,6 +63,39 @@ def test_host_assignments_insufficient_slots():
         get_host_assignments([HostInfo("h1", 2)], 4)
 
 
+def test_slot_env_flightrec_dump_dir_defaults_off_cwd(monkeypatch):
+    """Launcher-spawned workers must never litter the launching
+    process's cwd with flightrec.rank*.jsonl dumps: when the operator
+    didn't pin HVD_FLIGHTREC_DIR, slot_env points every rank at ONE
+    launcher-scoped temp dir — and an operator-pinned value is left
+    alone (the workers inherit it)."""
+    import tempfile
+
+    from horovod_tpu.runner import launch
+
+    monkeypatch.setattr(launch, "_flightrec_fallback_dir", None)
+    monkeypatch.delenv("HVD_FLIGHTREC_DIR", raising=False)
+    a0, a1 = get_host_assignments([HostInfo("localhost", 2)], 2)
+    env0 = launch.slot_env(a0, "127.0.0.1", 1, "127.0.0.1", 2, {})
+    env1 = launch.slot_env(a1, "127.0.0.1", 1, "127.0.0.1", 2, {})
+    d = env0["HVD_FLIGHTREC_DIR"]
+    assert os.path.isdir(d)
+    assert os.path.basename(d).startswith("hvd_flightrec_")
+    assert os.path.realpath(d).startswith(
+        os.path.realpath(tempfile.gettempdir()))
+    assert env1["HVD_FLIGHTREC_DIR"] == d  # one dir for the whole job
+    # Operator pinned a dir in the launcher env: inherited, not
+    # overridden with the fallback.
+    monkeypatch.setenv("HVD_FLIGHTREC_DIR", "/ops/flightrec")
+    env = launch.slot_env(a0, "127.0.0.1", 1, "127.0.0.1", 2, {})
+    assert "HVD_FLIGHTREC_DIR" not in env
+    # Operator pinned it per-worker via extra env: preserved verbatim.
+    monkeypatch.delenv("HVD_FLIGHTREC_DIR", raising=False)
+    env = launch.slot_env(a0, "127.0.0.1", 1, "127.0.0.1", 2,
+                          {"HVD_FLIGHTREC_DIR": "/ops/flightrec"})
+    assert env["HVD_FLIGHTREC_DIR"] == "/ops/flightrec"
+
+
 def test_parse_args_tuning():
     args = parse_args(["-np", "2", "--fusion-threshold-mb", "32",
                        "--cycle-time-ms", "2.5", "python", "x.py"])

@@ -639,3 +639,178 @@ def test_append_failure_leaves_table_unchanged(tmp_path):
         assert "rOld" in router.replicas()
     finally:
         router.stop()
+
+
+# --- graceful drain (ISSUE 20) ----------------------------------------------
+
+
+def test_drain_and_undrain_journal_outside_router_lock(tmp_path):
+    """Drain transitions follow the same lock discipline as
+    admit/cull: the fsync'd append holds _journal_lock, never _lock."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.start()
+    try:
+        router.admit("rX", {"addr": "127.0.0.1", "port": 1, "pid": 1,
+                            "model": "m"})
+        real_append = router._journal.append
+        seen = []
+
+        def checked_append(rec):
+            seen.append((rec["type"],
+                         router._lock._is_owned(),
+                         router._journal_lock.locked()))
+            return real_append(rec)
+
+        router._journal.append = checked_append
+        assert router.drain("rX", source="operator")
+        assert router.undrain("rX", source="operator")
+        assert [t for t, _, _ in seen] == ["drain", "undrain"]
+        for rec_type, lock_owned, journal_held in seen:
+            assert not lock_owned, \
+                "%s append ran under _lock" % rec_type
+            assert journal_held, \
+                "%s append ran outside _journal_lock" % rec_type
+    finally:
+        router.stop()
+
+
+def test_drain_append_failure_leaves_rotation_unchanged(tmp_path):
+    """Append-before-effect for drains: a failed journal write must
+    not bench the replica — a restarted router would silently serve a
+    rotation the journal never heard about."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.start()
+    try:
+        router.admit("rX", {"addr": "127.0.0.1", "port": 1, "pid": 1,
+                            "model": "m"})
+
+        def boom(rec):
+            raise OSError("disk full")
+
+        router._journal.append = boom
+        with pytest.raises(OSError):
+            router.drain("rX", source="operator")
+        assert router.stats()["draining"] == 0
+        assert "rX" in router._rotation_set
+    finally:
+        router.stop()
+
+
+def test_drain_survives_restart_via_replay(tmp_path):
+    """A drained replica stays benched across a router restart: the
+    journal, not the process, owns the drain."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    router.start()
+    router.admit("rA", {"addr": "h", "port": 1, "pid": 1, "model": "m"})
+    router.admit("rB", {"addr": "h", "port": 2, "pid": 2, "model": "m"})
+    assert router.drain("rA", source="roll")
+    router.stop()
+    router2 = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    try:
+        assert set(router2.replicas()) == {"rA", "rB"}
+        assert router2.stats()["draining"] == 1
+        assert "rA" not in router2._rotation_set
+        assert "rB" in router2._rotation_set
+        # Source survives too: a flag-less beat cannot lift the
+        # replayed roll drain...
+        assert not router2.undrain("rA", source="heartbeat",
+                                   expect_source="heartbeat")
+        # ...the controller that benched it can.
+        assert router2.undrain("rA", source="roll",
+                               expect_source="roll")
+        assert "rA" in router2._rotation_set
+    finally:
+        router2.stop()
+
+
+def test_steady_draining_beats_journal_once(tmp_path):
+    """The first draining beat journals the bench; every subsequent
+    one is a pure liveness stamp (no journal-lock hop, no fsync)."""
+    router = Router(port=0, journal_dir=str(tmp_path), monitor=False)
+    port = router.start()
+    try:
+        info = {"addr": "127.0.0.1", "port": 1, "pid": 1, "model": "m"}
+        router.admit("rX", info)
+        appends = []
+        real_append = router._journal.append
+        router._journal.append = \
+            lambda rec: (appends.append(rec), real_append(rec))
+        beat = json.dumps(dict(info, ts=time.time(),
+                               draining=True)).encode()
+        for _ in range(4):
+            write_kv("127.0.0.1", port, "heartbeat", "rX", beat)
+        assert [r["type"] for r in appends] == ["drain"]
+        assert router.stats()["draining"] == 1
+    finally:
+        router.stop()
+
+
+def test_operator_drain_endpoint_benches_and_undrains():
+    rep = _FakeReplica("A")
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        router.admit("rA", rep.info())
+        status, doc = _post(port, "/v1/drain", {"replica": "nope"})
+        assert status == 404
+        status, doc = _post(port, "/v1/drain", {})
+        assert status == 400
+        status, doc = _post(port, "/v1/drain", {"replica": "rA"})
+        assert status == 200 and doc["draining"] is True
+        # The fake replica has no /v1/drain route — benched anyway.
+        assert doc["replica_notified"] is False
+        assert router.stats()["draining"] == 1
+        assert "rA" not in router._rotation_set
+        status, doc = _post(port, "/v1/drain",
+                            {"replica": "rA", "undrain": True})
+        assert status == 200 and doc["ok"] is True
+        assert router.stats()["draining"] == 0
+        assert "rA" in router._rotation_set
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_goodbye_beat_culls_known_and_ignores_unknown():
+    """The farewell beat culls immediately (no liveness wait); a
+    goodbye for an unknown key must not admit-then-cull — the KV is an
+    open PUT endpoint."""
+    rep = _FakeReplica("A")
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        router.admit("rA", rep.info())
+        goodbye = json.dumps(dict(rep.info(), ts=time.time(),
+                                  draining=True, goodbye=True)).encode()
+        write_kv("127.0.0.1", port, "heartbeat", "rGhost", goodbye)
+        assert set(router.replicas()) == {"rA"}  # no admit-then-cull
+        write_kv("127.0.0.1", port, "heartbeat", "rA", goodbye)
+        assert router.replicas() == {}
+        assert router.stats()["draining"] == 0
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_healthz_rows_surface_step_and_lifecycle_state():
+    rep = _FakeReplica("A")
+    router = Router(port=0, monitor=False)
+    port = router.start()
+    try:
+        router.admit("rA", rep.info())
+        router.admit("rB", rep.info())
+        beat = json.dumps(dict(rep.info(), ts=time.time(),
+                               step=1200)).encode()
+        write_kv("127.0.0.1", port, "heartbeat", "rA", beat)
+        router.drain("rB", source="operator")
+        status, doc = _get(port, "/healthz")
+        assert status == 200
+        assert doc["replicas"]["rA"]["step"] == 1200
+        assert doc["replicas"]["rA"]["state"] == "serving"
+        assert doc["replicas"]["rB"]["step"] is None
+        assert doc["replicas"]["rB"]["state"] == "draining"
+        assert doc["draining"] == 1
+        assert doc["roll"] is None
+    finally:
+        router.stop()
+        rep.stop()

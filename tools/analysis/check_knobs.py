@@ -38,6 +38,7 @@ DEFAULT_ALLOWLIST: Dict[str, str] = {
     "HVD_CI_ANALYSIS_BUDGET": "ci/run_tests.sh lane budget",
     "HVD_CI_PLAN_BUDGET": "ci/run_tests.sh lane budget",
     "HVD_CI_FLEET_BUDGET": "ci/run_tests.sh lane budget",
+    "HVD_CI_OPS_BUDGET": "ci/run_tests.sh lane budget",
     # Test-suite internals (set and read only by tests/).
     "HVD_FUZZ_SEED": "tests/fuzz_worker.py reproducibility seed",
     "HVD_FLASH_SYNC_CACHE_DIR": "tests/flash_sync_worker.py per-rank "

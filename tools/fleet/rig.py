@@ -246,18 +246,33 @@ class ElasticRig:
 class _IdentityBackend:
     """One real jax-free predict backend: echoes the request body back
     with 200 (the identity model's serving contract), counting
-    requests so the rigs can prove traffic actually flowed."""
+    requests so the rigs can prove traffic actually flowed. With a
+    ``reload_handler`` it also answers ``POST /v1/reload`` (the roll
+    controller's hot-reload hop): handler(doc) -> (ok, payload),
+    mapped to 200/500 exactly as a real replica would answer."""
 
-    def __init__(self):
+    def __init__(self, reload_handler=None):
         self.server = KVStoreServer(port=0)
         self.requests = 0
         self._lock = threading.Lock()
         self.server.register_post_route("/v1/predict", self._predict)
+        if reload_handler is not None:
+            self._reload_handler = reload_handler
+            self.server.register_post_route("/v1/reload", self._reload)
 
     def _predict(self, body: bytes):
         with self._lock:
             self.requests += 1
         return (200, "application/json", body or b"{}")
+
+    def _reload(self, body: bytes):
+        try:
+            doc = json.loads(body.decode() or "{}")
+        except ValueError:
+            doc = {}
+        ok, payload = self._reload_handler(doc)
+        return ((200 if ok else 500), "application/json",
+                json.dumps(payload).encode())
 
     def start(self) -> int:
         return self.server.start()
@@ -284,6 +299,15 @@ class StubReplicaHerd:
         self.backend_ports = backend_ports
         self._stops: Dict[str, threading.Event] = {}
         self._threads: List[threading.Thread] = []
+        # Per-identity lifecycle the ops storms drive: the serving
+        # checkpoint step each stub reports in its beats (seeded 0 so
+        # the roll controller sees a uniform prior fleet), which
+        # identities flag themselves draining, and which target steps
+        # the shared reload handler refuses (the bad-checkpoint wave).
+        self._state_lock = threading.Lock()
+        self.steps: Dict[str, int] = {self.rid(i): 0 for i in range(n)}
+        self.draining: set = set()
+        self.poison_steps: set = set()
 
     def rid(self, i: int) -> str:
         return "fleet-r%04d" % i
@@ -292,6 +316,36 @@ class StubReplicaHerd:
         port = self.backend_ports[i % len(self.backend_ports)]
         return {"addr": "127.0.0.1", "port": port,
                 "pid": 200000 + i, "model": "identity"}
+
+    def payload(self, i: int) -> bytes:
+        """One heartbeat body, rebuilt per beat so it carries the
+        identity's CURRENT step and draining flag (the production
+        replica does the same in ``endpoint_payload``)."""
+        rid = self.rid(i)
+        info = dict(self.info(i), ts=time.time())
+        with self._state_lock:
+            step = self.steps.get(rid)
+            if step is not None:
+                info["step"] = step
+            if rid in self.draining:
+                info["draining"] = True
+        return json.dumps(info).encode()
+
+    def reload(self, doc: dict):
+        """The shared backends' /v1/reload handler: move the named
+        stub identity to the requested step — unless the step is
+        poisoned, which answers the way a replica whose restore blew
+        up does (500, still serving its old step)."""
+        rid = doc.get("replica")
+        step = doc.get("step")
+        with self._state_lock:
+            if step in self.poison_steps:
+                return False, {"error": "injected bad checkpoint",
+                               "step": self.steps.get(rid),
+                               "replica": rid}
+            if rid is not None:
+                self.steps[rid] = int(step)
+        return True, {"ok": True, "step": int(step), "replica": rid}
 
     def register_all(self) -> float:
         """The registration herd: every identity PUTs ``replica/<id>``
@@ -310,14 +364,12 @@ class StubReplicaHerd:
         def _loop(i: int, stop: threading.Event):
             if stop.wait(random.uniform(0.0, self.beat_sec)):
                 return
-            payload = json.dumps(
-                dict(self.info(i), ts=time.time())).encode()
             while not stop.is_set():
                 delay = self.beat_sec
                 try:
                     status, retry_after = put_kv(
                         "127.0.0.1", self.router_port, "heartbeat",
-                        self.rid(i), payload, timeout=5.0)
+                        self.rid(i), self.payload(i), timeout=5.0)
                     if status == 503 and retry_after > 0:
                         delay = min(self.beat_sec,
                                     retry_after
@@ -343,6 +395,37 @@ class StubReplicaHerd:
             if stop is not None:
                 stop.set()
 
+    def drain_ids(self, rids: List[str]):
+        """Flag identities draining: their NEXT beats carry
+        ``draining: true`` and the router benches them (the
+        replica-initiated drain shape, e.g. SIGTERM)."""
+        with self._state_lock:
+            self.draining.update(rids)
+
+    def undrain_ids(self, rids: List[str]):
+        """Drop the draining flag: flag-less beats auto-undrain."""
+        with self._state_lock:
+            self.draining.difference_update(rids)
+
+    def goodbye(self, rids: List[str]):
+        """Finish the drain the way a real replica does: stop the
+        identity's steady beats, then send ONE farewell beat
+        (draining + goodbye) — the router culls it immediately,
+        journaled, instead of waiting out the liveness window."""
+        for rid in rids:
+            stop = self._stops.get(rid)
+            if stop is not None:
+                stop.set()
+        for rid in rids:
+            i = int(rid.rsplit("r", 1)[1])
+            info = dict(self.info(i), ts=time.time(),
+                        draining=True, goodbye=True)
+            try:
+                put_kv("127.0.0.1", self.router_port, "heartbeat",
+                       rid, json.dumps(info).encode(), timeout=5.0)
+            except OSError:
+                pass
+
     def stop(self):
         for stop in self._stops.values():
             stop.set()
@@ -359,11 +442,21 @@ class ServeRig:
         self.journal_dir = journal_dir
         self.liveness_sec = liveness_sec
         self.monitor = monitor
-        self.backends = [_IdentityBackend() for _ in range(backends)]
+        # The reload handler late-binds to the CURRENT herd so router
+        # restarts (which rebuild the herd object) keep the roll
+        # controller's /v1/reload hops working mid-storm.
+        self.backends = [_IdentityBackend(reload_handler=self._reload)
+                         for _ in range(backends)]
         self.beat_sec = beat_sec
         self.router: Optional[Router] = None
         self.herd: Optional[StubReplicaHerd] = None
         self.lost = 0
+
+    def _reload(self, doc: dict):
+        herd = self.herd
+        if herd is None:
+            return False, {"error": "no herd"}
+        return herd.reload(doc)
 
     def start(self) -> Tuple[float, float]:
         """Stand the plane up. Returns (registration herd seconds,
@@ -401,10 +494,16 @@ class ServeRig:
         replay_ms = (time.monotonic() - t0) * 1000.0
         replayed = self.router._replayed
         router_port = self.router.start()
-        self.herd.stop()
+        old_herd = self.herd
+        old_herd.stop()
         self.herd = StubReplicaHerd(router_port, self.n,
                                     [b.port for b in self.backends],
                                     beat_sec=self.beat_sec)
+        # The stubs' lifecycle state survives a router restart (a real
+        # replica process would keep its loaded step and poison list).
+        with old_herd._state_lock:
+            self.herd.steps = dict(old_herd.steps)
+            self.herd.poison_steps = set(old_herd.poison_steps)
         reg_sec = self.herd.register_all()
         if self.beat_sec > 0:
             self.herd.start_beats()
@@ -491,6 +590,23 @@ class ServeRig:
             "p99_ms": (round(percentile(flat, 99), 3)
                        if flat else None),
         }
+
+    def kill_router(self) -> int:
+        """kill -9 the router IN PROCESS: ``abrupt_stop()`` marks the
+        incarnation dead (its surviving threads may not touch the
+        journal or lease again) without closing the journal file or
+        clearing the lease — exactly the state a SIGKILLed router
+        leaves on disk for a standby to take over. Returns the service
+        port the standby must adopt."""
+        assert self.router is not None
+        port = self.router.port
+        self.router.abrupt_stop()
+        return port
+
+    def adopt_router(self, router: Router):
+        """Point the rig (load clients, stats readouts) at a router
+        that took over — the standby's, or a by-hand restart."""
+        self.router = router
 
     def stop(self):
         if self.herd is not None:
